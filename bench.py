@@ -1,0 +1,82 @@
+"""Headline benchmark — prints ONE JSON line.
+
+Run on real TPU hardware by the driver. Reports the flagship end-to-end
+number (currently: fused TP-MLP-shape GEMM throughput on one chip; will
+become the Qwen3 TP decode step as the stack widens — see BASELINE.md).
+
+``vs_baseline`` is measured TFLOP/s divided by the chip's bf16 peak — the
+same "fraction of roofline" framing the reference uses for its overlap
+efficiency charts (README.md:190-209).
+"""
+
+import json
+import sys
+
+import jax
+import jax.numpy as jnp
+
+
+# bf16 matmul peak TFLOP/s per chip (v5e ≈ 197, v5p ≈ 459, v4 ≈ 275).
+_PEAK_TFLOPS = {
+    "v5 lite": 197.0,
+    "v5e": 197.0,
+    "v5p": 459.0,
+    "v4": 275.0,
+    "v6 lite": 918.0,
+    "v6e": 918.0,
+}
+
+
+def chip_peak_tflops() -> float:
+    kind = jax.devices()[0].device_kind.lower()
+    for key, val in _PEAK_TFLOPS.items():
+        if key in kind:
+            return val
+    return 197.0
+
+
+def main() -> None:
+    import functools
+    import time
+
+    import numpy as np
+
+    # Qwen3-8B-ish TP GEMM shape. Timing notes: through the axon relay,
+    # ``block_until_ready`` resolves early and identical executions are
+    # memoized, so we (a) chain iterations with a data dependency inside one
+    # jit and (b) fence by fetching a scalar to host.
+    M, K, N = 4096, 4096, 4096
+    ITERS = 64
+    key = jax.random.key(0)
+    a = (jax.random.normal(key, (M, K), jnp.float32) * 0.01).astype(jnp.bfloat16)
+    b = (jax.random.normal(key, (K, N), jnp.float32) * 0.01).astype(jnp.bfloat16)
+
+    @functools.partial(jax.jit, static_argnums=2)
+    def chain(a, b, iters):
+        def body(i, a):
+            return jnp.dot(a, b, preferred_element_type=jnp.bfloat16)
+        return jax.lax.fori_loop(0, iters, body, a)[0, 0]
+
+    np.asarray(chain(a, b, ITERS))  # compile + warm
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(chain(a, b, ITERS))
+        best = min(best, (time.perf_counter() - t0) / ITERS)
+    ms = best * 1e3
+    tflops = 2 * M * K * N / (ms * 1e-3) / 1e12
+    peak = chip_peak_tflops()
+    print(
+        json.dumps(
+            {
+                "metric": "tp_mlp_gemm_bf16_tflops",
+                "value": round(tflops, 2),
+                "unit": "TFLOP/s",
+                "vs_baseline": round(tflops / peak, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,58 @@
+"""Test configuration: force an 8-virtual-device CPU mesh.
+
+The test strategy (SURVEY.md §4) improves on the reference's
+torchrun-on-real-GPUs scripts: JAX simulates an 8-device mesh on CPU
+(``--xla_force_host_platform_device_count``) and Pallas TPU interpret mode
+(``pltpu.InterpretParams``) executes kernels — including inter-chip remote
+DMAs and semaphores — with faithful TPU memory semantics. Unit and
+multi-"node" tests therefore run cluster-free.
+
+Note: the environment's sitecustomize imports jax at interpreter startup and
+pins ``jax_platforms`` to the TPU plugin, so plain env vars are ignored; we
+override via ``jax.config`` before any backend is instantiated.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np
+import pytest
+
+from triton_distributed_tpu.runtime import mesh as mesh_mod
+
+
+@pytest.fixture
+def ctx8():
+    """8-device single-axis tp mesh."""
+    ctx = mesh_mod.initialize_distributed(tp=8)
+    yield ctx
+    mesh_mod.finalize_distributed()
+
+
+@pytest.fixture
+def ctx4():
+    """4-device single-axis tp mesh."""
+    ctx = mesh_mod.initialize_distributed(tp=4, devices=jax.devices()[:4])
+    yield ctx
+    mesh_mod.finalize_distributed()
+
+
+@pytest.fixture
+def ctx2x4():
+    """2x4 dp×tp mesh."""
+    ctx = mesh_mod.initialize_distributed(dp=2, tp=4)
+    yield ctx
+    mesh_mod.finalize_distributed()
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

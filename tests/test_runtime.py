@@ -1,0 +1,69 @@
+"""Runtime core tests (parity: reference test_utils.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_distributed_tpu.runtime import (
+    assert_allclose,
+    current_context,
+    init_seed,
+    initialize_distributed,
+    finalize_distributed,
+    perf_func,
+)
+from jax.sharding import PartitionSpec as P
+
+
+def test_initialize_basic():
+    ctx = initialize_distributed(tp=8)
+    assert ctx.world_size == 8
+    assert ctx.axis_names == ("tp",)
+    assert current_context() is ctx
+    finalize_distributed()
+    with pytest.raises(RuntimeError):
+        current_context()
+
+
+def test_initialize_dp_fill():
+    ctx = initialize_distributed(tp=4)
+    # remaining devices absorbed into dp
+    assert ctx.axis_names == ("dp", "tp")
+    assert ctx.axis_size("dp") == 2 and ctx.axis_size("tp") == 4
+    finalize_distributed()
+
+
+def test_axis_order_canonical():
+    ctx = initialize_distributed(axes={"tp": 2, "dp": 2, "pp": 2})
+    assert ctx.axis_names == ("dp", "pp", "tp")
+    finalize_distributed()
+
+
+def test_shard_map_collective(ctx8):
+    def psum_rank(x):
+        r = jax.lax.axis_index("tp").astype(jnp.float32)
+        return x + jax.lax.psum(r, "tp")
+
+    f = ctx8.shard_map(psum_rank, in_specs=P("tp"), out_specs=P("tp"))
+    x = jnp.zeros((8,), jnp.float32)
+    out = f(x)
+    np.testing.assert_allclose(np.asarray(out), np.full((8,), 28.0))
+
+
+def test_perf_func_returns_output():
+    out, ms = perf_func(lambda: jnp.ones((4,)).sum(), iters=2, warmup_iters=1)
+    assert float(out) == 4.0
+    assert ms >= 0.0
+
+
+def test_assert_allclose_reports():
+    with pytest.raises(AssertionError, match="mismatched"):
+        assert_allclose(np.ones(4), np.zeros(4))
+    assert_allclose(np.ones(4), np.ones(4) + 1e-6)
+
+
+def test_init_seed_deterministic():
+    k1 = init_seed(7)
+    k2 = init_seed(7)
+    assert jnp.array_equal(jax.random.uniform(k1, (3,)), jax.random.uniform(k2, (3,)))

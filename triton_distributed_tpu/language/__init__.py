@@ -1,0 +1,22 @@
+"""``triton_distributed_tpu.language`` — device-side primitive facade.
+
+Import as ``from triton_distributed_tpu import language as dl`` for parity
+with the reference's ``import triton_dist.language as dl``
+(``python/triton_dist/language/__init__.py:26-28``).
+"""
+
+from triton_distributed_tpu.language.primitives import (  # noqa: F401
+    barrier_all,
+    barrier_neighbors,
+    local_copy,
+    maybe_delay,
+    num_ranks,
+    put_signal,
+    quiet,
+    rank,
+    read,
+    remote_copy,
+    signal,
+    wait,
+    wait_recv,
+)

@@ -1,0 +1,221 @@
+"""Device-side communication primitives for Pallas TPU kernels.
+
+This is the TPU-native analog of the reference's device language
+(``python/triton_dist/language/distributed_ops.py:56-111`` — ``wait``,
+``consume_token``, ``rank``, ``num_ranks``, ``symm_at``, ``notify`` — and the
+NVSHMEM device API ``backends/nvidia/language/cuda/libnvshmem_device.py``:
+``putmem_signal``:589, ``signal_wait_until``:782, ``barrier_all``:240,
+``quiet``:371/``fence``:385).
+
+Mapping (see SURVEY.md §2.4):
+
+| reference (NVSHMEM/Triton)       | here (Pallas/Mosaic over ICI)           |
+|----------------------------------|-----------------------------------------|
+| ``dl.rank()`` / ``num_ranks``    | ``rank(axis)`` / ``num_ranks(axis)``    |
+| ``dl.notify(rank, sem, SET/ADD)``| ``signal(sem, inc, dst=...)``           |
+| ``dl.wait(sem, n)`` + token      | ``wait(sem, n)`` (ordering is by       |
+|                                  | semaphore dataflow, no token needed —   |
+|                                  | Mosaic orders the dependent DMA/loads)  |
+| ``symm_at(buf, rank)`` + put     | ``remote_copy(src, dst, dst_dev, ...)`` |
+| ``putmem_signal[_nbi]``          | ``put_signal(...)`` (recv semaphore IS  |
+|                                  | the arrival signal)                     |
+| ``barrier_all``                  | ``barrier_all(axis)``                   |
+| ``quiet``/``fence``              | ``quiet(*dmas)`` (drain started sends)  |
+
+Semantics notes:
+- NVSHMEM's ``consume_token`` exists because Triton must thread a dataflow
+  edge between a spin-wait and the subsequent load so the compiler cannot
+  reorder them. In Pallas the same guarantee comes from semaphores:
+  ``semaphore_wait`` has side-effect ordering against subsequent memory
+  ops in program order, so no token plumbing is exposed.
+- All primitives must run inside a ``pl.pallas_call`` that executes under
+  ``shard_map`` so ``jax.lax.axis_index`` resolves, and remote DMAs need
+  ``compiler_params=pltpu.CompilerParams(collective_id=...)``.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+# -- identity ---------------------------------------------------------------
+
+def rank(axis: str | Sequence[str]) -> jax.Array:
+    """This device's index along ``axis`` (parity: ``dl.rank``)."""
+    return jax.lax.axis_index(axis)
+
+
+def num_ranks(axis: str | Sequence[str]) -> int:
+    """Axis size (parity: ``dl.num_ranks``)."""
+    if isinstance(axis, str):
+        return jax.lax.axis_size(axis)
+    out = 1
+    for a in axis:
+        out *= jax.lax.axis_size(a)
+    return out
+
+
+# -- signal / wait ----------------------------------------------------------
+
+def signal(
+    sem,
+    inc: int | jax.Array = 1,
+    dst: jax.Array | int | None = None,
+    axis: str | None = None,
+):
+    """Increment a semaphore, locally or on a remote device.
+
+    Parity: ``dl.notify(..., sig_op=ADD)`` / ``nvshmemx_signal_op``.
+    NVSHMEM's SET mode has no Mosaic analog (semaphores are counters);
+    all our protocols are formulated with ADD, which the reference's
+    kernels also support.
+
+    ``dst``: peer index *along* ``axis`` (other mesh axes stay fixed, so
+    e.g. a tp-ring signal never crosses dp replicas); None = local.
+    """
+    if dst is None:
+        pltpu.semaphore_signal(sem, inc=inc)
+    else:
+        if axis is None:
+            raise ValueError("signal(dst=...) requires the mesh axis name")
+        pltpu.semaphore_signal(
+            sem, inc=inc, device_id={axis: dst},
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+
+
+def wait(sem, value: int | jax.Array = 1):
+    """Block until ``sem >= value``, then decrement by ``value``.
+
+    Parity: ``dl.wait(barrier, n)`` + ``dl.consume_token`` — ordering of
+    subsequent loads is guaranteed by Mosaic's semaphore semantics, so no
+    token is returned.
+    """
+    pltpu.semaphore_wait(sem, value)
+
+
+def read(sem) -> jax.Array:
+    """Non-blocking semaphore read (parity: spin-poll fast paths)."""
+    return pltpu.semaphore_read(sem)
+
+
+# -- remote DMA -------------------------------------------------------------
+
+def remote_copy(src_ref, dst_ref, dst_dev, send_sem, recv_sem, axis: str = "tp"):
+    """Async put: copy ``src_ref`` (local) into ``dst_ref`` on peer
+    ``dst_dev`` along mesh ``axis`` (other axes stay fixed).
+
+    Returns the DMA descriptor; call ``.start()`` / ``.wait()`` /
+    ``.wait_send()`` / ``.wait_recv()`` on it. Parity:
+    ``libnvshmem_device.putmem_nbi_block`` (nonblocking put).
+    """
+    return pltpu.make_async_remote_copy(
+        src_ref=src_ref,
+        dst_ref=dst_ref,
+        send_sem=send_sem,
+        recv_sem=recv_sem,
+        device_id={axis: dst_dev},
+        device_id_type=pltpu.DeviceIdType.MESH,
+    )
+
+
+def put_signal(src_ref, dst_ref, dst_dev, send_sem, recv_sem, axis: str = "tp"):
+    """Start an async put whose arrival the receiver observes on recv_sem.
+
+    Parity: ``putmem_signal_nbi`` (``libnvshmem_device.py:589-754``) — the
+    remote rank does ``wait(recv_sem)`` to learn the data has landed. On
+    TPU the recv semaphore is signaled by the DMA engine on completion of
+    the remote write, which gives exactly the put-with-signal contract
+    (data visibility before signal) without a separate flag write.
+
+    Returns the started DMA (caller may ``.wait_send()`` to drain).
+    """
+    dma = remote_copy(src_ref, dst_ref, dst_dev, send_sem, recv_sem, axis=axis)
+    dma.start()
+    return dma
+
+
+def local_copy(src_ref, dst_ref, sem):
+    """Async local (same-chip) DMA, e.g. HBM→VMEM staging."""
+    return pltpu.make_async_copy(src_ref, dst_ref, sem)
+
+
+def wait_recv(recv_sem, landed_ref):
+    """Receiver side of ``put_signal``: block until the put into
+    ``landed_ref`` has fully arrived (parity: ``signal_wait_until`` on the
+    consumer, ``libnvshmem_device.py:782``).
+
+    DMA semaphores count bytes; waiting is expressed by a descriptor of the
+    landed buffer so Mosaic knows how many to expect.
+    """
+    pltpu.make_async_copy(landed_ref, landed_ref, recv_sem).wait()
+
+
+def quiet(*dmas):
+    """Drain outstanding sends (parity: ``nvshmem_quiet``).
+
+    DMA send semaphores count bytes, not operations, so the fence is
+    expressed through the descriptors: pass the started DMAs and each is
+    ``wait_send``-ed, after which its source buffer is reusable.
+    """
+    for dma in dmas:
+        dma.wait_send()
+
+
+# -- barriers ---------------------------------------------------------------
+
+def barrier_all(axis: str):
+    """Full barrier across the mesh axis inside a kernel.
+
+    Parity: ``nvshmem_barrier_all`` / ``barrier_all_intra_node``
+    (``common_ops.py:142-210``). Signals every peer's barrier semaphore and
+    waits for all peers' signals. Requires
+    ``compiler_params=pltpu.CompilerParams(collective_id=...)``.
+    """
+    n = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    bsem = pltpu.get_barrier_semaphore()
+
+    def body(i, _):
+        peer = jax.lax.rem(me + i, n)
+        pltpu.semaphore_signal(
+            bsem, inc=1, device_id={axis: peer},
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+        return _
+
+    jax.lax.fori_loop(1, n, body, None)
+    pltpu.semaphore_wait(bsem, n - 1)
+
+
+def barrier_neighbors(axis: str):
+    """Barrier with ring neighbors only (cheaper; parity: ring protocols)."""
+    n = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    left = jax.lax.rem(me - 1 + n, n)
+    right = jax.lax.rem(me + 1, n)
+    bsem = pltpu.get_barrier_semaphore()
+    for peer in (left, right):
+        pltpu.semaphore_signal(
+            bsem, inc=1, device_id={axis: peer},
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+    pltpu.semaphore_wait(bsem, 2)
+
+
+# -- straggler / correctness hooks -----------------------------------------
+
+def maybe_delay(nanos: int | None):
+    """On-device delay for race-provocation tests.
+
+    Parity: the reference's ``for_correctness`` producer sleeps
+    (``allgather_gemm.py:507-508``) and straggler injection
+    (``allreduce.py:137``). ``pl.delay`` stalls this core's issue stream.
+    """
+    if nanos:
+        pltpu.delay(nanos)

@@ -1,0 +1,27 @@
+"""Runtime core: mesh/topology, distributed init, perf and logging utilities.
+
+Parity with the reference's host runtime layer (``python/triton_dist/utils.py``,
+see SURVEY.md §2.2 "Host runtime"): ``initialize_distributed`` (utils.py:182),
+symmetric-tensor allocation analog, barriers, ``perf_func`` (utils.py:274),
+``dist_print`` (utils.py:289), topology probes (utils.py:592-867) — all
+re-designed for JAX: process bootstrap is ``jax.distributed.initialize``, the
+"symmetric heap" is per-device shards inside ``shard_map`` over a Mesh, and
+topology is the TPU ICI/DCN mesh rather than NVLink/NUMA probing.
+"""
+
+from triton_distributed_tpu.runtime.mesh import (  # noqa: F401
+    DistContext,
+    MeshTopology,
+    current_context,
+    initialize_distributed,
+    finalize_distributed,
+    set_context,
+)
+from triton_distributed_tpu.runtime.utils import (  # noqa: F401
+    assert_allclose,
+    dist_print,
+    init_seed,
+    perf_func,
+    sleep_async,
+)
+from triton_distributed_tpu.runtime.profiling import group_profile  # noqa: F401

@@ -1,0 +1,265 @@
+"""Device mesh, topology, and distributed initialization.
+
+Reference parity: ``python/triton_dist/utils.py:182-205``
+(``initialize_distributed``: torchrun env → process group → NVSHMEM uid init)
+and the NVLink/PCIe/NUMA topology probes (``utils.py:592-867``).
+
+TPU-native design: there is no NVSHMEM symmetric heap to map — the data plane
+is the ICI mesh that XLA already knows about. "Initialization" therefore means:
+
+1. (multi-host only) ``jax.distributed.initialize`` — the control-plane
+   rendezvous, analog of ``torch.distributed.init_process_group``.
+2. Building a named ``jax.sharding.Mesh`` over the device grid with the
+   parallelism axes the caller asks for (dp/pp/tp/sp/ep), in an order that
+   keeps the fastest-varying (most-communicating) axes on contiguous ICI
+   neighbors.
+3. Recording topology facts kernels need (axis sizes, ring neighbors,
+   whether we are on real TPU or the CPU simulator) — the analog of the
+   reference's NVLink fullmesh/NUMA probes, except on TPU the answer comes
+   from the platform, not from sysfs crawling.
+
+Symmetric memory: the reference allocates NVSHMEM symmetric tensors
+(``utils.py:114-136``). In JAX the same thing is an identically-shaped
+per-device shard inside ``shard_map`` — every device holds the same local
+shape at the same logical name, and Pallas remote DMAs address peers by mesh
+index. No allocator is needed; ``DistContext.shard_map`` is the entry point.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+from typing import Any, Callable, Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.6 exposes shard_map at top level
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+# Canonical axis names, outermost (least communication) to innermost
+# (most communication → contiguous ICI). Mirrors the scaling-book recipe:
+# data axes outside, model axes inside.
+AXIS_ORDER = ("dp", "pp", "ep", "sp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshTopology:
+    """Static facts about the device grid a kernel may want.
+
+    Analog of the reference's topology probe results (nvlink fullmesh,
+    NUMA grouping — ``utils.py:592-867``): on TPU the useful facts are the
+    ICI axis structure and whether multiple slices (DCN hops) are involved.
+    """
+
+    num_devices: int
+    num_processes: int
+    process_index: int
+    platform: str  # "tpu" | "cpu" | ...
+    devices_per_process: int
+
+    @property
+    def on_tpu(self) -> bool:
+        return self.platform == "tpu"
+
+    @property
+    def multi_slice(self) -> bool:
+        """True when the mesh spans a DCN boundary (multi-process TPU)."""
+        return self.num_processes > 1
+
+
+class DistContext:
+    """Global distributed context: mesh + axis layout + topology.
+
+    The analog of the reference's ``initialize_distributed()`` return state
+    (process groups + NVSHMEM heap). Everything downstream (collectives,
+    overlap kernels, model layers) takes a ``DistContext`` the way the
+    reference ops take their per-op ``*Context`` dataclasses.
+    """
+
+    def __init__(self, mesh: Mesh, topology: MeshTopology):
+        self.mesh = mesh
+        self.topology = topology
+
+    # -- identity ---------------------------------------------------------
+    @property
+    def axis_names(self) -> tuple[str, ...]:
+        return tuple(self.mesh.axis_names)
+
+    def axis_size(self, axis: str) -> int:
+        return self.mesh.shape[axis]
+
+    @property
+    def world_size(self) -> int:
+        return int(np.prod(list(self.mesh.shape.values())))
+
+    @property
+    def on_tpu(self) -> bool:
+        return self.topology.on_tpu
+
+    # -- pallas helpers ---------------------------------------------------
+    def pallas_interpret(self):
+        """Interpret-mode params for Pallas on non-TPU backends.
+
+        On the CPU simulator mesh, Pallas TPU kernels (including remote
+        DMAs and semaphores) run under ``pltpu.InterpretParams`` with full
+        TPU memory semantics; on real TPU this returns False so kernels
+        compile through Mosaic.
+        """
+        if self.on_tpu:
+            return False
+        from jax.experimental.pallas import tpu as pltpu
+
+        return pltpu.InterpretParams()
+
+    # -- shard_map entry point -------------------------------------------
+    def shard_map(
+        self,
+        f: Callable,
+        in_specs: Any,
+        out_specs: Any,
+        check_vma: bool = False,
+    ) -> Callable:
+        """Wrap ``f`` in a ``shard_map`` over this mesh.
+
+        This is the "symmetric memory" entry point: inside ``f`` every
+        device sees its local shard and may address peers via Pallas remote
+        DMA or ``jax.lax`` collectives by axis name.
+        """
+        return shard_map(
+            f,
+            mesh=self.mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_vma=check_vma,
+        )
+
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    def replicate(self, x):
+        return jax.device_put(x, self.sharding())
+
+    def shard(self, x, *spec):
+        return jax.device_put(x, self.sharding(*spec))
+
+
+_CURRENT: DistContext | None = None
+
+
+def set_context(ctx: DistContext | None) -> None:
+    global _CURRENT
+    _CURRENT = ctx
+
+
+def current_context() -> DistContext:
+    if _CURRENT is None:
+        raise RuntimeError(
+            "Distributed context not initialized; call "
+            "triton_distributed_tpu.initialize_distributed() first."
+        )
+    return _CURRENT
+
+
+def _detect_topology(devices: Sequence[jax.Device]) -> MeshTopology:
+    platform = devices[0].platform
+    num_processes = jax.process_count()
+    return MeshTopology(
+        num_devices=len(devices),
+        num_processes=num_processes,
+        process_index=jax.process_index(),
+        platform=platform,
+        devices_per_process=max(1, len(devices) // num_processes),
+    )
+
+
+def initialize_distributed(
+    axes: Mapping[str, int] | None = None,
+    *,
+    tp: int | None = None,
+    dp: int | None = None,
+    pp: int | None = None,
+    sp: int | None = None,
+    ep: int | None = None,
+    devices: Sequence[jax.Device] | None = None,
+    multihost: bool | None = None,
+    set_as_current: bool = True,
+) -> DistContext:
+    """Create the global mesh + context.
+
+    Analog of reference ``initialize_distributed`` (``utils.py:182``):
+    where the reference wires torchrun env vars → NCCL/gloo groups → NVSHMEM
+    heap, we wire (optionally) ``jax.distributed.initialize`` → a named
+    ``Mesh`` whose axes map onto ICI.
+
+    Axis sizes may be given either as an ``axes`` mapping or via the
+    keyword shorthands; unspecified parallelism consumes no axis. If the
+    product is smaller than the device count, a ``dp`` axis absorbs the
+    remainder (data parallelism is free on TPU — it is just a sharded
+    leading axis).
+    """
+    if multihost is None:
+        multihost = bool(int(os.environ.get("TDT_MULTIHOST", "0")))
+    if multihost:
+        # Control-plane rendezvous across hosts (DCN). Must run before any
+        # JAX call that initializes an XLA backend, so we don't probe
+        # jax.process_count() first; re-initialization raises and is ignored.
+        try:
+            jax.distributed.initialize()
+        except RuntimeError:
+            pass  # already initialized (or single-process run)
+
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+
+    sizes: dict[str, int] = dict(axes or {})
+    for name, val in (("tp", tp), ("dp", dp), ("pp", pp), ("sp", sp), ("ep", ep)):
+        if val is not None:
+            sizes[name] = val
+
+    used = int(np.prod(list(sizes.values()))) if sizes else 1
+    n = len(devices)
+    if n % used != 0:
+        raise ValueError(
+            f"device count {n} not divisible by requested axes {sizes}"
+        )
+    if used < n and "dp" not in sizes:
+        sizes = {"dp": n // used, **sizes}
+    elif used < n:
+        sizes["dp"] = sizes["dp"] * (n // used)
+
+    # Order axes canonically: dp/pp outermost, tp innermost (contiguous ICI).
+    ordered = [a for a in AXIS_ORDER if a in sizes]
+    ordered += [a for a in sizes if a not in ordered]
+    shape = tuple(sizes[a] for a in ordered)
+    if not ordered:
+        ordered, shape = ["dp"], (n,)
+
+    dev_array = np.asarray(devices).reshape(shape)
+    mesh = Mesh(dev_array, tuple(ordered))
+    ctx = DistContext(mesh, _detect_topology(devices))
+    if set_as_current:
+        set_context(ctx)
+    return ctx
+
+
+def finalize_distributed() -> None:
+    """Tear down the global context (and multihost runtime if we own it)."""
+    set_context(None)
+
+
+@functools.lru_cache(maxsize=None)
+def cpu_sim_devices(n: int) -> tuple[jax.Device, ...]:
+    """Return ``n`` CPU devices for simulator meshes (tests, dry runs)."""
+    cpus = jax.devices("cpu")
+    if len(cpus) < n:
+        raise RuntimeError(
+            f"need {n} CPU devices; launch with "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n}"
+        )
+    return tuple(cpus[:n])

@@ -1,0 +1,41 @@
+"""Profiling: per-process trace capture with a merged timeline.
+
+Reference parity: ``group_profile`` (``python/triton_dist/utils.py:505-589``)
+wraps ``torch.profiler``, exports one chrome trace per rank, gathers them to
+rank 0 and merges into a single timeline. The TPU-native analog wraps
+``jax.profiler`` (XPlane/Perfetto): each process traces into
+``<dir>/<name>/rank<i>``; on shared filesystems the result is already merged
+by directory layout and loads as one timeline in XProf/Perfetto.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+import jax
+
+
+@contextlib.contextmanager
+def group_profile(
+    name: str | None = None,
+    do_prof: bool = True,
+    out_dir: str = "prof",
+):
+    """Context manager capturing a jax.profiler trace for all processes.
+
+    Usage parity with the reference (``test_ag_gemm.py:109``):
+
+        with group_profile("ag_gemm", do_prof=args.profile):
+            run_the_kernel()
+    """
+    if not do_prof or name is None:
+        yield
+        return
+    path = os.path.join(out_dir, name, f"rank{jax.process_index()}")
+    os.makedirs(path, exist_ok=True)
+    jax.profiler.start_trace(path)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
