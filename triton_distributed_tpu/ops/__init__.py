@@ -1,0 +1,35 @@
+"""Kernel library: collectives and compute–communication overlap ops.
+
+Parity: reference ``python/triton_dist/kernels/`` (SURVEY.md §2.2 L8).
+All ops come in (at least) two method flavors:
+
+- ``pallas``: device-initiated ICI protocols (remote DMA + semaphores),
+  the analog of the reference's NVSHMEM device kernels;
+- ``xla``: XLA collectives (``jax.lax.all_gather`` etc.), the analog of
+  the reference's NCCL golden path — also the DCN/multi-slice fallback
+  and the CPU-simulator default for layers that don't need overlap.
+
+Every op takes per-shard arrays and axis names and must be called inside
+``shard_map`` (or through the host-level ``*_op`` wrappers that build one).
+"""
+
+from triton_distributed_tpu.ops.collectives.all_gather import (  # noqa: F401
+    AllGatherMethod,
+    all_gather,
+    all_gather_op,
+)
+from triton_distributed_tpu.ops.collectives.reduce_scatter import (  # noqa: F401
+    ReduceScatterMethod,
+    reduce_scatter,
+    reduce_scatter_op,
+)
+from triton_distributed_tpu.ops.collectives.all_reduce import (  # noqa: F401
+    AllReduceMethod,
+    all_reduce,
+    all_reduce_op,
+    get_auto_allreduce_method,
+)
+from triton_distributed_tpu.ops.collectives.all_to_all import (  # noqa: F401
+    all_to_all,
+    all_to_all_op,
+)

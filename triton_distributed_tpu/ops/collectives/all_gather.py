@@ -1,0 +1,236 @@
+"""AllGather: XLA path + device-initiated Pallas ring protocols over ICI.
+
+Parity: reference ``kernels/nvidia/allgather.py`` — ``AllGatherMethod``
+enum (:46, FullMesh/Ring1D/Ring2D push/pull) and the copy-engine /
+NVSHMEM producers (:81-471).
+
+TPU design: ICI is a torus of point-to-point links, so the native
+protocols are rings; a "full mesh" push (every peer DMAs to every peer
+simultaneously) is also expressible and wins at small sizes (one hop
+latency instead of n-1). The XLA method is the NCCL-analog golden path.
+Ring step count and peer index arithmetic are static at trace time
+(axis sizes are Python ints), so protocols unroll fully — no scalar
+loops on the core.
+"""
+
+from __future__ import annotations
+
+import enum
+import functools
+
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu import language as dl
+from triton_distributed_tpu.ops.common import (
+    comm_pallas_call,
+    next_collective_id,
+    _on_tpu,
+)
+from triton_distributed_tpu.runtime.mesh import DistContext, current_context
+
+
+class AllGatherMethod(enum.Enum):
+    """Parity: ``allgather.py:46`` (auto/full-mesh/ring variants)."""
+
+    AUTO = "auto"
+    XLA = "xla"
+    PALLAS_RING = "pallas_ring"
+    PALLAS_BIDIR_RING = "pallas_bidir_ring"
+    PALLAS_FULL_MESH = "pallas_full_mesh"
+
+
+_AG_COLLECTIVE_ID = next_collective_id()
+
+
+def _ring_kernel(x_ref, o_ref, send_sems, recv_sems, *, axis: str):
+    """Unidirectional ring: at step s forward the chunk received at step
+    s-1 to the right neighbor; chunks land at their global row offset.
+
+    Equivalent role: ``cp_engine_producer_all_gather_ring_push_1d``
+    (reference ``allgather.py:140``), with the copy engine replaced by the
+    ICI DMA engine and the tile barrier by per-step recv semaphores.
+    """
+    me = dl.rank(axis)
+    n = dl.num_ranks(axis)
+    m_per = x_ref.shape[0]
+    right = jax.lax.rem(me + 1, n)
+
+    o_ref[pl.ds(me * m_per, m_per)] = x_ref[:]
+
+    dmas = []
+    for s in range(n - 1):
+        # Chunk to send this step originated at (me - s) mod n.
+        src_rank = jax.lax.rem(me - s + n, n)
+        sl = pl.ds(src_rank * m_per, m_per)
+        dmas.append(
+            dl.put_signal(
+                o_ref.at[sl], o_ref.at[sl], right,
+                send_sems.at[s], recv_sems.at[s], axis=axis,
+            )
+        )
+        # This step's incoming chunk originated at (me - s - 1) mod n.
+        in_rank = jax.lax.rem(me - s - 1 + n, n)
+        dl.wait_recv(recv_sems.at[s], o_ref.at[pl.ds(in_rank * m_per, m_per)])
+    dl.quiet(*dmas)
+
+
+def _bidir_ring_kernel(x_ref, o_ref, send_sems, recv_sems, *, axis: str):
+    """Bidirectional ring: each shard's top half travels clockwise and
+    bottom half counter-clockwise, using both directions of the torus
+    axis — 2x effective ICI bandwidth, (n-1) steps of half-chunks.
+
+    Equivalent role: the reference's NUMA-aware 2D rings
+    (``allgather.py:196``) — different topology, same idea: use every
+    link concurrently.
+    """
+    me = dl.rank(axis)
+    n = dl.num_ranks(axis)
+    m_per = x_ref.shape[0]
+    half = m_per // 2
+    right = jax.lax.rem(me + 1, n)
+    left = jax.lax.rem(me - 1 + n, n)
+
+    o_ref[pl.ds(me * m_per, m_per)] = x_ref[:]
+
+    dmas = []
+    for s in range(n - 1):
+        cw_src = jax.lax.rem(me - s + n, n)
+        cw_sl = pl.ds(cw_src * m_per, half)
+        dmas.append(
+            dl.put_signal(
+                o_ref.at[cw_sl], o_ref.at[cw_sl], right,
+                send_sems.at[0, s], recv_sems.at[0, s], axis=axis,
+            )
+        )
+        ccw_src = jax.lax.rem(me + s, n)
+        ccw_sl = pl.ds(ccw_src * m_per + half, m_per - half)
+        dmas.append(
+            dl.put_signal(
+                o_ref.at[ccw_sl], o_ref.at[ccw_sl], left,
+                send_sems.at[1, s], recv_sems.at[1, s], axis=axis,
+            )
+        )
+        cw_in = jax.lax.rem(me - s - 1 + n, n)
+        ccw_in = jax.lax.rem(me + s + 1, n)
+        dl.wait_recv(recv_sems.at[0, s], o_ref.at[pl.ds(cw_in * m_per, half)])
+        dl.wait_recv(
+            recv_sems.at[1, s],
+            o_ref.at[pl.ds(ccw_in * m_per + half, m_per - half)],
+        )
+    dl.quiet(*dmas)
+
+
+def _full_mesh_kernel(x_ref, o_ref, send_sems, recv_sems, *, axis: str):
+    """Every device pushes its shard directly to every peer (1 hop).
+
+    Equivalent role: ``cp_engine_producer_all_gather_full_mesh_push``
+    (reference ``allgather.py:81``). Best at small sizes where per-hop
+    latency dominates; the fabric routes concurrent DMAs.
+
+    All arrivals share one recv semaphore: shards are equal-sized, so
+    waiting (n-1) shard-sizes is order-independent.
+    """
+    me = dl.rank(axis)
+    n = dl.num_ranks(axis)
+    m_per = x_ref.shape[0]
+    own = pl.ds(me * m_per, m_per)
+
+    o_ref[own] = x_ref[:]
+
+    dmas = []
+    for i in range(1, n):
+        peer = jax.lax.rem(me + i, n)
+        dmas.append(
+            dl.put_signal(
+                o_ref.at[own], o_ref.at[own], peer,
+                send_sems.at[i - 1], recv_sems, axis=axis,
+            )
+        )
+    for _ in range(1, n):
+        dl.wait_recv(recv_sems, o_ref.at[own])
+    dl.quiet(*dmas)
+
+
+def all_gather(
+    x: jax.Array,
+    axis: str = "tp",
+    method: AllGatherMethod = AllGatherMethod.AUTO,
+    ctx: DistContext | None = None,
+) -> jax.Array:
+    """Gather shards along ``axis`` into the leading dim. Call inside
+    ``shard_map``; ``x`` is this device's shard ``[m_per, ...]`` and the
+    result is ``[n * m_per, ...]``.
+    """
+    n = jax.lax.axis_size(axis)
+    if method == AllGatherMethod.AUTO:
+        if not _on_tpu(ctx) or x.ndim < 2:
+            # CPU-simulator meshes run Pallas in interpret mode, which is
+            # for explicit kernel tests only; 1-D payloads (biases etc.)
+            # also take the XLA path the Pallas kernels don't cover.
+            method = AllGatherMethod.XLA
+        else:
+            nbytes = x.size * x.dtype.itemsize
+            if n <= 2 or nbytes <= 64 * 1024:
+                method = AllGatherMethod.PALLAS_FULL_MESH
+            else:
+                method = AllGatherMethod.PALLAS_BIDIR_RING
+
+    if method == AllGatherMethod.XLA:
+        return jax.lax.all_gather(x, axis, tiled=True)
+
+    if x.ndim < 2:
+        raise ValueError("pallas all_gather needs >=2D input (rows, lanes)")
+    m_per = x.shape[0]
+    out_shape = jax.ShapeDtypeStruct((n * m_per, *x.shape[1:]), x.dtype)
+
+    if method == AllGatherMethod.PALLAS_BIDIR_RING and (m_per < 2 or n <= 2):
+        method = AllGatherMethod.PALLAS_RING  # halves degenerate
+
+    if method == AllGatherMethod.PALLAS_RING:
+        kernel = functools.partial(_ring_kernel, axis=axis)
+        scratch = [pltpu.SemaphoreType.DMA((max(n - 1, 1),))] * 2
+    elif method == AllGatherMethod.PALLAS_BIDIR_RING:
+        kernel = functools.partial(_bidir_ring_kernel, axis=axis)
+        scratch = [pltpu.SemaphoreType.DMA((2, max(n - 1, 1)))] * 2
+    elif method == AllGatherMethod.PALLAS_FULL_MESH:
+        kernel = functools.partial(_full_mesh_kernel, axis=axis)
+        scratch = [
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+            pltpu.SemaphoreType.DMA(()),
+        ]
+    else:
+        raise ValueError(f"unknown method {method}")
+
+    return comm_pallas_call(
+        kernel,
+        out_shape,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=scratch,
+        collective_id=_AG_COLLECTIVE_ID,
+        ctx=ctx,
+    )(x)
+
+
+def all_gather_op(
+    x: jax.Array,
+    axis: str = "tp",
+    method: AllGatherMethod = AllGatherMethod.AUTO,
+    ctx: DistContext | None = None,
+) -> jax.Array:
+    """Host-level wrapper: ``x`` is sharded along its leading dim over
+    ``axis``; result is the gathered (replicated) array. Mainly for
+    tests/benchmarks — layers call :func:`all_gather` inside their own
+    ``shard_map``.
+    """
+    ctx = ctx or current_context()
+    rest = [None] * (x.ndim - 1)
+    f = ctx.shard_map(
+        functools.partial(all_gather, axis=axis, method=method, ctx=ctx),
+        in_specs=P(axis, *rest),
+        out_specs=P(None, *rest),
+    )
+    return f(x)

@@ -1,0 +1,162 @@
+"""AllReduce: method enum + size-based auto dispatch, Pallas + XLA paths.
+
+Parity: reference ``kernels/nvidia/allreduce.py`` (1,208 LoC: double-tree
+:215, one-shot :333-443, two-shot :447-717) and the method registry
+``kernels/allreduce.py:28-61`` with ``get_auto_allreduce_method``
+(:1101) picking by message size.
+
+TPU translation: the reference's multimem/NVLS switch reductions have no
+ICI analog (SURVEY.md §7 hard parts) — the latency-optimal small-message
+method here is ONE_SHOT (single-hop full-mesh exchange + local reduce)
+and the bandwidth method is TWO_SHOT (ring reduce-scatter + ring
+all-gather), which is also how XLA lowers large psums over ICI.
+"""
+
+from __future__ import annotations
+
+import enum
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu import language as dl
+from triton_distributed_tpu.ops.common import (
+    comm_pallas_call,
+    next_collective_id,
+    _on_tpu,
+)
+from triton_distributed_tpu.ops.collectives.all_gather import (
+    AllGatherMethod,
+    all_gather,
+)
+from triton_distributed_tpu.ops.collectives.reduce_scatter import (
+    ReduceScatterMethod,
+    reduce_scatter,
+)
+from triton_distributed_tpu.runtime.mesh import DistContext, current_context
+
+
+class AllReduceMethod(enum.Enum):
+    """Parity: ``kernels/allreduce.py:28-41``."""
+
+    AUTO = "auto"
+    XLA = "xla"  # jax.lax.psum — XLA's own ICI collective
+    ONE_SHOT = "one_shot"  # full-mesh exchange + local reduce (small msgs)
+    TWO_SHOT = "two_shot"  # ring RS + ring AG (large msgs)
+
+
+_ONESHOT_COLLECTIVE_ID = next_collective_id()
+
+# Below this payload size the single-hop exchange beats the ring's
+# 2(n-1) hops (parity: get_auto_allreduce_method, allreduce.py:1101).
+_ONE_SHOT_MAX_BYTES = 256 * 1024
+
+
+def get_auto_allreduce_method(nbytes: int, n: int) -> AllReduceMethod:
+    if n <= 2 or nbytes <= _ONE_SHOT_MAX_BYTES:
+        return AllReduceMethod.ONE_SHOT
+    return AllReduceMethod.TWO_SHOT
+
+
+def _one_shot_kernel(x_ref, o_ref, gather, send_sems, recv_sems, *, axis: str):
+    """Push local data to every peer's slot, then reduce locally.
+
+    Parity: one-shot push ``allreduce.py:333`` (every rank broadcasts,
+    every rank reduces all n copies).
+    """
+    me = dl.rank(axis)
+    n = dl.num_ranks(axis)
+
+    gather[me] = x_ref[:]
+    dmas = []
+    for i in range(1, n):
+        peer = jax.lax.rem(me + i, n)
+        dmas.append(
+            dl.put_signal(
+                gather.at[me], gather.at[me], peer,
+                send_sems.at[i - 1], recv_sems, axis=axis,
+            )
+        )
+    for _ in range(1, n):
+        dl.wait_recv(recv_sems, gather.at[me])
+    dl.quiet(*dmas)
+
+    acc = gather[0].astype(jnp.float32)
+    for i in range(1, n):
+        acc = acc + gather[i].astype(jnp.float32)
+    o_ref[:] = acc.astype(o_ref.dtype)
+
+
+def all_reduce(
+    x: jax.Array,
+    axis: str = "tp",
+    method: AllReduceMethod = AllReduceMethod.AUTO,
+    ctx: DistContext | None = None,
+) -> jax.Array:
+    """Sum ``x`` across ``axis``; every device gets the full result.
+
+    Call inside ``shard_map``; ``x`` is this device's partial sum.
+    """
+    n = jax.lax.axis_size(axis)
+    nbytes = x.size * x.dtype.itemsize
+    if method == AllReduceMethod.AUTO:
+        method = (
+            get_auto_allreduce_method(nbytes, n)
+            if _on_tpu(ctx) and x.ndim >= 2
+            else AllReduceMethod.XLA
+        )
+
+    if method == AllReduceMethod.XLA:
+        return jax.lax.psum(x, axis)
+
+    if method == AllReduceMethod.ONE_SHOT:
+        if x.ndim < 2:
+            raise ValueError("pallas all_reduce needs >=2D input")
+        return comm_pallas_call(
+            functools.partial(_one_shot_kernel, axis=axis),
+            jax.ShapeDtypeStruct(x.shape, x.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=[
+                pltpu.VMEM((n, *x.shape), x.dtype),
+                pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+                pltpu.SemaphoreType.DMA(()),
+            ],
+            collective_id=_ONESHOT_COLLECTIVE_ID,
+            ctx=ctx,
+        )(x)
+
+    if method == AllReduceMethod.TWO_SHOT:
+        # Ring reduce-scatter then ring all-gather; rows must split n-ways.
+        if x.shape[0] % n:
+            # ONE_SHOT gathers n copies into VMEM — only sane when small;
+            # large indivisible payloads go to XLA.
+            if nbytes <= _ONE_SHOT_MAX_BYTES:
+                return all_reduce(x, axis, AllReduceMethod.ONE_SHOT, ctx)
+            return jax.lax.psum(x, axis)
+        reduced = reduce_scatter(x, axis, ReduceScatterMethod.PALLAS_RING, ctx)
+        return all_gather(reduced, axis, AllGatherMethod.PALLAS_BIDIR_RING, ctx)
+
+    raise ValueError(f"unknown method {method}")
+
+
+def all_reduce_op(
+    x: jax.Array,
+    axis: str = "tp",
+    method: AllReduceMethod = AllReduceMethod.AUTO,
+    ctx: DistContext | None = None,
+) -> jax.Array:
+    """Host-level wrapper: ``x[i]`` is device i's partial array (host
+    shape ``[n, ...]``); returns the summed array (replicated)."""
+    ctx = ctx or current_context()
+    rest = [None] * (x.ndim - 1)
+
+    def body(xi):
+        return all_reduce(xi[0], axis=axis, method=method, ctx=ctx)
+
+    f = ctx.shard_map(body, in_specs=P(axis, *rest), out_specs=P(*rest))
+    return f(x)

@@ -1,0 +1,140 @@
+"""ReduceScatter: XLA path + device-initiated Pallas ring over ICI.
+
+Parity: reference ``kernels/nvidia/reduce_scatter.py`` —
+``ReduceScatter2DContext``:47, intra-node ring push variants :285-480,
+``kernel_ring_reduce_*``:674-744. The reference's 2-level multinode split
+(:828, intra-node ring then inter-node p2p) maps on TPU to: Pallas ring
+within the ICI slice, XLA collectives across DCN (see SURVEY.md §2.4).
+
+Ring protocol (sum): at step s (0..n-2) device r sends the partial
+accumulator for chunk ``(r-1-s) mod n`` to its right neighbor, receives
+chunk ``(r-2-s) mod n`` and adds its local contribution; after n-1 steps
+device r holds the fully-reduced chunk r. Each step receives into a
+distinct buffer slot, so no cross-step flow control is needed.
+"""
+
+from __future__ import annotations
+
+import enum
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu import language as dl
+from triton_distributed_tpu.ops.common import (
+    comm_pallas_call,
+    next_collective_id,
+    _on_tpu,
+)
+from triton_distributed_tpu.runtime.mesh import DistContext, current_context
+
+
+class ReduceScatterMethod(enum.Enum):
+    AUTO = "auto"
+    XLA = "xla"
+    PALLAS_RING = "pallas_ring"
+
+
+_RS_COLLECTIVE_ID = next_collective_id()
+
+
+def _ring_rs_kernel(x_ref, o_ref, bufs, send_sems, recv_sems, *, axis: str):
+    me = dl.rank(axis)
+    n = dl.num_ranks(axis)
+    m_per = o_ref.shape[0]
+    right = jax.lax.rem(me + 1, n)
+
+    def chunk(idx):
+        return pl.ds(idx * m_per, m_per)
+
+    dmas = []
+    for s in range(n - 1):
+        send_chunk = jax.lax.rem(me - 1 - s + 2 * n, n)
+        src = x_ref.at[chunk(send_chunk)] if s == 0 else bufs.at[s - 1]
+        dmas.append(
+            dl.put_signal(
+                src, bufs.at[s], right,
+                send_sems.at[s], recv_sems.at[s], axis=axis,
+            )
+        )
+        dl.wait_recv(recv_sems.at[s], bufs.at[s])
+        recv_chunk = jax.lax.rem(me - 2 - s + 2 * n, n)
+        bufs[s] = bufs[s] + x_ref[chunk(recv_chunk)]
+    dl.quiet(*dmas)
+    if n > 1:
+        o_ref[:] = bufs[n - 2]
+    else:
+        o_ref[:] = x_ref[:]
+
+
+def reduce_scatter(
+    x: jax.Array,
+    axis: str = "tp",
+    method: ReduceScatterMethod = ReduceScatterMethod.AUTO,
+    ctx: DistContext | None = None,
+) -> jax.Array:
+    """Sum-reduce ``x`` across ``axis`` and scatter along the leading dim.
+
+    Call inside ``shard_map``: ``x`` is ``[n*m_per, ...]`` of partial
+    sums; result is this device's reduced chunk ``[m_per, ...]``.
+    """
+    n = jax.lax.axis_size(axis)
+    if method == ReduceScatterMethod.AUTO:
+        method = (
+            ReduceScatterMethod.PALLAS_RING
+            if _on_tpu(ctx)
+            else ReduceScatterMethod.XLA
+        )
+
+    if method == ReduceScatterMethod.XLA:
+        return jax.lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
+
+    if x.ndim < 2:
+        raise ValueError("pallas reduce_scatter needs >=2D input")
+    if x.shape[0] % n:
+        raise ValueError(f"rows {x.shape[0]} not divisible by axis size {n}")
+    m_per = x.shape[0] // n
+    out_shape = jax.ShapeDtypeStruct((m_per, *x.shape[1:]), x.dtype)
+
+    return comm_pallas_call(
+        functools.partial(_ring_rs_kernel, axis=axis),
+        out_shape,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((max(n - 1, 1), m_per, *x.shape[1:]), x.dtype),
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+        ],
+        collective_id=_RS_COLLECTIVE_ID,
+        ctx=ctx,
+    )(x)
+
+
+def reduce_scatter_op(
+    x: jax.Array,
+    axis: str = "tp",
+    method: ReduceScatterMethod = ReduceScatterMethod.AUTO,
+    ctx: DistContext | None = None,
+) -> jax.Array:
+    """Host-level wrapper: ``x[i]`` is device i's partial-sum array
+    ``[n*m_per, ...]`` (host shape ``[n, n*m_per, ...]``); returns the
+    summed array, sharded over ``axis`` (host shape ``[n*m_per, ...]``).
+    For tests/benchmarks.
+    """
+    ctx = ctx or current_context()
+    rest = [None] * (x.ndim - 2)
+
+    def body(xi):
+        return reduce_scatter(xi[0], axis=axis, method=method, ctx=ctx)
+
+    f = ctx.shard_map(
+        body,
+        in_specs=P(axis, None, *rest),
+        out_specs=P(axis, *rest),
+    )
+    return f(x)

@@ -1,0 +1,96 @@
+"""Shared helpers for comm kernels: pallas_call builder, collective ids.
+
+Parity role: reference ``kernels/nvidia/common_ops.py`` (grid barriers,
+stream signal ops) — on TPU the equivalents are mostly folded into Mosaic,
+so what remains shared is boilerplate: interpret-mode selection, collective
+id allocation, VMEM budgeting.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Sequence
+
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_distributed_tpu.runtime.mesh import DistContext, current_context
+
+# Distinct collective_id per kernel *site* so barrier semaphores of
+# different collectives in one program never alias. Stable across traces
+# of the same site because allocation happens at import/def time.
+_collective_ids = itertools.count(1)
+
+
+def next_collective_id() -> int:
+    return next(_collective_ids)
+
+
+def interpret_mode(ctx: DistContext | None = None):
+    """Interpret params when not on real TPU (CPU simulator mesh)."""
+    if ctx is None:
+        try:
+            ctx = current_context()
+        except RuntimeError:
+            ctx = None
+    if ctx is not None:
+        return ctx.pallas_interpret()
+    return False if jax.default_backend() == "tpu" else pltpu.InterpretParams()
+
+
+def comm_pallas_call(
+    kernel,
+    out_shape: Any,
+    *,
+    in_specs: Sequence[pl.BlockSpec] | None = None,
+    out_specs: Any = None,
+    scratch_shapes: Sequence[Any] = (),
+    grid: tuple[int, ...] | None = None,
+    collective_id: int | None = None,
+    ctx: DistContext | None = None,
+    vmem_limit_bytes: int | None = None,
+    cost_estimate: pl.CostEstimate | None = None,
+    dimension_semantics: Sequence[str] | None = None,
+):
+    """Build a pallas_call configured for communication kernels.
+
+    Applies: side-effect marking (DMA-only kernels must not be DCE'd),
+    collective id (barrier semaphore scoping), and interpret-mode
+    selection for the CPU simulator.
+    """
+    params: dict[str, Any] = dict(has_side_effects=True)
+    if collective_id is not None:
+        params["collective_id"] = collective_id
+        # Our comm kernels sequence via DMA semaphores; not every one
+        # touches the barrier semaphore the id also scopes.
+        params["allow_collective_id_without_custom_barrier"] = True
+    if vmem_limit_bytes is not None:
+        params["vmem_limit_bytes"] = vmem_limit_bytes
+    if dimension_semantics is not None:
+        params["dimension_semantics"] = tuple(dimension_semantics)
+    kwargs: dict[str, Any] = {}
+    if grid is not None:
+        kwargs["grid"] = grid
+    if cost_estimate is not None:
+        kwargs["cost_estimate"] = cost_estimate
+    return pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=list(scratch_shapes),
+        compiler_params=pltpu.CompilerParams(**params),
+        interpret=interpret_mode(ctx),
+        **kwargs,
+    )
+
+
+def _on_tpu(ctx: DistContext | None = None) -> bool:
+    """True when kernels will compile through Mosaic (real TPU)."""
+    if ctx is not None:
+        return ctx.on_tpu
+    try:
+        return current_context().on_tpu
+    except RuntimeError:
+        return jax.default_backend() == "tpu"

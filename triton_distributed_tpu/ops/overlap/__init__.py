@@ -1,0 +1,12 @@
+from triton_distributed_tpu.ops.overlap.ag_gemm import (  # noqa: F401
+    AGGemmConfig,
+    ag_gemm,
+    ag_gemm_op,
+    create_ag_gemm_context,
+)
+from triton_distributed_tpu.ops.overlap.gemm_rs import (  # noqa: F401
+    GemmRSConfig,
+    create_gemm_rs_context,
+    gemm_rs,
+    gemm_rs_op,
+)

@@ -1,0 +1,218 @@
+"""AllGather + GEMM overlap — the TP prefill archetype, in ONE Pallas kernel.
+
+Parity: reference ``kernels/nvidia/allgather_gemm.py`` —
+``AllGatherGEMMTensorParallelContext``:417 (symmetric workspace + barrier
+alloc), ``create_ag_gemm_context``:489, ``ag_gemm``:534, consumer GEMM
+``kernel_consumer_gemm_persistent``:158 (per-tile ``dl.wait`` then
+``dl.consume_token`` then ``tl.dot``).
+
+TPU design (SURVEY.md §7 hard part "overlap without streams"): the
+reference splits producer (copy-engine/NVSHMEM pushes on a comm stream)
+from consumer (GEMM kernel spinning on tile barriers). TPU has no user
+streams — instead ONE kernel drives both: the ICI DMA engines carry the
+all-gather in the background while the MXU computes, and semaphores
+sequence chunk arrival → compute, exactly replacing the reference's
+tile-barrier spin loops.
+
+Protocol per device (tp axis, n ranks, A row-sharded [m_per, K], B
+column-sharded [K, n_loc]):
+
+1. grid = (n, num_n_tiles); step s computes A-chunk ``(me + s) mod n``
+   against B tiles. Starting with the own chunk means compute begins
+   with zero comm latency (the reference's rank-swizzled tile order,
+   ``threadblock_swizzle``, exists for the same reason).
+2. At (0, 0): push own chunk to every peer's workspace slot ``me``
+   (single-hop; DMA engines route + progress it concurrently with MXU
+   work — the "copy-engine producer" analog).
+3. At (s, 0): wait for chunk ``(me+s+1)``'s arrival semaphore and start
+   its HBM→VMEM stage into the idle half of a double buffer — the wait
+   only stalls if comm is slower than the previous chunk's compute.
+4. Compute c[s, j] = a_vmem[s%2] @ b[j] on the MXU.
+
+Output rows come back permuted (step-major); ``ag_gemm`` un-permutes with
+a cheap gather, keeping the kernel free of data-dependent output maps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu import language as dl
+from triton_distributed_tpu.ops.common import comm_pallas_call, next_collective_id
+from triton_distributed_tpu.runtime.mesh import DistContext, current_context
+
+_AG_GEMM_COLLECTIVE_ID = next_collective_id()
+
+
+@dataclasses.dataclass(frozen=True)
+class AGGemmConfig:
+    """Tile configuration (parity: the tile fields of
+    ``AllGatherGEMMTensorParallelContext``, ``allgather_gemm.py:417``).
+
+    The reference context also owns symmetric workspace tensors; here the
+    workspace is kernel-scratch HBM, allocated by Mosaic per call site, so
+    the config is pure numbers.
+    """
+
+    tile_n: int = 512
+    acc_dtype: jnp.dtype = jnp.float32
+
+
+def create_ag_gemm_context(
+    m_per: int, n_loc: int, k: int, dtype=jnp.bfloat16, tile_n: int | None = None
+) -> AGGemmConfig:
+    """Pick tiles for the shapes (parity: ``create_ag_gemm_context``:489)."""
+    if tile_n is None:
+        tile_n = min(512, n_loc)
+    while n_loc % tile_n:
+        tile_n //= 2
+    return AGGemmConfig(tile_n=max(tile_n, 128 if n_loc % 128 == 0 else 1))
+
+
+def _ag_gemm_kernel(
+    a_ref,      # [m_per, K] ANY/HBM — this device's A shard
+    b_ref,      # [K, tile_n] VMEM — B tile j (pipelined by BlockSpec)
+    c_ref,      # [1, m_per, tile_n] VMEM — output tile (s, j)
+    ws,         # [n, m_per, K] ANY/HBM output — gathered A chunks
+                # (a workspace; Mosaic only allows VMEM/SMEM/semaphore
+                # scratch, so HBM workspaces are extra outputs)
+    a_vmem,     # [2, m_per, K] VMEM — double-buffered compute chunk
+    load_sems,  # DMA (2,) — HBM→VMEM stage
+    send_sems,  # DMA (n-1,)
+    recv_sems,  # DMA (n,) — slot r signaled when chunk r lands
+    *,
+    axis: str,
+    acc_dtype,
+):
+    me = dl.rank(axis)
+    n = dl.num_ranks(axis)
+    s = pl.program_id(0)
+    j = pl.program_id(1)
+    num_j = pl.num_programs(1)
+
+    @pl.when(jnp.logical_and(s == 0, j == 0))
+    def _start():
+        # Stage own chunk for immediate compute.
+        pltpu.make_async_copy(a_ref, a_vmem.at[0], load_sems.at[0]).start()
+        # Copy own chunk into the workspace and push it to every peer
+        # (slot index = source rank, so consumers wait per-chunk).
+        for i in range(1, n):
+            peer = jax.lax.rem(me + i, n)
+            dl.put_signal(
+                a_ref, ws.at[me], peer,
+                send_sems.at[i - 1], recv_sems.at[me], axis=axis,
+            )
+        pltpu.make_async_copy(a_ref, a_vmem.at[0], load_sems.at[0]).wait()
+
+    @pl.when(jnp.logical_and(s > 0, j == 0))
+    def _land_current():
+        # VMEM stage started at (s-1, num_j-1).
+        pltpu.make_async_copy(
+            ws.at[0], a_vmem.at[s % 2], load_sems.at[s % 2]
+        ).wait()
+
+    c_ref[0] = jnp.dot(
+        a_vmem[s % 2], b_ref[:], preferred_element_type=acc_dtype
+    ).astype(c_ref.dtype)
+
+    @pl.when(jnp.logical_and(s + 1 < n, j == num_j - 1))
+    def _prefetch_next():
+        # Arrival fence + VMEM stage for the next chunk, placed after this
+        # step's last tile is issued so the blocking wait sits at the end
+        # of the step's compute, not ahead of it (keeps the MXU busy while
+        # the ICI push is in flight).
+        nxt = jax.lax.rem(me + s + 1, n)
+        dl.wait_recv(recv_sems.at[nxt], ws.at[nxt])
+        pltpu.make_async_copy(
+            ws.at[nxt], a_vmem.at[(s + 1) % 2], load_sems.at[(s + 1) % 2]
+        ).start()
+
+    @pl.when(jnp.logical_and(s == n - 1, j == num_j - 1))
+    def _drain():
+        for i in range(1, n):
+            pltpu.make_async_copy(a_ref, a_ref, send_sems.at[i - 1]).wait()
+
+
+def ag_gemm(
+    a: jax.Array,
+    b: jax.Array,
+    axis: str = "tp",
+    config: AGGemmConfig | None = None,
+    ctx: DistContext | None = None,
+) -> jax.Array:
+    """Overlapped ``all_gather(a) @ b`` inside ``shard_map``.
+
+    ``a``: ``[m_per, K]`` row shard; ``b``: ``[K, n_loc]`` column shard.
+    Returns ``[n * m_per, n_loc]`` (full rows, local columns) — same
+    contract as reference ``ag_gemm`` (``allgather_gemm.py:534``).
+    """
+    n = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    m_per, k = a.shape
+    k2, n_loc = b.shape
+    if k != k2:
+        raise ValueError(f"K mismatch {a.shape} @ {b.shape}")
+    config = config or create_ag_gemm_context(m_per, n_loc, k, a.dtype)
+    tile_n = min(config.tile_n, n_loc)
+    if n_loc % tile_n:
+        raise ValueError(f"n_loc={n_loc} not divisible by tile_n={tile_n}")
+    num_j = n_loc // tile_n
+
+    grid = (n, num_j)
+    out, _ws = comm_pallas_call(
+        functools.partial(_ag_gemm_kernel, axis=axis, acc_dtype=config.acc_dtype),
+        (
+            jax.ShapeDtypeStruct((n, m_per, n_loc), a.dtype),
+            jax.ShapeDtypeStruct((n, m_per, k), a.dtype),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),  # a: manual DMA
+            pl.BlockSpec((k, tile_n), lambda s, j: (0, j), memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec(
+                (1, m_per, tile_n), lambda s, j: (s, 0, j), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, m_per, k), a.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+            pltpu.SemaphoreType.DMA((n,)),
+        ],
+        collective_id=_AG_GEMM_COLLECTIVE_ID,
+        dimension_semantics=("arbitrary", "arbitrary"),
+        ctx=ctx,
+    )(a, b)
+
+    # Step s computed chunk (me+s) mod n → global row-chunk r sits at
+    # step (r-me) mod n. One gather puts rows in global order.
+    steps = jnp.remainder(jnp.arange(n) - me, n)
+    return out[steps].reshape(n * m_per, n_loc)
+
+
+def ag_gemm_op(
+    a: jax.Array,
+    b: jax.Array,
+    axis: str = "tp",
+    config: AGGemmConfig | None = None,
+    ctx: DistContext | None = None,
+) -> jax.Array:
+    """Host-level wrapper: ``a`` row-sharded over ``axis``, ``b``
+    column-sharded; returns C with columns sharded (host shape [M, N])."""
+    ctx = ctx or current_context()
+    f = ctx.shard_map(
+        functools.partial(ag_gemm, axis=axis, config=config, ctx=ctx),
+        in_specs=(P(axis, None), P(None, axis)),
+        out_specs=P(None, axis),
+    )
+    return f(a, b)

@@ -1,0 +1,237 @@
+"""GEMM + ReduceScatter overlap — the TP output-projection archetype.
+
+Parity: reference ``kernels/nvidia/gemm_reduce_scatter.py`` —
+``GEMMReduceScatterTensorParallelContext``:42, producer GEMM with
+per-tile notify :122-413, ``gemm_rs_op``:508, ``gemm_rs``:569 — plus the
+ring-reduce consumer from ``reduce_scatter.py:674-744``.
+
+TPU design: one kernel fuses producer and consumer. Row-parallel GEMM
+(``a [M, k_loc] @ b [k_loc, N]`` giving partial C) is computed chunk by
+chunk in *ring-reduce order*: at step s the device computes its partial
+for destination chunk ``(me-1-s) mod n``, adds the accumulated partial
+arriving from its left neighbor, and forwards the sum right — so each
+row chunk circulates once around the ring, gathering every device's
+contribution, while the MXU stays busy producing the next chunk. The
+final step's chunk is the device's own output. Per-step receive slots in
+HBM make the protocol flow-control-free (slot s is written exactly once,
+by the left neighbor's step s-1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu import language as dl
+from triton_distributed_tpu.ops.common import comm_pallas_call, next_collective_id
+from triton_distributed_tpu.runtime.mesh import DistContext, current_context
+
+_GEMM_RS_COLLECTIVE_ID = next_collective_id()
+
+
+@dataclasses.dataclass(frozen=True)
+class GemmRSConfig:
+    """Parity: tile fields of ``GEMMReduceScatterTensorParallelContext``."""
+
+    tile_n: int = 512
+    acc_dtype: jnp.dtype = jnp.float32
+
+
+def create_gemm_rs_context(
+    m: int, n_out: int, k_loc: int, dtype=jnp.bfloat16, tile_n: int | None = None
+) -> GemmRSConfig:
+    if tile_n is None:
+        tile_n = min(512, n_out)
+    while n_out % tile_n:
+        tile_n //= 2
+    return GemmRSConfig(tile_n=max(tile_n, 128 if n_out % 128 == 0 else 1))
+
+
+def _gemm_rs_kernel(
+    a_ref,      # [M, k_loc] ANY/HBM — this device's column shard of A
+    b_ref,      # [k_loc, tile_n] VMEM — B tile j
+    o_ref,      # [m_per, N] ANY/HBM — final reduced chunk (written once)
+    ws,         # [n-1, m_per, N] ANY/HBM output — per-step inbound slots
+                # (workspace-as-output; Mosaic forbids HBM scratch)
+    a_vmem,     # [2, m_per, k_loc] VMEM — A chunk double buffer
+    acc,        # [2, m_per, N] VMEM — outbound accumulated partial
+    inbound,    # [m_per, N] VMEM — staged inbound partial
+    load_sems,  # DMA (2,)
+    stage_sem,  # DMA ()
+    send_sems,  # DMA (n-1,)
+    recv_sems,  # DMA (n-1,)
+    *,
+    axis: str,
+    acc_dtype,
+):
+    me = dl.rank(axis)
+    n = dl.num_ranks(axis)
+    s = pl.program_id(0)
+    j = pl.program_id(1)
+    num_j = pl.num_programs(1)
+    m_per = o_ref.shape[0]
+    tile_n = b_ref.shape[1]
+    right = jax.lax.rem(me + 1, n)
+
+    def chunk_rows(c):
+        return pl.ds(c * m_per, m_per)
+
+    def a_chunk(step):
+        return jax.lax.rem(me - 1 - step + 2 * n, n)
+
+    @pl.when(jnp.logical_and(s == 0, j == 0))
+    def _start():
+        dma = pltpu.make_async_copy(
+            a_ref.at[chunk_rows(a_chunk(0))], a_vmem.at[0], load_sems.at[0]
+        )
+        dma.start()
+        dma.wait()
+
+    @pl.when(jnp.logical_and(s + 1 < n, j == 0))
+    def _prefetch_next_a():
+        pltpu.make_async_copy(
+            a_ref.at[chunk_rows(a_chunk(s + 1))],
+            a_vmem.at[(s + 1) % 2],
+            load_sems.at[(s + 1) % 2],
+        ).start()
+
+    @pl.when(jnp.logical_and(s > 0, j == 0))
+    def _land():
+        # A chunk staged during the previous step.
+        pltpu.make_async_copy(
+            a_ref.at[chunk_rows(0)], a_vmem.at[s % 2], load_sems.at[s % 2]
+        ).wait()
+        # Inbound accumulated partial for this step's chunk (left's step s-1).
+        dl.wait_recv(recv_sems.at[s - 1], ws.at[s - 1])
+        dma = pltpu.make_async_copy(ws.at[s - 1], inbound, stage_sem)
+        dma.start()
+        dma.wait()
+        # Before reusing acc slot s%2 (last used at step s-2), drain its send.
+        @pl.when(s >= 2)
+        def _():
+            pltpu.make_async_copy(
+                acc.at[s % 2], acc.at[s % 2], send_sems.at[s - 2]
+            ).wait()
+
+    partial = jnp.dot(
+        a_vmem[s % 2], b_ref[:], preferred_element_type=acc_dtype
+    )
+
+    jsl = pl.ds(j * tile_n, tile_n)
+
+    @pl.when(s == 0)
+    def _first_step():
+        acc[0, :, jsl] = partial.astype(acc.dtype)
+
+    @pl.when(s > 0)
+    def _accumulate():
+        acc[s % 2, :, jsl] = (
+            partial + inbound[:, jsl].astype(acc_dtype)
+        ).astype(acc.dtype)
+
+    @pl.when(jnp.logical_and(s < n - 1, j == num_j - 1))
+    def _forward():
+        # Receiver consumes this at its step s+1 from slot s.
+        dl.put_signal(
+            acc.at[s % 2], ws.at[s], right,
+            send_sems.at[s], recv_sems.at[s], axis=axis,
+        )
+
+    @pl.when(jnp.logical_and(s == n - 1, j == num_j - 1))
+    def _finish():
+        # Write the final chunk out in one DMA (o_ref lives in HBM; its
+        # block is never revisited across grid steps).
+        dma = pltpu.make_async_copy(acc.at[(n - 1) % 2], o_ref, stage_sem)
+        dma.start()
+        dma.wait()
+        # Steps 0..n-3 were drained on acc-slot reuse; only n-2 remains.
+        step = n - 2
+        pltpu.make_async_copy(
+            acc.at[step % 2], acc.at[step % 2], send_sems.at[step]
+        ).wait()
+
+
+def gemm_rs(
+    a: jax.Array,
+    b: jax.Array,
+    axis: str = "tp",
+    config: GemmRSConfig | None = None,
+    ctx: DistContext | None = None,
+) -> jax.Array:
+    """Overlapped ``reduce_scatter(a @ b)`` inside ``shard_map``.
+
+    ``a``: ``[M, k_loc]`` column shard; ``b``: ``[k_loc, N]`` row shard.
+    Returns this device's reduced row chunk ``[M/n, N]`` — same contract
+    as reference ``gemm_rs`` (``gemm_reduce_scatter.py:569``).
+    """
+    n = jax.lax.axis_size(axis)
+    m, k_loc = a.shape
+    _, n_out = b.shape
+    if m % n:
+        raise ValueError(f"M={m} not divisible by axis size {n}")
+    m_per = m // n
+    config = config or create_gemm_rs_context(m, n_out, k_loc, a.dtype)
+    tile_n = min(config.tile_n, n_out)
+    if n_out % tile_n:
+        raise ValueError(f"n_out={n_out} not divisible by tile_n={tile_n}")
+    num_j = n_out // tile_n
+
+    if n == 1:
+        return jnp.dot(a, b, preferred_element_type=config.acc_dtype).astype(a.dtype)
+
+    out, _ws = comm_pallas_call(
+        functools.partial(_gemm_rs_kernel, axis=axis, acc_dtype=config.acc_dtype),
+        (
+            jax.ShapeDtypeStruct((m_per, n_out), a.dtype),
+            jax.ShapeDtypeStruct((n - 1, m_per, n_out), a.dtype),
+        ),
+        grid=(n, num_j),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(
+                (k_loc, tile_n), lambda s, j: (0, j), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, m_per, k_loc), a.dtype),
+            pltpu.VMEM((2, m_per, n_out), a.dtype),
+            pltpu.VMEM((m_per, n_out), a.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((n - 1,)),
+            pltpu.SemaphoreType.DMA((n - 1,)),
+        ],
+        collective_id=_GEMM_RS_COLLECTIVE_ID,
+        dimension_semantics=("arbitrary", "arbitrary"),
+        ctx=ctx,
+    )(a, b)
+    return out
+
+
+def gemm_rs_op(
+    a: jax.Array,
+    b: jax.Array,
+    axis: str = "tp",
+    config: GemmRSConfig | None = None,
+    ctx: DistContext | None = None,
+) -> jax.Array:
+    """Host-level wrapper: ``a [M, K]`` column-sharded over ``axis``,
+    ``b [K, N]`` row-sharded; returns ``[M, N]`` row-sharded (the summed
+    GEMM, scattered)."""
+    ctx = ctx or current_context()
+    f = ctx.shard_map(
+        functools.partial(gemm_rs, axis=axis, config=config, ctx=ctx),
+        in_specs=(P(None, axis), P(axis, None)),
+        out_specs=P(axis, None),
+    )
+    return f(a, b)

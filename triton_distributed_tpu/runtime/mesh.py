@@ -36,10 +36,16 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # jax >= 0.6 exposes shard_map at top level
+try:  # jax >= 0.6 exposes shard_map at top level (check_vma kwarg)
     shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map  # type: ignore
+except AttributeError:  # pragma: no cover - older jax uses check_rep
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+        return _legacy_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma,
+        )
 
 # Canonical axis names, outermost (least communication) to innermost
 # (most communication → contiguous ICI). Mirrors the scaling-book recipe:
