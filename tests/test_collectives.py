@@ -65,8 +65,10 @@ def test_all_reduce_auto_dispatch():
     from triton_distributed_tpu.ops import get_auto_allreduce_method
 
     assert get_auto_allreduce_method(1024, 8) == AllReduceMethod.ONE_SHOT
-    assert get_auto_allreduce_method(1 << 24, 8) == AllReduceMethod.TWO_SHOT
-    assert get_auto_allreduce_method(1 << 24, 2) == AllReduceMethod.ONE_SHOT
+    assert get_auto_allreduce_method(1 << 21, 8) == AllReduceMethod.TWO_SHOT
+    # payloads beyond the VMEM ceiling fall back to the XLA collective
+    assert get_auto_allreduce_method(1 << 24, 8) == AllReduceMethod.XLA
+    assert get_auto_allreduce_method(1 << 24, 2) == AllReduceMethod.XLA
 
 
 @pytest.mark.parametrize("method", ["xla", "pallas"])

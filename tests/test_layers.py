@@ -1,0 +1,70 @@
+"""Layer tests (parity: reference test_tp_mlp.py / test_tp_attn.py —
+golden = replicated jnp forward, compare with allclose)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_distributed_tpu.layers.tp_mlp import TPMLP
+
+
+def _golden_mlp(x, gate, up, down):
+    h = jax.nn.silu(x @ gate) * (x @ up)
+    return h @ down
+
+
+@pytest.mark.parametrize("mode", ["xla", "pallas", "xla_ar", "pallas_ar"])
+def test_tp_mlp(ctx4, rng, mode):
+    d_model, d_ff, m = 64, 256, 32
+    gate = jnp.asarray(rng.standard_normal((d_model, d_ff)) * 0.05, jnp.float32)
+    up = jnp.asarray(rng.standard_normal((d_model, d_ff)) * 0.05, jnp.float32)
+    down = jnp.asarray(rng.standard_normal((d_ff, d_model)) * 0.05, jnp.float32)
+    x = jnp.asarray(rng.standard_normal((m, d_model)) * 0.1, jnp.float32)
+
+    layer = TPMLP(d_model, d_ff, dtype=jnp.float32, ctx=ctx4)
+    layer.load(gate, up, down)
+    out = layer.forward(x, mode=mode)
+
+    ref = _golden_mlp(x, gate, up, down)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4, rtol=2e-4)
+
+
+def _golden_attn(x, wq, wk, wv, wo, hq, hkv, hd, theta=1e6, qn=None, kn=None):
+    from triton_distributed_tpu.ops.attention.flash_attention import mha_reference
+    from triton_distributed_tpu.ops.attention.rope import apply_rope
+    from triton_distributed_tpu.layers.tp_attn import _rms_head
+
+    s = x.shape[0]
+    q = (x @ wq).reshape(s, hq, hd)
+    k = (x @ wk).reshape(s, hkv, hd)
+    v = (x @ wv).reshape(s, hkv, hd)
+    q = _rms_head(q, qn)
+    k = _rms_head(k, kn)
+    pos = jnp.arange(s)
+    q = apply_rope(q.swapaxes(0, 1), pos, theta)
+    k = apply_rope(k.swapaxes(0, 1), pos, theta)
+    o = mha_reference(q[None], k[None], v.swapaxes(0, 1)[None], causal=True)[0]
+    return o.swapaxes(0, 1).reshape(s, hq * hd) @ wo
+
+
+@pytest.mark.parametrize("mode", ["xla", "pallas"])
+def test_tp_attn_prefill(ctx4, rng, mode):
+    from triton_distributed_tpu.layers.tp_attn import TPAttn
+
+    d, hq, hkv, hd, s = 64, 8, 4, 32, 256
+    f32 = jnp.float32
+    wq = jnp.asarray(rng.standard_normal((d, hq * hd)) * 0.05, f32)
+    wk = jnp.asarray(rng.standard_normal((d, hkv * hd)) * 0.05, f32)
+    wv = jnp.asarray(rng.standard_normal((d, hkv * hd)) * 0.05, f32)
+    wo = jnp.asarray(rng.standard_normal((hq * hd, d)) * 0.05, f32)
+    qn = jnp.asarray(1.0 + 0.1 * rng.standard_normal(hd), f32)
+    kn = jnp.asarray(1.0 + 0.1 * rng.standard_normal(hd), f32)
+    x = jnp.asarray(rng.standard_normal((s, d)) * 0.1, f32)
+
+    layer = TPAttn(d, hq, hkv, hd, dtype=f32, ctx=ctx4)
+    layer.load(wq, wk, wv, wo, qn, kn)
+    out = layer.prefill(x, mode=mode)
+    ref = _golden_attn(x, wq, wk, wv, wo, hq, hkv, hd, qn=qn, kn=kn)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=5e-4,
+                               rtol=5e-4)

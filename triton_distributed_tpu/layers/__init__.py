@@ -1,0 +1,21 @@
+"""Model layers: tensor/expert/sequence-parallel building blocks.
+
+Parity: reference ``python/triton_dist/layers/nvidia/`` (SURVEY.md §2.2 L9)
+— ``TP_MLP``, ``TP_Attn``, ``TP_MoE``, ``EPAll2AllLayer``,
+``SpGQAFlashDecodeAttention``, ``CommOp``.
+
+Design: each layer is a pure-JAX parameter pytree + per-shard forward
+functions meant to run inside a model-level ``shard_map`` (every device
+executes the same program on its shard — the analog of the reference's
+one-process-per-GPU SPMD). Host-level ``*_op`` wrappers build the
+``shard_map`` for standalone use/tests. Three forward modes mirror the
+reference's per-layer ``torch`` / ``triton_dist`` / ``triton_dist_AR``
+switch (``models/qwen.py:84-96``):
+
+- ``xla``      — jax.lax collectives (golden path; NCCL-analog)
+- ``pallas``   — fused overlap kernels (ag_gemm / gemm_rs; prefill)
+- ``pallas_ar``— all-reduce decode path (small-batch latency)
+"""
+
+from triton_distributed_tpu.layers.tp_mlp import TPMLP  # noqa: F401
+from triton_distributed_tpu.layers.tp_attn import TPAttn  # noqa: F401

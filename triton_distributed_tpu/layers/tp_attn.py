@@ -1,0 +1,290 @@
+"""Tensor-parallel attention (GQA + RoPE + optional QK-norm).
+
+Parity: reference ``layers/nvidia/tp_attn.py`` — ``TP_Attn`` with fused
+qkv ag_gemm, rotary, flash attention, o-proj gemm_rs
+(``dist_triton_fwd``:203-271) and the AR decode path (local GEMMs +
+flash-decode + all_reduce). Heads are sharded over the ``tp`` axis; each
+device owns ``hq/n`` query heads and ``hkv/n`` KV heads with the full
+sequence — the KV cache is therefore head-sharded, and decode needs no
+cross-device attention (that is the SP decode layer's job).
+
+Prefill activations are sequence-sharded between layers; the qkv
+projection is the overlapped ag_gemm and the output projection the
+overlapped gemm_rs, mirroring the reference's zero-exposed-comm prefill.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Literal
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.ops.attention.flash_attention import flash_attention
+from triton_distributed_tpu.ops.attention.flash_decode import flash_decode
+from triton_distributed_tpu.ops.attention.rope import apply_rope
+from triton_distributed_tpu.ops.collectives.all_reduce import all_reduce
+from triton_distributed_tpu.ops.overlap.ag_gemm import ag_gemm
+from triton_distributed_tpu.ops.overlap.gemm_rs import gemm_rs
+from triton_distributed_tpu.runtime.mesh import DistContext, current_context
+
+Mode = Literal["xla", "pallas", "pallas_ar", "xla_ar"]
+
+
+@dataclasses.dataclass
+class TPAttnParams:
+    """Per-shard weights: ``wqkv [d, (hq_loc + 2*hkv_loc) * hd]``
+    (q | k | v blocks), ``wo [hq_loc * hd, d]``, optional per-head RMS
+    scales ``q_norm``/``k_norm`` ``[hd]`` (Qwen3)."""
+
+    wqkv: jax.Array
+    wo: jax.Array
+    q_norm: jax.Array | None
+    k_norm: jax.Array | None
+
+
+jax.tree_util.register_dataclass(
+    TPAttnParams, ["wqkv", "wo", "q_norm", "k_norm"], []
+)
+
+
+def _rms_head(x: jax.Array, scale: jax.Array | None, eps: float = 1e-6):
+    if scale is None:
+        return x
+    xf = x.astype(jnp.float32)
+    xf = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class TPAttnDims:
+    """Static head geometry for the local shard."""
+
+    hq_loc: int
+    hkv_loc: int
+    head_dim: int
+    rope_theta: float = 1e6
+
+    @property
+    def qkv_loc(self) -> int:
+        return (self.hq_loc + 2 * self.hkv_loc) * self.head_dim
+
+    def split_qkv(self, qkv: jax.Array):
+        """``[..., qkv_loc] → q [..., hq_loc, hd], k/v [..., hkv_loc, hd]``."""
+        hd = self.head_dim
+        q, k, v = jnp.split(
+            qkv, [self.hq_loc * hd, (self.hq_loc + self.hkv_loc) * hd], axis=-1
+        )
+        lead = qkv.shape[:-1]
+        return (
+            q.reshape(*lead, self.hq_loc, hd),
+            k.reshape(*lead, self.hkv_loc, hd),
+            v.reshape(*lead, self.hkv_loc, hd),
+        )
+
+
+def tp_attn_prefill(
+    params: TPAttnParams,
+    x: jax.Array,  # [s_loc, d] — sequence shard (batch folded upstream)
+    dims: TPAttnDims,
+    *,
+    axis: str = "tp",
+    mode: Mode = "pallas",
+    ctx: DistContext | None = None,
+):
+    """Per-shard prefill forward (inside ``shard_map``).
+
+    Returns ``(out [s_loc, d], k [hkv_loc, S, hd], v [hkv_loc, S, hd])``
+    — k/v are the full-sequence local-head cache entries (parity:
+    ``TP_Attn.dist_triton_fwd`` writing the KV cache, ``tp_attn.py:203``).
+    """
+    if mode == "pallas":
+        qkv = ag_gemm(x, params.wqkv, axis=axis, ctx=ctx)  # [S, qkv_loc]
+    else:
+        full = jax.lax.all_gather(x, axis, axis=0, tiled=True)
+        qkv = jnp.dot(
+            full, params.wqkv, preferred_element_type=jnp.float32
+        ).astype(x.dtype)
+    s_full = qkv.shape[0]
+    q, k, v = dims.split_qkv(qkv)  # [S, h, hd]
+    q = _rms_head(q, params.q_norm)
+    k = _rms_head(k, params.k_norm)
+    pos = jnp.arange(s_full)
+    q = apply_rope(q.swapaxes(0, 1), pos, dims.rope_theta)  # [h, S, hd]
+    k = apply_rope(k.swapaxes(0, 1), pos, dims.rope_theta)
+    v = v.swapaxes(0, 1)
+    o = flash_attention(q[None], k[None], v[None], causal=True)[0]  # [h, S, hd]
+    o_flat = o.swapaxes(0, 1).reshape(s_full, dims.hq_loc * dims.head_dim)
+    o_flat = o_flat.astype(x.dtype)
+    if mode == "pallas":
+        out = gemm_rs(o_flat, params.wo, axis=axis, ctx=ctx)
+    else:
+        part = jnp.dot(o_flat, params.wo, preferred_element_type=jnp.float32)
+        out = jax.lax.psum_scatter(
+            part, axis, scatter_dimension=0, tiled=True
+        ).astype(x.dtype)
+    return out, k, v
+
+
+def tp_attn_decode(
+    params: TPAttnParams,
+    x: jax.Array,        # [B, d] replicated — one new token per sequence
+    k_cache: jax.Array,  # [B, hkv_loc, S_max, hd]
+    v_cache: jax.Array,
+    kv_len: jax.Array,   # [B] int32 — tokens already in cache
+    dims: TPAttnDims,
+    *,
+    axis: str = "tp",
+    mode: Mode = "pallas_ar",
+    ctx: DistContext | None = None,
+):
+    """Per-shard decode step (inside ``shard_map``).
+
+    Local qkv GEMM → rope at position ``kv_len`` → cache append →
+    GQA flash-decode over local heads → o-proj partial → all-reduce.
+    Returns ``(out [B, d] replicated, k_cache, v_cache)``.
+    """
+    b = x.shape[0]
+    qkv = jnp.dot(x, params.wqkv, preferred_element_type=jnp.float32).astype(
+        x.dtype
+    )
+    q, k, v = dims.split_qkv(qkv)  # [B, h, hd]
+    q = _rms_head(q, params.q_norm)
+    k = _rms_head(k, params.k_norm)
+    q = apply_rope(q, kv_len[:, None], dims.rope_theta)
+    k = apply_rope(k, kv_len[:, None], dims.rope_theta)
+
+    # Append at position kv_len[b] (per-sequence scatter).
+    def upd(cache, new):  # cache [h, S, hd], new [h, hd], pos scalar
+        def one(c, n, p):
+            return jax.lax.dynamic_update_slice(c, n[:, None, :], (0, p, 0))
+        return jax.vmap(one)(cache, new, kv_len)
+
+    k_cache = upd(k_cache, k)
+    v_cache = upd(v_cache, v)
+
+    o = flash_decode(q, k_cache, v_cache, kv_len + 1)  # [B, hq_loc, hd]
+    o_flat = o.reshape(b, dims.hq_loc * dims.head_dim).astype(x.dtype)
+    part = jnp.dot(o_flat, params.wo, preferred_element_type=jnp.float32).astype(
+        x.dtype
+    )
+    if mode in ("xla", "xla_ar"):
+        out = jax.lax.psum(part, axis)
+    elif mode in ("pallas", "pallas_ar"):
+        out = all_reduce(part, axis=axis, ctx=ctx)
+    else:
+        raise ValueError(f"unknown mode {mode!r}")
+    return out, k_cache, v_cache
+
+
+class TPAttn:
+    """Host-level layer (parity: ``TP_Attn``, ``layers/nvidia/tp_attn.py:78``)."""
+
+    def __init__(
+        self,
+        d_model: int,
+        num_q_heads: int,
+        num_kv_heads: int,
+        head_dim: int,
+        *,
+        qk_norm: bool = True,
+        rope_theta: float = 1e6,
+        dtype=jnp.bfloat16,
+        axis: str = "tp",
+        ctx: DistContext | None = None,
+    ):
+        self.ctx = ctx or current_context()
+        self.axis = axis
+        n = self.ctx.axis_size(axis)
+        if num_q_heads % n or num_kv_heads % n:
+            raise ValueError(
+                f"heads ({num_q_heads}, {num_kv_heads}) not divisible by tp={n}"
+            )
+        self.d_model = d_model
+        self.num_q_heads = num_q_heads
+        self.num_kv_heads = num_kv_heads
+        self.dims = TPAttnDims(
+            hq_loc=num_q_heads // n,
+            hkv_loc=num_kv_heads // n,
+            head_dim=head_dim,
+            rope_theta=rope_theta,
+        )
+        self.qk_norm = qk_norm
+        self.dtype = dtype
+        self.params: TPAttnParams | None = None
+
+    def load(
+        self,
+        wq: jax.Array,  # [d, hq * hd]
+        wk: jax.Array,  # [d, hkv * hd]
+        wv: jax.Array,  # [d, hkv * hd]
+        wo: jax.Array,  # [hq * hd, d]
+        q_norm: jax.Array | None = None,
+        k_norm: jax.Array | None = None,
+    ) -> TPAttnParams:
+        """Shard full weights: per-device wqkv = [q_loc | k_loc | v_loc]."""
+        n = self.ctx.axis_size(self.axis)
+        hd = self.dims.head_dim
+        d = self.d_model
+
+        def by_shard(w, h):  # [d, h*hd] → [n, d, (h/n)*hd]
+            return w.reshape(d, n, (h // n) * hd).swapaxes(0, 1)
+
+        wqkv = jnp.concatenate(
+            [
+                by_shard(wq, self.num_q_heads),
+                by_shard(wk, self.num_kv_heads),
+                by_shard(wv, self.num_kv_heads),
+            ],
+            axis=2,
+        )  # [n, d, qkv_loc]
+        wqkv = wqkv.swapaxes(0, 1).reshape(d, n * self.dims.qkv_loc)
+        self.params = TPAttnParams(
+            wqkv=self.ctx.shard(wqkv.astype(self.dtype), None, self.axis),
+            wo=self.ctx.shard(wo.astype(self.dtype), self.axis, None),
+            q_norm=None if q_norm is None else self.ctx.replicate(q_norm),
+            k_norm=None if k_norm is None else self.ctx.replicate(k_norm),
+        )
+        return self.params
+
+    def init(self, key: jax.Array) -> TPAttnParams:
+        hd = self.dims.head_dim
+        ks = jax.random.split(key, 4)
+        scale = self.d_model**-0.5
+        wq = jax.random.normal(ks[0], (self.d_model, self.num_q_heads * hd)) * scale
+        wk = jax.random.normal(ks[1], (self.d_model, self.num_kv_heads * hd)) * scale
+        wv = jax.random.normal(ks[2], (self.d_model, self.num_kv_heads * hd)) * scale
+        wo = jax.random.normal(ks[3], (self.num_q_heads * hd, self.d_model)) * scale
+        qn = kn = jnp.ones((hd,)) if self.qk_norm else None
+        return self.load(
+            wq.astype(self.dtype), wk.astype(self.dtype), wv.astype(self.dtype),
+            wo.astype(self.dtype), qn, kn,
+        )
+
+    @property
+    def param_specs(self):
+        from jax.sharding import PartitionSpec as P
+
+        return TPAttnParams(
+            wqkv=P(None, self.axis), wo=P(self.axis, None),
+            q_norm=None if not self.qk_norm else P(),
+            k_norm=None if not self.qk_norm else P(),
+        )
+
+    def prefill(self, x: jax.Array, mode: Mode = "pallas") -> jax.Array:
+        """``x [S, d]`` host-global; returns ``[S, d]`` (seq-sharded)."""
+        from jax.sharding import PartitionSpec as P
+
+        assert self.params is not None
+        f = self.ctx.shard_map(
+            functools.partial(
+                tp_attn_prefill, dims=self.dims, axis=self.axis, mode=mode,
+                ctx=self.ctx,
+            ),
+            in_specs=(self.param_specs, P(self.axis, None)),
+            out_specs=(P(self.axis, None), P(self.axis), P(self.axis)),
+        )
+        out, _, _ = f(self.params, x)
+        return out

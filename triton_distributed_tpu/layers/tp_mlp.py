@@ -1,0 +1,161 @@
+"""Tensor-parallel MLP (SwiGLU) with overlapped comm.
+
+Parity: reference ``layers/nvidia/tp_mlp.py`` — ``TP_MLP`` with
+``torch_fwd``:96, ``dist_triton_fwd``:143 (ag_gemm fc1 → silu-mul →
+gemm_rs fc2) and the AR decode path :177 (local GEMMs → all_reduce).
+
+TPU design: weights are column-sharded (gate/up fused into one fc1) and
+row-sharded (down) over the ``tp`` axis. Activations are sequence-sharded
+between layers (the reference's "scatter" activation layout), so the
+prefill path is ag_gemm → silu·mul → gemm_rs with zero exposed
+collectives; the decode path keeps activations replicated and all-reduces
+the partial down-projection.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Literal
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu.ops.collectives.all_reduce import all_reduce
+from triton_distributed_tpu.ops.overlap.ag_gemm import ag_gemm
+from triton_distributed_tpu.ops.overlap.gemm_rs import gemm_rs
+from triton_distributed_tpu.runtime.mesh import DistContext, current_context
+
+Mode = Literal["xla", "pallas", "pallas_ar", "xla_ar"]
+
+
+@dataclasses.dataclass
+class TPMLPParams:
+    """Per-shard weights. ``w1`` fuses gate and up projections
+    (``[d_model, 2 * d_ff_loc]``, gate first) so prefill needs a single
+    ag_gemm — same fusion the reference applies (``tp_mlp.py:51-76``
+    concatenates gate/up into one fc1 weight)."""
+
+    w1: jax.Array  # [d_model, 2 * d_ff_loc]
+    w2: jax.Array  # [d_ff_loc, d_model]
+
+
+jax.tree_util.register_dataclass(TPMLPParams, ["w1", "w2"], [])
+
+
+def _silu_mul(h: jax.Array) -> jax.Array:
+    gate, up = jnp.split(h, 2, axis=-1)
+    return (jax.nn.silu(gate.astype(jnp.float32)) * up.astype(jnp.float32)).astype(
+        h.dtype
+    )
+
+
+def tp_mlp_fwd(
+    params: TPMLPParams,
+    x: jax.Array,
+    *,
+    axis: str = "tp",
+    mode: Mode = "pallas",
+    ctx: DistContext | None = None,
+) -> jax.Array:
+    """Per-shard forward, runs inside ``shard_map``.
+
+    prefill modes (``x`` is the sequence shard ``[m_per, d]``; returns the
+    sequence shard): ``pallas`` = overlapped ag_gemm/gemm_rs
+    (parity ``dist_triton_fwd``); ``xla`` = lax collectives golden path.
+    decode modes (``x`` replicated ``[m, d]``; returns replicated):
+    ``pallas_ar`` / ``xla_ar`` = local GEMMs + all-reduce
+    (parity ``tp_mlp.py:177``).
+    """
+    if mode == "pallas":
+        h = _silu_mul(ag_gemm(x, params.w1, axis=axis, ctx=ctx))
+        return gemm_rs(h, params.w2, axis=axis, ctx=ctx)
+    if mode == "xla":
+        full = jax.lax.all_gather(x, axis, axis=0, tiled=True)
+        h = _silu_mul(jnp.dot(full, params.w1, preferred_element_type=jnp.float32)
+                      .astype(x.dtype))
+        part = jnp.dot(h, params.w2, preferred_element_type=jnp.float32)
+        return jax.lax.psum_scatter(
+            part, axis, scatter_dimension=0, tiled=True
+        ).astype(x.dtype)
+    if mode in ("pallas_ar", "xla_ar"):
+        h = _silu_mul(
+            jnp.dot(x, params.w1, preferred_element_type=jnp.float32).astype(x.dtype)
+        )
+        part = jnp.dot(h, params.w2, preferred_element_type=jnp.float32).astype(
+            x.dtype
+        )
+        if mode == "xla_ar":
+            return jax.lax.psum(part, axis)
+        return all_reduce(part, axis=axis, ctx=ctx)
+    raise ValueError(f"unknown mode {mode!r}")
+
+
+class TPMLP:
+    """Host-level layer: owns sharded weights + shard_map wrappers.
+
+    Parity: ``TP_MLP`` (``layers/nvidia/tp_mlp.py:51``) — there the layer
+    shards torch weights onto each rank and allocates symmetric contexts;
+    here weights are ``jax.device_put`` with column/row shardings and the
+    kernels allocate their own workspace.
+    """
+
+    def __init__(
+        self,
+        d_model: int,
+        d_ff: int,
+        *,
+        dtype=jnp.bfloat16,
+        axis: str = "tp",
+        ctx: DistContext | None = None,
+    ):
+        self.ctx = ctx or current_context()
+        self.axis = axis
+        self.d_model = d_model
+        self.d_ff = d_ff
+        self.dtype = dtype
+        self.params: TPMLPParams | None = None
+
+    def init(self, key: jax.Array) -> TPMLPParams:
+        k1, k2, k3 = jax.random.split(key, 3)
+        scale = self.d_model**-0.5
+        gate = jax.random.normal(k1, (self.d_model, self.d_ff), self.dtype) * scale
+        up = jax.random.normal(k2, (self.d_model, self.d_ff), self.dtype) * scale
+        down = jax.random.normal(k3, (self.d_ff, self.d_model), self.dtype) * scale
+        return self.load(gate, up, down)
+
+    def load(self, gate: jax.Array, up: jax.Array, down: jax.Array) -> TPMLPParams:
+        """Shard full weights onto the mesh (parity: ``TP_MLP._init_parameters``)."""
+        n = self.ctx.axis_size(self.axis)
+        d_ff_loc = self.d_ff // n
+        # Fuse gate/up per shard: [d, 2*ff_loc] blocks so each device's
+        # w1 column shard is [gate_loc | up_loc].
+        w1 = jnp.concatenate(
+            [
+                gate.reshape(self.d_model, n, d_ff_loc),
+                up.reshape(self.d_model, n, d_ff_loc),
+            ],
+            axis=2,
+        ).reshape(self.d_model, 2 * self.d_ff)
+        self.params = TPMLPParams(
+            w1=self.ctx.shard(w1.astype(self.dtype), None, self.axis),
+            w2=self.ctx.shard(down.astype(self.dtype), self.axis, None),
+        )
+        return self.params
+
+    def forward(self, x: jax.Array, mode: Mode = "pallas") -> jax.Array:
+        """``x`` host-global ``[M, d]``. Prefill modes return ``[M, d]``
+        sequence-sharded; AR modes return ``[M, d]`` replicated."""
+        assert self.params is not None, "call init()/load() first"
+        seq_modes = mode in ("pallas", "xla")
+        xs = P(self.axis, None) if seq_modes else P()
+        f = self.ctx.shard_map(
+            functools.partial(tp_mlp_fwd, axis=self.axis, mode=mode, ctx=self.ctx),
+            in_specs=(
+                TPMLPParams(w1=P(None, self.axis), w2=P(self.axis, None)),
+                xs,
+            ),
+            out_specs=xs,
+        )
+        return f(self.params, x)

@@ -1,0 +1,216 @@
+"""Blockwise flash attention (prefill) — Pallas TPU kernel.
+
+Parity role: the reference consumes flash attention from its own Triton
+kernels inside SP-AG attention (``sp_ag_attention_intra_node.py:256`` —
+causal consumer) and from torch SDPA in layers (``tp_attn.py:203-271``).
+Here the kernel is first-class: causal/GQA flash attention with an
+optional log-sum-exp output, which the distributed decode and SP paths
+reuse for cross-shard softmax merging (``flash_decode.py:482`` analog).
+
+TPU design: grid = (batch·q_heads, q_blocks, kv_blocks), kv innermost so
+the f32 accumulator + running (m, l) live in VMEM scratch across the kv
+sweep; the MXU sees [block_q, d] @ [d, block_k] and [block_q, block_k] @
+[block_k, d] shapes; causal blocks above the diagonal are skipped via
+``pl.when`` (zero-work predication, the analog of the reference's early
+``continue`` on masked tiles).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_distributed_tpu.ops.common import interpret_mode
+
+_NEG_INF = -1e30
+
+
+def _attn_kernel(
+    q_ref,    # [1, block_q, d] VMEM
+    k_ref,    # [1, block_k, d] VMEM
+    v_ref,    # [1, block_k, d] VMEM
+    o_ref,    # [1, block_q, d] VMEM
+    lse_ref,  # [1, 1, sq] VMEM or None — full row; slice qi written at
+              # finalize (Mosaic requires the block's trailing dims to
+              # match the array, so the block spans the whole q length)
+    acc,      # [block_q, d] f32 scratch
+    m_i,      # [block_q, 1] f32 scratch — running max
+    l_i,      # [block_q, 1] f32 scratch — running sum-exp
+    *,
+    sm_scale: float,
+    causal: bool,
+    kv_offset: int,
+    block_q: int,
+    block_k: int,
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    num_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_i[:] = jnp.full_like(m_i, _NEG_INF)
+        l_i[:] = jnp.zeros_like(l_i)
+
+    # Causal skip: the kv block starts after the last q row can see.
+    q_end = kv_offset + (qi + 1) * block_q - 1  # last absolute q position
+    run = (ki * block_k <= q_end) if causal else True
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale  # [block_q, block_k]
+        if causal:
+            rows = kv_offset + qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(cols <= rows, s, _NEG_INF)
+        m_new = jnp.maximum(m_i[:], jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_i[:] - m_new)
+        l_i[:] = l_i[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc[:] = acc[:] * alpha + jnp.dot(
+            p.astype(v_ref.dtype), v_ref[0], preferred_element_type=jnp.float32
+        )
+        m_i[:] = m_new
+
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        l = jnp.maximum(l_i[:], 1e-30)
+        o_ref[0] = (acc[:] / l).astype(o_ref.dtype)
+        if lse_ref is not None:
+            lse_ref[0, 0, pl.ds(qi * block_q, block_q)] = (m_i[:] + jnp.log(l))[
+                :, 0
+            ]
+
+
+def flash_attention(
+    q: jax.Array,  # [B, Hq, Sq, D]
+    k: jax.Array,  # [B, Hkv, Sk, D]
+    v: jax.Array,  # [B, Hkv, Sk, D]
+    *,
+    causal: bool = True,
+    sm_scale: float | None = None,
+    kv_offset: int = 0,
+    block_q: int = 128,
+    block_k: int = 128,
+    return_lse: bool = False,
+    interpret=None,
+):
+    """Causal/GQA flash attention. ``kv_offset``: absolute position of
+    ``q[..., 0, :]`` within the kv sequence (non-zero for chunked prefill
+    against a KV cache — parity with the reference's offset handling in
+    ``flash_decode.py`` host wrappers).
+
+    Returns ``o [B, Hq, Sq, D]`` (and ``lse [B, Hq, Sq]`` f32 when
+    ``return_lse`` — base-e log-sum-exp of scaled scores, the quantity the
+    distributed combine merges).
+    """
+    b, hq, sq, d = q.shape
+    _, hkv, sk, _ = k.shape
+    if hq % hkv:
+        raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
+    group = hq // hkv
+    if sm_scale is None:
+        sm_scale = d**-0.5
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError(f"seq ({sq},{sk}) not divisible by blocks "
+                         f"({block_q},{block_k}); pad upstream")
+
+    qf = q.reshape(b * hq, sq, d)
+    kf = k.reshape(b * hkv, sk, d)
+    vf = v.reshape(b * hkv, sk, d)
+    grid = (b * hq, sq // block_q, sk // block_k)
+
+    out_shape = [jax.ShapeDtypeStruct((b * hq, sq, d), q.dtype)]
+    out_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+    ]
+    if return_lse:
+        out_shape.append(jax.ShapeDtypeStruct((b * hq, 1, sq), jnp.float32))
+        out_specs.append(
+            pl.BlockSpec((1, 1, sq), lambda bh, qi, ki: (bh, 0, 0))
+        )
+
+    kernel = functools.partial(
+        _attn_kernel,
+        sm_scale=sm_scale,
+        causal=causal,
+        kv_offset=kv_offset,
+        block_q=block_q,
+        block_k=block_k,
+    )
+    if not return_lse:
+        kernel = functools.partial(_drop_lse, kernel)
+
+    res = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec(
+                (1, block_k, d), lambda bh, qi, ki, g=group: (bh // g, ki, 0)
+            ),
+            pl.BlockSpec(
+                (1, block_k, d), lambda bh, qi, ki, g=group: (bh // g, ki, 0)
+            ),
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+        ),
+        interpret=interpret_mode() if interpret is None else interpret,
+    )(qf, kf, vf)
+
+    o = res[0].reshape(b, hq, sq, d)
+    if return_lse:
+        return o, res[1].reshape(b, hq, sq)
+    return o
+
+
+def _drop_lse(kernel, q_ref, k_ref, v_ref, o_ref, acc, m_i, l_i, **kw):
+    kernel(q_ref, k_ref, v_ref, o_ref, None, acc, m_i, l_i, **kw)
+
+
+def mha_reference(
+    q, k, v, *, causal=True, sm_scale=None, kv_offset: int = 0,
+    return_lse: bool = False,
+):
+    """Golden attention (parity: the reference's torch-SDPA goldens)."""
+    b, hq, sq, d = q.shape
+    _, hkv, sk, _ = k.shape
+    if sm_scale is None:
+        sm_scale = d**-0.5
+    k = jnp.repeat(k, hq // hkv, axis=1)
+    v = jnp.repeat(v, hq // hkv, axis=1)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
+    s *= sm_scale
+    if causal:
+        rows = kv_offset + jnp.arange(sq)[:, None]
+        cols = jnp.arange(sk)[None, :]
+        s = jnp.where(cols <= rows, s, _NEG_INF)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)
+    p = jnp.exp(s - lse[..., None])
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+    if return_lse:
+        return o, lse
+    return o

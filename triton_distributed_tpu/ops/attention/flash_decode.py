@@ -1,0 +1,225 @@
+"""GQA flash-decode: split-KV kernel + cross-rank combine.
+
+Parity: reference ``kernels/nvidia/flash_decode.py`` — split-KV kernel
+:130 (each program attends q over one KV chunk, emitting a partial
+output + log-sum-exp), intra-rank combine :393, and the **inter-rank**
+combine :482 where ranks exchange (partial O, LSE) via ``putmem_signal``
+and merge with a log-sum-exp weighting — scaling decode 1→32 GPUs
+(README "Scaling of Distributed Flash-Decode").
+
+TPU design: the split-KV pass is one Pallas kernel, grid =
+(batch, kv_heads, kv_chunks) with the GQA head group riding the sublane
+dimension (q block ``[group, d]``), context length masked per chunk from
+a scalar-prefetch ``kv_len``. The combine is a log-sum-exp merge —
+intra-chip over the chunk axis, and for the distributed form across the
+``sp`` mesh axis after an all-gather of the (O, LSE) partials (XLA
+collective or our Pallas ring — the device-initiated putmem analog).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_distributed_tpu.ops.collectives.all_gather import all_gather
+from triton_distributed_tpu.ops.common import interpret_mode
+
+_NEG_INF = -1e30
+
+
+def _decode_kernel(
+    kv_len_ref,  # [B] int32 SMEM (scalar prefetch)
+    q_ref,       # [1, 1, group, d] VMEM
+    k_ref,       # [1, 1, chunk, d] VMEM
+    v_ref,       # [1, 1, chunk, d] VMEM
+    o_ref,       # [1, 1, 1, group, d] VMEM f32 — partial output, chunk ci
+    lse_ref,     # [1, 1, C, group] VMEM f32 — full chunk column, row ci
+                 # written per step (Mosaic needs the block's trailing two
+                 # dims to match the array, so the block spans all chunks)
+    *,
+    sm_scale: float,
+    chunk_k: int,
+):
+    b = pl.program_id(0)
+    ci = pl.program_id(2)
+    start = ci * chunk_k
+    valid = kv_len_ref[b] - start  # may be <=0 (fully masked chunk)
+
+    @pl.when(valid > 0)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        group = q.shape[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale  # [group, chunk]
+        cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(cols < valid, s, _NEG_INF)
+        m = jnp.max(s, axis=1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.sum(p, axis=1, keepdims=True)
+        o = jnp.dot(
+            p.astype(v_ref.dtype), v_ref[0, 0], preferred_element_type=jnp.float32
+        )
+        o_ref[0, 0, 0] = o / l
+        lse_ref[0, 0, ci] = (m + jnp.log(l))[:, 0]
+
+    @pl.when(valid <= 0)
+    def _skip():
+        o_ref[:] = jnp.zeros_like(o_ref)
+        lse_ref[0, 0, ci] = jnp.full(lse_ref.shape[-1:], _NEG_INF, jnp.float32)
+
+
+def lse_combine(o_parts: jax.Array, lse_parts: jax.Array, part_axis: int = 0):
+    """Merge partial attention outputs by log-sum-exp weighting.
+
+    Parity: reference combine kernels (``flash_decode.py:393,482``).
+    ``o_parts [..., P, ..., d]`` f32 with partials on ``part_axis``;
+    ``lse_parts`` matching without d. Returns (o, lse) reduced over P.
+    """
+    m = jnp.max(lse_parts, axis=part_axis, keepdims=True)
+    m = jnp.maximum(m, _NEG_INF)  # all-masked guard
+    w = jnp.exp(lse_parts - m)
+    den = jnp.sum(w, axis=part_axis)
+    o = jnp.sum(o_parts * w[..., None], axis=part_axis) / jnp.maximum(
+        den[..., None], 1e-30
+    )
+    lse = jnp.squeeze(m, part_axis) + jnp.log(jnp.maximum(den, 1e-30))
+    return o, lse
+
+
+def flash_decode(
+    q: jax.Array,        # [B, Hq, D]
+    k_cache: jax.Array,  # [B, Hkv, S, D]
+    v_cache: jax.Array,  # [B, Hkv, S, D]
+    kv_len: jax.Array,   # [B] int32 — valid context length per sequence
+    *,
+    sm_scale: float | None = None,
+    chunk_k: int = 256,
+    return_lse: bool = False,
+    interpret=None,
+):
+    """Single-token GQA decode attention over a (possibly padded) KV cache.
+
+    Parity: ``gqa_fwd_batch_decode`` (``flash_decode.py:763``). Returns
+    ``o [B, Hq, D]`` (q.dtype) and optionally ``lse [B, Hq]`` f32 for the
+    cross-rank combine.
+    """
+    b, hq, d = q.shape
+    _, hkv, s, _ = k_cache.shape
+    if hq % hkv:
+        raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
+    group = hq // hkv
+    if sm_scale is None:
+        sm_scale = d**-0.5
+    chunk_k = min(chunk_k, s)
+    if s % chunk_k:
+        raise ValueError(f"cache len {s} not divisible by chunk_k {chunk_k}")
+    num_chunks = s // chunk_k
+    kv_len = jnp.broadcast_to(jnp.asarray(kv_len, jnp.int32), (b,))
+
+    qg = q.reshape(b, hkv, group, d)
+    grid = (b, hkv, num_chunks)
+    o_parts, lse_parts = pl.pallas_call(
+        functools.partial(_decode_kernel, sm_scale=sm_scale, chunk_k=chunk_k),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            # index maps receive the scalar-prefetch ref as a trailing arg
+            in_specs=[
+                pl.BlockSpec((1, 1, group, d), lambda b, h, ci, _: (b, h, 0, 0)),
+                pl.BlockSpec(
+                    (1, 1, chunk_k, d), lambda b, h, ci, _: (b, h, ci, 0)
+                ),
+                pl.BlockSpec(
+                    (1, 1, chunk_k, d), lambda b, h, ci, _: (b, h, ci, 0)
+                ),
+            ],
+            out_specs=[
+                pl.BlockSpec(
+                    (1, 1, 1, group, d), lambda b, h, ci, _: (b, h, ci, 0, 0)
+                ),
+                pl.BlockSpec(
+                    (1, 1, num_chunks, group), lambda b, h, ci, _: (b, h, 0, 0)
+                ),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hkv, num_chunks, group, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, hkv, num_chunks, group), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret_mode() if interpret is None else interpret,
+    )(kv_len, qg, k_cache, v_cache)
+
+    o, lse = lse_combine(o_parts, lse_parts, part_axis=2)  # [B, Hkv, group, d]
+    o = o.reshape(b, hq, d).astype(q.dtype)
+    if return_lse:
+        return o, lse.reshape(b, hq)
+    return o
+
+
+def distributed_flash_decode(
+    q: jax.Array,        # [B, Hq, D] replicated
+    k_shard: jax.Array,  # [B, Hkv, S_loc, D] — this rank's KV slice
+    v_shard: jax.Array,
+    kv_len: jax.Array,   # [B] int32 GLOBAL context length
+    *,
+    axis: str = "sp",
+    sm_scale: float | None = None,
+    chunk_k: int = 256,
+    method: str = "xla",
+    ctx=None,
+):
+    """Decode attention with the KV cache sequence-sharded over ``axis``.
+
+    Runs inside ``shard_map``. Each rank attends q over its local KV slice
+    (split-KV kernel), then partial (O, LSE) are exchanged across ranks
+    and merged — parity with the reference's inter-rank combine
+    (``flash_decode.py:482``) which putmem_signals partials between GPUs.
+    ``method='pallas'`` uses the device-initiated ring all-gather;
+    ``'xla'`` the XLA collective.
+    """
+    me = jax.lax.axis_index(axis)
+    s_loc = k_shard.shape[2]
+    # Positions covered locally: [me*s_loc, me*s_loc + s_loc).
+    local_len = jnp.clip(kv_len - me * s_loc, 0, s_loc)
+    o, lse = flash_decode(
+        q, k_shard, v_shard, local_len,
+        sm_scale=sm_scale, chunk_k=chunk_k, return_lse=True,
+    )
+    b, hq, d = q.shape
+    o = o.astype(jnp.float32)
+    if method == "pallas":
+        flat = jnp.concatenate([o.reshape(b * hq, d), lse.reshape(b * hq, 1)], 1)
+        gathered = all_gather(flat, axis=axis, ctx=ctx)  # [n*b*hq, d+1]
+        gathered = gathered.reshape(-1, b * hq, d + 1)
+        o_all = gathered[..., :d].reshape(-1, b, hq, d)
+        lse_all = gathered[..., d].reshape(-1, b, hq)
+    else:
+        o_all = jax.lax.all_gather(o, axis)      # [n, B, Hq, D]
+        lse_all = jax.lax.all_gather(lse, axis)  # [n, B, Hq]
+    merged, _ = lse_combine(o_all, lse_all, part_axis=0)
+    return merged.astype(q.dtype)
+
+
+def gqa_decode_reference(q, k_cache, v_cache, kv_len, *, sm_scale=None):
+    """Golden decode (parity: the reference's torch goldens)."""
+    b, hq, d = q.shape
+    _, hkv, s, _ = k_cache.shape
+    if sm_scale is None:
+        sm_scale = d**-0.5
+    k = jnp.repeat(k_cache, hq // hkv, axis=1).astype(jnp.float32)
+    v = jnp.repeat(v_cache, hq // hkv, axis=1).astype(jnp.float32)
+    s_ = jnp.einsum("bhd,bhkd->bhk", q.astype(jnp.float32), k) * sm_scale
+    kv_len = jnp.broadcast_to(jnp.asarray(kv_len, jnp.int32), (b,))
+    mask = jnp.arange(s)[None, None, :] < kv_len[:, None, None]
+    s_ = jnp.where(mask, s_, _NEG_INF)
+    p = jax.nn.softmax(s_, axis=-1)
+    return jnp.einsum("bhk,bhkd->bhd", p, v).astype(q.dtype)

@@ -1,0 +1,35 @@
+"""Rotary position embeddings (RoPE).
+
+Parity role: the reference applies rotary inside ``TP_Attn``
+(``layers/nvidia/tp_attn.py:120-160``) with precomputed cos/sin caches.
+Here it's a pure function over positions — XLA fuses the trig + rotate
+into the surrounding kernels, so no cache tensor is materialized.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_freqs(head_dim: int, theta: float = 1e6) -> jax.Array:
+    """Inverse frequencies [head_dim/2] (Qwen3 default theta=1e6)."""
+    return 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+
+
+def apply_rope(
+    x: jax.Array,          # [..., S, head_dim] or [..., head_dim]
+    positions: jax.Array,  # [..., S] or [...] int32 absolute positions
+    theta: float = 1e6,
+) -> jax.Array:
+    """Rotate-half RoPE (HF convention: first/second half pairing)."""
+    head_dim = x.shape[-1]
+    inv = rope_freqs(head_dim, theta)
+    ang = positions[..., None].astype(jnp.float32) * inv  # [..., hd/2]
+    cos = jnp.cos(ang)
+    sin = jnp.sin(ang)
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)

@@ -25,6 +25,7 @@ from jax.sharding import PartitionSpec as P
 
 from triton_distributed_tpu import language as dl
 from triton_distributed_tpu.ops.common import (
+    VMEM_COMM_MAX_BYTES,
     comm_pallas_call,
     next_collective_id,
     _on_tpu,
@@ -58,6 +59,9 @@ def _ring_kernel(x_ref, o_ref, send_sems, recv_sems, *, axis: str):
     m_per = x_ref.shape[0]
     right = jax.lax.rem(me + 1, n)
 
+    # Entry barrier: peers must have entered (their o_ref allocated and
+    # no longer owned by preceding XLA ops) before any remote write.
+    dl.barrier_all(axis)
     o_ref[pl.ds(me * m_per, m_per)] = x_ref[:]
 
     dmas = []
@@ -93,6 +97,7 @@ def _bidir_ring_kernel(x_ref, o_ref, send_sems, recv_sems, *, axis: str):
     right = jax.lax.rem(me + 1, n)
     left = jax.lax.rem(me - 1 + n, n)
 
+    dl.barrier_all(axis)
     o_ref[pl.ds(me * m_per, m_per)] = x_ref[:]
 
     dmas = []
@@ -138,6 +143,7 @@ def _full_mesh_kernel(x_ref, o_ref, send_sems, recv_sems, *, axis: str):
     m_per = x_ref.shape[0]
     own = pl.ds(me * m_per, m_per)
 
+    dl.barrier_all(axis)
     o_ref[own] = x_ref[:]
 
     dmas = []
@@ -173,7 +179,10 @@ def all_gather(
             method = AllGatherMethod.XLA
         else:
             nbytes = x.size * x.dtype.itemsize
-            if n <= 2 or nbytes <= 64 * 1024:
+            if n * nbytes > VMEM_COMM_MAX_BYTES:
+                # Gathered result must fit VMEM; larger goes through XLA.
+                method = AllGatherMethod.XLA
+            elif n <= 2 or nbytes <= 64 * 1024:
                 method = AllGatherMethod.PALLAS_FULL_MESH
             else:
                 method = AllGatherMethod.PALLAS_BIDIR_RING

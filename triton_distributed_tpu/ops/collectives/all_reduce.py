@@ -25,6 +25,7 @@ from jax.sharding import PartitionSpec as P
 
 from triton_distributed_tpu import language as dl
 from triton_distributed_tpu.ops.common import (
+    VMEM_COMM_MAX_BYTES,
     comm_pallas_call,
     next_collective_id,
     _on_tpu,
@@ -57,9 +58,12 @@ _ONE_SHOT_MAX_BYTES = 256 * 1024
 
 
 def get_auto_allreduce_method(nbytes: int, n: int) -> AllReduceMethod:
-    if n <= 2 or nbytes <= _ONE_SHOT_MAX_BYTES:
+    if nbytes <= _ONE_SHOT_MAX_BYTES:
         return AllReduceMethod.ONE_SHOT
-    return AllReduceMethod.TWO_SHOT
+    if nbytes <= VMEM_COMM_MAX_BYTES:
+        return AllReduceMethod.TWO_SHOT
+    # Payload exceeds what the VMEM-resident kernels can hold.
+    return AllReduceMethod.XLA
 
 
 def _one_shot_kernel(x_ref, o_ref, gather, send_sems, recv_sems, *, axis: str):
@@ -71,6 +75,7 @@ def _one_shot_kernel(x_ref, o_ref, gather, send_sems, recv_sems, *, axis: str):
     me = dl.rank(axis)
     n = dl.num_ranks(axis)
 
+    dl.barrier_all(axis)  # peers' gather slots must exist before any put
     gather[me] = x_ref[:]
     dmas = []
     for i in range(1, n):

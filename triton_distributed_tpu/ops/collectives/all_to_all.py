@@ -22,6 +22,7 @@ from jax.sharding import PartitionSpec as P
 
 from triton_distributed_tpu import language as dl
 from triton_distributed_tpu.ops.common import (
+    VMEM_COMM_MAX_BYTES,
     comm_pallas_call,
     next_collective_id,
     _on_tpu,
@@ -39,6 +40,7 @@ def _a2a_kernel(x_ref, o_ref, send_sems, recv_sems, *, axis: str):
     def chunk(idx):
         return pl.ds(idx * m_per, m_per)
 
+    dl.barrier_all(axis)  # peers' o_ref must exist before any put
     # Own chunk stays local.
     o_ref[chunk(me)] = x_ref[chunk(me)]
 
@@ -72,7 +74,8 @@ def all_to_all(
     """
     n = jax.lax.axis_size(axis)
     if method == "auto":
-        method = "pallas" if _on_tpu(ctx) else "xla"
+        on_chip = x.size * x.dtype.itemsize <= VMEM_COMM_MAX_BYTES
+        method = "pallas" if _on_tpu(ctx) and on_chip else "xla"
     if method == "xla":
         return jax.lax.all_to_all(
             x.reshape(n, x.shape[0] // n, *x.shape[1:]),

@@ -51,6 +51,7 @@ def _ring_rs_kernel(x_ref, o_ref, bufs, send_sems, recv_sems, *, axis: str):
     def chunk(idx):
         return pl.ds(idx * m_per, m_per)
 
+    dl.barrier_all(axis)  # peers' bufs must exist before any put
     dmas = []
     for s in range(n - 1):
         send_chunk = jax.lax.rem(me - 1 - s + 2 * n, n)

@@ -27,6 +27,23 @@ def next_collective_id() -> int:
     return next(_collective_ids)
 
 
+# VMEM-resident comm kernels (payload + peer slots all on-chip) are only
+# selected by AUTO below this per-device payload size; larger payloads
+# fall back to the XLA collective, which tiles through HBM. (Future:
+# HBM-chunked ring kernels lift this ceiling.)
+VMEM_COMM_MAX_BYTES = 4 * 1024 * 1024
+
+
+def pick_tile(n: int, preferred: int = 512) -> int:
+    """Largest power-of-two-ish tile dividing ``n`` (shared by the
+    overlap-GEMM context builders; parity: the reference's per-shape tile
+    heuristics in its ``create_*_context`` helpers)."""
+    tile = min(preferred, n)
+    while n % tile:
+        tile //= 2
+    return max(tile, 128 if n % 128 == 0 else 1)
+
+
 def interpret_mode(ctx: DistContext | None = None):
     """Interpret params when not on real TPU (CPU simulator mesh)."""
     if ctx is None:

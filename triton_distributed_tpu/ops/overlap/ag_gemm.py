@@ -45,7 +45,11 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
 from triton_distributed_tpu import language as dl
-from triton_distributed_tpu.ops.common import comm_pallas_call, next_collective_id
+from triton_distributed_tpu.ops.common import (
+    comm_pallas_call,
+    next_collective_id,
+    pick_tile,
+)
 from triton_distributed_tpu.runtime.mesh import DistContext, current_context
 
 _AG_GEMM_COLLECTIVE_ID = next_collective_id()
@@ -69,11 +73,7 @@ def create_ag_gemm_context(
     m_per: int, n_loc: int, k: int, dtype=jnp.bfloat16, tile_n: int | None = None
 ) -> AGGemmConfig:
     """Pick tiles for the shapes (parity: ``create_ag_gemm_context``:489)."""
-    if tile_n is None:
-        tile_n = min(512, n_loc)
-    while n_loc % tile_n:
-        tile_n //= 2
-    return AGGemmConfig(tile_n=max(tile_n, 128 if n_loc % 128 == 0 else 1))
+    return AGGemmConfig(tile_n=pick_tile(n_loc) if tile_n is None else tile_n)
 
 
 def _ag_gemm_kernel(
@@ -99,8 +99,11 @@ def _ag_gemm_kernel(
 
     @pl.when(jnp.logical_and(s == 0, j == 0))
     def _start():
-        # Stage own chunk for immediate compute.
+        # Stage own chunk for immediate compute (overlaps the barrier).
         pltpu.make_async_copy(a_ref, a_vmem.at[0], load_sems.at[0]).start()
+        # Entry barrier: peers' ws outputs must be allocated before any
+        # remote write lands.
+        dl.barrier_all(axis)
         # Copy own chunk into the workspace and push it to every peer
         # (slot index = source rank, so consumers wait per-chunk).
         for i in range(1, n):

@@ -29,7 +29,11 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
 from triton_distributed_tpu import language as dl
-from triton_distributed_tpu.ops.common import comm_pallas_call, next_collective_id
+from triton_distributed_tpu.ops.common import (
+    comm_pallas_call,
+    next_collective_id,
+    pick_tile,
+)
 from triton_distributed_tpu.runtime.mesh import DistContext, current_context
 
 _GEMM_RS_COLLECTIVE_ID = next_collective_id()
@@ -46,11 +50,7 @@ class GemmRSConfig:
 def create_gemm_rs_context(
     m: int, n_out: int, k_loc: int, dtype=jnp.bfloat16, tile_n: int | None = None
 ) -> GemmRSConfig:
-    if tile_n is None:
-        tile_n = min(512, n_out)
-    while n_out % tile_n:
-        tile_n //= 2
-    return GemmRSConfig(tile_n=max(tile_n, 128 if n_out % 128 == 0 else 1))
+    return GemmRSConfig(tile_n=pick_tile(n_out) if tile_n is None else tile_n)
 
 
 def _gemm_rs_kernel(
@@ -87,6 +87,9 @@ def _gemm_rs_kernel(
 
     @pl.when(jnp.logical_and(s == 0, j == 0))
     def _start():
+        # Entry barrier: the first remote put (end of step 0) targets the
+        # right neighbor's ws output, which must already be allocated.
+        dl.barrier_all(axis)
         dma = pltpu.make_async_copy(
             a_ref.at[chunk_rows(a_chunk(0))], a_vmem.at[0], load_sems.at[0]
         )
