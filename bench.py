@@ -1,78 +1,101 @@
 """Headline benchmark — prints ONE JSON line.
 
-Run on real TPU hardware by the driver. Reports the flagship end-to-end
-number (currently: fused TP-MLP-shape GEMM throughput on one chip; will
-become the Qwen3 TP decode step as the stack widens — see BASELINE.md).
+Flagship number: Qwen3-0.6B bf16 single-chip decode step latency
+(bs=1, 512-token context), the chip-local analog of the quantity the
+reference headlines for its TP8 decode ladder
+(``docs/mega_triton_kernel.md:27-37`` — torch / cudagraph /
+triton_dist_AR / megakernel ms-per-step). Multi-chip TP isn't measurable
+on this one-chip runner, so:
 
-``vs_baseline`` is measured TFLOP/s divided by the chip's bf16 peak — the
-same "fraction of roofline" framing the reference uses for its overlap
-efficiency charts (README.md:190-209).
+``vs_baseline`` = achieved HBM bandwidth fraction of the chip's peak —
+decode is bandwidth-bound (weights + KV streamed once per token), the
+decode analog of the reference's "fraction of comm hidden" roofline
+framing (README.md:190-209).
+
+Timing notes (axon relay): ``block_until_ready`` resolves early and
+identical executions are memoized, so all decode steps are chained
+inside ONE jit via ``lax.fori_loop`` (data-dependent greedy feedback)
+and fenced by fetching the final token to host.
 """
 
 import json
 import sys
+import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-
-# bf16 matmul peak TFLOP/s per chip (v5e ≈ 197, v5p ≈ 459, v4 ≈ 275).
-_PEAK_TFLOPS = {
-    "v5 lite": 197.0,
-    "v5e": 197.0,
-    "v5p": 459.0,
-    "v4": 275.0,
-    "v6 lite": 918.0,
-    "v6e": 918.0,
+# HBM peak GB/s per chip.
+_PEAK_GBS = {
+    "v5 lite": 819.0,
+    "v5e": 819.0,
+    "v5p": 2765.0,
+    "v4": 1228.0,
+    "v6 lite": 1640.0,
+    "v6e": 1640.0,
 }
 
 
-def chip_peak_tflops() -> float:
+def chip_peak_gbs() -> float:
     kind = jax.devices()[0].device_kind.lower()
-    for key, val in _PEAK_TFLOPS.items():
+    for key, val in _PEAK_GBS.items():
         if key in kind:
             return val
-    return 197.0
+    return 819.0
 
 
 def main() -> None:
-    import functools
-    import time
+    from triton_distributed_tpu.models import AutoLLM
+    from triton_distributed_tpu.runtime.mesh import initialize_distributed
 
-    import numpy as np
+    ctx = initialize_distributed(tp=1, devices=jax.devices()[:1])
+    model = AutoLLM.from_pretrained("Qwen/Qwen3-0.6B", ctx=ctx, max_length=1024)
+    cfg = model.cfg
 
-    # Qwen3-8B-ish TP GEMM shape. Timing notes: through the axon relay,
-    # ``block_until_ready`` resolves early and identical executions are
-    # memoized, so we (a) chain iterations with a data dependency inside one
-    # jit and (b) fence by fetching a scalar to host.
-    M, K, N = 4096, 4096, 4096
-    ITERS = 64
-    key = jax.random.key(0)
-    a = (jax.random.normal(key, (M, K), jnp.float32) * 0.01).astype(jnp.bfloat16)
-    b = (jax.random.normal(key, (K, N), jnp.float32) * 0.01).astype(jnp.bfloat16)
+    PROMPT, STEPS = 512, 32
+    cache = model.new_cache(1)
+    tokens = jnp.asarray(np.arange(PROMPT) % cfg.vocab_size, jnp.int32)
+    logits, cache = model.prefill(tokens, cache, "xla")
+    tok = jnp.argmax(logits)[None].astype(jnp.int32)
 
-    @functools.partial(jax.jit, static_argnums=2)
-    def chain(a, b, iters):
-        def body(i, a):
-            return jnp.dot(a, b, preferred_element_type=jnp.bfloat16)
-        return jax.lax.fori_loop(0, iters, body, a)[0, 0]
+    step = model.decode_fn("xla")
 
-    np.asarray(chain(a, b, ITERS))  # compile + warm
+    def decode_n(params, tok, cache, n):
+        def body(_, carry):
+            tok, cache = carry
+            logits, cache = step(params, tok, cache)
+            return jnp.argmax(logits, -1).astype(jnp.int32), cache
+
+        return jax.lax.fori_loop(0, n, body, (tok, cache))
+
+    run = jax.jit(decode_n, static_argnums=3)
+    out_tok, _ = run(model.params, tok, cache, STEPS)
+    np.asarray(out_tok)  # compile + warm
     best = float("inf")
     for _ in range(3):
         t0 = time.perf_counter()
-        np.asarray(chain(a, b, ITERS))
-        best = min(best, (time.perf_counter() - t0) / ITERS)
+        out_tok, _ = run(model.params, tok, cache, STEPS)
+        np.asarray(out_tok)
+        best = min(best, (time.perf_counter() - t0) / STEPS)
     ms = best * 1e3
-    tflops = 2 * M * K * N / (ms * 1e-3) / 1e12
-    peak = chip_peak_tflops()
+
+    # Bandwidth roofline: weights read once per step + KV context read.
+    param_bytes = sum(
+        x.size * x.dtype.itemsize for x in jax.tree.leaves(model.params)
+    )
+    kv_bytes = (
+        2 * cfg.num_layers * cfg.num_kv_heads * PROMPT * cfg.head_dim
+        * jnp.dtype(cfg.dtype).itemsize
+    )
+    gbs = (param_bytes + kv_bytes) / (ms * 1e-3) / 1e9
     print(
         json.dumps(
             {
-                "metric": "tp_mlp_gemm_bf16_tflops",
-                "value": round(tflops, 2),
-                "unit": "TFLOP/s",
-                "vs_baseline": round(tflops / peak, 4),
+                "metric": "qwen3_0.6b_decode_ms_per_step",
+                "value": round(ms, 3),
+                "unit": "ms",
+                "vs_baseline": round(gbs / chip_peak_gbs(), 4),
             }
         )
     )

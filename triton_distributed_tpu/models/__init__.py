@@ -1,0 +1,87 @@
+"""Models + serving (parity: reference ``python/triton_dist/models/``).
+
+``AutoLLM`` mirrors ``models/__init__.py:32-48`` — dispatch by model
+name/config to Qwen3 dense or MoE, loading HF weights when a checkpoint
+directory is given and random-initializing otherwise (the reference's
+perf scripts also run on random weights).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+
+from triton_distributed_tpu.models.config import ModelConfig, get_config  # noqa: F401
+from triton_distributed_tpu.models.engine import Engine  # noqa: F401
+from triton_distributed_tpu.models.kv_cache import KVCache, init_cache  # noqa: F401
+from triton_distributed_tpu.models.qwen import (  # noqa: F401
+    Qwen3,
+    Qwen3Params,
+    load_hf_state_dict,
+)
+from triton_distributed_tpu.runtime.mesh import DistContext, current_context
+
+
+class AutoLLM:
+    """Parity: ``AutoLLM.from_pretrained`` (reference
+    ``models/__init__.py:32-48``)."""
+
+    @staticmethod
+    def from_pretrained(
+        name_or_path: str,
+        *,
+        ctx: DistContext | None = None,
+        axis: str = "tp",
+        seed: int = 0,
+        **overrides,
+    ) -> Qwen3:
+        ctx = ctx or current_context()
+        if os.path.isdir(name_or_path):
+            cfg, state = _load_hf_checkpoint(name_or_path, **overrides)
+            model = Qwen3(cfg, axis=axis, ctx=ctx)
+            n = ctx.axis_size(axis)
+            model.set_params(load_hf_state_dict(cfg, state, n))
+            return model
+        cfg = get_config(name_or_path, **overrides)
+        if cfg.num_experts:
+            raise NotImplementedError(
+                "MoE model construction lands with the EP stack"
+            )
+        model = Qwen3(cfg, axis=axis, ctx=ctx)
+        model.init_params(jax.random.key(seed))
+        return model
+
+
+def _load_hf_checkpoint(path: str, **overrides):
+    """Read config.json + *.safetensors from a local HF checkpoint dir."""
+    with open(os.path.join(path, "config.json")) as f:
+        hf = json.load(f)
+    cfg = ModelConfig(
+        model_name=hf.get("_name_or_path", path),
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        intermediate_size=hf["intermediate_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_q_heads=hf["num_attention_heads"],
+        num_kv_heads=hf["num_key_value_heads"],
+        head_dim=hf.get(
+            "head_dim", hf["hidden_size"] // hf["num_attention_heads"]
+        ),
+        rope_theta=hf.get("rope_theta", 1e6),
+        rms_eps=hf.get("rms_norm_eps", 1e-6),
+        tie_word_embeddings=hf.get("tie_word_embeddings", False),
+        **overrides,
+    )
+    from safetensors import safe_open
+
+    state = {}
+    for fname in sorted(os.listdir(path)):
+        if fname.endswith(".safetensors"):
+            with safe_open(os.path.join(path, fname), framework="np") as f:
+                for key in f.keys():
+                    state[key] = f.get_tensor(key)
+    if not state:
+        raise FileNotFoundError(f"no .safetensors files under {path}")
+    return cfg, state

@@ -1,0 +1,82 @@
+"""Model configs (parity: reference ``models/config.py:31`` ModelConfig).
+
+The reference keys everything off an HF model name and reads the
+architecture from HF configs at load time; here the architecture fields
+are explicit (JAX builds the program from static shapes) with presets for
+the model families the reference ships (Qwen3 dense + MoE,
+``models/__init__.py:32-48``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    model_name: str = "Qwen/Qwen3-8B"
+    vocab_size: int = 151936
+    hidden_size: int = 4096
+    intermediate_size: int = 12288
+    num_layers: int = 36
+    num_q_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    rope_theta: float = 1e6
+    rms_eps: float = 1e-6
+    tie_word_embeddings: bool = False
+    # MoE (0 experts = dense)
+    num_experts: int = 0
+    num_experts_per_tok: int = 0
+    moe_intermediate_size: int = 0
+    # runtime
+    max_length: int = 4096
+    dtype: jnp.dtype = jnp.bfloat16
+
+
+# Architecture presets (numbers from the public HF configs the reference
+# loads via AutoLLM; reference models/__init__.py:32-48).
+_PRESETS: dict[str, dict] = {
+    "Qwen/Qwen3-0.6B": dict(
+        hidden_size=1024, intermediate_size=3072, num_layers=28,
+        num_q_heads=16, num_kv_heads=8, head_dim=128,
+        tie_word_embeddings=True,
+    ),
+    "Qwen/Qwen3-8B": dict(
+        hidden_size=4096, intermediate_size=12288, num_layers=36,
+        num_q_heads=32, num_kv_heads=8, head_dim=128,
+    ),
+    "Qwen/Qwen3-32B": dict(
+        hidden_size=5120, intermediate_size=25600, num_layers=64,
+        num_q_heads=64, num_kv_heads=8, head_dim=128,
+    ),
+    "Qwen/Qwen3-30B-A3B": dict(
+        hidden_size=2048, intermediate_size=6144, num_layers=48,
+        num_q_heads=32, num_kv_heads=4, head_dim=128,
+        num_experts=128, num_experts_per_tok=8, moe_intermediate_size=768,
+    ),
+    # Tiny configs for tests / CPU-simulator runs.
+    "tiny": dict(
+        vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_q_heads=8, num_kv_heads=4, head_dim=32, max_length=128,
+        dtype=jnp.float32,
+    ),
+    "tiny-moe": dict(
+        vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_q_heads=8, num_kv_heads=4, head_dim=32, max_length=128,
+        num_experts=8, num_experts_per_tok=2, moe_intermediate_size=64,
+        dtype=jnp.float32,
+    ),
+}
+
+
+def get_config(model_name: str, **overrides) -> ModelConfig:
+    if model_name not in _PRESETS:
+        raise ValueError(
+            f"unknown model {model_name!r}; presets: {sorted(_PRESETS)}"
+        )
+    fields = dict(_PRESETS[model_name])
+    fields.update(overrides)
+    return ModelConfig(model_name=model_name, **fields)

@@ -1,0 +1,57 @@
+"""KV cache (parity: reference ``models/kv_cache.py:29`` ``KV_Cache``).
+
+Functional pytree: ``k/v [L, B, Hkv_loc, S_max, hd]`` with heads sharded
+over ``tp`` (TP attention owns whole sequences of its local heads) and a
+shared ``kv_len [B]`` offset — the analog of the reference's
+``update_kv_cache``/``inc_offset`` torch buffers, but immutable so the
+jitted decode step can donate + thread it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.models.config import ModelConfig
+from triton_distributed_tpu.runtime.mesh import DistContext
+
+
+@dataclasses.dataclass
+class KVCache:
+    k: jax.Array  # [L, B, Hkv(_loc), S_max, hd]
+    v: jax.Array
+    kv_len: jax.Array  # [B] int32 — tokens currently cached
+
+
+jax.tree_util.register_dataclass(KVCache, ["k", "v", "kv_len"], [])
+
+
+def init_cache(
+    cfg: ModelConfig,
+    batch_size: int,
+    ctx: DistContext,
+    axis: str = "tp",
+    max_length: int | None = None,
+) -> KVCache:
+    """Allocate the sharded cache (parity: ``KV_Cache.__init__``)."""
+    s_max = max_length or cfg.max_length
+    shape = (cfg.num_layers, batch_size, cfg.num_kv_heads, s_max, cfg.head_dim)
+    spec = (None, None, axis, None, None)
+    return KVCache(
+        k=ctx.shard(jnp.zeros(shape, cfg.dtype), *spec),
+        v=ctx.shard(jnp.zeros(shape, cfg.dtype), *spec),
+        kv_len=ctx.replicate(jnp.zeros((batch_size,), jnp.int32)),
+    )
+
+
+def cache_specs(axis: str = "tp"):
+    """shard_map PartitionSpecs matching :func:`init_cache`."""
+    from jax.sharding import PartitionSpec as P
+
+    return KVCache(
+        k=P(None, None, axis, None, None),
+        v=P(None, None, axis, None, None),
+        kv_len=P(),
+    )

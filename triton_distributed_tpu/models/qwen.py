@@ -1,0 +1,349 @@
+"""Qwen3 (dense) tensor-parallel model.
+
+Parity: reference ``models/qwen.py`` — ``Qwen3`` with per-layer fwd modes
+``torch`` / ``triton_dist`` / ``triton_dist_AR`` (:84-96), prefill
+``inference``:209 and the decode path driven by ``Engine``
+(``models/engine.py``). Weight names follow the HF Qwen3 checkpoint so
+:func:`load_hf_state_dict` maps 1:1.
+
+TPU design: the whole forward is ONE per-shard SPMD program under
+``shard_map`` + ``jax.jit`` — every device runs the same trace on its
+weight shards (column/row-parallel), the analog of the reference's
+one-process-per-GPU torchrun SPMD. Layers are stacked on a leading L axis
+and driven by ``lax.scan`` (one compile for all layers); the jitted,
+donated decode step is the CUDA-graph analog (``engine.py:75-105``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Literal
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu.layers.tp_attn import (
+    TPAttnDims,
+    TPAttnParams,
+    tp_attn_decode,
+    tp_attn_prefill,
+)
+from triton_distributed_tpu.layers.tp_mlp import TPMLPParams, tp_mlp_fwd
+from triton_distributed_tpu.models.config import ModelConfig
+from triton_distributed_tpu.models.kv_cache import KVCache, cache_specs, init_cache
+from triton_distributed_tpu.runtime.mesh import DistContext, current_context
+
+Mode = Literal["xla", "pallas"]
+
+
+@dataclasses.dataclass
+class Qwen3LayerParams:
+    ln1: jax.Array  # [d] input_layernorm
+    attn: TPAttnParams
+    ln2: jax.Array  # [d] post_attention_layernorm
+    mlp: TPMLPParams
+
+
+@dataclasses.dataclass
+class Qwen3Params:
+    embed: jax.Array    # [V, d] replicated
+    layers: Qwen3LayerParams  # leaves stacked with leading [L, ...]
+    norm: jax.Array     # [d]
+    lm_head: jax.Array  # [d, V] column-sharded
+
+
+for _cls, _fields in ((Qwen3LayerParams, ["ln1", "attn", "ln2", "mlp"]),
+                      (Qwen3Params, ["embed", "layers", "norm", "lm_head"])):
+    jax.tree_util.register_dataclass(_cls, _fields, [])
+
+
+def rms_norm(x: jax.Array, w: jax.Array, eps: float = 1e-6) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    xf = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * w.astype(jnp.float32)).astype(x.dtype)
+
+
+class Qwen3:
+    """Host-level model wrapper (parity: reference ``Qwen3``,
+    ``models/qwen.py``). Holds sharded params + jitted SPMD programs."""
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        *,
+        axis: str = "tp",
+        ctx: DistContext | None = None,
+    ):
+        self.cfg = cfg
+        self.ctx = ctx or current_context()
+        self.axis = axis
+        n = self.ctx.axis_size(axis)
+        if cfg.num_q_heads % n or cfg.num_kv_heads % n:
+            raise ValueError(f"heads not divisible by tp={n}")
+        if cfg.intermediate_size % n:
+            raise ValueError(f"d_ff not divisible by tp={n}")
+        self.dims = TPAttnDims(
+            hq_loc=cfg.num_q_heads // n,
+            hkv_loc=cfg.num_kv_heads // n,
+            head_dim=cfg.head_dim,
+            rope_theta=cfg.rope_theta,
+        )
+        self.params: Qwen3Params | None = None
+        self._decode_jit: dict = {}
+        self._prefill_jit: dict = {}
+
+    # -- parameter construction ------------------------------------------
+    @property
+    def param_specs(self) -> Qwen3Params:
+        ax = self.axis
+        return Qwen3Params(
+            embed=P(),
+            layers=Qwen3LayerParams(
+                ln1=P(),
+                attn=TPAttnParams(
+                    wqkv=P(None, None, ax), wo=P(None, ax, None),
+                    q_norm=P(), k_norm=P(),
+                ),
+                ln2=P(),
+                mlp=TPMLPParams(w1=P(None, None, ax), w2=P(None, ax, None)),
+            ),
+            norm=P(),
+            lm_head=P(None, ax),
+        )
+
+    def init_params(self, key: jax.Array) -> Qwen3Params:
+        """Random init (tests/benchmarks; parity: the reference's
+        ``rand_fill`` paths used in its perf scripts)."""
+        cfg = self.cfg
+        n = self.ctx.axis_size(self.axis)
+        hd, d = cfg.head_dim, cfg.hidden_size
+        L = cfg.num_layers
+        ks = iter(jax.random.split(key, 9))
+        dt = cfg.dtype
+
+        def rnd(k, *shape, scale=None):
+            scale = scale if scale is not None else shape[-2] ** -0.5
+            return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dt)
+
+        # Fused qkv, laid out per shard [q_loc | k_loc | v_loc].
+        wq = rnd(next(ks), L, d, cfg.num_q_heads * hd)
+        wk = rnd(next(ks), L, d, cfg.num_kv_heads * hd)
+        wv = rnd(next(ks), L, d, cfg.num_kv_heads * hd)
+        wqkv = _fuse_by_shard([wq, wk, wv], n)
+        gate = rnd(next(ks), L, d, cfg.intermediate_size)
+        up = rnd(next(ks), L, d, cfg.intermediate_size)
+        w1 = _fuse_by_shard([gate, up], n)
+        params = Qwen3Params(
+            embed=rnd(next(ks), cfg.vocab_size, d, scale=0.02),
+            layers=Qwen3LayerParams(
+                ln1=jnp.ones((L, d), dt),
+                attn=TPAttnParams(
+                    wqkv=wqkv,
+                    wo=rnd(next(ks), L, cfg.num_q_heads * hd, d),
+                    q_norm=jnp.ones((L, hd), dt),
+                    k_norm=jnp.ones((L, hd), dt),
+                ),
+                ln2=jnp.ones((L, d), dt),
+                mlp=TPMLPParams(w1=w1, w2=rnd(next(ks), L, cfg.intermediate_size, d)),
+            ),
+            norm=jnp.ones((d,), dt),
+            lm_head=rnd(next(ks), d, cfg.vocab_size),
+        )
+        return self.set_params(params)
+
+    def set_params(self, params: Qwen3Params) -> Qwen3Params:
+        self.params = jax.tree.map(
+            lambda x, s: jax.device_put(x, self.ctx.sharding(*s)),
+            params,
+            self.param_specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        return self.params
+
+    # -- per-shard forward bodies ----------------------------------------
+    def _embed(self, params: Qwen3Params, tokens: jax.Array) -> jax.Array:
+        return jnp.take(params.embed, tokens, axis=0)
+
+    def _logits(self, params: Qwen3Params, x: jax.Array) -> jax.Array:
+        """[B, d] → full logits [B, V] (lm_head column-sharded + gather)."""
+        loc = jnp.dot(
+            x, params.lm_head, preferred_element_type=jnp.float32
+        )
+        return jax.lax.all_gather(loc, self.axis, axis=1, tiled=True)
+
+    def _decode_shard(self, params, tokens, cache: KVCache, *, mode: Mode):
+        """One decode step, per-shard: ``tokens [B]`` → logits [B, V]."""
+        cfg = self.cfg
+        x = self._embed(params, tokens)
+        ar = "pallas_ar" if mode == "pallas" else "xla_ar"
+
+        def layer_fn(carry, inp):
+            x = carry
+            lp, kc, vc = inp
+            h = rms_norm(x, lp.ln1, cfg.rms_eps)
+            a, kc, vc = tp_attn_decode(
+                lp.attn, h, kc, vc, cache.kv_len, self.dims,
+                axis=self.axis, mode=ar, ctx=self.ctx,
+            )
+            x = x + a
+            h = rms_norm(x, lp.ln2, cfg.rms_eps)
+            x = x + tp_mlp_fwd(lp.mlp, h, axis=self.axis, mode=ar, ctx=self.ctx)
+            return x, (kc, vc)
+
+        x, (k_new, v_new) = jax.lax.scan(
+            layer_fn, x, (params.layers, cache.k, cache.v)
+        )
+        x = rms_norm(x, params.norm, cfg.rms_eps)
+        logits = self._logits(params, x)
+        return logits, KVCache(k=k_new, v=v_new, kv_len=cache.kv_len + 1)
+
+    def _prefill_shard(self, params, tokens, cache: KVCache, *, mode: Mode):
+        """Prefill one sequence (batch entry 0), per-shard.
+
+        ``tokens [s_loc]`` is this device's sequence slice; activations
+        stay sequence-sharded through all layers (ag_gemm gathers rows on
+        the fly — reference ``dist_triton_fwd`` layout). Returns last-token
+        logits [V] and the filled cache.
+        """
+        cfg = self.cfg
+        n = self.ctx.axis_size(self.axis)
+        me = jax.lax.axis_index(self.axis)
+        x = self._embed(params, tokens)  # [s_loc, d]
+
+        def layer_fn(carry, inp):
+            x = carry
+            lp, kc, vc = inp  # kc/vc: [B, hkv_loc, S_max, hd] layer slice
+            h = rms_norm(x, lp.ln1, cfg.rms_eps)
+            a, k_full, v_full = tp_attn_prefill(
+                lp.attn, h, self.dims, axis=self.axis, mode=mode, ctx=self.ctx
+            )
+            # k_full [hkv_loc, S, hd] → cache entry 0, positions [0, S).
+            kc = jax.lax.dynamic_update_slice(
+                kc, k_full.swapaxes(0, 1)[None].swapaxes(1, 2).astype(kc.dtype),
+                (0, 0, 0, 0),
+            )
+            vc = jax.lax.dynamic_update_slice(
+                vc, v_full.swapaxes(0, 1)[None].swapaxes(1, 2).astype(vc.dtype),
+                (0, 0, 0, 0),
+            )
+            x = x + a
+            h = rms_norm(x, lp.ln2, cfg.rms_eps)
+            x = x + tp_mlp_fwd(lp.mlp, h, axis=self.axis, mode=mode, ctx=self.ctx)
+            return x, (kc, vc)
+
+        x, (k_new, v_new) = jax.lax.scan(
+            layer_fn, x, (params.layers, cache.k, cache.v)
+        )
+        x = rms_norm(x, params.norm, cfg.rms_eps)
+        # Last token lives on the last rank's shard; broadcast it.
+        last = jnp.where(me == n - 1, 1.0, 0.0).astype(jnp.float32)
+        x_last = jax.lax.psum(x[-1].astype(jnp.float32) * last, self.axis)
+        logits = self._logits(params, x_last[None].astype(x.dtype))[0]
+        s = tokens.shape[0] * n
+        kv_len = cache.kv_len.at[0].set(s)
+        return logits, KVCache(k=k_new, v=v_new, kv_len=kv_len)
+
+    # -- jitted SPMD entry points ----------------------------------------
+    def decode_fn(self, mode: Mode = "xla"):
+        """The un-jitted shard_map'd step ``(params, tokens, cache) →
+        (logits, cache)`` — composable inside callers' own jit/scan
+        (bench chains steps through ``lax.fori_loop``)."""
+        return self.ctx.shard_map(
+            functools.partial(self._decode_shard, mode=mode),
+            in_specs=(self.param_specs, P(), cache_specs(self.axis)),
+            out_specs=(P(), cache_specs(self.axis)),
+        )
+
+    def decode_step(self, tokens: jax.Array, cache: KVCache, mode: Mode = "xla"):
+        """Jitted one-token step for the whole batch (CUDA-graph analog).
+        ``tokens [B]`` int32 → ``(logits [B, V] f32, cache)``."""
+        if mode not in self._decode_jit:
+            f = self.decode_fn(mode)
+            self._decode_jit[mode] = jax.jit(
+                lambda p, t, c: f(p, t, c), donate_argnums=(2,)
+            )
+        return self._decode_jit[mode](self.params, tokens, cache)
+
+    def prefill(self, tokens: jax.Array, cache: KVCache, mode: Mode = "xla"):
+        """Prefill one sequence (``tokens [S]``, S divisible by tp).
+        Returns (last-token logits [V], cache with entry 0 filled)."""
+        key = (mode, int(tokens.shape[0]))
+        if key not in self._prefill_jit:
+            f = self.ctx.shard_map(
+                functools.partial(self._prefill_shard, mode=mode),
+                in_specs=(self.param_specs, P(self.axis), cache_specs(self.axis)),
+                out_specs=(P(), cache_specs(self.axis)),
+            )
+            self._prefill_jit[key] = jax.jit(
+                lambda p, t, c: f(p, t, c), donate_argnums=(2,)
+            )
+        return self._prefill_jit[key](self.params, tokens, cache)
+
+    def new_cache(self, batch_size: int, max_length: int | None = None) -> KVCache:
+        return init_cache(
+            self.cfg, batch_size, self.ctx, self.axis, max_length
+        )
+
+
+def _fuse_by_shard(parts: list[jax.Array], n: int) -> jax.Array:
+    """Stack column-parallel weights so each device shard is the
+    concatenation of its slice of every part: ``[L, d, sum(cols)]`` with
+    per-shard layout ``[p0_loc | p1_loc | ...]``."""
+    L, d = parts[0].shape[:2]
+    split = [p.reshape(L, d, n, p.shape[2] // n) for p in parts]
+    fused = jnp.concatenate(split, axis=3)  # [L, d, n, sum_loc]
+    return fused.reshape(L, d, fused.shape[2] * fused.shape[3])
+
+
+def load_hf_state_dict(cfg: ModelConfig, state: dict, n: int) -> Qwen3Params:
+    """Map an HF Qwen3 state dict (numpy/jnp arrays, torch layout
+    ``weight [out, in]``) to :class:`Qwen3Params` (parity: reference
+    weight loading, ``models/qwen.py:147-165``)."""
+    L = cfg.num_layers
+
+    def get(name):
+        return jnp.asarray(state[name]).astype(cfg.dtype)
+
+    def stack(fmt, transpose=True):
+        ws = [get(fmt.format(i)) for i in range(L)]
+        ws = [w.T if transpose else w for w in ws]
+        return jnp.stack(ws)
+
+    wq = stack("model.layers.{}.self_attn.q_proj.weight")
+    wk = stack("model.layers.{}.self_attn.k_proj.weight")
+    wv = stack("model.layers.{}.self_attn.v_proj.weight")
+    gate = stack("model.layers.{}.mlp.gate_proj.weight")
+    up = stack("model.layers.{}.mlp.up_proj.weight")
+    embed = get("model.embed_tokens.weight")
+    lm_head = (
+        embed.T
+        if cfg.tie_word_embeddings
+        else get("lm_head.weight").T
+    )
+    return Qwen3Params(
+        embed=embed,
+        layers=Qwen3LayerParams(
+            ln1=stack("model.layers.{}.input_layernorm.weight", transpose=False),
+            attn=TPAttnParams(
+                wqkv=_fuse_by_shard([wq, wk, wv], n),
+                wo=stack("model.layers.{}.self_attn.o_proj.weight"),
+                q_norm=stack(
+                    "model.layers.{}.self_attn.q_norm.weight", transpose=False
+                ),
+                k_norm=stack(
+                    "model.layers.{}.self_attn.k_norm.weight", transpose=False
+                ),
+            ),
+            ln2=stack(
+                "model.layers.{}.post_attention_layernorm.weight", transpose=False
+            ),
+            mlp=TPMLPParams(
+                w1=_fuse_by_shard([gate, up], n),
+                w2=stack("model.layers.{}.mlp.down_proj.weight"),
+            ),
+        ),
+        norm=get("model.norm.weight"),
+        lm_head=lm_head,
+    )

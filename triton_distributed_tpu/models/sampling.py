@@ -1,0 +1,36 @@
+"""Token sampling (parity: reference ``models/utils.py`` sampling helpers
+— greedy, temperature, top-p nucleus)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def greedy(logits: jax.Array) -> jax.Array:
+    """``logits [..., V]`` → token ids ``[...]``."""
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def sample(
+    logits: jax.Array,
+    key: jax.Array,
+    temperature: float = 1.0,
+    top_p: float = 1.0,
+) -> jax.Array:
+    """Temperature + nucleus sampling. ``temperature<=0`` → greedy."""
+    if temperature <= 0.0:
+        return greedy(logits)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Keep the smallest prefix with cumulative prob >= top_p (always
+        # keep the top token).
+        keep = cum - probs < top_p
+        cutoff = jnp.min(
+            jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits >= cutoff, logits, -jnp.inf)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
