@@ -1,0 +1,74 @@
+"""Sequence-parallel GQA flash-decode attention layer.
+
+Parity: reference ``layers/nvidia/sp_flash_decode_layer.py`` —
+``SpGQAFlashDecodeAttention.forward``:83: the KV cache is sharded across
+ranks along the sequence, each rank attends its shard, and partials are
+combined cross-rank (``flash_decode.py:482``), scaling decode with the
+mesh instead of replicating the cache.
+
+TPU design: cache shard ``[B, hkv, s_loc, hd]`` per device along the
+``sp`` axis in rank order; the new token's K/V is appended by whichever
+rank owns position ``kv_len``; attention = local split-KV kernel +
+all-gather(partial O, LSE) + log-sum-exp merge.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.ops.attention.flash_decode import (
+    distributed_flash_decode,
+)
+
+
+def sp_append_kv(
+    cache: jax.Array,  # [B, h, s_loc, hd] — this rank's sequence slice
+    new: jax.Array,    # [B, h, hd] — replicated new-token K or V
+    kv_len: jax.Array,  # [B] int32 GLOBAL positions to write
+    axis: str = "sp",
+) -> jax.Array:
+    """Write ``new`` at global position ``kv_len[b]`` — a no-op on every
+    rank but the owner of that position."""
+    me = jax.lax.axis_index(axis)
+    s_loc = cache.shape[2]
+    local = kv_len - me * s_loc
+    owner = jnp.logical_and(local >= 0, local < s_loc)
+    safe = jnp.clip(local, 0, s_loc - 1)
+
+    def one(c, x, p, ok):  # c [h, s_loc, hd]
+        upd = jax.lax.dynamic_update_slice(c, x[:, None, :].astype(c.dtype),
+                                           (0, p, 0))
+        return jnp.where(ok, upd, c)
+
+    return jax.vmap(one)(cache, new, safe, owner)
+
+
+def sp_decode_attention(
+    q: jax.Array,        # [B, hq, hd] replicated
+    k_new: jax.Array,    # [B, hkv, hd] replicated
+    v_new: jax.Array,
+    k_cache: jax.Array,  # [B, hkv, s_loc, hd] — sequence shard
+    v_cache: jax.Array,
+    kv_len: jax.Array,   # [B] int32 GLOBAL context length (before append)
+    *,
+    axis: str = "sp",
+    sm_scale: float | None = None,
+    chunk_k: int = 256,
+    method: str = "xla",
+    ctx=None,
+):
+    """One SP decode-attention step inside ``shard_map``.
+
+    Appends the new token's K/V to the owning rank's shard, then runs the
+    distributed split-KV attention. Returns ``(o [B, hq, hd] replicated,
+    k_cache, v_cache)`` — parity with
+    ``SpGQAFlashDecodeAttention.forward``.
+    """
+    k_cache = sp_append_kv(k_cache, k_new, kv_len, axis)
+    v_cache = sp_append_kv(v_cache, v_new, kv_len, axis)
+    o = distributed_flash_decode(
+        q, k_cache, v_cache, kv_len + 1,
+        axis=axis, sm_scale=sm_scale, chunk_k=chunk_k, method=method, ctx=ctx,
+    )
+    return o, k_cache, v_cache

@@ -15,3 +15,9 @@ from triton_distributed_tpu.ops.attention.flash_decode import (  # noqa: F401
     gqa_decode_reference,
     distributed_flash_decode,
 )
+from triton_distributed_tpu.ops.attention.sp_ag_attention import (  # noqa: F401
+    sp_ag_attention,
+)
+from triton_distributed_tpu.ops.attention.ring_attention import (  # noqa: F401
+    ring_attention,
+)

@@ -1,0 +1,84 @@
+"""Ring attention over the ICI torus — the idiomatic TPU long-context
+(context-parallel) kernel.
+
+Parity role: the reference fills the SP slot with AG-attention only
+(SURVEY.md §2.3: "CP / ring attention / Ulysses: absent"); ring attention
+is the TPU-native addition the survey calls for (§5) — KV circulates the
+ring via ``ppermute`` (XLA double-buffers the collective-permute against
+compute, the stream-overlap analog) while each device accumulates
+blockwise-softmax partials with its flash-attention kernel, merged by
+log-sum-exp — the same merge the distributed decode uses.
+
+Causal load: chunks from later ranks contribute nothing to earlier
+ranks' queries; they are masked (full lse=-inf partials) rather than
+skipped so every ring step is a static program. A zig-zag sharding (half
+from each sequence end per device) would rebalance — left for a later
+round.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.ops.attention.flash_attention import flash_attention
+from triton_distributed_tpu.ops.attention.flash_decode import lse_combine
+
+
+def ring_attention(
+    q: jax.Array,  # [hq, s_loc, hd] — this device's q shard (rank order)
+    k: jax.Array,  # [hkv, s_loc, hd]
+    v: jax.Array,
+    *,
+    axis: str = "sp",
+    causal: bool = True,
+    sm_scale: float | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+) -> jax.Array:
+    """Causal ring attention inside ``shard_map``; returns [hq, s_loc, hd].
+
+    Uses the Pallas flash kernel per step (LSE out) + ppermute rotation;
+    n steps visit every KV chunk once.
+    """
+    n = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    hq, s_loc, hd = q.shape
+    if sm_scale is None:
+        sm_scale = hd**-0.5
+    perm = [(i, (i + 1) % n) for i in range(n)]  # chunk r hops right
+
+    def step(carry, i):
+        k_cur, v_cur = carry
+        src = jax.lax.rem(me - i + n, n)  # rank that produced this chunk
+        # Block-level mask: src < me → fully visible; src == me → causal
+        # within; src > me → fully masked (future rows).
+        o_i, lse_i = flash_attention(
+            q[None], k_cur[None], v_cur[None],
+            causal=False, sm_scale=sm_scale,
+            block_q=block_q, block_k=block_k, return_lse=True,
+        )
+        if causal:
+            # Recompute own-chunk causal variant and select by src (src is
+            # dynamic, so both variants trace; the causal one only matters
+            # one step out of n — acceptable until zig-zag sharding lands).
+            o_c, lse_c = flash_attention(
+                q[None], k_cur[None], v_cur[None],
+                causal=True, sm_scale=sm_scale,
+                block_q=block_q, block_k=block_k, return_lse=True,
+            )
+            own = src == me
+            visible = src < me
+            o_i = jnp.where(own, o_c, jnp.where(visible, o_i, 0.0))
+            lse_i = jnp.where(
+                own, lse_c, jnp.where(visible, lse_i, -jnp.inf)
+            )
+        k_nxt = jax.lax.ppermute(k_cur, axis, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis, perm)
+        return (k_nxt, v_nxt), (o_i[0].astype(jnp.float32), lse_i[0])
+
+    (_, _), (o_parts, lse_parts) = jax.lax.scan(
+        step, (k, v), jnp.arange(n)
+    )  # o_parts [n, hq, s_loc, hd], lse [n, hq, s_loc]
+    o, _ = lse_combine(o_parts, lse_parts, part_axis=0)
+    return o.astype(q.dtype)

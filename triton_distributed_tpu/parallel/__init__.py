@@ -1,0 +1,12 @@
+"""Parallelism transports and schedules beyond the collective ops.
+
+Parity: reference ``layers/nvidia/p2p.py`` (``CommOp`` pipeline
+transport) — plus, TPU-natively, everything expressed over the mesh axes
+(dp is a sharded leading axis; tp/sp/ep live in ops/ and layers/).
+"""
+
+from triton_distributed_tpu.parallel.p2p import (  # noqa: F401
+    pp_recv_from_prev,
+    pp_send_recv,
+    pp_shift,
+)
