@@ -1,0 +1,163 @@
+"""Tensor-parallel MoE layer (experts' FFN dims sharded over tp).
+
+Parity: reference ``layers/nvidia/tp_moe.py`` — ``TP_MoE``:48 with the
+``dist_triton_fwd`` AG-scatter-groupGEMM → gather-RS pipeline (:237):
+tokens all-gathered, every rank runs ALL experts on its column shard of
+every expert's weights, outputs reduce-scattered back; the router and
+sort mirror ``csrc`` moe_utils.
+
+Modes: ``pallas`` / ``xla`` (prefill, sequence-sharded activations) and
+``pallas_ar`` / ``xla_ar`` (decode, replicated activations + all-reduce).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Literal
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu.ops.collectives.all_gather import all_gather
+from triton_distributed_tpu.ops.collectives.all_reduce import all_reduce
+from triton_distributed_tpu.ops.collectives.reduce_scatter import reduce_scatter
+from triton_distributed_tpu.ops.moe.grouped_gemm import grouped_ffn
+from triton_distributed_tpu.ops.moe.routing import moe_combine, moe_sort, router_topk
+from triton_distributed_tpu.runtime.mesh import DistContext, current_context
+
+Mode = Literal["xla", "pallas", "pallas_ar", "xla_ar"]
+
+
+@dataclasses.dataclass
+class TPMoEParams:
+    w_router: jax.Array  # [d, E] replicated
+    w1: jax.Array        # [E, d, 2*f_loc] — gate|up fused, column shard
+    w2: jax.Array        # [E, f_loc, d] — row shard
+
+
+jax.tree_util.register_dataclass(TPMoEParams, ["w_router", "w1", "w2"], [])
+
+
+def tp_moe_fwd(
+    params: TPMoEParams,
+    x: jax.Array,
+    k: int,
+    *,
+    axis: str = "tp",
+    mode: Mode = "pallas",
+    norm_topk_prob: bool = True,
+    ctx: DistContext | None = None,
+) -> jax.Array:
+    """Per-shard forward inside ``shard_map``.
+
+    Prefill (``x [t_loc, d]`` sequence shard → same): all-gather tokens,
+    route + expert-sort, grouped SwiGLU over every expert's local column
+    shard, weighted combine, reduce-scatter. Decode AR modes take
+    replicated ``x [B, d]``.
+    """
+    num_experts = params.w1.shape[0]
+    seq_mode = mode in ("pallas", "xla")
+    if seq_mode:
+        if mode == "pallas":
+            full = all_gather(x, axis=axis, ctx=ctx)
+        else:
+            full = jax.lax.all_gather(x, axis, axis=0, tiled=True)
+    else:
+        full = x
+    t = full.shape[0]
+
+    route = router_topk(full, params.w_router, k, norm_topk_prob=norm_topk_prob)
+    st = moe_sort(route, num_experts)
+    h = grouped_ffn(full[st.token_ids], params.w1, params.w2, st.group_sizes)
+    part = moe_combine(h, st, t)  # [T, d] — partial (f is sharded)
+
+    if seq_mode:
+        if mode == "pallas":
+            return reduce_scatter(part, axis=axis, ctx=ctx)
+        return jax.lax.psum_scatter(
+            part.astype(jnp.float32), axis, scatter_dimension=0, tiled=True
+        ).astype(x.dtype)
+    if mode == "xla_ar":
+        return jax.lax.psum(part.astype(jnp.float32), axis).astype(x.dtype)
+    return all_reduce(part, axis=axis, ctx=ctx)
+
+
+class TPMoE:
+    """Host-level layer (parity: ``TP_MoE``, ``tp_moe.py:48``)."""
+
+    def __init__(
+        self,
+        d_model: int,
+        d_ff: int,  # per-expert FFN width (moe_intermediate_size)
+        num_experts: int,
+        top_k: int,
+        *,
+        dtype=jnp.bfloat16,
+        axis: str = "tp",
+        ctx: DistContext | None = None,
+    ):
+        self.ctx = ctx or current_context()
+        self.axis = axis
+        n = self.ctx.axis_size(axis)
+        if d_ff % n:
+            raise ValueError(f"moe d_ff {d_ff} not divisible by tp={n}")
+        self.d_model, self.d_ff = d_model, d_ff
+        self.num_experts, self.top_k = num_experts, top_k
+        self.dtype = dtype
+        self.params: TPMoEParams | None = None
+
+    @property
+    def param_specs(self):
+        return TPMoEParams(
+            w_router=P(),
+            w1=P(None, None, self.axis),
+            w2=P(None, self.axis, None),
+        )
+
+    def load(
+        self,
+        w_router: jax.Array,  # [d, E]
+        gate: jax.Array,      # [E, d, f]
+        up: jax.Array,        # [E, d, f]
+        down: jax.Array,      # [E, f, d]
+    ) -> TPMoEParams:
+        n = self.ctx.axis_size(self.axis)
+        e, d, f = gate.shape
+        f_loc = f // n
+        # Fuse gate|up per shard: [E, d, n, 2*f_loc] → [E, d, 2*f].
+        w1 = jnp.concatenate(
+            [gate.reshape(e, d, n, f_loc), up.reshape(e, d, n, f_loc)], axis=3
+        ).reshape(e, d, 2 * f)
+        self.params = TPMoEParams(
+            w_router=self.ctx.replicate(w_router.astype(self.dtype)),
+            w1=self.ctx.shard(w1.astype(self.dtype), None, None, self.axis),
+            w2=self.ctx.shard(down.astype(self.dtype), None, self.axis, None),
+        )
+        return self.params
+
+    def init(self, key: jax.Array) -> TPMoEParams:
+        e, d, f = self.num_experts, self.d_model, self.d_ff
+        ks = jax.random.split(key, 4)
+        s = d**-0.5
+        return self.load(
+            jax.random.normal(ks[0], (d, e), jnp.float32) * s,
+            jax.random.normal(ks[1], (e, d, f), jnp.float32) * s,
+            jax.random.normal(ks[2], (e, d, f), jnp.float32) * s,
+            jax.random.normal(ks[3], (e, f, d), jnp.float32) * (f**-0.5),
+        )
+
+    def forward(self, x: jax.Array, mode: Mode = "pallas") -> jax.Array:
+        assert self.params is not None
+        seq = mode in ("pallas", "xla")
+        xs = P(self.axis, None) if seq else P()
+        f = self.ctx.shard_map(
+            functools.partial(
+                tp_moe_fwd, k=self.top_k, axis=self.axis, mode=mode,
+                ctx=self.ctx,
+            ),
+            in_specs=(self.param_specs, xs),
+            out_specs=xs,
+        )
+        return f(self.params, x)

@@ -46,10 +46,11 @@ class AutoLLM:
             return model
         cfg = get_config(name_or_path, **overrides)
         if cfg.num_experts:
-            raise NotImplementedError(
-                "MoE model construction lands with the EP stack"
-            )
-        model = Qwen3(cfg, axis=axis, ctx=ctx)
+            from triton_distributed_tpu.models.qwen_moe import Qwen3MoE
+
+            model = Qwen3MoE(cfg, axis=axis, ctx=ctx)
+        else:
+            model = Qwen3(cfg, axis=axis, ctx=ctx)
         model.init_params(jax.random.key(seed))
         return model
 

@@ -163,6 +163,10 @@ class Qwen3:
         return self.params
 
     # -- per-shard forward bodies ----------------------------------------
+    def _mlp_fwd(self, mlp_params, h: jax.Array, mode) -> jax.Array:
+        """Per-shard MLP call — overridden by the MoE model."""
+        return tp_mlp_fwd(mlp_params, h, axis=self.axis, mode=mode, ctx=self.ctx)
+
     def _embed(self, params: Qwen3Params, tokens: jax.Array) -> jax.Array:
         return jnp.take(params.embed, tokens, axis=0)
 
@@ -189,7 +193,7 @@ class Qwen3:
             )
             x = x + a
             h = rms_norm(x, lp.ln2, cfg.rms_eps)
-            x = x + tp_mlp_fwd(lp.mlp, h, axis=self.axis, mode=ar, ctx=self.ctx)
+            x = x + self._mlp_fwd(lp.mlp, h, ar)
             return x, (kc, vc)
 
         x, (k_new, v_new) = jax.lax.scan(
@@ -230,7 +234,7 @@ class Qwen3:
             )
             x = x + a
             h = rms_norm(x, lp.ln2, cfg.rms_eps)
-            x = x + tp_mlp_fwd(lp.mlp, h, axis=self.axis, mode=mode, ctx=self.ctx)
+            x = x + self._mlp_fwd(lp.mlp, h, mode)
             return x, (kc, vc)
 
         x, (k_new, v_new) = jax.lax.scan(

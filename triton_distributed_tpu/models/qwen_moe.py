@@ -1,0 +1,152 @@
+"""Qwen3-MoE tensor-parallel model.
+
+Parity: reference ``models/qwen_moe.py`` (206 LoC) — Qwen3 architecture
+with the dense MLP swapped for the top-k routed expert FFN
+(``TP_MoE``-backed, ``tp_moe.py:48``); same attention / norms / decode
+flow as the dense model.
+
+Weights follow HF ``Qwen3MoeForCausalLM`` naming
+(``mlp.gate.weight`` router, ``mlp.experts.N.{gate,up,down}_proj``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu.layers.tp_attn import TPAttnParams
+from triton_distributed_tpu.layers.tp_moe import TPMoEParams, tp_moe_fwd
+from triton_distributed_tpu.models.config import ModelConfig
+from triton_distributed_tpu.models.qwen import (
+    Qwen3,
+    Qwen3LayerParams,
+    Qwen3Params,
+    _fuse_by_shard,
+)
+
+
+class Qwen3MoE(Qwen3):
+    """Dense Qwen3 skeleton with routed-expert MLPs (parity:
+    reference ``Qwen3MoE``)."""
+
+    def _mlp_fwd(self, mlp_params: TPMoEParams, h: jax.Array, mode) -> jax.Array:
+        return tp_moe_fwd(
+            mlp_params, h, self.cfg.num_experts_per_tok,
+            axis=self.axis, mode=mode, ctx=self.ctx,
+        )
+
+    @property
+    def param_specs(self) -> Qwen3Params:
+        specs = super().param_specs
+        specs.layers.mlp = TPMoEParams(
+            w_router=P(),
+            w1=P(None, None, None, self.axis),  # [L, E, d, 2*f]
+            w2=P(None, None, self.axis, None),  # [L, E, f, d]
+        )
+        return specs
+
+    def init_params(self, key: jax.Array) -> Qwen3Params:
+        cfg = self.cfg
+        if not cfg.num_experts:
+            raise ValueError("Qwen3MoE needs cfg.num_experts > 0")
+        n = self.ctx.axis_size(self.axis)
+        L, d = cfg.num_layers, cfg.hidden_size
+        e, f = cfg.num_experts, cfg.moe_intermediate_size
+        ks = iter(jax.random.split(key, 12))
+        dt = cfg.dtype
+
+        def rnd(kk, *shape, scale):
+            return (jax.random.normal(kk, shape, jnp.float32) * scale).astype(dt)
+
+        hd = cfg.head_dim
+        wq = rnd(next(ks), L, d, cfg.num_q_heads * hd, scale=d**-0.5)
+        wk = rnd(next(ks), L, d, cfg.num_kv_heads * hd, scale=d**-0.5)
+        wv = rnd(next(ks), L, d, cfg.num_kv_heads * hd, scale=d**-0.5)
+        gate = rnd(next(ks), L, e, d, f, scale=d**-0.5)
+        up = rnd(next(ks), L, e, d, f, scale=d**-0.5)
+        w1 = jnp.concatenate(
+            [
+                gate.reshape(L, e, d, n, f // n),
+                up.reshape(L, e, d, n, f // n),
+            ],
+            axis=4,
+        ).reshape(L, e, d, 2 * f)
+        params = Qwen3Params(
+            embed=rnd(next(ks), cfg.vocab_size, d, scale=0.02),
+            layers=Qwen3LayerParams(
+                ln1=jnp.ones((L, d), dt),
+                attn=TPAttnParams(
+                    wqkv=_fuse_by_shard([wq, wk, wv], n),
+                    wo=rnd(next(ks), L, cfg.num_q_heads * hd, d,
+                           scale=(cfg.num_q_heads * hd) ** -0.5),
+                    q_norm=jnp.ones((L, hd), dt),
+                    k_norm=jnp.ones((L, hd), dt),
+                ),
+                ln2=jnp.ones((L, d), dt),
+                mlp=TPMoEParams(
+                    w_router=rnd(next(ks), L, d, e, scale=d**-0.5),
+                    w1=w1,
+                    w2=rnd(next(ks), L, e, f, d, scale=f**-0.5),
+                ),
+            ),
+            norm=jnp.ones((d,), dt),
+            lm_head=rnd(next(ks), d, cfg.vocab_size, scale=d**-0.5),
+        )
+        return self.set_params(params)
+
+
+def load_hf_moe_state_dict(
+    cfg: ModelConfig, state: dict, n: int
+) -> Qwen3Params:
+    """Map an HF Qwen3-MoE state dict to :class:`Qwen3Params` with
+    :class:`TPMoEParams` MLP leaves."""
+    from triton_distributed_tpu.models.qwen import load_hf_state_dict
+
+    L, e, f, d = (
+        cfg.num_layers, cfg.num_experts, cfg.moe_intermediate_size,
+        cfg.hidden_size,
+    )
+
+    def get(name):
+        return jnp.asarray(state[name]).astype(cfg.dtype)
+
+    # Reuse the dense loader for everything but the MLP by synthesizing
+    # dense-shaped placeholders, then overwrite the MLP leaves.
+    dense_state = dict(state)
+    zero = jnp.zeros((1, d), cfg.dtype)
+    for i in range(L):
+        p = f"model.layers.{i}.mlp."
+        dense_state[p + "gate_proj.weight"] = zero
+        dense_state[p + "up_proj.weight"] = zero
+        dense_state[p + "down_proj.weight"] = zero.T
+    params = load_hf_state_dict(cfg, dense_state, n)
+
+    routers, w1s, w2s = [], [], []
+    for i in range(L):
+        p = f"model.layers.{i}.mlp."
+        routers.append(get(p + "gate.weight").T)  # [d, E]
+        gates = jnp.stack(
+            [get(p + f"experts.{j}.gate_proj.weight").T for j in range(e)]
+        )  # [E, d, f]
+        ups = jnp.stack(
+            [get(p + f"experts.{j}.up_proj.weight").T for j in range(e)]
+        )
+        downs = jnp.stack(
+            [get(p + f"experts.{j}.down_proj.weight").T for j in range(e)]
+        )
+        w1 = jnp.concatenate(
+            [
+                gates.reshape(e, d, n, f // n),
+                ups.reshape(e, d, n, f // n),
+            ],
+            axis=3,
+        ).reshape(e, d, 2 * f)
+        w1s.append(w1)
+        w2s.append(downs)
+    params.layers.mlp = TPMoEParams(
+        w_router=jnp.stack(routers),
+        w1=jnp.stack(w1s),
+        w2=jnp.stack(w2s),
+    )
+    return params

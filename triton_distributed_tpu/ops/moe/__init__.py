@@ -1,0 +1,22 @@
+"""MoE kernels: routing/sort, grouped GEMM, TP and EP data paths.
+
+Parity: reference MoE stack — ``csrc/lib/moe_utils.cu`` (token sort),
+``kernels/nvidia/allgather_group_gemm.py`` (AG+GroupGEMM),
+``moe_reduce_rs.py`` (MoE+RS), ``ep_a2a.py`` /
+``low_latency_all_to_all.py`` (EP dispatch/combine) — SURVEY.md §2.2.
+"""
+
+from triton_distributed_tpu.ops.moe.routing import (  # noqa: F401
+    moe_combine,
+    moe_sort,
+    router_topk,
+)
+from triton_distributed_tpu.ops.moe.grouped_gemm import (  # noqa: F401
+    grouped_ffn,
+    grouped_gemm,
+)
+from triton_distributed_tpu.ops.moe.ep_a2a import (  # noqa: F401
+    ep_combine,
+    ep_dispatch,
+    ep_moe_ffn,
+)

@@ -1,0 +1,156 @@
+"""Expert-parallel all-to-all dispatch/combine (DeepEP-style).
+
+Parity: reference ``kernels/nvidia/ep_a2a.py`` —
+``kernel_dispatch_token``:37 (route token copies to expert-owner ranks),
+``kernel_combine_token``:152 (return + weighted reduce),
+``kernel_get_ag_splits_and_recv_offset``:244 (splits exchange) — and the
+low-latency variant ``low_latency_all_to_all.py`` (putmem_signal +
+double buffering, README.md:101-187).
+
+TPU design (SURVEY.md §7 hard part "dynamic shapes"): XLA wants static
+shapes, so the variable per-rank splits become a fixed per-destination
+``capacity`` with drop-on-overflow (the reference also pads its grouped
+GEMM batches). Dispatch builds ``[n_ranks, capacity]`` send buffers with
+a cumulative-occurrence slot assignment (the ``bincount``+offset logic of
+the CUDA align kernel), exchanges them with one all-to-all (XLA or the
+device-initiated Pallas ring), runs the local expert FFN expert-sorted,
+and combine reverses the same slots — no splits exchange needed because
+slots, not offsets, carry identity.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.ops.collectives.all_to_all import all_to_all
+from triton_distributed_tpu.ops.moe.grouped_gemm import grouped_ffn
+from triton_distributed_tpu.ops.moe.routing import RouterOut
+
+
+class DispatchState(NamedTuple):
+    """Everything the source rank needs to route results back."""
+
+    dest: jax.Array      # [T*k] destination rank per assignment
+    slot: jax.Array      # [T*k] slot in the dest buffer
+    valid: jax.Array     # [T*k] bool — False when dropped (over capacity)
+    weights: jax.Array   # [T*k] f32 gate weights
+    token_ids: jax.Array  # [T*k] source token index
+
+
+def ep_dispatch(
+    x: jax.Array,        # [T, d] — this rank's tokens
+    route: RouterOut,
+    num_experts: int,
+    capacity: int,
+    axis: str = "ep",
+    method: str = "auto",
+    ctx=None,
+):
+    """Send each (token, expert) assignment to the expert's owner rank.
+
+    Returns ``(recv_x [n*C, d], recv_expert [n*C] local expert ids,
+    recv_valid [n*C], state)`` — parity: ``kernel_dispatch_token``.
+    """
+    n = jax.lax.axis_size(axis)
+    t, d = x.shape
+    k = route.expert_ids.shape[1]
+    epr = num_experts // n  # experts per rank
+
+    flat_e = route.expert_ids.reshape(-1)      # [T*k]
+    dest = (flat_e // epr).astype(jnp.int32)
+    token_ids = (jnp.arange(t * k) // k).astype(jnp.int32)
+
+    # Slot = occurrence index among assignments with the same destination
+    # (the cumsum the CUDA align kernel computes per expert block).
+    onehot = jax.nn.one_hot(dest, n, dtype=jnp.int32)  # [T*k, n]
+    occ = jnp.cumsum(onehot, axis=0) - onehot          # exclusive
+    slot = jnp.take_along_axis(occ, dest[:, None], axis=1)[:, 0]
+    valid = slot < capacity
+
+    # Scatter into per-destination buffers; out-of-capacity rows drop.
+    send_x = jnp.zeros((n, capacity, d), x.dtype)
+    send_x = send_x.at[dest, slot].set(
+        x[token_ids], mode="drop", unique_indices=True
+    )
+    local_e = (flat_e % epr).astype(jnp.int32)
+    # Invalid slots carry expert 0 with zero payload (harmless rows).
+    send_e = jnp.zeros((n, capacity), jnp.int32)
+    send_e = send_e.at[dest, slot].set(local_e, mode="drop", unique_indices=True)
+    send_v = jnp.zeros((n, capacity), jnp.int32)
+    send_v = send_v.at[dest, slot].set(1, mode="drop", unique_indices=True)
+
+    recv_x = all_to_all(send_x, axis=axis, method=method, ctx=ctx)
+    meta = jnp.concatenate(
+        [send_e.astype(jnp.int32)[..., None], send_v[..., None]], axis=-1
+    )
+    recv_meta = all_to_all(meta, axis=axis, method=method, ctx=ctx)
+    recv_e = recv_meta[..., 0].reshape(n * capacity)
+    recv_v = recv_meta[..., 1].reshape(n * capacity).astype(bool)
+    state = DispatchState(dest, slot, valid, route.weights.reshape(-1), token_ids)
+    return recv_x.reshape(n * capacity, d), recv_e, recv_v, state
+
+
+def ep_combine(
+    expert_out: jax.Array,  # [n*C, d] — receiver order (same slots)
+    state: DispatchState,
+    num_tokens: int,
+    axis: str = "ep",
+    method: str = "auto",
+    ctx=None,
+) -> jax.Array:
+    """Route results back and reduce weighted per token → [T, d]
+    (parity: ``kernel_combine_token``)."""
+    n = jax.lax.axis_size(axis)
+    capacity = expert_out.shape[0] // n
+    d = expert_out.shape[1]
+    back = all_to_all(
+        expert_out.reshape(n, capacity, d), axis=axis, method=method, ctx=ctx
+    )  # [n, C, d] — slot layout mirrors what this rank sent
+    picked = back[state.dest, state.slot]  # [T*k, d]
+    w = jnp.where(state.valid, state.weights, 0.0)
+    out = jnp.zeros((num_tokens, d), jnp.float32)
+    out = out.at[state.token_ids].add(picked.astype(jnp.float32) * w[:, None])
+    return out.astype(expert_out.dtype)
+
+
+def ep_moe_ffn(
+    x: jax.Array,         # [T, d] — this rank's tokens
+    w_router: jax.Array,  # [d, E] replicated
+    w1: jax.Array,        # [E_loc, d, 2*f] — this rank's experts
+    w2: jax.Array,        # [E_loc, f, d]
+    k: int,
+    *,
+    capacity_factor: float = 1.3,
+    axis: str = "ep",
+    method: str = "auto",
+    norm_topk_prob: bool = True,
+    ctx=None,
+) -> jax.Array:
+    """Full EP MoE FFN inside ``shard_map`` (parity:
+    ``EPAll2AllLayer.forward`` — ``ep_a2a_layer.py:195/240``)."""
+    from triton_distributed_tpu.ops.moe.routing import router_topk
+
+    n = jax.lax.axis_size(axis)
+    t, d = x.shape
+    num_experts = w1.shape[0] * n
+    epr = w1.shape[0]
+    # Expected load per destination is t*k/n; round capacity to a
+    # lane-friendly multiple of 8.
+    capacity = int(-(-(t * k * capacity_factor / n) // 8) * 8)
+
+    route = router_topk(x, w_router, k, norm_topk_prob=norm_topk_prob)
+    recv_x, recv_e, recv_v, state = ep_dispatch(
+        x, route, num_experts, capacity, axis, method, ctx
+    )
+    # Expert-sort received rows (invalid rows ride along in expert 0 with
+    # zero payload — they contribute nothing and cost one extra group row).
+    order = jnp.argsort(recv_e, stable=True)
+    inv = jnp.argsort(order)
+    sorted_x = recv_x[order]
+    group_sizes = jnp.bincount(recv_e, length=epr).astype(jnp.int32)
+    out_sorted = grouped_ffn(sorted_x, w1, w2, group_sizes)
+    expert_out = out_sorted[inv]
+    return ep_combine(expert_out, state, t, axis, method, ctx)

@@ -1,0 +1,42 @@
+"""Grouped (expert-batched) GEMM on the MXU.
+
+Parity: reference grouped GEMMs inside ``allgather_group_gemm.py``
+(``kernel_consumer_m_parallel_scatter_group_gemm``:535) and
+``moe_reduce_rs.py`` (:167). There the kernel walks expert segments of
+the sorted token array; here ``jax.lax.ragged_dot`` expresses exactly
+that contraction (rows grouped by ``group_sizes``, one rhs matrix per
+group) and XLA/Mosaic does the segment tiling.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def grouped_gemm(
+    x: jax.Array,           # [M, d] — rows sorted by group
+    w: jax.Array,           # [E, d, f]
+    group_sizes: jax.Array,  # [E] int32, sum == M
+    *,
+    acc_dtype=jnp.float32,
+) -> jax.Array:
+    """``out[i] = x[i] @ w[group_of_row(i)]`` → [M, f]."""
+    return jax.lax.ragged_dot(
+        x, w, group_sizes, preferred_element_type=acc_dtype
+    ).astype(x.dtype)
+
+
+def grouped_ffn(
+    x: jax.Array,            # [M, d] expert-sorted
+    w1: jax.Array,           # [E, d, 2*f] — gate|up fused per expert
+    w2: jax.Array,           # [E, f, d]
+    group_sizes: jax.Array,  # [E]
+) -> jax.Array:
+    """SwiGLU expert FFN over sorted tokens → [M, d] (un-combined)."""
+    h = grouped_gemm(x, w1, group_sizes)
+    gate, up = jnp.split(h, 2, axis=-1)
+    act = (jax.nn.silu(gate.astype(jnp.float32)) * up.astype(jnp.float32)).astype(
+        x.dtype
+    )
+    return grouped_gemm(act, w2, group_sizes)

@@ -1,0 +1,82 @@
+"""MoE routing: top-k gating, expert-sort, weighted combine.
+
+Parity: the reference's token sorting lives in CUDA
+(``csrc/lib/moe_utils.cu:61-356`` ``moe_ag_scatter_align_block_size`` —
+sorts topk token→expert assignments into block-aligned expert batches)
+with a Triton reimpl (``threadblock_swizzle_ag_moe_triton.py``).
+
+TPU design: XLA's sort is a first-class TPU op, so the sort/align is a
+``jnp.argsort`` + ``bincount`` composition; grouped GEMM consumes the
+``group_sizes`` vector directly (``jax.lax.ragged_dot``), no block
+alignment pass needed — the alignment the CUDA kernel creates by hand is
+what ragged_dot's tiling does internally.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class RouterOut(NamedTuple):
+    expert_ids: jax.Array   # [T, k] int32
+    weights: jax.Array      # [T, k] f32 — normalized gate weights
+
+
+class SortedTokens(NamedTuple):
+    order: jax.Array        # [T*k] — argsort of flattened expert ids
+    token_ids: jax.Array    # [T*k] — source token per sorted slot
+    expert_ids: jax.Array   # [T*k] — expert per sorted slot (ascending)
+    weights: jax.Array      # [T*k] f32 — gate weight per sorted slot
+    group_sizes: jax.Array  # [E] int32 — tokens per expert
+
+
+def router_topk(
+    x: jax.Array,         # [T, d]
+    w_router: jax.Array,  # [d, E]
+    k: int,
+    *,
+    norm_topk_prob: bool = True,
+) -> RouterOut:
+    """Qwen3-MoE gate: softmax over all experts, take top-k, renormalize
+    (HF ``norm_topk_prob``)."""
+    logits = jnp.dot(
+        x.astype(jnp.float32), w_router.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    probs = jax.nn.softmax(logits, axis=-1)
+    weights, ids = jax.lax.top_k(probs, k)
+    if norm_topk_prob:
+        weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
+    return RouterOut(ids.astype(jnp.int32), weights)
+
+
+def moe_sort(route: RouterOut, num_experts: int) -> SortedTokens:
+    """Sort (token, expert) assignments into expert-contiguous order
+    (parity: the CUDA align kernel's output contract)."""
+    flat_e = route.expert_ids.reshape(-1)
+    flat_w = route.weights.reshape(-1)
+    k = route.expert_ids.shape[1]
+    order = jnp.argsort(flat_e, stable=True)
+    return SortedTokens(
+        order=order,
+        token_ids=(order // k).astype(jnp.int32),
+        expert_ids=flat_e[order],
+        weights=flat_w[order],
+        group_sizes=jnp.bincount(flat_e, length=num_experts).astype(jnp.int32),
+    )
+
+
+def moe_combine(
+    expert_out: jax.Array,  # [T*k, d] — per sorted slot
+    sorted_tokens: SortedTokens,
+    num_tokens: int,
+) -> jax.Array:
+    """Weighted scatter-add back to token order → [T, d] (parity: the
+    gather-topk-reduce stage of ``moe_reduce_rs.py:293``)."""
+    weighted = expert_out.astype(jnp.float32) * sorted_tokens.weights[:, None]
+    out = jnp.zeros((num_tokens, expert_out.shape[1]), jnp.float32)
+    out = out.at[sorted_tokens.token_ids].add(weighted)
+    return out.astype(expert_out.dtype)
