@@ -10,8 +10,11 @@ import pytest
 
 from triton_distributed_tpu.ops.overlap import (
     AGGemmConfig,
+    GemmARConfig,
+    GemmARMethod,
     GemmRSConfig,
     ag_gemm_op,
+    gemm_ar_op,
     gemm_rs_op,
 )
 
@@ -55,6 +58,27 @@ def test_gemm_rs_8dev(ctx8, rng):
     a = jnp.asarray(rng.standard_normal((M, K), dtype=np.float32))
     b = jnp.asarray(rng.standard_normal((K, N), dtype=np.float32))
     out = gemm_rs_op(a, b, "tp", GemmRSConfig(tile_n=128), ctx8)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(a) @ np.asarray(b), rtol=1e-4, atol=1e-4
+    )
+
+
+@pytest.mark.parametrize("method", [GemmARMethod.ONE_SHOT, GemmARMethod.TWO_SHOT])
+def test_gemm_ar(ctx4, rng, method):
+    M, K, N = 4 * 8, 256, 256
+    a = jnp.asarray(rng.standard_normal((M, K), dtype=np.float32))
+    b = jnp.asarray(rng.standard_normal((K, N), dtype=np.float32))
+    out = gemm_ar_op(a, b, "tp", method, GemmARConfig(tile_n=128), ctx4)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(a) @ np.asarray(b), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_gemm_ar_one_shot_8dev(ctx8, rng):
+    M, K, N = 16, 256, 128
+    a = jnp.asarray(rng.standard_normal((M, K), dtype=np.float32))
+    b = jnp.asarray(rng.standard_normal((K, N), dtype=np.float32))
+    out = gemm_ar_op(a, b, "tp", GemmARMethod.ONE_SHOT, GemmARConfig(tile_n=128), ctx8)
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(a) @ np.asarray(b), rtol=1e-4, atol=1e-4
     )

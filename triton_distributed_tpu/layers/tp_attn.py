@@ -25,7 +25,7 @@ import jax.numpy as jnp
 from triton_distributed_tpu.ops.attention.flash_attention import flash_attention
 from triton_distributed_tpu.ops.attention.flash_decode import flash_decode
 from triton_distributed_tpu.ops.attention.rope import apply_rope
-from triton_distributed_tpu.ops.collectives.all_reduce import all_reduce
+from triton_distributed_tpu.ops.overlap.gemm_ar import gemm_ar
 from triton_distributed_tpu.ops.overlap.ag_gemm import ag_gemm
 from triton_distributed_tpu.ops.overlap.gemm_rs import gemm_rs
 from triton_distributed_tpu.runtime.mesh import DistContext, current_context
@@ -167,13 +167,13 @@ def tp_attn_decode(
 
     o = flash_decode(q, k_cache, v_cache, kv_len + 1)  # [B, hq_loc, hd]
     o_flat = o.reshape(b, dims.hq_loc * dims.head_dim).astype(x.dtype)
-    part = jnp.dot(o_flat, params.wo, preferred_element_type=jnp.float32).astype(
-        x.dtype
-    )
     if mode in ("xla", "xla_ar"):
-        out = jax.lax.psum(part, axis)
+        part = jnp.dot(o_flat, params.wo, preferred_element_type=jnp.float32)
+        out = jax.lax.psum(part.astype(x.dtype), axis)
     elif mode in ("pallas", "pallas_ar"):
-        out = all_reduce(part, axis=axis, ctx=ctx)
+        # o-proj fused with its cross-rank sum (parity: the reference AR
+        # decode o-proj + allreduce, tp_attn.py:261-271).
+        out = gemm_ar(o_flat, params.wo, axis=axis, ctx=ctx)
     else:
         raise ValueError(f"unknown mode {mode!r}")
     return out, k_cache, v_cache

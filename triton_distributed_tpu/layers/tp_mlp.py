@@ -22,8 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from triton_distributed_tpu.ops.collectives.all_reduce import all_reduce
 from triton_distributed_tpu.ops.overlap.ag_gemm import ag_gemm
+from triton_distributed_tpu.ops.overlap.gemm_ar import gemm_ar
 from triton_distributed_tpu.ops.overlap.gemm_rs import gemm_rs
 from triton_distributed_tpu.runtime.mesh import DistContext, current_context
 
@@ -83,12 +83,13 @@ def tp_mlp_fwd(
         h = _silu_mul(
             jnp.dot(x, params.w1, preferred_element_type=jnp.float32).astype(x.dtype)
         )
-        part = jnp.dot(h, params.w2, preferred_element_type=jnp.float32).astype(
-            x.dtype
-        )
         if mode == "xla_ar":
-            return jax.lax.psum(part, axis)
-        return all_reduce(part, axis=axis, ctx=ctx)
+            part = jnp.dot(h, params.w2, preferred_element_type=jnp.float32)
+            return jax.lax.psum(part.astype(x.dtype), axis)
+        # Down-projection fused with its cross-rank sum (parity: the
+        # reference AR decode path tp_mlp.py:177, here via the one-shot
+        # per-tile-broadcast gemm_ar instead of GEMM-then-all_reduce).
+        return gemm_ar(h, params.w2, axis=axis, ctx=ctx)
     raise ValueError(f"unknown mode {mode!r}")
 
 

@@ -4,6 +4,13 @@ from triton_distributed_tpu.ops.overlap.ag_gemm import (  # noqa: F401
     ag_gemm_op,
     create_ag_gemm_context,
 )
+from triton_distributed_tpu.ops.overlap.gemm_ar import (  # noqa: F401
+    GemmARConfig,
+    GemmARMethod,
+    create_gemm_ar_context,
+    gemm_ar,
+    gemm_ar_op,
+)
 from triton_distributed_tpu.ops.overlap.gemm_rs import (  # noqa: F401
     GemmRSConfig,
     create_gemm_rs_context,

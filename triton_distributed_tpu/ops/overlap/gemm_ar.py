@@ -1,0 +1,266 @@
+"""GEMM + AllReduce overlap — the TP decode-latency archetype.
+
+Parity: reference ``kernels/nvidia/gemm_allreduce.py`` —
+``GemmARContext``/``LLGemmARContext``:48/74, persistent GEMM-with-notify
+:329/389, ``consumer_all_reduce_kernel``:124, fused one-kernel variant
+:233, ops :509/546 — whose role is the row-parallel o-proj/fc2 GEMM of a
+TP decode step where the partial products must be summed across ranks
+and *every* rank needs the full result.
+
+TPU design, two methods (mirroring the reference's LL one-shot vs
+two-shot split):
+
+- ``ONE_SHOT``: one fused Pallas kernel. The GEMM is tiled over N; as
+  each output tile comes off the MXU it is broadcast to every peer's
+  arrival slot with ``put_signal`` while the MXU moves on to the next
+  tile (comm of tile j hides under compute of tile j+1 — the same
+  per-tile notify pipelining as the reference's persistent GEMM
+  producer). A second grid phase waits per-(peer, tile) arrival
+  semaphores and reduces the n partials locally. Latency-optimal for
+  decode shapes (small M·N): every payload crosses the ICI once.
+- ``TWO_SHOT``: composition of the overlapped ring ``gemm_rs`` kernel
+  (GEMM hidden under ring reduce-scatter) with a bidirectional-ring
+  all-gather — bandwidth-optimal for prefill shapes, the same
+  RS-then-AG structure XLA uses for large psums.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu import language as dl
+from triton_distributed_tpu.ops.common import (
+    VMEM_COMM_MAX_BYTES,
+    comm_pallas_call,
+    next_collective_id,
+    pick_tile,
+    _on_tpu,
+)
+from triton_distributed_tpu.ops.collectives.all_gather import (
+    AllGatherMethod,
+    all_gather,
+)
+from triton_distributed_tpu.ops.overlap.gemm_rs import GemmRSConfig, gemm_rs
+from triton_distributed_tpu.runtime.mesh import DistContext, current_context
+
+_GEMM_AR_COLLECTIVE_ID = next_collective_id()
+
+# Above this full-output size the one-shot kernel's n-copy arrival
+# buffer stops paying for its single-hop latency win (parity: the
+# size-based LL/two-shot dispatch in ``gemm_allreduce.py:509-546``).
+_ONE_SHOT_MAX_BYTES = 512 * 1024
+
+
+class GemmARMethod(enum.Enum):
+    AUTO = "auto"
+    XLA = "xla"  # psum(a @ b) — XLA's own overlap scheduling
+    ONE_SHOT = "one_shot"  # fused per-tile broadcast + local reduce
+    TWO_SHOT = "two_shot"  # overlapped gemm_rs ring + ring all-gather
+
+
+@dataclasses.dataclass(frozen=True)
+class GemmARConfig:
+    """Parity: tile fields of ``GemmARContext`` (``gemm_allreduce.py:48``)."""
+
+    tile_n: int = 512
+    acc_dtype: jnp.dtype = jnp.float32
+
+
+def create_gemm_ar_context(
+    m: int, n_out: int, k_loc: int, dtype=jnp.bfloat16, tile_n: int | None = None
+) -> GemmARConfig:
+    return GemmARConfig(tile_n=pick_tile(n_out) if tile_n is None else tile_n)
+
+
+def _gemm_ar_one_shot_kernel(
+    a_ref,      # [M, k_loc] VMEM — this device's K shard of A (resident)
+    b_ref,      # [k_loc, tile_n] VMEM — B tile min(s, num_j-1)
+    o_ref,      # [M, tile_n] VMEM — reduced output tile max(s-1, 0)
+    ws,         # [n, M, N] ANY/HBM output — slot p holds peer p's partial
+    sbuf,       # [M, tile_n] VMEM — partial tile staging
+    vbuf,       # [n, M, tile_n] VMEM — reduce staging
+    stage_sem,  # DMA ()
+    send_sems,  # DMA (n-1,)
+    recv_sems,  # DMA (n, num_j) — arrival of (src rank, tile)
+    *,
+    axis: str,
+    acc_dtype,
+):
+    me = dl.rank(axis)
+    n = dl.num_ranks(axis)
+    s = pl.program_id(0)
+    num_j = pl.num_programs(0) - 1
+
+    @pl.when(s == 0)
+    def _entry():
+        # Peers' ws slots must exist before the first remote put lands.
+        dl.barrier_all(axis)
+
+    @pl.when(s < num_j)
+    def _produce():
+        # Partial tile s off the MXU → local slot (HBM) → broadcast. The
+        # remote puts are non-blocking: tile s's n-1 sends drain while
+        # tile s-1 is being reduced and tile s+1 is on the MXU (per-tile
+        # notify pipelining, as the reference's producer GEMM does with
+        # its tile barriers).
+        tile_n = b_ref.shape[1]
+        jsl = pl.ds(s * tile_n, tile_n)
+        sbuf[:] = jnp.dot(
+            a_ref[:], b_ref[:], preferred_element_type=acc_dtype
+        ).astype(sbuf.dtype)
+        dma = dl.local_copy(sbuf, ws.at[me].at[:, jsl], stage_sem)
+        dma.start()
+        dma.wait()
+        for i in range(1, n):
+            peer = jax.lax.rem(me + i, n)
+            dl.put_signal(
+                ws.at[me].at[:, jsl], ws.at[me].at[:, jsl], peer,
+                send_sems.at[i - 1], recv_sems.at[me, s], axis=axis,
+            )
+
+    @pl.when(s > 0)
+    def _reduce():
+        # Reduce tile s-1: wait its n-1 inbound partials (per-(src, tile)
+        # semaphores — the analog of the reference consumer's per-tile
+        # ``dl.wait`` + ``consume_token``), stage, sum locally.
+        tile_n = o_ref.shape[1]
+        j = s - 1
+        jsl = pl.ds(j * tile_n, tile_n)
+        for i in range(1, n):
+            src = jax.lax.rem(me + i, n)
+            dl.wait_recv(recv_sems.at[src, j], ws.at[src].at[:, jsl])
+        dma = dl.local_copy(ws.at[:, :, jsl], vbuf, stage_sem)
+        dma.start()
+        dma.wait()
+        acc = vbuf[0].astype(acc_dtype)
+        for i in range(1, n):
+            acc = acc + vbuf[i].astype(acc_dtype)
+        o_ref[:] = acc.astype(o_ref.dtype)
+
+    @pl.when(s == num_j)
+    def _drain():
+        # All num_j tiles were sent to each peer: [M, N] bytes per peer.
+        for i in range(1, n):
+            pltpu.make_async_copy(
+                ws.at[me], ws.at[me], send_sems.at[i - 1]
+            ).wait()
+
+
+def gemm_ar(
+    a: jax.Array,
+    b: jax.Array,
+    axis: str = "tp",
+    method: GemmARMethod = GemmARMethod.AUTO,
+    config: GemmARConfig | None = None,
+    ctx: DistContext | None = None,
+) -> jax.Array:
+    """Overlapped ``psum(a @ b)`` inside ``shard_map``.
+
+    ``a``: ``[M, k_loc]`` column shard; ``b``: ``[k_loc, N]`` row shard.
+    Every device returns the full reduced ``[M, N]`` — same contract as
+    reference ``gemm_allreduce_op`` (``gemm_allreduce.py:509``).
+    """
+    n = jax.lax.axis_size(axis)
+    m, k_loc = a.shape
+    _, n_out = b.shape
+    config = config or create_gemm_ar_context(m, n_out, k_loc, a.dtype)
+
+    if n == 1:
+        return jnp.dot(a, b, preferred_element_type=config.acc_dtype).astype(a.dtype)
+
+    out_bytes = m * n_out * a.dtype.itemsize
+    if method == GemmARMethod.AUTO:
+        if not _on_tpu(ctx):
+            method = GemmARMethod.XLA
+        elif out_bytes <= _ONE_SHOT_MAX_BYTES:
+            method = GemmARMethod.ONE_SHOT
+        elif m % n == 0 and out_bytes <= VMEM_COMM_MAX_BYTES:
+            # The trailing ring all-gather holds the full [M, N] in VMEM.
+            method = GemmARMethod.TWO_SHOT
+        else:
+            method = GemmARMethod.XLA
+
+    if method == GemmARMethod.XLA:
+        return jax.lax.psum(
+            jnp.dot(a, b, preferred_element_type=config.acc_dtype).astype(a.dtype),
+            axis,
+        )
+
+    if method == GemmARMethod.TWO_SHOT:
+        reduced = gemm_rs(
+            a, b, axis=axis, config=GemmRSConfig(config.tile_n, config.acc_dtype),
+            ctx=ctx,
+        )
+        return all_gather(reduced, axis, AllGatherMethod.PALLAS_BIDIR_RING, ctx)
+
+    # ONE_SHOT
+    tile_n = min(config.tile_n, n_out)
+    if n_out % tile_n:
+        raise ValueError(f"n_out={n_out} not divisible by tile_n={tile_n}")
+    num_j = n_out // tile_n
+
+    out, _ws = comm_pallas_call(
+        functools.partial(
+            _gemm_ar_one_shot_kernel, axis=axis, acc_dtype=config.acc_dtype
+        ),
+        (
+            jax.ShapeDtypeStruct((m, n_out), a.dtype),
+            jax.ShapeDtypeStruct((n, m, n_out), a.dtype),
+        ),
+        grid=(num_j + 1,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (k_loc, tile_n),
+                lambda s: (0, jnp.minimum(s, num_j - 1)),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=(
+            pl.BlockSpec(
+                (m, tile_n),
+                lambda s: (0, jnp.maximum(s - 1, 0)),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((m, tile_n), a.dtype),
+            pltpu.VMEM((n, m, tile_n), a.dtype),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((n - 1,)),
+            pltpu.SemaphoreType.DMA((n, num_j)),
+        ],
+        collective_id=_GEMM_AR_COLLECTIVE_ID,
+        dimension_semantics=("arbitrary",),
+        ctx=ctx,
+    )(a, b)
+    return out
+
+
+def gemm_ar_op(
+    a: jax.Array,
+    b: jax.Array,
+    axis: str = "tp",
+    method: GemmARMethod = GemmARMethod.AUTO,
+    config: GemmARConfig | None = None,
+    ctx: DistContext | None = None,
+) -> jax.Array:
+    """Host-level wrapper: ``a [M, K]`` column-sharded over ``axis``,
+    ``b [K, N]`` row-sharded; returns the full ``[M, N]`` (replicated) —
+    the summed GEMM on every device."""
+    ctx = ctx or current_context()
+    f = ctx.shard_map(
+        functools.partial(gemm_ar, axis=axis, method=method, config=config, ctx=ctx),
+        in_specs=(P(None, axis), P(axis, None)),
+        out_specs=P(None, None),
+    )
+    return f(a, b)
