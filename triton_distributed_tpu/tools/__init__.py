@@ -1,0 +1,21 @@
+"""Tools: distributed-aware autotuning, analytic perf models, AOT export.
+
+Parity: reference ``python/triton_dist/autotuner.py`` (contextual
+autotuner), ``kernels/nvidia/{gemm,comm}_perf_model.py`` and
+``python/triton_dist/tools/`` (AOT compile CLI + C runtime).
+"""
+
+from triton_distributed_tpu.tools.autotuner import (  # noqa: F401
+    Config,
+    autotune,
+    contextual_autotune,
+)
+from triton_distributed_tpu.tools.perf_model import (  # noqa: F401
+    ChipSpec,
+    chip_spec,
+    estimate_all_gather_time_ms,
+    estimate_all_reduce_time_ms,
+    estimate_gemm_time_ms,
+    estimate_reduce_scatter_time_ms,
+    prune_configs_by_model,
+)

@@ -1,0 +1,224 @@
+"""Distributed-aware autotuning for overlap kernels.
+
+Parity: reference ``python/triton_dist/autotuner.py`` —
+``contextual_autotune(is_dist=...)``:97 wraps a thunk so ``triton.autotune``
+works on multi-kernel, stateful, multi-rank code paths, and
+``_contextual_tuning_run``:155 benches each config (skipping ones that
+fault), aggregates timings across ranks with an all-reduce MAX, and
+caches the argmin per key.
+
+TPU translation: a "config" is a set of static kernel parameters (tile
+sizes, method enums), and benching a config means jit-compiling the
+wrapped function with those statics and timing it. The reference's
+cross-rank MAX aggregation exists because each CUDA rank times its own
+kernel; under JAX's single-controller model a timed ``shard_map`` op
+already runs on every device and the host-side wall clock bounds the
+slowest device — the MAX is structural. For multi-host meshes the
+aggregation hook still applies (over ``jax.distributed`` hosts).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Any, Callable, Iterable, Mapping, Sequence
+
+import jax
+
+from triton_distributed_tpu.runtime.utils import perf_func
+
+logger = logging.getLogger("triton_distributed_tpu.autotune")
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    """One candidate: kwargs passed to the tuned function.
+
+    Parity: ``triton.Config`` — there meta-kwargs + num_warps/stages;
+    here any static kwargs the wrapped function understands.
+    """
+
+    kwargs: Mapping[str, Any]
+
+    def __str__(self) -> str:
+        return ", ".join(f"{k}={v}" for k, v in sorted(self.kwargs.items()))
+
+    def __hash__(self) -> int:
+        return hash(tuple(sorted(self.kwargs.items())))
+
+
+class KernelError(Exception):
+    """A config failed to compile/run (parity: the reference skipping
+    ``TritonError`` configs during the sweep)."""
+
+
+def _log_dir() -> str | None:
+    """File logging is opt-in via TDT_AUTOTUNE_LOG_DIR (the reference
+    always writes ./.autotune_logs/; that litters the CWD)."""
+    return os.environ.get("TDT_AUTOTUNE_LOG_DIR") or None
+
+
+def _aggregate_max_over_hosts(times_ms: list[float]) -> list[float]:
+    """MAX-combine per-config timings across hosts (parity: the
+    ``all_reduce(..., MAX)`` in ``_contextual_tuning_run``:155). No-op on
+    single-host meshes."""
+    if jax.process_count() <= 1:
+        return times_ms
+    from jax.experimental import multihost_utils
+    import numpy as np
+
+    arr = multihost_utils.process_allgather(np.asarray(times_ms))
+    return list(np.max(arr, axis=0))
+
+
+class Autotuner:
+    """Caches the fastest ``Config`` per key and replays it.
+
+    The wrapped ``fn(*args, **config.kwargs, **kwargs)`` must be a
+    complete runnable op (may invoke several kernels / carry state —
+    that's the "contextual" part: whole-op timing, not one kernel).
+    """
+
+    def __init__(
+        self,
+        fn: Callable[..., Any],
+        configs: Sequence[Config],
+        key: Callable[..., Any] | None = None,
+        prune: Callable[[Sequence[Config]], Sequence[Config]] | None = None,
+        n_warmup: int = 3,
+        n_repeat: int = 5,
+        is_dist: bool = False,
+    ):
+        self.fn = fn
+        self.configs = list(configs)
+        self.key_fn = key
+        self.prune_fn = prune
+        self.n_warmup = n_warmup
+        self.n_repeat = n_repeat
+        self.is_dist = is_dist
+        self.cache: dict[Any, Config] = {}
+        self.timings: dict[Any, list[tuple[Config, float]]] = {}
+        self._log_file = None
+
+    # -- logging ------------------------------------------------------------
+
+    def _log(self, msg: str) -> None:
+        logger.info(msg)
+        d = _log_dir()
+        if d:
+            if self._log_file is None:
+                os.makedirs(d, exist_ok=True)
+                rank = jax.process_index()
+                self._log_file = open(
+                    os.path.join(d, f"rank-{rank}.log"), "a", buffering=1
+                )
+            print(msg, file=self._log_file, flush=True)
+
+    # -- tuning -------------------------------------------------------------
+
+    def _key(self, args, kwargs):
+        if self.key_fn is not None:
+            return self.key_fn(*args, **kwargs)
+        parts = []
+        for a in args:
+            if hasattr(a, "shape") and hasattr(a, "dtype"):
+                parts.append((tuple(a.shape), str(a.dtype)))
+            elif isinstance(a, (int, str, bool)):
+                parts.append(a)
+        return tuple(parts)
+
+    def _bench_config(self, cfg: Config, args, kwargs) -> float:
+        def thunk():
+            return self.fn(*args, **{**kwargs, **cfg.kwargs})
+
+        _, ms = perf_func(thunk, iters=self.n_repeat, warmup_iters=self.n_warmup)
+        return ms
+
+    def __call__(self, *args, **kwargs):
+        if len(self.configs) <= 1:
+            cfg = self.configs[0] if self.configs else Config({})
+            return self.fn(*args, **{**kwargs, **cfg.kwargs})
+
+        key = self._key(args, kwargs)
+        cfg = self.cache.get(key)
+        if cfg is not None:
+            return self.fn(*args, **{**kwargs, **cfg.kwargs})
+
+        candidates = list(
+            self.prune_fn(self.configs) if self.prune_fn else self.configs
+        )
+        # Failed configs record inf so the per-config vector stays aligned
+        # across hosts for the MAX aggregation (a config that faults on
+        # ANY host is thereby rejected everywhere).
+        times_ms: list[float] = []
+        for i, cand in enumerate(candidates):
+            try:
+                ms = self._bench_config(cand, args, kwargs)
+            except Exception as e:  # config doesn't compile/run: skip it
+                self._log(
+                    f"fn: {getattr(self.fn, '__name__', self.fn)} | key: {key}"
+                    f" | config-id: {i} | config: {{{cand}}} | error: {e}"
+                )
+                times_ms.append(float("inf"))
+                continue
+            self._log(
+                f"fn: {getattr(self.fn, '__name__', self.fn)} | key: {key}"
+                f" | config-id: {i} | config: {{{cand}}} | mean latency: {ms} ms"
+            )
+            times_ms.append(ms)
+
+        times_ms = _aggregate_max_over_hosts(times_ms)
+        okay = [
+            (c, t) for c, t in zip(candidates, times_ms) if t != float("inf")
+        ]
+        if not okay:
+            raise KernelError("cannot find valid config")
+        best, best_ms = min(okay, key=lambda ct: ct[1])
+        self._log(
+            f"fn: {getattr(self.fn, '__name__', self.fn)} | key: {key}"
+            f" | best-config: {{{best}}} | best-latency: {best_ms} ms"
+        )
+        self.cache[key] = best
+        self.timings[key] = okay
+        return self.fn(*args, **{**kwargs, **best.kwargs})
+
+
+def autotune(
+    configs: Iterable[Mapping[str, Any] | Config],
+    key: Callable[..., Any] | None = None,
+    prune: Callable[[Sequence[Config]], Sequence[Config]] | None = None,
+    n_warmup: int = 3,
+    n_repeat: int = 5,
+    is_dist: bool = False,
+):
+    """Decorator form (parity: ``triton.autotune`` +
+    ``contextual_autotune`` combined — on TPU there is no separate
+    kernel-level tuner to patch, so one decorator covers both roles)."""
+    cfgs = [c if isinstance(c, Config) else Config(dict(c)) for c in configs]
+
+    def decor(fn):
+        return Autotuner(
+            fn, cfgs, key=key, prune=prune,
+            n_warmup=n_warmup, n_repeat=n_repeat, is_dist=is_dist,
+        )
+
+    return decor
+
+
+def contextual_autotune(is_dist: bool = False, n_repeat: int = 5, n_warmup: int = 3):
+    """Parity shim matching the reference's entry point
+    (``autotuner.py:97``): wraps a thunk whose inner ops are
+    ``Autotuner`` instances. Under the JAX design the inner tuners are
+    already contextual (they time the whole wrapped op), so this only
+    forwards the call — it exists so reference-style call sites port
+    one-to-one."""
+
+    def decor(fn):
+        def wrapped(*args, **kwargs):
+            return fn(*args, **kwargs)
+
+        wrapped.__name__ = getattr(fn, "__name__", "tuned_fn")
+        return wrapped
+
+    return decor

@@ -1,0 +1,159 @@
+"""Analytic GEMM / communication time models for autotuner pruning.
+
+Parity: reference ``kernels/nvidia/gemm_perf_model.py`` (tensor-core
+roofline from clock rate × subcores) and ``comm_perf_model.py``
+(``estimate_reduce_scatter_time_ms`` / ``estimate_all_gather_time_ms``
+from NVLink/NIC bandwidth, :97-116). The TPU translation replaces the
+CUDA-capability table with a chip-spec table (MXU TFLOPs, HBM GB/s, ICI
+GB/s per link) and the NVLink/NIC split with the ICI/DCN split.
+
+Numbers are public per-chip specs (the same ones the scaling-book recipe
+uses for its roofline arithmetic); unknown chips fall back to v5e.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ChipSpec:
+    name: str
+    bf16_tflops: float       # MXU peak, bf16
+    int8_tops: float         # MXU peak, int8
+    hbm_gbs: float           # HBM bandwidth GB/s
+    ici_gbs_per_link: float  # one ICI link, one direction, GB/s
+    ici_links: int           # links per chip (torus degree)
+    dcn_gbs: float           # per-host DCN bandwidth GB/s (order-of-magnitude)
+
+
+_SPECS = {
+    "v4": ChipSpec("v4", 275.0, 275.0, 1228.0, 45.0, 6, 25.0),
+    "v5p": ChipSpec("v5p", 459.0, 918.0, 2765.0, 90.0, 6, 25.0),
+    "v5e": ChipSpec("v5e", 197.0, 394.0, 819.0, 45.0, 4, 25.0),
+    "v6e": ChipSpec("v6e", 918.0, 1836.0, 1640.0, 90.0, 4, 25.0),
+}
+
+
+@functools.lru_cache()
+def chip_spec(device_kind: str | None = None) -> ChipSpec:
+    """Resolve the spec of the current (or named) chip generation."""
+    if device_kind is None:
+        devs = jax.devices()
+        device_kind = devs[0].device_kind if devs else "cpu"
+    kind = device_kind.lower().replace(" ", "")
+    for key in ("v6e", "v6lite", "v5p", "v5e", "v5lite", "v4"):
+        if key in kind:
+            return _SPECS.get(key.replace("lite", "e"), _SPECS["v5e"])
+    return _SPECS["v5e"]
+
+
+def _dtype_tflops(spec: ChipSpec, dtype) -> float:
+    itemsize = jnp.dtype(dtype).itemsize
+    if itemsize == 1:
+        return spec.int8_tops
+    if itemsize >= 4:
+        return spec.bf16_tflops / 2  # fp32 runs the MXU at half rate
+    return spec.bf16_tflops
+
+
+def estimate_gemm_time_ms(
+    m: int, n: int, k: int, dtype=jnp.bfloat16, spec: ChipSpec | None = None
+) -> float:
+    """Roofline GEMM estimate: max(MXU time, HBM stream time).
+
+    Parity: ``estimate_matmul_time`` (``gemm_perf_model.py``) — there
+    compute/load/store terms from tensor-core TFLOPs + DRAM bandwidth;
+    here the same two terms against MXU and HBM peaks. MXU efficiency is
+    derated for small/ragged shapes (128-alignment), the TPU analog of
+    the reference's wave-quantization term.
+    """
+    spec = spec or chip_spec()
+    itemsize = jnp.dtype(dtype).itemsize
+    tflops = _dtype_tflops(spec, dtype)
+
+    def pad(x):  # MXU tiles are 128-aligned; ragged edges burn lanes
+        return ((x + 127) // 128) * 128
+
+    eff_flops = 2.0 * pad(m) * pad(n) * pad(k)
+    compute_ms = eff_flops / (tflops * 1e12) * 1e3
+    bytes_moved = (m * k + k * n) * itemsize + m * n * itemsize
+    mem_ms = bytes_moved / (spec.hbm_gbs * 1e9) * 1e3
+    return max(compute_ms, mem_ms)
+
+
+def _ring_bw_gbs(spec: ChipSpec, bidir: bool = True) -> float:
+    """Per-chip ring bandwidth over ICI: a 1-D ring uses 2 links per chip
+    (one per direction) when the protocol is bidirectional."""
+    links = 2 if bidir and spec.ici_links >= 2 else 1
+    return spec.ici_gbs_per_link * links
+
+
+def estimate_reduce_scatter_time_ms(
+    nbytes: int,
+    world_size: int,
+    local_world_size: int | None = None,
+    spec: ChipSpec | None = None,
+    bidir: bool = True,
+) -> float:
+    """Ring reduce-scatter estimate over ICI, with a DCN term when the
+    axis spans slices.
+
+    Parity: ``estimate_reduce_scatter_time_ms`` (``comm_perf_model.py:97``)
+    — intra-node NVLink term + inter-node NIC term, overlapped when
+    fullmesh. TPU: intra-slice ICI ring moves (n-1)/n of the payload per
+    chip; the inter-slice share rides DCN and dominates when present.
+    """
+    spec = spec or chip_spec()
+    local = local_world_size or world_size
+    intra_ms = (
+        nbytes * (local - 1) / local / (_ring_bw_gbs(spec, bidir) * 1e9) * 1e3
+    )
+    if world_size != local:
+        nslices = world_size // local
+        inter_ms = nbytes / local / (spec.dcn_gbs * 1e9) * 1e3 * (nslices - 1)
+        return intra_ms + inter_ms
+    return intra_ms
+
+
+def estimate_all_gather_time_ms(
+    nbytes: int,
+    world_size: int,
+    local_world_size: int | None = None,
+    spec: ChipSpec | None = None,
+    bidir: bool = True,
+) -> float:
+    """Same cost shape as reduce-scatter (parity:
+    ``comm_perf_model.py:113-116``). ``nbytes`` is the FULL gathered
+    size."""
+    return estimate_reduce_scatter_time_ms(
+        nbytes, world_size, local_world_size, spec, bidir
+    )
+
+
+def estimate_all_reduce_time_ms(
+    nbytes: int,
+    world_size: int,
+    local_world_size: int | None = None,
+    spec: ChipSpec | None = None,
+) -> float:
+    """Two-shot allreduce = RS + AG of the same payload."""
+    return 2.0 * estimate_reduce_scatter_time_ms(
+        nbytes, world_size, local_world_size, spec
+    )
+
+
+def prune_configs_by_model(configs, est_fn, top_k: int = 8):
+    """Keep the ``top_k`` configs by estimated time.
+
+    Parity: the reference prunes its autotune space with the perf models
+    (``gemm_perf_model.py`` used via ``triton.autotune`` ``prune_configs_by``).
+    ``est_fn(config) -> ms``.
+    """
+    if len(configs) <= top_k:
+        return list(configs)
+    return sorted(configs, key=est_fn)[:top_k]
