@@ -58,6 +58,47 @@ def test_autotune_picks_best_and_caches(tmp_path, monkeypatch):
     assert "best-config" in log and "error" in log
 
 
+def test_autotune_key_includes_kwargs():
+    tuned_with = []
+
+    def op(x=None, flag=False, tile=64):
+        tuned_with.append((flag, tile))
+        return flag
+
+    tuner = Autotuner(
+        op, [Config({"tile": 64}), Config({"tile": 128})],
+        n_warmup=0, n_repeat=1,
+    )
+    tuner(x=jnp.ones((4, 4)), flag=False)
+    tuner(x=jnp.ones((4096, 4)), flag=False)  # kw array: distinct key
+    tuner(x=jnp.ones((4, 4)), flag=True)      # kw scalar: distinct key
+    assert len(tuner.cache) == 3
+
+
+def test_contextual_autotune_overrides_inner_tuners():
+    from triton_distributed_tpu.tools.autotuner import contextual_autotune
+
+    bench_calls = []
+
+    def op(x, tile=64):
+        bench_calls.append(tile)
+        return x
+
+    tuner = Autotuner(
+        op, [Config({"tile": 64}), Config({"tile": 128})],
+        n_warmup=0, n_repeat=5,
+    )
+
+    @contextual_autotune(n_repeat=1, n_warmup=0)
+    def outer(x):
+        return tuner(x)
+
+    outer(jnp.ones((2, 2)))
+    # 2 configs x (1 repeat + 0 warmup) + 1 replay = 3 calls, not 11.
+    assert len(bench_calls) == 3
+    assert outer.__name__ == "outer"  # functools.wraps applied
+
+
 def test_autotune_decorator_and_all_fail():
     @autotune(configs=[{"t": 1}, {"t": 2}], n_warmup=0, n_repeat=1)
     def op(x, t=1):

@@ -1,0 +1,49 @@
+"""Megakernel subsystem: whole-model single persistent Pallas kernel.
+
+Parity: reference ``python/triton_dist/mega_triton_kernel/`` (SURVEY.md
+§2.2 L11) — task graph (``core/task_base.py``), registry
+(``core/registry.py``), scheduler (``core/scheduler.py``), code
+generator (``core/code_generator.py``), task kernels (``kernels/``),
+``ModelBuilder`` (``models/model_builder.py``) and the Qwen3 megakernel
+model (``models/qwen3.py``).
+"""
+
+from triton_distributed_tpu.megakernel import kernels  # noqa: F401  (register bodies)
+from triton_distributed_tpu.megakernel.code_generator import (
+    MegaConfig,
+    MegaDims,
+)
+from triton_distributed_tpu.megakernel.model_builder import (
+    CompiledMegaKernel,
+    ModelBuilder,
+)
+from triton_distributed_tpu.megakernel.qwen3 import MegaQwen3
+from triton_distributed_tpu.megakernel.registry import (
+    register_task,
+    registered_types,
+)
+from triton_distributed_tpu.megakernel.scheduler import SchedulePolicy, schedule
+from triton_distributed_tpu.megakernel.task import (
+    Task,
+    TaskDependency,
+    TaskIDManager,
+    TaskType,
+    pack_table,
+)
+
+__all__ = [
+    "CompiledMegaKernel",
+    "MegaConfig",
+    "MegaDims",
+    "MegaQwen3",
+    "ModelBuilder",
+    "SchedulePolicy",
+    "Task",
+    "TaskDependency",
+    "TaskIDManager",
+    "TaskType",
+    "pack_table",
+    "register_task",
+    "registered_types",
+    "schedule",
+]

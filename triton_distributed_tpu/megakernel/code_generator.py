@@ -1,0 +1,264 @@
+"""Megakernel code generator: one Pallas kernel for a whole task graph.
+
+Parity: reference ``mega_triton_kernel/core/code_generator.py`` —
+``make_mega_kernel_src``:31 emits ONE ``@triton.jit`` kernel that loads
+8-int task headers and dispatches via generated if/elif :92-174.
+
+TPU redesign: no source-text generation — the "generated kernel" is a
+traced closure. The task table is a scalar-prefetch operand (the analog
+of the per-SM int32 work queues living in SMEM), the grid is the task
+count with ``dimension_semantics=("arbitrary",)`` (sequential, so
+schedule order IS the dependency order), and dispatch is a ``pl.when``
+chain over exactly the task types the model uses — same shape as the
+reference's generated if/elif, but over Mosaic predication.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_distributed_tpu.megakernel.registry import get_body_factory
+from triton_distributed_tpu.megakernel.task import Task, TaskType
+from triton_distributed_tpu.ops.common import interpret_mode, pick_tile
+from triton_distributed_tpu.runtime.mesh import DistContext
+
+
+@dataclasses.dataclass(frozen=True)
+class MegaDims:
+    """Static per-shard geometry of the decode step."""
+
+    batch: int
+    d: int
+    hq_loc: int
+    hkv_loc: int
+    head_dim: int
+    f_loc: int
+    v_loc: int
+    num_layers: int
+    s_max: int
+    n_ranks: int
+    rms_eps: float = 1e-6
+    rope_theta: float = 1e6
+
+    @property
+    def qkv_loc(self) -> int:
+        return (self.hq_loc + 2 * self.hkv_loc) * self.head_dim
+
+    @property
+    def o_k(self) -> int:
+        return self.hq_loc * self.head_dim
+
+
+@dataclasses.dataclass(frozen=True)
+class MegaConfig:
+    """Tile configuration (parity: the reference's per-task tile configs
+    in its TaskBuilders). Resolved against dims by :func:`resolve`."""
+
+    tile_n: int = 512
+    tile_k: int = 512
+    s_blk: int = 256
+
+    def resolve(self, dims: MegaDims) -> "ResolvedConfig":
+        return ResolvedConfig(
+            tn_qkv=pick_tile(dims.qkv_loc, self.tile_n),
+            tn_fc1=pick_tile(dims.f_loc, self.tile_n),
+            tn_lm=pick_tile(dims.v_loc, self.tile_n),
+            tk_o=pick_tile(dims.o_k, self.tile_k),
+            tk_fc2=pick_tile(dims.f_loc, self.tile_k),
+            s_blk=pick_tile(dims.s_max, self.s_blk),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ResolvedConfig:
+    tn_qkv: int
+    tn_fc1: int
+    tn_lm: int
+    tk_o: int
+    tk_fc2: int
+    s_blk: int
+
+    @property
+    def tn_max(self) -> int:
+        return max(self.tn_qkv, self.tn_fc1, self.tn_lm)
+
+    @property
+    def tk_max(self) -> int:
+        return max(self.tk_o, self.tk_fc2)
+
+
+class KernelCtx:
+    """Everything a task body sees: dims, config, header fields, refs.
+
+    Ref attributes are bound by :func:`make_mega_kernel` per trace; the
+    names are the contract between the generator and ``kernels.py``.
+    """
+
+    def __init__(self, dims: MegaDims, cfg: ResolvedConfig, axis: str,
+                 wdtype, cdtype):
+        self.dims = dims
+        self.cfg = cfg
+        self.axis = axis
+        self.wdtype = wdtype
+        self.cdtype = cdtype
+        # traced per-step header fields, bound in the kernel body:
+        self.layer: Any = None
+        self.arg0: Any = None
+        self.arg1: Any = None
+
+
+def make_mega_kernel(
+    dims: MegaDims,
+    cfg: ResolvedConfig,
+    used_types: tuple[TaskType, ...],
+    *,
+    axis: str,
+    wdtype,
+    cdtype,
+):
+    """Build the kernel function dispatching over ``used_types``."""
+    kctx = KernelCtx(dims, cfg, axis, wdtype, cdtype)
+    # Build one body closure per used type, in enum order.
+    bodies = [(int(t), get_body_factory(t)(kctx)) for t in sorted(used_types)]
+
+    def kernel(
+        task_tab, kv_len, tokens,                      # scalar prefetch
+        embed, wqkv, wo, w1, w2, lm_head,              # ANY (HBM)
+        ln1, ln2, normf, qn, kn,                       # VMEM (small)
+        kc_in, vc_in,                                  # ANY, aliased
+        logits, kc, vc,                                # outputs
+        x, h, qkv, ao, mlp, estage,                    # VMEM state
+        colstage, rowstage, kstage, vstage,            # weight/KV staging
+        knew_st, vnew_st, arsrc, cbuf,                 # attn + AR staging
+        wsem, esem, osem, ksem, vsem, arsend, arrecv,  # DMA semaphores
+    ):
+        del kc_in, vc_in  # aliased: bodies use the output refs
+        step = pl.program_id(0)
+        kctx.kv_len = kv_len
+        kctx.tokens = tokens
+        kctx.embed, kctx.wqkv, kctx.wo = embed, wqkv, wo
+        kctx.w1, kctx.w2, kctx.lm_head = w1, w2, lm_head
+        kctx.ln1, kctx.ln2, kctx.normf = ln1, ln2, normf
+        kctx.qn, kctx.kn = qn, kn
+        kctx.logits, kctx.kc, kctx.vc = logits, kc, vc
+        kctx.x, kctx.h, kctx.qkv, kctx.ao, kctx.mlp = x, h, qkv, ao, mlp
+        kctx.estage, kctx.colstage, kctx.rowstage = estage, colstage, rowstage
+        kctx.kstage, kctx.vstage = kstage, vstage
+        kctx.knew_st, kctx.vnew_st = knew_st, vnew_st
+        kctx.arsrc, kctx.cbuf = arsrc, cbuf
+        kctx.wsem, kctx.esem, kctx.osem = wsem, esem, osem
+        kctx.ksem, kctx.vsem = ksem, vsem
+        kctx.arsend, kctx.arrecv = arsend, arrecv
+
+        ttype = task_tab[step, 0]
+        kctx.layer = task_tab[step, 1]
+        kctx.arg0 = task_tab[step, 2]
+        kctx.arg1 = task_tab[step, 3]
+
+        for value, body in bodies:
+            pl.when(ttype == value)(body)
+
+    return kernel
+
+
+def build_mega_call(
+    dims: MegaDims,
+    mcfg: MegaConfig,
+    tasks: list[Task],
+    *,
+    axis: str,
+    ctx: DistContext,
+    wdtype,
+    cdtype,
+    collective_id: int,
+    table: Any,
+):
+    """Assemble the pallas_call for a scheduled task list.
+
+    Returns ``f(kv_len, tokens, embed, wqkv, wo, w1, w2, lm_head, ln1,
+    ln2, normf, qn, kn, kc, vc) → (logits, kc, vc)`` — a per-shard
+    function to run under ``shard_map``.
+    """
+    cfg = mcfg.resolve(dims)
+    used = tuple({t.task_type for t in tasks})
+    kernel = make_mega_kernel(
+        dims, cfg, used, axis=axis, wdtype=wdtype, cdtype=cdtype
+    )
+    B, d = dims.batch, dims.d
+    n = dims.n_ranks
+    hkv, hd = dims.hkv_loc, dims.head_dim
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(len(tasks),),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 6
+        + [pl.BlockSpec(memory_space=pltpu.VMEM)] * 5
+        + [pl.BlockSpec(memory_space=pl.ANY)] * 2,
+        out_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # logits
+            pl.BlockSpec(memory_space=pl.ANY),      # k cache (aliased)
+            pl.BlockSpec(memory_space=pl.ANY),      # v cache (aliased)
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((B, d), jnp.float32),                   # x
+            pltpu.VMEM((B, d), jnp.float32),                   # h
+            pltpu.VMEM((B, dims.qkv_loc), jnp.float32),        # qkv
+            pltpu.VMEM((B, dims.o_k), jnp.float32),            # ao
+            pltpu.VMEM((B, dims.f_loc), jnp.float32),          # mlp
+            pltpu.VMEM((B, d), wdtype),                        # estage
+            pltpu.VMEM((2, d, cfg.tn_max), wdtype),            # colstage
+            pltpu.VMEM((2, cfg.tk_max, d), wdtype),            # rowstage
+            pltpu.VMEM((2, B, hkv, cfg.s_blk, hd), cdtype),    # kstage
+            pltpu.VMEM((2, B, hkv, cfg.s_blk, hd), cdtype),    # vstage
+            pltpu.VMEM((B, hkv, hd), cdtype),                  # knew_st
+            pltpu.VMEM((B, hkv, hd), cdtype),                  # vnew_st
+            pltpu.VMEM((B, d), jnp.float32),                   # arsrc
+            pltpu.VMEM((n, B, d), jnp.float32),                # cbuf
+            pltpu.SemaphoreType.DMA((2,)),                     # wsem
+            pltpu.SemaphoreType.DMA,                           # esem
+            pltpu.SemaphoreType.DMA,                           # osem
+            pltpu.SemaphoreType.DMA((2,)),                     # ksem
+            pltpu.SemaphoreType.DMA((2,)),                     # vsem
+            pltpu.SemaphoreType.DMA,                           # arsend
+            pltpu.SemaphoreType.DMA((n,)),                     # arrecv
+        ],
+    )
+
+    call = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, dims.v_loc), jnp.float32),
+            jax.ShapeDtypeStruct(
+                (dims.num_layers, B, hkv, dims.s_max, hd), cdtype
+            ),
+            jax.ShapeDtypeStruct(
+                (dims.num_layers, B, hkv, dims.s_max, hd), cdtype
+            ),
+        ],
+        # Input indices include the 3 scalar-prefetch args:
+        # kc is input 14 (3 prefetch + 11 arrays before it), vc is 15.
+        input_output_aliases={14: 1, 15: 2},
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True,
+            dimension_semantics=("arbitrary",),
+            collective_id=collective_id,
+            allow_collective_id_without_custom_barrier=True,
+        ),
+        interpret=interpret_mode(ctx),
+    )
+
+    def run(kv_len, tokens, embed, wqkv, wo, w1, w2, lm_head,
+            ln1, ln2, normf, qn, kn, kc, vc):
+        return call(
+            table, kv_len, tokens, embed, wqkv, wo, w1, w2, lm_head,
+            ln1, ln2, normf, qn, kn, kc, vc,
+        )
+
+    return run

@@ -1,0 +1,445 @@
+"""Device-side megakernel task bodies.
+
+Parity: reference ``mega_triton_kernel/kernels/*`` — the per-task device
+code (linear 99, flash_attn 232, norm 227, allreduce 65, …) dispatched by
+the generated megakernel, plus ``task_context.py``'s ``Scoreboard``
+(:107 ``wait_deps``, :126 ``release_tile``).
+
+TPU redesign (SURVEY.md §7 "megakernel scoreboard" hard part): the
+sequential Pallas grid discharges intra-chip dependencies by schedule
+order, so no scoreboard polling exists; tile-level overlap lives inside
+each body as a double-buffered HBM→VMEM weight pipeline (the DMA engines
+fetch tile ``j+1`` while the MXU consumes tile ``j``), and the only
+cross-chip task (ALLREDUCE) synchronizes with DMA semaphores — dataflow,
+not shared-memory spinning. Activations never touch HBM: the residual
+stream ``x``, branch input ``h``, qkv, attention output, and MLP
+activations all live in VMEM scratch for the whole decode step, which is
+the megakernel's fusion win (the reference keeps them in L2/HBM between
+task tiles).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_distributed_tpu import language as dl
+from triton_distributed_tpu.megakernel.registry import register_task
+from triton_distributed_tpu.megakernel.task import TaskType
+
+
+def _rms(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    """f32 RMS-norm (matches ``models.qwen.rms_norm``)."""
+    x = x * jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
+    return x * w.astype(jnp.float32)
+
+
+def _stream_cols(kctx, x_f32, w_hbm, n: int, tn: int, consume, col0: int = 0):
+    """Column-streamed GEMM: ``x [B, K] @ w_hbm [K, col0:col0+n*tn]``
+    tile-by-tile.
+
+    Double-buffered: tile ``j+1``'s DMA runs under tile ``j``'s matmul
+    (parity role: the reference linear task's tile pipeline,
+    ``mega_triton_kernel/kernels/linear.py``). ``consume(j, val)`` sinks
+    each ``[B, tn]`` f32 product.
+    """
+    stage, sem = kctx.colstage, kctx.wsem
+    k = x_f32.shape[1]
+    xa = x_f32.astype(kctx.wdtype)
+
+    def copy(j, slot):
+        return pltpu.make_async_copy(
+            w_hbm.at[:, pl.ds(col0 + j * tn, tn)],
+            stage.at[slot, :k, :tn],
+            sem.at[slot],
+        )
+
+    copy(0, 0).start()
+
+    def body(j, carry):
+        slot = jax.lax.rem(j, 2)
+
+        @pl.when(j + 1 < n)
+        def _prefetch():
+            copy(j + 1, 1 - slot).start()
+
+        copy(j, slot).wait()
+        val = jnp.dot(
+            xa, stage[slot, :k, :tn], preferred_element_type=jnp.float32
+        )
+        consume(j, val)
+        return carry
+
+    jax.lax.fori_loop(0, n, body, 0, unroll=False)
+
+
+def _stream_rows(kctx, x_f32, w_hbm, out_ref, n: int, tk: int):
+    """Row-streamed GEMM with accumulation: ``out += x [B, K] @ w [K, d]``
+    streaming K tiles (o-proj / fc2 shape class). Overwrites ``out_ref``."""
+    stage, sem = kctx.rowstage, kctx.wsem
+    d = out_ref.shape[-1]
+    xa = x_f32.astype(kctx.wdtype)
+
+    def copy(j, slot):
+        return pltpu.make_async_copy(
+            w_hbm.at[pl.ds(j * tk, tk), :],
+            stage.at[slot, :tk, :d],
+            sem.at[slot],
+        )
+
+    copy(0, 0).start()
+    out_ref[...] = jnp.zeros_like(out_ref)
+
+    def body(j, carry):
+        slot = jax.lax.rem(j, 2)
+
+        @pl.when(j + 1 < n)
+        def _prefetch():
+            copy(j + 1, 1 - slot).start()
+
+        copy(j, slot).wait()
+        val = jnp.dot(
+            jax.lax.dynamic_slice_in_dim(xa, j * tk, tk, 1),
+            stage[slot, :tk, :d],
+            preferred_element_type=jnp.float32,
+        )
+        out_ref[...] = out_ref[...] + val
+        return carry
+
+    jax.lax.fori_loop(0, n, body, 0, unroll=False)
+
+
+# -- task bodies -------------------------------------------------------------
+
+@register_task(TaskType.EMBED)
+def embed_body(kctx):
+    def body():
+        B = kctx.dims.batch
+
+        def row(b):
+            return pltpu.make_async_copy(
+                kctx.embed.at[kctx.tokens[b]], kctx.estage.at[b], kctx.esem
+            )
+
+        for b in range(B):
+            row(b).start()
+        for b in range(B):
+            row(b).wait()
+        kctx.x[...] = kctx.estage[...].astype(jnp.float32)
+
+    return body
+
+
+@register_task(TaskType.NORM)
+def norm_body(kctx):
+    def body():
+        eps = kctx.dims.rms_eps
+        xv = kctx.x[...]
+
+        @pl.when(kctx.arg0 == 0)
+        def _ln1():
+            kctx.h[...] = _rms(xv, kctx.ln1[kctx.layer], eps)
+
+        @pl.when(kctx.arg0 == 1)
+        def _ln2():
+            kctx.h[...] = _rms(xv, kctx.ln2[kctx.layer], eps)
+
+        @pl.when(kctx.arg0 == 2)
+        def _final():
+            kctx.h[...] = _rms(xv, kctx.normf[...], eps)
+
+    return body
+
+
+@register_task(TaskType.QKV_PROJ)
+def qkv_body(kctx):
+    def body():
+        dims = kctx.dims
+        tn = kctx.cfg.tn_qkv
+        n = dims.qkv_loc // tn
+
+        def sink(j, val):
+            kctx.qkv[:, pl.ds(j * tn, tn)] = val
+
+        _stream_cols(kctx, kctx.h[...], kctx.wqkv.at[kctx.layer], n, tn, sink)
+
+    return body
+
+
+@register_task(TaskType.ATTN)
+def attn_body(kctx):
+    """RoPE + QK-norm + cache append + GQA flash-decode (online softmax
+    over double-buffered KV blocks). Parity: reference attn task
+    (``mega_triton_kernel/kernels/flash_attn.py``) + paged-KV append."""
+
+    def body():
+        dims = kctx.dims
+        B, hq, hkv, hd = dims.batch, dims.hq_loc, dims.hkv_loc, dims.head_dim
+        g = hq // hkv
+        eps, theta = dims.rms_eps, dims.rope_theta
+        layer = kctx.layer
+        pos = [kctx.kv_len[b] for b in range(B)]
+
+        qkv = kctx.qkv[...]  # [B, (hq + 2 hkv) hd] f32
+        q = qkv[:, : hq * hd].reshape(B, hq, hd)
+        knew = qkv[:, hq * hd:(hq + hkv) * hd].reshape(B, hkv, hd)
+        vnew = qkv[:, (hq + hkv) * hd:].reshape(B, hkv, hd)
+
+        def headnorm(t, w):
+            return t * jax.lax.rsqrt(
+                jnp.mean(t * t, axis=-1, keepdims=True) + eps
+            ) * w.astype(jnp.float32)
+
+        q = headnorm(q, kctx.qn[layer])
+        knew = headnorm(knew, kctx.kn[layer])
+
+        # iota (not arange): concrete arrays would be captured consts,
+        # which pallas_call rejects.
+        i2 = jax.lax.broadcasted_iota(jnp.float32, (1, hd // 2), 1) * 2.0
+        inv = 1.0 / (theta ** (i2 / hd))  # [1, hd/2]
+
+        def rope(t, p):  # t [h, hd], p scalar
+            ang = p.astype(jnp.float32) * inv
+            cos, sin = jnp.cos(ang), jnp.sin(ang)
+            t1, t2 = t[:, : hd // 2], t[:, hd // 2:]
+            return jnp.concatenate(
+                [t1 * cos - t2 * sin, t2 * cos + t1 * sin], axis=-1
+            )
+
+        q = jnp.stack([rope(q[b], pos[b]) for b in range(B)])
+        knew = jnp.stack([rope(knew[b], pos[b]) for b in range(B)])
+
+        # Append at position kv_len[b] via staged DMA into the cache.
+        kctx.knew_st[...] = knew.astype(kctx.cdtype)
+        kctx.vnew_st[...] = vnew.astype(kctx.cdtype)
+
+        def appends(b):
+            return (
+                pltpu.make_async_copy(
+                    kctx.knew_st.at[b], kctx.kc.at[layer, b, :, pos[b], :],
+                    kctx.osem,
+                ),
+                pltpu.make_async_copy(
+                    kctx.vnew_st.at[b], kctx.vc.at[layer, b, :, pos[b], :],
+                    kctx.osem,
+                ),
+            )
+
+        for b in range(B):
+            ka, va = appends(b)
+            ka.start()
+            va.start()
+        for b in range(B):
+            ka, va = appends(b)
+            ka.wait()
+            va.wait()
+
+        # Online-softmax decode over KV blocks, double-buffered. The
+        # block loop is bounded by the furthest live position, not
+        # s_max — per-step cost is O(kv_len), the fori upper bound is
+        # traced (parity role: the reference's split-KV sizing by
+        # actual seq len, ``flash_decode.py:130``).
+        sblk = kctx.cfg.s_blk
+        maxpos = pos[0]
+        for b in range(1, B):
+            maxpos = jnp.maximum(maxpos, pos[b])
+        nblk = maxpos // sblk + 1  # blocks overlapping [0, maxpos]
+        scale = hd ** -0.5
+
+        def kv_copy(j, slot):
+            return (
+                pltpu.make_async_copy(
+                    kctx.kc.at[layer, :, :, pl.ds(j * sblk, sblk), :],
+                    kctx.kstage.at[slot], kctx.ksem.at[slot],
+                ),
+                pltpu.make_async_copy(
+                    kctx.vc.at[layer, :, :, pl.ds(j * sblk, sblk), :],
+                    kctx.vstage.at[slot], kctx.vsem.at[slot],
+                ),
+            )
+
+        kc0, vc0 = kv_copy(0, 0)
+        kc0.start()
+        vc0.start()
+
+        neg = jnp.float32(-1e30)
+        m0 = jnp.full((B, hq, 1), neg, jnp.float32)
+        l0 = jnp.zeros((B, hq, 1), jnp.float32)
+        a0 = jnp.zeros((B, hq, hd), jnp.float32)
+
+        def blk(j, carry):
+            m, l, acc = carry
+            slot = jax.lax.rem(j, 2)
+
+            @pl.when(j + 1 < nblk)
+            def _prefetch():
+                kn_, vn_ = kv_copy(j + 1, 1 - slot)
+                kn_.start()
+                vn_.start()
+
+            kc_, vc_ = kv_copy(j, slot)
+            kc_.wait()
+            vc_.wait()
+            kb = kctx.kstage[slot].astype(jnp.float32)  # [B, hkv, sblk, hd]
+            vb = kctx.vstage[slot].astype(jnp.float32)
+            idx = j * sblk + jax.lax.broadcasted_iota(jnp.int32, (1, sblk), 1)
+
+            rows = []
+            for b in range(B):
+                valid = idx <= pos[b]  # [1, sblk] — includes appended token
+                for h in range(hkv):
+                    s = jnp.dot(
+                        q[b, h * g:(h + 1) * g], kb[b, h].T,
+                        preferred_element_type=jnp.float32,
+                    ) * scale  # [g, sblk]
+                    rows.append(jnp.where(valid, s, neg))
+            s_all = jnp.stack(rows).reshape(B, hq, sblk)
+
+            m_new = jnp.maximum(m, jnp.max(s_all, axis=-1, keepdims=True))
+            p = jnp.exp(s_all - m_new)
+            corr = jnp.exp(m - m_new)
+            l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+            pv_rows = []
+            for b in range(B):
+                for h in range(hkv):
+                    pv_rows.append(jnp.dot(
+                        p[b, h * g:(h + 1) * g], vb[b, h],
+                        preferred_element_type=jnp.float32,
+                    ))  # [g, hd]
+            pv = jnp.stack(pv_rows).reshape(B, hq, hd)
+            acc = acc * corr + pv
+            return m_new, l, acc
+
+        _, l, acc = jax.lax.fori_loop(0, nblk, blk, (m0, l0, a0), unroll=False)
+        kctx.ao[...] = (acc / l).reshape(B, hq * hd)
+
+    return body
+
+
+@register_task(TaskType.O_PROJ)
+def o_proj_body(kctx):
+    def body():
+        dims = kctx.dims
+        tk = kctx.cfg.tk_o
+        n = (dims.hq_loc * dims.head_dim) // tk
+        _stream_rows(
+            kctx, kctx.ao[...], kctx.wo.at[kctx.layer], kctx.h, n, tk
+        )
+
+    return body
+
+
+@register_task(TaskType.FC1)
+def fc1_body(kctx):
+    """Gate pass then up pass over the fused ``[d, gate_loc | up_loc]``
+    shard layout (``models.qwen._fuse_by_shard``); silu·mul fused into
+    the sinks — the reference's separate activation/elementwise tasks
+    (``tasks/activation.py``) fold into this body on TPU."""
+
+    def body():
+        dims = kctx.dims
+        tn = kctx.cfg.tn_fc1
+        n = dims.f_loc // tn
+        h = kctx.h[...]
+        w1 = kctx.w1.at[kctx.layer]
+
+        def sink_gate(j, val):
+            kctx.mlp[:, pl.ds(j * tn, tn)] = val * jax.lax.logistic(val)
+
+        _stream_cols(kctx, h, w1, n, tn, sink_gate, col0=0)
+
+        def sink_up(j, val):
+            sl = pl.ds(j * tn, tn)
+            kctx.mlp[:, sl] = kctx.mlp[:, sl] * val
+
+        _stream_cols(kctx, h, w1, n, tn, sink_up, col0=dims.f_loc)
+
+    return body
+
+
+@register_task(TaskType.FC2)
+def fc2_body(kctx):
+    def body():
+        dims = kctx.dims
+        tk = kctx.cfg.tk_fc2
+        n = dims.f_loc // tk
+        _stream_rows(
+            kctx, kctx.mlp[...], kctx.w2.at[kctx.layer], kctx.h, n, tk
+        )
+
+    return body
+
+
+@register_task(TaskType.ALLREDUCE)
+def allreduce_body(kctx):
+    """``x += psum(h)`` over the tp axis: one-shot broadcast into
+    symmetric workspace slots + local reduction, trailing barrier.
+
+    Parity: the reference's in-megakernel allreduce task
+    (``tasks/allreduce.py``, ``kernels/allreduce.py``) which likewise
+    pushes partials to peer symmetric buffers. The trailing barrier
+    bounds cross-rank skew so slot reuse by the NEXT allreduce task is
+    race-free — the role the reference's scoreboard release plays.
+    """
+
+    def body():
+        axis = kctx.axis
+        n = kctx.dims.n_ranks
+        me = jax.lax.axis_index(axis)
+        h = kctx.h[...]
+        kctx.arsrc[...] = h
+
+        def put(p):
+            dst = jax.lax.rem(me + p, n)
+            return pltpu.make_async_remote_copy(
+                src_ref=kctx.arsrc,
+                dst_ref=kctx.cbuf.at[me],
+                send_sem=kctx.arsend,
+                recv_sem=kctx.arrecv.at[me],
+                device_id={axis: dst},
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+
+        for p in range(1, n):
+            put(p).start()
+
+        acc = kctx.x[...] + h
+        for p in range(1, n):
+            src = jax.lax.rem(me + p, n)
+            pltpu.make_async_copy(
+                kctx.cbuf.at[src], kctx.arsrc, kctx.arrecv.at[src]
+            ).wait()
+            # The DMA above waits arrival only (src == dst ref trick is
+            # not used here: read the landed slot directly).
+            acc = acc + kctx.cbuf[src]
+        kctx.x[...] = acc
+        for p in range(1, n):
+            put(p).wait_send()
+        dl.barrier_all(axis)
+
+    return body
+
+
+@register_task(TaskType.LM_HEAD)
+def lm_head_body(kctx):
+    def body():
+        dims = kctx.dims
+        tn = kctx.cfg.tn_lm
+        n = dims.v_loc // tn
+
+        def sink(j, val):
+            kctx.logits[:, pl.ds(j * tn, tn)] = val
+
+        _stream_cols(kctx, kctx.h[...], kctx.lm_head, n, tn, sink)
+
+    return body
+
+
+@register_task(TaskType.BARRIER)
+def barrier_body(kctx):
+    def body():
+        dl.barrier_all(kctx.axis)
+
+    return body

@@ -1,0 +1,101 @@
+"""Qwen3 megakernel model: the whole TP decode step as ONE Pallas kernel.
+
+Parity: reference ``mega_triton_kernel/models/qwen3.py`` —
+``Qwen3Model``:108 building fc1/qkv/attn/allreduce/… tasks for every
+layer and running the persistent kernel per decode step (the top rung of
+the reference's decode ladder, ``docs/mega_triton_kernel.md:27-37``).
+
+Reuses :class:`~triton_distributed_tpu.models.qwen.Qwen3` for parameters
+and sharding, so the megakernel is a drop-in third decode mode next to
+``xla`` / ``pallas``.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu.megakernel.code_generator import MegaConfig, MegaDims
+from triton_distributed_tpu.megakernel.model_builder import ModelBuilder
+from triton_distributed_tpu.megakernel.scheduler import SchedulePolicy
+from triton_distributed_tpu.models.kv_cache import KVCache, cache_specs
+from triton_distributed_tpu.models.qwen import Qwen3, Qwen3Params
+
+
+class MegaQwen3:
+    """Megakernel decode wrapper around a (loaded) :class:`Qwen3`."""
+
+    def __init__(
+        self,
+        model: Qwen3,
+        *,
+        cfg: MegaConfig | None = None,
+        policy: SchedulePolicy = SchedulePolicy.ROUND_ROBIN,
+    ):
+        if model.params is None:
+            raise ValueError("load or init Qwen3 params first")
+        self.model = model
+        self.cfg = cfg or MegaConfig()
+        self.policy = policy
+        self._jit: dict = {}
+
+    def _dims(self, batch: int, s_max: int) -> MegaDims:
+        m = self.model
+        c = m.cfg
+        n = m.ctx.axis_size(m.axis)
+        return MegaDims(
+            batch=batch,
+            d=c.hidden_size,
+            hq_loc=m.dims.hq_loc,
+            hkv_loc=m.dims.hkv_loc,
+            head_dim=c.head_dim,
+            f_loc=c.intermediate_size // n,
+            v_loc=c.vocab_size // n,
+            num_layers=c.num_layers,
+            s_max=s_max,
+            n_ranks=n,
+            rms_eps=c.rms_eps,
+            rope_theta=c.rope_theta,
+        )
+
+    def build(self, batch: int, s_max: int):
+        """Build + schedule the task graph and jit the SPMD step
+        (parity: ``Qwen3Model.build_fwd`` + ``compile``)."""
+        m = self.model
+        dims = self._dims(batch, s_max)
+        mb = ModelBuilder(
+            dims, cfg=self.cfg, axis=m.axis, ctx=m.ctx,
+            wdtype=m.cfg.dtype, cdtype=m.cfg.dtype,
+        )
+        mb.build_decoder_graph()
+        compiled = mb.compile(self.policy)
+        per_shard = compiled.per_shard
+        ax = m.axis
+
+        def shard_fn(params: Qwen3Params, tokens, cache: KVCache):
+            lp = params.layers
+            logits, k_new, v_new = per_shard(
+                cache.kv_len, tokens,
+                params.embed, lp.attn.wqkv, lp.attn.wo, lp.mlp.w1, lp.mlp.w2,
+                params.lm_head, lp.ln1, lp.ln2, params.norm,
+                lp.attn.q_norm, lp.attn.k_norm,
+                cache.k, cache.v,
+            )
+            return logits, KVCache(k=k_new, v=v_new, kv_len=cache.kv_len + 1)
+
+        f = m.ctx.shard_map(
+            shard_fn,
+            in_specs=(m.param_specs, P(), cache_specs(ax)),
+            out_specs=(P(None, ax), cache_specs(ax)),
+        )
+        step = jax.jit(f, donate_argnums=(2,))
+        return compiled, step
+
+    def decode_step(self, tokens: jax.Array, cache: KVCache):
+        """One decode step for the whole batch: ``tokens [B] int32 →
+        (logits [B, V] f32, cache)`` — the megakernel rung of the decode
+        ladder."""
+        key = (int(tokens.shape[0]), int(cache.k.shape[3]))
+        if key not in self._jit:
+            self._jit[key] = self.build(*key)[1]
+        return self._jit[key](self.model.params, tokens, cache)

@@ -1,0 +1,52 @@
+"""Task registry: task type → device body factory.
+
+Parity: reference ``mega_triton_kernel/core/registry.py`` —
+``register_task``:38 maps a task key to its TaskBuilder + device kernel;
+the code generator then emits only the branches a model actually uses.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Protocol
+
+from triton_distributed_tpu.megakernel.task import TaskType
+
+
+class BodyFactory(Protocol):
+    """Builds the device-side body for one task type.
+
+    Called once at code-generation time with the static kernel context
+    (dims, config, refs); returns a zero-arg callable executed under
+    ``pl.when(task_type == value)`` with the current header in scope.
+    """
+
+    def __call__(self, kctx) -> Callable[[], None]: ...
+
+
+_REGISTRY: dict[TaskType, BodyFactory] = {}
+
+
+def register_task(task_type: TaskType):
+    """Decorator (parity: ``@register_task``, ``core/registry.py:38``)."""
+
+    def deco(factory: BodyFactory) -> BodyFactory:
+        if task_type in _REGISTRY:
+            raise ValueError(f"duplicate task body for {task_type!r}")
+        _REGISTRY[task_type] = factory
+        return factory
+
+    return deco
+
+
+def get_body_factory(task_type: TaskType) -> BodyFactory:
+    try:
+        return _REGISTRY[task_type]
+    except KeyError:
+        raise KeyError(
+            f"no device body registered for {task_type!r}; "
+            "import triton_distributed_tpu.megakernel.kernels"
+        ) from None
+
+
+def registered_types() -> tuple[TaskType, ...]:
+    return tuple(sorted(_REGISTRY, key=int))

@@ -1,0 +1,107 @@
+"""Megakernel task graph: task types, headers, ids, dependencies.
+
+Parity: reference ``mega_triton_kernel/core/task_base.py`` —
+``CodeGenKey``:36 (task_type/layer dispatch key), ``TaskIDManager``:75,
+``TaskDependency``:112 — and its 8-int device-side task headers read by
+the generated megakernel (``core/code_generator.py:92-174``).
+
+TPU redesign: the reference schedules *tile*-granular tasks onto many SMs
+and synchronizes them with a shared-memory scoreboard
+(``kernels/task_context.py:107``). A TPU chip exposes one sequential
+Pallas grid per core, so tasks here are *op*-granular (one task = one
+fused op over the whole batch), tile-level parallelism lives INSIDE a
+task body as a double-buffered DMA pipeline, and intra-chip dependencies
+are discharged by schedule order (the grid is sequential under
+``dimension_semantics=("arbitrary",)``) — the scoreboard survives only at
+chip boundaries, as DMA-semaphore dataflow in the allreduce task.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+import numpy as np
+
+# Device-side header layout: HDR_INTS int32 per task.
+# [0] task_type  [1] layer_id  [2] arg0  [3] arg1  (rest reserved)
+HDR_INTS = 8
+
+
+class TaskType(enum.IntEnum):
+    """Dispatch key (parity: ``CodeGenKey.task_type``).
+
+    Values index the generated ``pl.when`` dispatch chain, mirroring the
+    reference's generated if/elif over task types
+    (``core/code_generator.py:103-152``).
+    """
+
+    EMBED = 0        # x ← embed[tokens]
+    NORM = 1         # h ← rms_norm(x) * w;  arg0: 0=ln1  1=ln2  2=final
+    QKV_PROJ = 2     # qkv ← h @ wqkv[layer]
+    ATTN = 3         # rope + cache append + GQA flash-decode → attn out
+    O_PROJ = 4       # h ← attn_out @ wo[layer]   (partial sum over tp)
+    FC1 = 5          # mlp ← silu(h @ gate) * (h @ up)
+    FC2 = 6          # h ← mlp @ w2[layer]        (partial sum over tp)
+    ALLREDUCE = 7    # x ← x + psum(h);  arg0: parity slot
+    LM_HEAD = 8      # logits ← rms_norm(x) stage then tiled GEMM
+    BARRIER = 9      # standalone cross-chip barrier (stress/test fixture)
+
+
+# Resource class used by the zig-zag scheduler: tasks whose cost is
+# dominated by the MXU vs by DMA/ICI traffic (parity role: the
+# reference's compute/comm SM partitioning heuristics).
+COMM_TASKS = frozenset({TaskType.ALLREDUCE, TaskType.BARRIER, TaskType.EMBED})
+
+
+@dataclasses.dataclass(frozen=True)
+class TaskDependency:
+    """Edge producer → consumer (parity: ``TaskDependency``,
+    ``core/task_base.py:112``). Tile ranges collapse to whole-task edges
+    in the op-granular design."""
+
+    producer: int  # task id
+
+
+@dataclasses.dataclass
+class Task:
+    """One schedulable unit (parity: the reference's task records built
+    by ``TaskBuilderBase.build_tasks``, ``core/builder.py:62``)."""
+
+    task_id: int
+    task_type: TaskType
+    layer_id: int = 0
+    arg0: int = 0
+    arg1: int = 0
+    deps: tuple[TaskDependency, ...] = ()
+
+    def header(self) -> list[int]:
+        h = [int(self.task_type), self.layer_id, self.arg0, self.arg1]
+        return h + [0] * (HDR_INTS - len(h))
+
+
+class TaskIDManager:
+    """Monotone task-id allocator (parity: ``TaskIDManager``,
+    ``core/task_base.py:75``)."""
+
+    def __init__(self) -> None:
+        self._next = 0
+
+    def alloc(self) -> int:
+        tid = self._next
+        self._next += 1
+        return tid
+
+    @property
+    def count(self) -> int:
+        return self._next
+
+
+def pack_table(tasks: list[Task]) -> np.ndarray:
+    """Flatten scheduled tasks into the int32 device table the kernel
+    scalar-prefetches (parity: the per-SM int32 work queues,
+    ``core/scheduler.py:40-63`` — collapsed to one queue for the
+    sequential TPU grid)."""
+    if not tasks:
+        raise ValueError("empty task list")
+    return np.asarray([t.header() for t in tasks], np.int32)

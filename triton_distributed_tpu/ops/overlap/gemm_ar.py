@@ -199,14 +199,8 @@ def gemm_ar(
             a, b, axis=axis, config=GemmRSConfig(config.tile_n, config.acc_dtype),
             ctx=ctx,
         )
-        # AUTO keeps the VMEM-size guard: outputs too big for the ring
-        # kernel ride the XLA all-gather instead.
-        ag_method = (
-            AllGatherMethod.PALLAS_BIDIR_RING
-            if out_bytes <= VMEM_COMM_MAX_BYTES and _on_tpu(ctx)
-            else AllGatherMethod.AUTO
-        )
-        return all_gather(reduced, axis, ag_method, ctx)
+        # AUTO applies the VMEM-size / on-TPU guards inside all_gather.
+        return all_gather(reduced, axis, AllGatherMethod.AUTO, ctx)
 
     # ONE_SHOT
     tile_n = min(config.tile_n, n_out)

@@ -20,6 +20,7 @@ aggregation hook still applies (over ``jax.distributed`` hosts).
 from __future__ import annotations
 
 import dataclasses
+import functools
 import logging
 import os
 from typing import Any, Callable, Iterable, Mapping, Sequence
@@ -120,19 +121,35 @@ class Autotuner:
     def _key(self, args, kwargs):
         if self.key_fn is not None:
             return self.key_fn(*args, **kwargs)
-        parts = []
-        for a in args:
+
+        def part(a):
             if hasattr(a, "shape") and hasattr(a, "dtype"):
-                parts.append((tuple(a.shape), str(a.dtype)))
-            elif isinstance(a, (int, str, bool)):
-                parts.append(a)
-        return tuple(parts)
+                return (tuple(a.shape), str(a.dtype))
+            if isinstance(a, (int, float, str, bool)):
+                return a
+            return None
+
+        parts = [part(a) for a in args]
+        parts += [(k, part(v)) for k, v in sorted(kwargs.items())]
+        return tuple(p for p in parts if p is not None)
+
+    def _effective(self) -> tuple[bool, int, int]:
+        """(is_dist, n_repeat, n_warmup) with any enclosing
+        ``contextual_autotune`` override applied (None = keep own)."""
+        is_dist, n_repeat, n_warmup = self.is_dist, self.n_repeat, self.n_warmup
+        if _context_overrides:
+            c_dist, c_rep, c_warm = _context_overrides[-1]
+            is_dist = c_dist if c_dist is not None else is_dist
+            n_repeat = c_rep if c_rep is not None else n_repeat
+            n_warmup = c_warm if c_warm is not None else n_warmup
+        return is_dist, n_repeat, n_warmup
 
     def _bench_config(self, cfg: Config, args, kwargs) -> float:
         def thunk():
             return self.fn(*args, **{**kwargs, **cfg.kwargs})
 
-        _, ms = perf_func(thunk, iters=self.n_repeat, warmup_iters=self.n_warmup)
+        _, n_repeat, n_warmup = self._effective()
+        _, ms = perf_func(thunk, iters=n_repeat, warmup_iters=n_warmup)
         return ms
 
     def __call__(self, *args, **kwargs):
@@ -149,8 +166,12 @@ class Autotuner:
             self.prune_fn(self.configs) if self.prune_fn else self.configs
         )
         # Failed configs record inf so the per-config vector stays aligned
-        # across hosts for the MAX aggregation (a config that faults on
-        # ANY host is thereby rejected everywhere).
+        # across hosts for the MAX aggregation; a config that fails the
+        # same way on every host (compile error, bad tile) is rejected
+        # everywhere. NOTE: a config whose *collective* faults on only a
+        # subset of hosts can still desynchronize the sweep (the healthy
+        # hosts block inside the collective) — same exposure as the
+        # reference; prune such configs ahead of time via ``prune``.
         times_ms: list[float] = []
         for i, cand in enumerate(candidates):
             try:
@@ -168,7 +189,8 @@ class Autotuner:
             )
             times_ms.append(ms)
 
-        times_ms = _aggregate_max_over_hosts(times_ms)
+        if self._effective()[0]:
+            times_ms = _aggregate_max_over_hosts(times_ms)
         okay = [
             (c, t) for c, t in zip(candidates, times_ms) if t != float("inf")
         ]
@@ -206,19 +228,34 @@ def autotune(
     return decor
 
 
-def contextual_autotune(is_dist: bool = False, n_repeat: int = 5, n_warmup: int = 3):
-    """Parity shim matching the reference's entry point
-    (``autotuner.py:97``): wraps a thunk whose inner ops are
-    ``Autotuner`` instances. Under the JAX design the inner tuners are
-    already contextual (they time the whole wrapped op), so this only
-    forwards the call — it exists so reference-style call sites port
-    one-to-one."""
+def contextual_autotune(
+    is_dist: bool | None = None,
+    n_repeat: int | None = None,
+    n_warmup: int | None = None,
+):
+    """Parity entry point matching the reference (``autotuner.py:97``):
+    wraps a thunk whose inner ops are ``Autotuner`` instances. Under the
+    JAX design the inner tuners are already contextual (they time the
+    whole wrapped op), so the wrapper's job is to scope overrides: while
+    the wrapped fn runs, explicitly-passed ``is_dist`` / ``n_repeat`` /
+    ``n_warmup`` replace the inner tuners' own settings (``is_dist``
+    gates the cross-host MAX timing aggregation; None leaves each inner
+    tuner's value untouched)."""
 
     def decor(fn):
+        @functools.wraps(fn)
         def wrapped(*args, **kwargs):
-            return fn(*args, **kwargs)
+            _context_overrides.append((is_dist, n_repeat, n_warmup))
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                _context_overrides.pop()
 
-        wrapped.__name__ = getattr(fn, "__name__", "tuned_fn")
         return wrapped
 
     return decor
+
+
+# Innermost contextual_autotune override: (is_dist, n_repeat, n_warmup),
+# None meaning "keep the inner tuner's own value".
+_context_overrides: list[tuple[bool | None, int | None, int | None]] = []
