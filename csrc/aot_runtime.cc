@@ -1,0 +1,163 @@
+// AOT artifact container: C-callable archive of exported XLA programs.
+//
+// Parity: reference python/triton_dist/tools/runtime/triton_aot_runtime.cc
+// (+ tools/compile.{c,h}) — there, AOT-compiled cubins plus algo-info
+// structs are loaded by a C runtime so serving stacks launch kernels
+// without Python. The TPU translation (SURVEY.md §2.1 "AOT runtime"):
+// programs are serialized with jax.export (StableHLO + calling
+// convention); this library is the native container/loader half — a
+// single-file archive holding {name, JSON metadata (the algo-info
+// analog: shapes, dtypes, static config), serialized program bytes} with
+// a C API for writers (the compile_aot CLI) and readers (C++ serving
+// hosts, which hand the bytes to their PJRT runtime; Python readers
+// deserialize with jax.export.deserialize).
+//
+// Format TDTAOT01 (little-endian):
+//   u8[8]  magic "TDTAOT01"
+//   u32    entry count
+//   repeat: u32 name_len, name bytes, u32 meta_len, meta bytes (JSON),
+//           u64 data_len, data bytes
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr char kMagic[8] = {'T', 'D', 'T', 'A', 'O', 'T', '0', '1'};
+
+struct Entry {
+  std::string name;
+  std::string meta;
+  std::vector<uint8_t> data;
+};
+
+struct Archive {
+  std::vector<Entry> entries;
+};
+
+bool ReadExact(std::FILE* f, void* dst, size_t n) {
+  return std::fread(dst, 1, n, f) == n;
+}
+
+bool WriteExact(std::FILE* f, const void* src, size_t n) {
+  return std::fwrite(src, 1, n, f) == n;
+}
+
+}  // namespace
+
+extern "C" {
+
+typedef struct Archive TdtAotArchive;
+
+// Returns nullptr on malformed/unreadable archives.
+TdtAotArchive* tdt_aot_open(const char* path) {
+  std::FILE* f = std::fopen(path, "rb");
+  if (!f) return nullptr;
+  auto fail = [&]() -> TdtAotArchive* {
+    std::fclose(f);
+    return nullptr;
+  };
+  // File size bounds every untrusted length field: a corrupt header can
+  // otherwise drive a multi-GB resize (bad_alloc across the C boundary).
+  if (std::fseek(f, 0, SEEK_END) != 0) return fail();
+  long fsize = std::ftell(f);
+  if (fsize < 12 || std::fseek(f, 0, SEEK_SET) != 0) return fail();
+  uint64_t remaining = static_cast<uint64_t>(fsize) - 12;
+
+  char magic[8];
+  if (!ReadExact(f, magic, 8) || std::memcmp(magic, kMagic, 8) != 0) {
+    return fail();
+  }
+  uint32_t count = 0;
+  if (!ReadExact(f, &count, 4)) return fail();
+  auto* a = new Archive();
+  a->entries.reserve(std::min<uint64_t>(count, remaining / 16));
+  for (uint32_t i = 0; i < count; ++i) {
+    uint32_t name_len = 0, meta_len = 0;
+    uint64_t data_len = 0;
+    Entry e;
+    auto take = [&](uint64_t need) {
+      if (need > remaining) return false;
+      remaining -= need;
+      return true;
+    };
+    if (!ReadExact(f, &name_len, 4) || !take(4u + name_len)) goto bad;
+    e.name.resize(name_len);
+    if (name_len && !ReadExact(f, e.name.data(), name_len)) goto bad;
+    if (!ReadExact(f, &meta_len, 4) || !take(4u + meta_len)) goto bad;
+    e.meta.resize(meta_len);
+    if (meta_len && !ReadExact(f, e.meta.data(), meta_len)) goto bad;
+    if (!ReadExact(f, &data_len, 8) || !take(8) || !take(data_len)) goto bad;
+    e.data.resize(data_len);
+    if (data_len && !ReadExact(f, e.data.data(), data_len)) goto bad;
+    a->entries.push_back(std::move(e));
+  }
+  std::fclose(f);
+  return a;
+bad:
+  delete a;
+  return fail();
+}
+
+int tdt_aot_num_entries(const TdtAotArchive* a) {
+  return static_cast<int>(a->entries.size());
+}
+
+const char* tdt_aot_entry_name(const TdtAotArchive* a, int i) {
+  if (i < 0 || i >= static_cast<int>(a->entries.size())) return nullptr;
+  return a->entries[i].name.c_str();
+}
+
+const char* tdt_aot_entry_meta(const TdtAotArchive* a, int i) {
+  if (i < 0 || i >= static_cast<int>(a->entries.size())) return nullptr;
+  return a->entries[i].meta.c_str();
+}
+
+const uint8_t* tdt_aot_entry_data(const TdtAotArchive* a, int i,
+                                  uint64_t* len) {
+  if (i < 0 || i >= static_cast<int>(a->entries.size())) return nullptr;
+  *len = a->entries[i].data.size();
+  return a->entries[i].data.data();
+}
+
+int tdt_aot_find(const TdtAotArchive* a, const char* name) {
+  for (size_t i = 0; i < a->entries.size(); ++i) {
+    if (a->entries[i].name == name) return static_cast<int>(i);
+  }
+  return -1;
+}
+
+void tdt_aot_close(TdtAotArchive* a) { delete a; }
+
+// Writes an archive in one shot. Returns 0 on success.
+int tdt_aot_write(const char* path, int n, const char** names,
+                  const char** metas, const uint8_t** datas,
+                  const uint64_t* data_lens) {
+  std::FILE* f = std::fopen(path, "wb");
+  if (!f) return 1;
+  auto fail = [&]() {
+    std::fclose(f);
+    std::remove(path);
+    return 2;
+  };
+  uint32_t count = static_cast<uint32_t>(n);
+  if (!WriteExact(f, kMagic, 8) || !WriteExact(f, &count, 4)) return fail();
+  for (int i = 0; i < n; ++i) {
+    uint32_t name_len = static_cast<uint32_t>(std::strlen(names[i]));
+    uint32_t meta_len = static_cast<uint32_t>(std::strlen(metas[i]));
+    uint64_t data_len = data_lens[i];
+    if (!WriteExact(f, &name_len, 4) || !WriteExact(f, names[i], name_len) ||
+        !WriteExact(f, &meta_len, 4) || !WriteExact(f, metas[i], meta_len) ||
+        !WriteExact(f, &data_len, 8) ||
+        (data_len && !WriteExact(f, datas[i], data_len))) {
+      return fail();
+    }
+  }
+  if (std::fclose(f) != 0) return 3;
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,119 @@
+// MoE token-sort / block-align native ops.
+//
+// Parity: reference csrc/lib/moe_utils.cu:61-356
+// (moe_ag_scatter_align_block_size_kernel + parallel variant :195-314) —
+// sorts flattened top-k token→expert assignments into expert-contiguous
+// order, padding each expert's segment to a multiple of the grouped-GEMM
+// block size, and emits the per-block expert map the tile scheduler
+// consumes. The reference binds this as a torch extension
+// (csrc/lib/op_pybind.cc:31); here the same routine is exposed twice:
+//   1. an XLA FFI custom call (CPU platform) usable inside jit, and
+//   2. a plain C entry point for the ctypes host-planning path.
+// TPU grouped GEMM (jax.lax.ragged_dot) consumes group_sizes directly, so
+// on-device the pure-JAX composition in ops/moe/routing.py is the default;
+// this native variant keeps the "native stays native" contract (SURVEY.md
+// §2.1) and serves host-side planners.
+
+#include <algorithm>
+#include <cstdint>
+#include <vector>
+
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+namespace {
+
+// Core routine, shared by the FFI handler and the C API.
+// sorted_ids[cap]: slot -> source index into the flattened [T*k] routing
+//   (sentinel n for pad slots). Each expert segment is padded to a
+//   multiple of block_size.
+// block_expert[bcap]: grouped-GEMM tile -> expert id (-1 past the end).
+// counts[2]: {num_blocks, num_padded_slots}.
+int AlignBlockSize(const int32_t* eids, int64_t n, int32_t num_experts,
+                   int32_t block_size, int32_t* sorted_ids, int64_t cap,
+                   int32_t* block_expert, int64_t bcap, int32_t* counts) {
+  if (block_size <= 0 || num_experts <= 0) return 1;
+  std::vector<int64_t> count(num_experts, 0);
+  for (int64_t i = 0; i < n; ++i) {
+    int32_t e = eids[i];
+    if (e < 0 || e >= num_experts) return 2;
+    ++count[e];
+  }
+  std::vector<int64_t> padded(num_experts), start(num_experts);
+  int64_t total_padded = 0;
+  for (int32_t e = 0; e < num_experts; ++e) {
+    padded[e] = (count[e] + block_size - 1) / block_size * block_size;
+    start[e] = total_padded;
+    total_padded += padded[e];
+  }
+  int64_t num_blocks = total_padded / block_size;
+  if (total_padded > cap || num_blocks > bcap) return 3;
+
+  std::fill(sorted_ids, sorted_ids + cap, static_cast<int32_t>(n));
+  std::vector<int64_t> cursor(start);  // next free slot per expert
+  for (int64_t i = 0; i < n; ++i) {    // stable: ascending source index
+    sorted_ids[cursor[eids[i]]++] = static_cast<int32_t>(i);
+  }
+  std::fill(block_expert, block_expert + bcap, -1);
+  for (int32_t e = 0; e < num_experts; ++e) {
+    for (int64_t b = start[e] / block_size;
+         b < (start[e] + padded[e]) / block_size; ++b) {
+      block_expert[b] = e;
+    }
+  }
+  counts[0] = static_cast<int32_t>(num_blocks);
+  counts[1] = static_cast<int32_t>(total_padded);
+  return 0;
+}
+
+ffi::Error MoeAlignImpl(ffi::Buffer<ffi::S32> expert_ids,
+                        ffi::Result<ffi::Buffer<ffi::S32>> sorted_ids,
+                        ffi::Result<ffi::Buffer<ffi::S32>> block_expert,
+                        ffi::Result<ffi::Buffer<ffi::S32>> counts,
+                        int32_t num_experts, int32_t block_size) {
+  if (counts->element_count() < 2) {
+    return ffi::Error::InvalidArgument("counts must have >= 2 elements");
+  }
+  int rc = AlignBlockSize(
+      expert_ids.typed_data(), expert_ids.element_count(),
+      num_experts, block_size, sorted_ids->typed_data(),
+      sorted_ids->element_count(), block_expert->typed_data(),
+      block_expert->element_count(), counts->typed_data());
+  switch (rc) {
+    case 0:
+      return ffi::Error::Success();
+    case 2:
+      return ffi::Error::InvalidArgument("expert id out of range");
+    case 3:
+      return ffi::Error::InvalidArgument("output capacity too small");
+    default:
+      return ffi::Error::InvalidArgument("bad num_experts/block_size");
+  }
+}
+
+}  // namespace
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    TdtMoeAlignBlockSize, MoeAlignImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::S32>>()
+        .Ret<ffi::Buffer<ffi::S32>>()
+        .Ret<ffi::Buffer<ffi::S32>>()
+        .Ret<ffi::Buffer<ffi::S32>>()
+        .Attr<int32_t>("num_experts")
+        .Attr<int32_t>("block_size"));
+
+extern "C" {
+
+// ctypes host-planning entry (parity: the torch-extension host op).
+int tdt_moe_align_block_size_host(const int32_t* eids, int64_t n,
+                                  int32_t num_experts, int32_t block_size,
+                                  int32_t* sorted_ids, int64_t cap,
+                                  int32_t* block_expert, int64_t bcap,
+                                  int32_t* counts) {
+  return AlignBlockSize(eids, n, num_experts, block_size, sorted_ids, cap,
+                        block_expert, bcap, counts);
+}
+
+}  // extern "C"

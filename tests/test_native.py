@@ -1,0 +1,188 @@
+"""Native (C++) component tests: MoE align op + AOT archive/export.
+
+Parity model (SURVEY.md §4): reference ``test_moe_utils.py`` validates
+the CUDA sort against a torch reference; ``test_compile_aot.py`` runs the
+AOT-compiled kernels. Here: C++ vs pure-JAX align equality, FFI
+custom-call path under jit, archive roundtrip through the C API, and
+export → archive → deserialize → run.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.native import native_available
+from triton_distributed_tpu.ops.moe.routing import (
+    align_capacities,
+    moe_align_block_size,
+)
+
+needs_native = pytest.mark.skipif(
+    not native_available(), reason="no C++ toolchain"
+)
+
+
+def _random_routing(rng, T=64, k=4, E=16):
+    return rng.integers(0, E, size=(T, k)).astype(np.int32), E
+
+
+class TestAlignJax:
+    def test_contract(self, rng):
+        eids, E = _random_routing(rng)
+        bs = 8
+        out = moe_align_block_size(jnp.asarray(eids), E, bs)
+        n = eids.size
+        cap, bcap = align_capacities(n, E, bs)
+        assert out.sorted_ids.shape == (cap,)
+        assert out.block_expert.shape == (bcap,)
+        counts = np.bincount(eids.reshape(-1), minlength=E)
+        padded = (counts + bs - 1) // bs * bs
+        assert int(out.num_padded) == padded.sum()
+        assert int(out.num_blocks) == padded.sum() // bs
+
+        sids = np.asarray(out.sorted_ids)
+        bexp = np.asarray(out.block_expert)
+        flat = eids.reshape(-1)
+        start = 0
+        for e in range(E):
+            seg = sids[start:start + padded[e]]
+            real = seg[seg < n]
+            # every real slot routes to expert e, stably ordered
+            assert (flat[real] == e).all()
+            assert (np.diff(real) > 0).all() if len(real) > 1 else True
+            assert len(real) == counts[e]
+            # pad slots carry the sentinel n
+            assert (seg[len(real):] == n).all()
+            for b in range(start // bs, (start + padded[e]) // bs):
+                assert bexp[b] == e
+            start += padded[e]
+        assert (bexp[int(out.num_blocks):] == -1).all()
+
+
+@needs_native
+class TestAlignNative:
+    def test_host_matches_jax(self, rng):
+        from triton_distributed_tpu.ops.moe.native_sort import (
+            moe_align_block_size_host,
+        )
+
+        eids, E = _random_routing(rng, T=128, k=8, E=32)
+        bs = 16
+        gold = moe_align_block_size(jnp.asarray(eids), E, bs)
+        got = moe_align_block_size_host(eids, E, bs)
+        np.testing.assert_array_equal(got.sorted_ids, np.asarray(gold.sorted_ids))
+        np.testing.assert_array_equal(
+            got.block_expert, np.asarray(gold.block_expert)
+        )
+        assert int(got.num_blocks) == int(gold.num_blocks)
+        assert int(got.num_padded) == int(gold.num_padded)
+
+    def test_ffi_under_jit(self, rng):
+        from triton_distributed_tpu.ops.moe.native_sort import (
+            moe_align_block_size_ffi,
+        )
+
+        eids, E = _random_routing(rng)
+        bs = 8
+        gold = moe_align_block_size(jnp.asarray(eids), E, bs)
+
+        @jax.jit
+        def run(x):
+            return moe_align_block_size_ffi(x, E, bs)
+
+        got = run(jnp.asarray(eids))
+        np.testing.assert_array_equal(
+            np.asarray(got.sorted_ids), np.asarray(gold.sorted_ids)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got.block_expert), np.asarray(gold.block_expert)
+        )
+
+    def test_host_rejects_bad_expert(self):
+        from triton_distributed_tpu.ops.moe.native_sort import (
+            moe_align_block_size_host,
+        )
+
+        with pytest.raises(ValueError, match="rc=2"):
+            moe_align_block_size_host(np.asarray([[99]], np.int32), 4, 8)
+
+
+@needs_native
+class TestAotArchive:
+    def test_roundtrip(self, tmp_path):
+        from triton_distributed_tpu.tools.aot import (
+            AotEntry,
+            read_archive,
+            write_archive,
+        )
+
+        path = str(tmp_path / "a.tdtaot")
+        entries = [
+            AotEntry("k1", {"shape": [2, 2]}, b"\x00\x01payload"),
+            AotEntry("k2", {"cfg": {"tile": 128}}, b""),
+        ]
+        write_archive(path, entries)
+        got = read_archive(path)
+        assert [e.name for e in got] == ["k1", "k2"]
+        assert got[0].data == b"\x00\x01payload"
+        assert got[0].meta == {"shape": [2, 2]}
+        assert got[1].meta["cfg"]["tile"] == 128
+        assert got[1].data == b""
+
+    def test_open_rejects_garbage(self, tmp_path):
+        from triton_distributed_tpu.native import get_native
+
+        p = tmp_path / "bad.tdtaot"
+        p.write_bytes(b"NOTANARCHIVE")
+        assert get_native().cdll.tdt_aot_open(str(p).encode()) in (None, 0)
+
+    def test_export_run_roundtrip(self, tmp_path):
+        from triton_distributed_tpu.tools.aot import (
+            export_fn,
+            load_entry,
+            write_archive,
+        )
+
+        def f(x, y):
+            return jnp.dot(x, y) + 1.0
+
+        x = jnp.ones((4, 8), jnp.float32)
+        y = jnp.ones((8, 4), jnp.float32)
+        e = export_fn(f, (x, y), "matmul", meta={"tile": 4})
+        assert e.meta["arg_shapes"] == [[4, 8], [8, 4]]
+        path = str(tmp_path / "m.tdtaot")
+        write_archive(path, [e])
+        g = load_entry(path, "matmul")
+        np.testing.assert_allclose(np.asarray(g(x, y)), np.asarray(f(x, y)))
+        with pytest.raises(KeyError):
+            load_entry(path, "missing")
+
+    def test_compile_aot_cli(self, tmp_path):
+        from triton_distributed_tpu.tools.compile_aot import main
+        from triton_distributed_tpu.tools.aot import load_entry, read_archive
+        from triton_distributed_tpu.models import AutoLLM
+        from triton_distributed_tpu.runtime.mesh import (
+            finalize_distributed,
+            initialize_distributed,
+        )
+
+        out = str(tmp_path / "model.tdtaot")
+        assert main([
+            "--model", "tiny", "--batch", "2", "--max-len", "64",
+            "--tp", "1", "--out", out,
+        ]) == 0
+        entries = read_archive(out)
+        assert entries[0].meta["kind"] == "decode_step"
+
+        # Rehydrate and run one decode step.
+        finalize_distributed()
+        ctx = initialize_distributed(tp=1, devices=jax.devices()[:1])
+        model = AutoLLM.from_pretrained("tiny", ctx=ctx)
+        cache = model.new_cache(2, max_length=64)
+        fn = load_entry(out, entries[0].name)
+        logits, _ = fn(model.params, jnp.asarray([1, 2], jnp.int32), cache)
+        assert logits.shape == (2, model.cfg.vocab_size)
+        assert not np.isnan(np.asarray(logits)).any()
+        finalize_distributed()

@@ -45,9 +45,9 @@ class TPAttnParams:
     k_norm: jax.Array | None
 
 
-jax.tree_util.register_dataclass(
-    TPAttnParams, ["wqkv", "wo", "q_norm", "k_norm"], []
-)
+from triton_distributed_tpu.runtime.pytree import register_param_dataclass
+
+register_param_dataclass(TPAttnParams, ["wqkv", "wo", "q_norm", "k_norm"])
 
 
 def _rms_head(x: jax.Array, scale: jax.Array | None, eps: float = 1e-6):

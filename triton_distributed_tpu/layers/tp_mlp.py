@@ -41,7 +41,9 @@ class TPMLPParams:
     w2: jax.Array  # [d_ff_loc, d_model]
 
 
-jax.tree_util.register_dataclass(TPMLPParams, ["w1", "w2"], [])
+from triton_distributed_tpu.runtime.pytree import register_param_dataclass
+
+register_param_dataclass(TPMLPParams, ["w1", "w2"])
 
 
 def _silu_mul(h: jax.Array) -> jax.Array:

@@ -37,7 +37,9 @@ class TPMoEParams:
     w2: jax.Array        # [E, f_loc, d] — row shard
 
 
-jax.tree_util.register_dataclass(TPMoEParams, ["w_router", "w1", "w2"], [])
+from triton_distributed_tpu.runtime.pytree import register_param_dataclass
+
+register_param_dataclass(TPMoEParams, ["w_router", "w1", "w2"])
 
 
 def tp_moe_fwd(

@@ -25,7 +25,9 @@ class KVCache:
     kv_len: jax.Array  # [B] int32 — tokens currently cached
 
 
-jax.tree_util.register_dataclass(KVCache, ["k", "v", "kv_len"], [])
+from triton_distributed_tpu.runtime.pytree import register_param_dataclass
+
+register_param_dataclass(KVCache, ["k", "v", "kv_len"])
 
 
 def init_cache(

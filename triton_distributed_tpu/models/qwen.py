@@ -34,6 +34,7 @@ from triton_distributed_tpu.layers.tp_mlp import TPMLPParams, tp_mlp_fwd
 from triton_distributed_tpu.models.config import ModelConfig
 from triton_distributed_tpu.models.kv_cache import KVCache, cache_specs, init_cache
 from triton_distributed_tpu.runtime.mesh import DistContext, current_context
+from triton_distributed_tpu.runtime.pytree import register_param_dataclass
 
 Mode = Literal["xla", "pallas"]
 
@@ -56,7 +57,7 @@ class Qwen3Params:
 
 for _cls, _fields in ((Qwen3LayerParams, ["ln1", "attn", "ln2", "mlp"]),
                       (Qwen3Params, ["embed", "layers", "norm", "lm_head"])):
-    jax.tree_util.register_dataclass(_cls, _fields, [])
+    register_param_dataclass(_cls, _fields)
 
 
 def rms_norm(x: jax.Array, w: jax.Array, eps: float = 1e-6) -> jax.Array:

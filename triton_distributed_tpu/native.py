@@ -1,0 +1,124 @@
+"""Native (C++) library: build-on-demand, ctypes bindings, FFI targets.
+
+Parity role: the reference builds its native pieces as a torch extension
+(``csrc/lib/op_pybind.cc``, registry ``csrc/lib/registry.h:38-39``) and a
+C AOT runtime (``tools/runtime/triton_aot_runtime.cc``). Here one shared
+library ``libtdt_native.so`` carries both: the MoE align/sort op (exposed
+as an XLA FFI custom call + a plain C host entry) and the AOT archive C
+API. pybind11 is not assumed — bindings are ctypes over ``extern "C"``
+plus XLA FFI handler capsules (the no-framework equivalents).
+
+Build: g++ at first use, cached next to the package (ignored by git);
+everything degrades gracefully to pure-JAX/Python fallbacks when a
+toolchain is unavailable (``native_available()`` gates call sites).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import functools
+import os
+import subprocess
+
+import jax
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(__file__)), "csrc")
+_OUT_DIR = os.path.join(os.path.dirname(__file__), "_native")
+_LIB = os.path.join(_OUT_DIR, "libtdt_native.so")
+_SOURCES = ("moe_utils.cc", "aot_runtime.cc")
+
+
+def _sources_mtime() -> float:
+    return max(os.path.getmtime(os.path.join(_CSRC, s)) for s in _SOURCES)
+
+
+def build(force: bool = False) -> str:
+    """Compile csrc/ into libtdt_native.so (no-op when fresh)."""
+    if (
+        not force
+        and os.path.exists(_LIB)
+        and os.path.getmtime(_LIB) >= _sources_mtime()
+    ):
+        return _LIB
+    os.makedirs(_OUT_DIR, exist_ok=True)
+    # Compile to a process-private path and rename into place: concurrent
+    # builders (pytest workers, serving processes) then never dlopen a
+    # half-written library.
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
+    cmd = [
+        "g++", "-std=c++17", "-O2", "-fPIC", "-shared",
+        "-I", jax.ffi.include_dir(),
+        *[os.path.join(_CSRC, s) for s in _SOURCES],
+        "-o", tmp,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, _LIB)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+    return _LIB
+
+
+class NativeLib:
+    """ctypes view of libtdt_native.so with typed signatures."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.cdll = ctypes.CDLL(path)
+        c = self.cdll
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        c.tdt_moe_align_block_size_host.restype = ctypes.c_int
+        c.tdt_moe_align_block_size_host.argtypes = [
+            i32p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+            i32p, ctypes.c_int64, i32p, ctypes.c_int64, i32p,
+        ]
+        c.tdt_aot_open.restype = ctypes.c_void_p
+        c.tdt_aot_open.argtypes = [ctypes.c_char_p]
+        c.tdt_aot_num_entries.restype = ctypes.c_int
+        c.tdt_aot_num_entries.argtypes = [ctypes.c_void_p]
+        c.tdt_aot_entry_name.restype = ctypes.c_char_p
+        c.tdt_aot_entry_name.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        c.tdt_aot_entry_meta.restype = ctypes.c_char_p
+        c.tdt_aot_entry_meta.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        c.tdt_aot_entry_data.restype = u8p
+        c.tdt_aot_entry_data.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_uint64)
+        ]
+        c.tdt_aot_find.restype = ctypes.c_int
+        c.tdt_aot_find.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        c.tdt_aot_close.restype = None
+        c.tdt_aot_close.argtypes = [ctypes.c_void_p]
+        c.tdt_aot_write.restype = ctypes.c_int
+        c.tdt_aot_write.argtypes = [
+            ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_uint64),
+        ]
+        self._ffi_registered = False
+
+    def register_ffi_targets(self) -> None:
+        """Register the XLA FFI custom calls on the CPU platform
+        (host-side planning ops; TPU in-jit paths use the pure-JAX
+        equivalents — XLA custom calls execute on the host there)."""
+        if self._ffi_registered:
+            return
+        handler = jax.ffi.pycapsule(self.cdll.TdtMoeAlignBlockSize)
+        jax.ffi.register_ffi_target(
+            "tdt_moe_align_block_size", handler, platform="cpu"
+        )
+        self._ffi_registered = True
+
+
+@functools.cache
+def get_native() -> NativeLib | None:
+    """Build + load the native lib; None when no toolchain is present."""
+    try:
+        return NativeLib(build())
+    except (OSError, subprocess.CalledProcessError):
+        return None
+
+
+def native_available() -> bool:
+    return get_native() is not None

@@ -26,7 +26,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from triton_distributed_tpu.ops.collectives.all_gather import all_gather
-from triton_distributed_tpu.ops.common import interpret_mode
+from triton_distributed_tpu.ops.common import exporting_portable, interpret_mode
 
 _NEG_INF = -1e30
 
@@ -122,6 +122,15 @@ def flash_decode(
     num_chunks = s // chunk_k
     kv_len = jnp.broadcast_to(jnp.asarray(kv_len, jnp.int32), (b,))
 
+    # jax.export can't serialize the host callbacks interpret-mode Pallas
+    # lowers to; exports traced off-TPU take the pure-XLA reference path.
+    resolved = interpret_mode() if interpret is None else interpret
+    if resolved and exporting_portable():
+        return gqa_decode_reference(
+            q, k_cache, v_cache, kv_len,
+            sm_scale=sm_scale, return_lse=return_lse,
+        )
+
     qg = q.reshape(b, hkv, group, d)
     grid = (b, hkv, num_chunks)
     o_parts, lse_parts = pl.pallas_call(
@@ -155,7 +164,7 @@ def flash_decode(
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
-        interpret=interpret_mode() if interpret is None else interpret,
+        interpret=resolved,
     )(kv_len, qg, k_cache, v_cache)
 
     o, lse = lse_combine(o_parts, lse_parts, part_axis=2)  # [B, Hkv, group, d]
@@ -209,8 +218,11 @@ def distributed_flash_decode(
     return merged.astype(q.dtype)
 
 
-def gqa_decode_reference(q, k_cache, v_cache, kv_len, *, sm_scale=None):
-    """Golden decode (parity: the reference's torch goldens)."""
+def gqa_decode_reference(
+    q, k_cache, v_cache, kv_len, *, sm_scale=None, return_lse=False
+):
+    """Golden decode (parity: the reference's torch goldens); also the
+    portable-export path of :func:`flash_decode`."""
     b, hq, d = q.shape
     _, hkv, s, _ = k_cache.shape
     if sm_scale is None:
@@ -222,4 +234,7 @@ def gqa_decode_reference(q, k_cache, v_cache, kv_len, *, sm_scale=None):
     mask = jnp.arange(s)[None, None, :] < kv_len[:, None, None]
     s_ = jnp.where(mask, s_, _NEG_INF)
     p = jax.nn.softmax(s_, axis=-1)
-    return jnp.einsum("bhk,bhkd->bhd", p, v).astype(q.dtype)
+    o = jnp.einsum("bhk,bhkd->bhd", p, v).astype(q.dtype)
+    if return_lse:
+        return o, jax.nn.logsumexp(s_, axis=-1)
+    return o

@@ -8,6 +8,7 @@ id allocation, VMEM budgeting.
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 from typing import Any, Sequence
 
@@ -42,6 +43,28 @@ def pick_tile(n: int, preferred: int = 512) -> int:
     while n % tile:
         tile //= 2
     return max(tile, 128 if n % 128 == 0 else 1)
+
+
+# jax.export cannot serialize host callbacks, which is what interpret-mode
+# Pallas lowers to off-TPU. Ops with a pure-XLA equivalent consult
+# exporting_portable() and take it while an export is being traced.
+_EXPORT_PORTABLE = False
+
+
+@contextlib.contextmanager
+def portable_export():
+    """Trace-for-export mode: ops avoid interpret-mode Pallas."""
+    global _EXPORT_PORTABLE
+    prev = _EXPORT_PORTABLE
+    _EXPORT_PORTABLE = True
+    try:
+        yield
+    finally:
+        _EXPORT_PORTABLE = prev
+
+
+def exporting_portable() -> bool:
+    return _EXPORT_PORTABLE
 
 
 def interpret_mode(ctx: DistContext | None = None):

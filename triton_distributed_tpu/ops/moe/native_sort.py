@@ -1,0 +1,88 @@
+"""Native (C++) MoE align/sort entry points.
+
+Parity: the reference binds ``moe_ag_scatter_align_block_size`` as a
+torch-extension host op (``csrc/lib/op_pybind.cc:31``); here the same
+C++ routine (``csrc/moe_utils.cc``) is reachable two ways:
+
+- :func:`moe_align_block_size_host` — ctypes call on host numpy arrays
+  (planner path, no XLA involved);
+- :func:`moe_align_block_size_ffi` — XLA FFI custom call, jit-safe on
+  the CPU platform (custom calls execute on host; TPU in-jit paths use
+  the pure-JAX ``routing.moe_align_block_size``).
+
+Both share the output contract of :class:`routing.AlignedBlocks`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from triton_distributed_tpu.native import get_native
+from triton_distributed_tpu.ops.moe.routing import (
+    AlignedBlocks,
+    align_capacities,
+)
+
+
+def moe_align_block_size_host(
+    expert_ids: np.ndarray,  # [T, k] or [N] int32
+    num_experts: int,
+    block_size: int,
+) -> AlignedBlocks:
+    """C++ host planner (raises RuntimeError without a native build)."""
+    lib = get_native()
+    if lib is None:
+        raise RuntimeError("native library unavailable (no g++?)")
+    flat = np.ascontiguousarray(expert_ids.reshape(-1), np.int32)
+    n = flat.shape[0]
+    cap, bcap = align_capacities(n, num_experts, block_size)
+    sorted_ids = np.empty((cap,), np.int32)
+    block_expert = np.empty((bcap,), np.int32)
+    counts = np.empty((2,), np.int32)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    rc = lib.cdll.tdt_moe_align_block_size_host(
+        flat.ctypes.data_as(i32p), n, num_experts, block_size,
+        sorted_ids.ctypes.data_as(i32p), cap,
+        block_expert.ctypes.data_as(i32p), bcap,
+        counts.ctypes.data_as(i32p),
+    )
+    if rc != 0:
+        raise ValueError(f"moe_align_block_size failed (rc={rc})")
+    return AlignedBlocks(
+        sorted_ids=sorted_ids,
+        block_expert=block_expert,
+        num_blocks=np.int32(counts[0]),
+        num_padded=np.int32(counts[1]),
+    )
+
+
+def moe_align_block_size_ffi(
+    expert_ids: jax.Array,  # [T, k] or [N] int32
+    num_experts: int,
+    block_size: int,
+) -> AlignedBlocks:
+    """XLA FFI custom-call form (CPU platform, usable inside jit)."""
+    lib = get_native()
+    if lib is None:
+        raise RuntimeError("native library unavailable (no g++?)")
+    lib.register_ffi_targets()
+    flat = expert_ids.reshape(-1).astype(jnp.int32)
+    cap, bcap = align_capacities(flat.shape[0], num_experts, block_size)
+    sorted_ids, block_expert, counts = jax.ffi.ffi_call(
+        "tdt_moe_align_block_size",
+        (
+            jax.ShapeDtypeStruct((cap,), jnp.int32),
+            jax.ShapeDtypeStruct((bcap,), jnp.int32),
+            jax.ShapeDtypeStruct((2,), jnp.int32),
+        ),
+    )(flat, num_experts=np.int32(num_experts), block_size=np.int32(block_size))
+    return AlignedBlocks(
+        sorted_ids=sorted_ids,
+        block_expert=block_expert,
+        num_blocks=counts[0],
+        num_padded=counts[1],
+    )

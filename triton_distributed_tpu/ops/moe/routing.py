@@ -69,6 +69,65 @@ def moe_sort(route: RouterOut, num_experts: int) -> SortedTokens:
     )
 
 
+class AlignedBlocks(NamedTuple):
+    """Block-aligned grouped-GEMM schedule (the CUDA align kernel's
+    output contract, ``moe_utils.cu:61-193``)."""
+
+    sorted_ids: jax.Array    # [cap] — slot → flattened source index; pad = N
+    block_expert: jax.Array  # [bcap] — tile → expert id; past-end = -1
+    num_blocks: jax.Array    # [] int32
+    num_padded: jax.Array    # [] int32
+
+
+def align_capacities(n: int, num_experts: int, block_size: int) -> tuple[int, int]:
+    """Static worst-case output sizes: every expert padded by up to
+    ``block_size - 1`` slots."""
+    cap = n + num_experts * (block_size - 1)
+    cap = (cap + block_size - 1) // block_size * block_size
+    return cap, cap // block_size
+
+
+def moe_align_block_size(
+    expert_ids: jax.Array,  # [T, k] or [N] int32
+    num_experts: int,
+    block_size: int,
+) -> AlignedBlocks:
+    """Pure-JAX block-aligned expert sort (jit-safe, static shapes).
+
+    Parity: ``moe_ag_scatter_align_block_size`` (``moe_utils.cu:61-356``).
+    The native XLA-FFI/C++ variant with identical semantics lives in
+    ``csrc/moe_utils.cc`` (host planning path); this composition is the
+    on-device default — XLA sorts/scans are first-class TPU ops.
+    """
+    flat = expert_ids.reshape(-1).astype(jnp.int32)
+    n = flat.shape[0]
+    cap, bcap = align_capacities(n, num_experts, block_size)
+    counts = jnp.bincount(flat, length=num_experts)
+    padded = (counts + block_size - 1) // block_size * block_size
+    start = jnp.cumsum(padded) - padded  # exclusive prefix
+    order = jnp.argsort(flat, stable=True)
+    es = flat[order]
+    # Within-expert rank of each sorted slot = position - first slot of
+    # that expert in plain sorted order.
+    first_sorted = jnp.cumsum(counts) - counts
+    within = jnp.arange(n) - first_sorted[es]
+    dest = start[es] + within
+    sorted_ids = jnp.full((cap,), n, jnp.int32).at[dest].set(
+        order.astype(jnp.int32)
+    )
+    bounds = jnp.cumsum(padded) // block_size  # block-end per expert
+    blk = jnp.arange(bcap)
+    block_expert = jnp.searchsorted(bounds, blk, side="right").astype(jnp.int32)
+    num_blocks = (jnp.sum(padded) // block_size).astype(jnp.int32)
+    block_expert = jnp.where(blk < num_blocks, block_expert, -1)
+    return AlignedBlocks(
+        sorted_ids=sorted_ids,
+        block_expert=block_expert,
+        num_blocks=num_blocks,
+        num_padded=jnp.sum(padded).astype(jnp.int32),
+    )
+
+
 def moe_combine(
     expert_out: jax.Array,  # [T*k, d] — per sorted slot
     sorted_tokens: SortedTokens,

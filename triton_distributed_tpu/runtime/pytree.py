@@ -1,0 +1,26 @@
+"""Pytree registration helper for parameter/state dataclasses.
+
+Registers a dataclass both as a JAX pytree node and with ``jax.export``'s
+PyTreeDef serializer, so any function over our param/state containers can
+be AOT-exported (SURVEY.md §2.1 "AOT runtime": the TPU analog of the
+reference's algo-info structs riding beside compiled kernels).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import export as jax_export
+
+
+def register_param_dataclass(cls, data_fields: list[str]):
+    """``jax.tree_util.register_dataclass`` (no meta fields) + export
+    serialization. Returns ``cls`` for decorator-style use."""
+    jax.tree_util.register_dataclass(cls, data_fields, [])
+    jax_export.register_pytree_node_serialization(
+        cls,
+        serialized_name=f"triton_distributed_tpu.{cls.__name__}",
+        # No-meta dataclasses flatten with auxdata () — nothing to store.
+        serialize_auxdata=lambda aux: b"",
+        deserialize_auxdata=lambda b: (),
+    )
+    return cls

@@ -1,0 +1,116 @@
+"""AOT compile/export: serialize jitted programs into a native archive.
+
+Parity: reference ``tools/compile_aot.py:61-298`` (AOT-compile Triton
+kernels to C-callable cubins with algo-info structs) + the C runtime
+``tools/runtime/triton_aot_runtime.cc``. TPU translation (SURVEY.md §2.1
+"AOT runtime"): AOT = ``jax.export`` — a jitted function lowers to
+serialized StableHLO with a stable calling convention; the archive
+container + loader are native C++ (``csrc/aot_runtime.cc``), and the
+algo-info struct becomes a JSON metadata blob per entry (shapes, dtypes,
+static config) that C++ serving hosts can read without deserializing the
+program.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import json
+from typing import Any, Callable, Sequence
+
+import jax
+from jax import export as jax_export
+
+from triton_distributed_tpu.native import get_native
+
+
+@dataclasses.dataclass
+class AotEntry:
+    """One exported program (parity: a compiled kernel + algo-info)."""
+
+    name: str
+    meta: dict[str, Any]
+    data: bytes
+
+
+def export_fn(
+    fn: Callable,
+    args: Sequence[Any],
+    name: str,
+    *,
+    meta: dict[str, Any] | None = None,
+    platforms: Sequence[str] | None = None,
+) -> AotEntry:
+    """Lower + serialize ``jax.jit(fn)(*args)`` (parity: one
+    ``compile_aot`` kernel entry). ``args`` may be arrays or
+    ShapeDtypeStructs; shapes/dtypes are recorded as metadata."""
+    specs = [
+        x if isinstance(x, jax.ShapeDtypeStruct)
+        else jax.ShapeDtypeStruct(jax.numpy.shape(x), jax.numpy.result_type(x))
+        for x in jax.tree.leaves(list(args))
+    ]
+    from triton_distributed_tpu.ops.common import portable_export
+
+    with portable_export():
+        exported = jax_export.export(jax.jit(fn), platforms=platforms)(*args)
+    full_meta = {
+        "arg_shapes": [list(s.shape) for s in specs],
+        "arg_dtypes": [str(s.dtype) for s in specs],
+        "out_tree": str(exported.out_tree),
+        "platforms": list(exported.platforms),
+        **(meta or {}),
+    }
+    return AotEntry(name=name, meta=full_meta, data=bytes(exported.serialize()))
+
+
+def write_archive(path: str, entries: Sequence[AotEntry]) -> None:
+    """Write entries through the native C writer (tdt_aot_write)."""
+    lib = get_native()
+    if lib is None:
+        raise RuntimeError("native library unavailable (no g++?)")
+    n = len(entries)
+    names = (ctypes.c_char_p * n)(*[e.name.encode() for e in entries])
+    metas = (ctypes.c_char_p * n)(
+        *[json.dumps(e.meta).encode() for e in entries]
+    )
+    bufs = [
+        ctypes.create_string_buffer(bytes(e.data), max(len(e.data), 1))
+        for e in entries
+    ]
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    datas = (u8p * n)(*[ctypes.cast(b, u8p) for b in bufs])
+    lens = (ctypes.c_uint64 * n)(*[len(e.data) for e in entries])
+    rc = lib.cdll.tdt_aot_write(path.encode(), n, names, metas, datas, lens)
+    if rc != 0:
+        raise OSError(f"tdt_aot_write failed (rc={rc})")
+
+
+def read_archive(path: str) -> list[AotEntry]:
+    """Read an archive through the native C loader."""
+    lib = get_native()
+    if lib is None:
+        raise RuntimeError("native library unavailable (no g++?)")
+    a = lib.cdll.tdt_aot_open(path.encode())
+    if not a:
+        raise OSError(f"cannot open AOT archive {path}")
+    try:
+        out = []
+        for i in range(lib.cdll.tdt_aot_num_entries(a)):
+            name = lib.cdll.tdt_aot_entry_name(a, i).decode()
+            meta = json.loads(lib.cdll.tdt_aot_entry_meta(a, i).decode())
+            ln = ctypes.c_uint64()
+            ptr = lib.cdll.tdt_aot_entry_data(a, i, ctypes.byref(ln))
+            data = ctypes.string_at(ptr, ln.value) if ln.value else b""
+            out.append(AotEntry(name=name, meta=meta, data=data))
+        return out
+    finally:
+        lib.cdll.tdt_aot_close(a)
+
+
+def load_entry(path: str, name: str):
+    """Deserialize one entry into a callable (parity: the C runtime's
+    launch-by-name; Python hosts rehydrate via jax.export)."""
+    for e in read_archive(path):
+        if e.name == name:
+            return jax_export.deserialize(e.data).call
+    raise KeyError(f"no AOT entry named {name!r} in {path}")

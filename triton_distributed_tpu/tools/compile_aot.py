@@ -1,0 +1,64 @@
+"""AOT compile CLI: export the serving kernel set into one archive.
+
+Parity: reference ``tools/compile_aot.py:61`` + ``scripts/aot_kernels.txt``
+(the flash-decode kernel family precompiled for deployment). TPU analog:
+export the jitted decode step and the overlap ops at the model's shapes.
+
+Usage:
+    python -m triton_distributed_tpu.tools.compile_aot \
+        --model tiny --batch 2 --max-len 128 --tp 1 --out model.tdtaot
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+
+
+def build_entries(model_name: str, batch: int, max_len: int, tp: int):
+    from triton_distributed_tpu.models import AutoLLM
+    from triton_distributed_tpu.runtime.mesh import initialize_distributed
+    from triton_distributed_tpu.tools.aot import export_fn
+
+    ctx = initialize_distributed(tp=tp, devices=jax.devices()[:tp])
+    model = AutoLLM.from_pretrained(model_name, ctx=ctx)
+    cache = model.new_cache(batch, max_length=max_len)
+    tok = jnp.zeros((batch,), jnp.int32)
+    step = model.decode_fn("xla")
+
+    entries = [
+        export_fn(
+            step,
+            (model.params, tok, cache),
+            name=f"decode_step_b{batch}_s{max_len}",
+            meta={
+                "model": model_name, "tp": tp, "batch": batch,
+                "max_len": max_len, "kind": "decode_step",
+            },
+        )
+    ]
+    return entries
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model", default="tiny")
+    p.add_argument("--batch", type=int, default=1)
+    p.add_argument("--max-len", type=int, default=512)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--out", required=True)
+    args = p.parse_args(argv)
+
+    from triton_distributed_tpu.tools.aot import write_archive
+
+    entries = build_entries(args.model, args.batch, args.max_len, args.tp)
+    write_archive(args.out, entries)
+    for e in entries:
+        print(f"exported {e.name}: {len(e.data)} bytes")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
