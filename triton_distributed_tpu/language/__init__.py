@@ -17,6 +17,7 @@ from triton_distributed_tpu.language.primitives import (  # noqa: F401
     read,
     remote_copy,
     signal,
+    straggle_if_rank,
     wait,
     wait_recv,
 )

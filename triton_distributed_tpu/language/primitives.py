@@ -218,4 +218,17 @@ def maybe_delay(nanos: int | None):
     (``allreduce.py:137``). ``pl.delay`` stalls this core's issue stream.
     """
     if nanos:
-        pltpu.delay(nanos)
+        pl.delay(nanos)
+
+
+def straggle_if_rank(straggler_rank: int | None, axis: str, nanos: int):
+    """Delay only on one rank — the straggler fixture (parity:
+    ``straggler_option`` / ``_run_straggler``, ``allreduce.py:137``).
+    Static ``straggler_rank`` (None = no-op) so production traces carry
+    zero overhead."""
+    if straggler_rank is None or not nanos:
+        return
+
+    @pl.when(rank(axis) == straggler_rank)
+    def _lag():
+        pl.delay(nanos)

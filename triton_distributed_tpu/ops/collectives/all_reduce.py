@@ -66,16 +66,21 @@ def get_auto_allreduce_method(nbytes: int, n: int) -> AllReduceMethod:
     return AllReduceMethod.XLA
 
 
-def _one_shot_kernel(x_ref, o_ref, gather, send_sems, recv_sems, *, axis: str):
+def _one_shot_kernel(
+    x_ref, o_ref, gather, send_sems, recv_sems, *,
+    axis: str, straggler_rank: int | None = None, straggler_nanos: int = 0,
+):
     """Push local data to every peer's slot, then reduce locally.
 
     Parity: one-shot push ``allreduce.py:333`` (every rank broadcasts,
-    every rank reduces all n copies).
+    every rank reduces all n copies); straggler fixture parity:
+    ``_run_straggler`` (``allreduce.py:137``).
     """
     me = dl.rank(axis)
     n = dl.num_ranks(axis)
 
     dl.barrier_all(axis)  # peers' gather slots must exist before any put
+    dl.straggle_if_rank(straggler_rank, axis, straggler_nanos)
     gather[me] = x_ref[:]
     dmas = []
     for i in range(1, n):
@@ -101,10 +106,15 @@ def all_reduce(
     axis: str = "tp",
     method: AllReduceMethod = AllReduceMethod.AUTO,
     ctx: DistContext | None = None,
+    *,
+    straggler_rank: int | None = None,
+    straggler_nanos: int = 500_000,
 ) -> jax.Array:
     """Sum ``x`` across ``axis``; every device gets the full result.
 
     Call inside ``shard_map``; ``x`` is this device's partial sum.
+    ``straggler_rank`` lags one rank's pushes (stress fixture; parity:
+    ``_run_straggler``).
     """
     n = jax.lax.axis_size(axis)
     nbytes = x.size * x.dtype.itemsize
@@ -122,7 +132,11 @@ def all_reduce(
         if x.ndim < 2:
             raise ValueError("pallas all_reduce needs >=2D input")
         return comm_pallas_call(
-            functools.partial(_one_shot_kernel, axis=axis),
+            functools.partial(
+                _one_shot_kernel, axis=axis,
+                straggler_rank=straggler_rank,
+                straggler_nanos=straggler_nanos,
+            ),
             jax.ShapeDtypeStruct(x.shape, x.dtype),
             in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
             out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),

@@ -1,0 +1,139 @@
+"""Hierarchical (two-level) collectives: ICI inner axis, DCN outer axis.
+
+Parity: the reference's NUMA-/node-aware collective variants —
+``low_latency_allgather.py`` ``_forward_push_2d``:345 / ``_forward_push_3d``
+:400 (NVLink intra-node + RDMA inter-node stages), ``allgather.py``
+``ring_push_numa_2d``:196 / ``ring_push_2d_inter_node``:293, and the
+two-level multinode reduce-scatter ``reduce_scatter.py:828``
+(``reduce_scatter_multi_node``).
+
+TPU translation (SURVEY.md §2.4): the intra/inter-node split maps to
+intra-slice **ICI** (device-initiated Pallas kernels, remote DMA +
+semaphores) vs inter-slice **DCN** (XLA collectives — DCN transfers
+cannot be device-initiated, SURVEY.md §7 hard parts). Each op stages the
+fast level through the Pallas kernels and rides XLA across slices. The
+reference's LL "flag-in-data" codecs (``_pack_ll_block``:549) have no TPU
+analog — DMA completion semaphores *are* the arrival flags — so the
+latency-optimized small-message path is the single-hop full-mesh kernel
+(``AllGatherMethod.PALLAS_FULL_MESH``), selected by AUTO.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu.ops.collectives.all_gather import (
+    AllGatherMethod,
+    all_gather,
+)
+from triton_distributed_tpu.ops.collectives.reduce_scatter import (
+    ReduceScatterMethod,
+    reduce_scatter,
+)
+from triton_distributed_tpu.runtime.mesh import DistContext, current_context
+
+
+def all_gather_2d(
+    x: jax.Array,
+    inner_axis: str = "tp",
+    outer_axis: str = "dcn",
+    *,
+    inner_method: AllGatherMethod = AllGatherMethod.AUTO,
+    ctx: DistContext | None = None,
+) -> jax.Array:
+    """Two-stage all-gather (call inside ``shard_map`` over both axes).
+
+    ``x [m_per, ...]`` is the local shard of an array laid out
+    outer-major over ``(outer_axis, inner_axis)``; returns the full
+    ``[n_out * n_in * m_per, ...]`` array on every device. Stage 1 rides
+    ICI (Pallas kernel); stage 2 rides DCN (XLA). Parity:
+    ``_forward_push_2d`` — NVLink stage then inter-node stage.
+    """
+    y = all_gather(x, inner_axis, inner_method, ctx)   # [n_in * m, ...]
+    return jax.lax.all_gather(y, outer_axis, axis=0, tiled=True)
+
+
+def reduce_scatter_2d(
+    x: jax.Array,
+    inner_axis: str = "tp",
+    outer_axis: str = "dcn",
+    *,
+    inner_method: ReduceScatterMethod = ReduceScatterMethod.AUTO,
+    ctx: DistContext | None = None,
+) -> jax.Array:
+    """Two-stage reduce-scatter (call inside ``shard_map``).
+
+    ``x [M, ...]`` (same on every device logically; summed across both
+    axes) → this device's chunk ``[M / (n_in * n_out), ...]``, chunks
+    assigned inner-major (chunk id = ``inner * n_out + outer``). Stage 1
+    ring-reduces over ICI; stage 2 scatters the survivor over DCN.
+    Parity: ``reduce_scatter_multi_node`` (``reduce_scatter.py:828``) —
+    intra-node ring then the inter-node exchange.
+    """
+    y = reduce_scatter(x, inner_axis, inner_method, ctx)  # [M / n_in, ...]
+    return jax.lax.psum_scatter(y, outer_axis, scatter_dimension=0, tiled=True)
+
+
+def all_reduce_2level(
+    x: jax.Array,
+    inner_axis: str = "tp",
+    outer_axis: str = "dcn",
+    *,
+    ctx: DistContext | None = None,
+) -> jax.Array:
+    """Two-level all-reduce: ICI reduce-scatter → DCN psum → ICI
+    all-gather — the canonical slice-aware AR (parity role: the
+    reference's double-tree/two-shot AR generalized across node
+    boundaries, ``allreduce.py:215-700``)."""
+    y = reduce_scatter(x, inner_axis, ReduceScatterMethod.AUTO, ctx)
+    y = jax.lax.psum(y, outer_axis)
+    return all_gather(y, inner_axis, AllGatherMethod.AUTO, ctx)
+
+
+# -- host-level wrappers (tests/benchmarks) ---------------------------------
+
+def all_gather_2d_op(
+    x: jax.Array,
+    inner_axis: str = "tp",
+    outer_axis: str = "dcn",
+    ctx: DistContext | None = None,
+) -> jax.Array:
+    """``x`` sharded outer-major over both axes on dim 0 → replicated."""
+    ctx = ctx or current_context()
+    rest = [None] * (x.ndim - 1)
+    f = ctx.shard_map(
+        functools.partial(
+            all_gather_2d, inner_axis=inner_axis, outer_axis=outer_axis,
+            ctx=ctx,
+        ),
+        in_specs=P((outer_axis, inner_axis), *rest),
+        out_specs=P(None, *rest),
+    )
+    return f(x)
+
+
+def all_reduce_2level_op(
+    x: jax.Array,
+    inner_axis: str = "tp",
+    outer_axis: str = "dcn",
+    ctx: DistContext | None = None,
+) -> jax.Array:
+    """``x [n_total, ...]`` with one addend per device → summed, replicated."""
+    ctx = ctx or current_context()
+    rest = [None] * (x.ndim - 1)
+
+    def shard_fn(xi):
+        return all_reduce_2level(
+            xi[0], inner_axis=inner_axis, outer_axis=outer_axis, ctx=ctx
+        )
+
+    f = ctx.shard_map(
+        shard_fn,
+        in_specs=P((outer_axis, inner_axis), *rest),
+        out_specs=P(*rest),  # addend dim consumed by the reduction
+    )
+    return f(x)

@@ -67,6 +67,12 @@ class AGGemmConfig:
 
     tile_n: int = 512
     acc_dtype: jnp.dtype = jnp.float32
+    # Race-provocation fixtures (parity: ``for_correctness`` producer
+    # sleeps, ``allgather_gemm.py:507-508``, and ``straggler_option``,
+    # :534). Static: production traces carry zero overhead.
+    for_correctness: bool = False
+    straggler_rank: int | None = None
+    straggler_nanos: int = 500_000
 
 
 def create_ag_gemm_context(
@@ -90,6 +96,9 @@ def _ag_gemm_kernel(
     *,
     axis: str,
     acc_dtype,
+    for_correctness: bool = False,
+    straggler_rank: int | None = None,
+    straggler_nanos: int = 0,
 ):
     me = dl.rank(axis)
     n = dl.num_ranks(axis)
@@ -104,6 +113,12 @@ def _ag_gemm_kernel(
         # Entry barrier: peers' ws outputs must be allocated before any
         # remote write lands.
         dl.barrier_all(axis)
+        # Race fixtures: lag this rank's pushes so any consumer missing a
+        # wait reads stale workspace (reference for_correctness sleep /
+        # straggler injection).
+        dl.straggle_if_rank(straggler_rank, axis, straggler_nanos)
+        if for_correctness:
+            dl.maybe_delay(200_000)
         # Copy own chunk into the workspace and push it to every peer
         # (slot index = source rank, so consumers wait per-chunk).
         for i in range(1, n):
@@ -170,7 +185,12 @@ def ag_gemm(
 
     grid = (n, num_j)
     out, _ws = comm_pallas_call(
-        functools.partial(_ag_gemm_kernel, axis=axis, acc_dtype=config.acc_dtype),
+        functools.partial(
+            _ag_gemm_kernel, axis=axis, acc_dtype=config.acc_dtype,
+            for_correctness=config.for_correctness,
+            straggler_rank=config.straggler_rank,
+            straggler_nanos=config.straggler_nanos,
+        ),
         (
             jax.ShapeDtypeStruct((n, m_per, n_loc), a.dtype),
             jax.ShapeDtypeStruct((n, m_per, k), a.dtype),
