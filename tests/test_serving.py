@@ -1,0 +1,50 @@
+"""Model-server tests: protocol round trip vs direct Engine output.
+
+Parity model: the reference's server is exercised by its chat/bench
+clients (``mega_triton_kernel/test/models/``); here the client is
+in-process and the golden is ``Engine.serve`` on the same weights.
+"""
+
+import numpy as np
+
+from triton_distributed_tpu.models import AutoLLM
+from triton_distributed_tpu.models.engine import Engine
+from triton_distributed_tpu.serving import ModelServer, request
+
+
+def test_server_round_trip(ctx4):
+    model = AutoLLM.from_pretrained("tiny", ctx=ctx4)
+    engine = Engine(model, temperature=0.0, mode="xla")
+
+    prompts = np.asarray([[1, 2, 3, 4, 5, 6, 7, 8]], np.int32)
+    gold = engine.serve(prompts, gen_len=4)
+
+    server = ModelServer(engine).start()
+    try:
+        assert request(server.host, server.port, {"cmd": "ping"})["ok"]
+        resp = request(
+            server.host, server.port,
+            {"input_ids": prompts.tolist(), "gen_len": 4},
+        )
+        np.testing.assert_array_equal(
+            np.asarray(resp["output_ids"], np.int32), gold
+        )
+        assert "decode_ms_per_step" in resp["stats"]
+    finally:
+        server.shutdown()
+
+
+def test_server_reports_errors(ctx4):
+    model = AutoLLM.from_pretrained("tiny", ctx=ctx4)
+    engine = Engine(model, mode="xla")
+    server = ModelServer(engine).start()
+    try:
+        import pytest
+
+        with pytest.raises(RuntimeError, match="server error"):
+            request(
+                server.host, server.port,
+                {"input_ids": [[1, 2, 3]], "gen_len": 2},  # len 3 % tp4 != 0
+            )
+    finally:
+        server.shutdown()

@@ -281,9 +281,11 @@ class Qwen3:
                 in_specs=(self.param_specs, P(self.axis), cache_specs(self.axis)),
                 out_specs=(P(), cache_specs(self.axis)),
             )
-            self._prefill_jit[key] = jax.jit(
-                lambda p, t, c: f(p, t, c), donate_argnums=(2,)
-            )
+            # No cache donation here: callers pass batch-1 cache slices
+            # (engine prefill loop) that can alias the full cache when
+            # B == 1 — donating would delete the caller's buffer. The
+            # per-token donation win lives in decode_step.
+            self._prefill_jit[key] = jax.jit(lambda p, t, c: f(p, t, c))
         return self._prefill_jit[key](self.params, tokens, cache)
 
     def new_cache(self, batch_size: int, max_length: int | None = None) -> KVCache:

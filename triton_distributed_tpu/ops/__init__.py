@@ -33,3 +33,25 @@ from triton_distributed_tpu.ops.collectives.all_to_all import (  # noqa: F401
     all_to_all,
     all_to_all_op,
 )
+from triton_distributed_tpu.ops.collectives.hierarchical import (  # noqa: F401
+    all_gather_2d,
+    all_gather_2d_op,
+    all_reduce_2level,
+    all_reduce_2level_op,
+    reduce_scatter_2d,
+)
+from triton_distributed_tpu.ops.overlap.ag_gemm import (  # noqa: F401
+    AGGemmConfig,
+    ag_gemm,
+    ag_gemm_op,
+    create_ag_gemm_context,
+)
+from triton_distributed_tpu.ops.overlap.gemm_ar import (  # noqa: F401
+    gemm_ar,
+    gemm_ar_op,
+)
+from triton_distributed_tpu.ops.overlap.gemm_rs import (  # noqa: F401
+    GemmRSConfig,
+    gemm_rs,
+    gemm_rs_op,
+)

@@ -50,7 +50,8 @@ except AttributeError:  # pragma: no cover - older jax uses check_rep
 # Canonical axis names, outermost (least communication) to innermost
 # (most communication → contiguous ICI). Mirrors the scaling-book recipe:
 # data axes outside, model axes inside.
-AXIS_ORDER = ("dp", "pp", "ep", "sp", "tp")
+# dcn (cross-slice) outermost; tp innermost (contiguous ICI neighbors).
+AXIS_ORDER = ("dcn", "dp", "pp", "ep", "sp", "tp")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,10 @@
+"""Serving front-ends: socket model server + chat client.
+
+Parity: reference ``mega_triton_kernel/test/models/model_server.py``
+(socket server :112-198) and ``chat.py`` (interactive client) — the
+demo/deployment surface on top of the Engine.
+"""
+
+from triton_distributed_tpu.serving.server import ModelServer, request
+
+__all__ = ["ModelServer", "request"]

@@ -1,0 +1,79 @@
+"""Interactive chat client for the model server.
+
+Parity: reference ``mega_triton_kernel/test/models/chat.py`` — connects
+to the socket server, tokenizes with the HF tokenizer when available,
+streams turns in a REPL.
+
+Usage:
+    # terminal 1
+    python -m triton_distributed_tpu.serving.run_server --model tiny
+    # terminal 2
+    python -m triton_distributed_tpu.serving.chat --port <printed port>
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from triton_distributed_tpu.serving.server import request
+
+
+def get_tokenizer(model_name: str):
+    """HF tokenizer when installed/downloadable; else a byte-level
+    fallback so the demo runs in hermetic environments."""
+    try:
+        from transformers import AutoTokenizer
+
+        return AutoTokenizer.from_pretrained(model_name)
+    except Exception:
+        class ByteTok:
+            def encode(self, text):
+                return list(text.encode("utf-8"))
+
+            def decode(self, ids):
+                return bytes(int(i) % 256 for i in ids).decode(
+                    "utf-8", errors="replace"
+                )
+
+        return ByteTok()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--tokenizer", default="Qwen/Qwen3-0.6B")
+    p.add_argument("--gen-len", type=int, default=64)
+    p.add_argument("--pad-to", type=int, default=8,
+                   help="pad prompts to a multiple (tp divisibility)")
+    args = p.parse_args(argv)
+
+    tok = get_tokenizer(args.tokenizer)
+    print("chat ready — empty line to quit")
+    while True:
+        try:
+            text = input("you> ")
+        except EOFError:
+            break
+        if not text.strip():
+            break
+        ids = tok.encode(text)
+        pad = (-len(ids)) % args.pad_to
+        ids = [0] * pad + list(ids)
+        resp = request(
+            args.host, args.port,
+            {"input_ids": [ids], "gen_len": args.gen_len},
+        )
+        out = resp["output_ids"][0][len(ids):]
+        stats = resp.get("stats", {})
+        print(f"bot> {tok.decode(out)}")
+        if stats:
+            print(
+                f"     [{stats.get('decode_ms_per_step', 0):.2f} ms/step, "
+                f"{stats.get('tokens_per_s', 0):.1f} tok/s]"
+            )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,45 @@
+"""Model-server entry point.
+
+Parity: the reference's server launch path
+(``mega_triton_kernel/test/models/model_server.py`` ``__main__``).
+
+Usage:
+    python -m triton_distributed_tpu.serving.run_server \
+        --model tiny --tp 1 --port 8765
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model", default="tiny")
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--mode", default="xla", choices=["xla", "pallas"])
+    args = p.parse_args(argv)
+
+    from triton_distributed_tpu.models import AutoLLM
+    from triton_distributed_tpu.models.engine import Engine
+    from triton_distributed_tpu.runtime.mesh import initialize_distributed
+    from triton_distributed_tpu.serving.server import ModelServer
+
+    ctx = initialize_distributed(tp=args.tp, devices=jax.devices()[: args.tp])
+    model = AutoLLM.from_pretrained(args.model, ctx=ctx)
+    engine = Engine(
+        model, temperature=args.temperature, mode=args.mode, verbose=True
+    )
+    server = ModelServer(engine, host=args.host, port=args.port)
+    print(f"serving {args.model} (tp={args.tp}) on {server.host}:{server.port}")
+    server.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,107 @@
+"""Socket model server over the Engine.
+
+Parity: reference ``mega_triton_kernel/test/models/model_server.py`` —
+a TCP server (:112-198) that owns the compiled model and answers
+generation requests, with the chat/bench clients speaking a small
+framed protocol. Here the protocol is newline-delimited JSON over TCP:
+
+    → {"input_ids": [[...]], "gen_len": 32}
+    ← {"output_ids": [[...]], "stats": {...}}
+    → {"cmd": "ping"}            ← {"ok": true}
+    → {"cmd": "shutdown"}        ← {"ok": true}   (server then exits)
+
+One request at a time (the accelerator is serial anyway — the reference
+server is likewise single-stream).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+
+import numpy as np
+
+from triton_distributed_tpu.models.engine import Engine
+
+
+class ModelServer:
+    """Own a listening socket + an Engine; serve generation requests."""
+
+    def __init__(self, engine: Engine, host: str = "127.0.0.1", port: int = 0):
+        self.engine = engine
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(4)
+        self.host, self.port = self._sock.getsockname()
+        self._shutdown = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- request handling ------------------------------------------------
+    def _handle(self, req: dict) -> dict:
+        if req.get("cmd") == "ping":
+            return {"ok": True}
+        if req.get("cmd") == "shutdown":
+            self._shutdown.set()
+            return {"ok": True}
+        input_ids = np.asarray(req["input_ids"], np.int32)
+        gen_len = int(req.get("gen_len", 16))
+        out = self.engine.serve(input_ids, gen_len)
+        return {
+            "output_ids": out.tolist(),
+            "stats": self.engine.last_stats,
+        }
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        with conn, conn.makefile("rwb") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    resp = self._handle(json.loads(line))
+                except Exception as e:  # report, keep serving
+                    resp = {"error": f"{type(e).__name__}: {e}"}
+                f.write(json.dumps(resp).encode() + b"\n")
+                f.flush()
+                if self._shutdown.is_set():
+                    return
+
+    def serve_forever(self) -> None:
+        """Accept loop; returns after a shutdown request."""
+        self._sock.settimeout(0.2)
+        while not self._shutdown.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            self._serve_conn(conn)
+        self._sock.close()
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "ModelServer":
+        """Run the accept loop on a background thread (tests/demos)."""
+        self._thread = threading.Thread(target=self.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+def request(host: str, port: int, payload: dict, timeout: float = 120.0) -> dict:
+    """One JSON request/response round trip (client side)."""
+    with socket.create_connection((host, port), timeout=timeout) as s, \
+            s.makefile("rwb") as f:
+        f.write(json.dumps(payload).encode() + b"\n")
+        f.flush()
+        line = f.readline()
+    if not line:
+        raise ConnectionError("server closed connection without a response")
+    resp = json.loads(line)
+    if "error" in resp:
+        raise RuntimeError(f"server error: {resp['error']}")
+    return resp

@@ -1,0 +1,49 @@
+"""Tutorial 02: intra-slice all-gather over ICI.
+
+Parity: reference ``tutorials/02-intra-node-allgather.py`` (copy-engine
+and NVSHMEM producers over NVLink). On TPU the "node" is an ICI slice
+and the producers are Pallas kernels driving the per-chip DMA engines:
+
+- PALLAS_FULL_MESH — every rank puts its shard to every peer in one
+  round (lowest latency for small messages; the reference's full-mesh
+  copy-engine producer).
+- PALLAS_RING / PALLAS_BIDIR_RING — neighbor pushes around the ring,
+  bidirectional splits the payload both ways (bandwidth-optimal; the
+  reference's ring_push_1d / NUMA-aware variants).
+- XLA — ``jax.lax.all_gather``, the compiler-scheduled baseline AUTO
+  falls back to for large payloads or off-TPU.
+"""
+
+from _common import setup
+
+jax = setup()
+
+import jax.numpy as jnp
+import numpy as np
+
+from triton_distributed_tpu.ops import AllGatherMethod, all_gather_op
+from triton_distributed_tpu.runtime.mesh import initialize_distributed
+from triton_distributed_tpu.runtime.utils import perf_func
+
+
+def main():
+    ctx = initialize_distributed(tp=min(8, len(jax.devices())))
+    n = ctx.axis_size("tp")
+    x = jnp.arange(n * 16 * 128, dtype=jnp.float32).reshape(n * 16, 128)
+
+    for method in (
+        AllGatherMethod.XLA,
+        AllGatherMethod.PALLAS_FULL_MESH,
+        AllGatherMethod.PALLAS_RING,
+        AllGatherMethod.PALLAS_BIDIR_RING,
+    ):
+        out = all_gather_op(x, "tp", method, ctx)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+        _, ms = perf_func(
+            lambda: all_gather_op(x, "tp", method, ctx), iters=5, warmup_iters=2
+        )
+        print(f"all_gather[{method.name:17s}] n={n}: OK   {ms:7.3f} ms")
+
+
+if __name__ == "__main__":
+    main()

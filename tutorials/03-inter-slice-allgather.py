@@ -1,0 +1,43 @@
+"""Tutorial 03: hierarchical all-gather across slices (ICI + DCN).
+
+Parity: reference ``tutorials/03-inter-node-allgather.py`` (2D push:
+NVLink stage intra-node, RDMA ring across nodes). TPU translation
+(SURVEY.md §2.4): intra-slice stage = Pallas kernel over ICI; the
+inter-slice stage rides XLA's DCN collectives (DCN transfers cannot be
+device-initiated, so the 2-level split is structural, exactly like the
+reference's intra/inter-node split).
+
+The simulated mesh uses axes (dcn=2, tp=4) — on real hardware the outer
+axis maps across slices/hosts.
+"""
+
+from _common import setup
+
+jax = setup()
+
+import jax.numpy as jnp
+import numpy as np
+
+from triton_distributed_tpu.ops.collectives.hierarchical import all_gather_2d_op
+from triton_distributed_tpu.runtime.mesh import initialize_distributed
+
+
+def main():
+    nd = len(jax.devices())
+    if nd >= 8:
+        dcn, tp = 2, 4
+    elif nd >= 2:
+        dcn, tp = 2, nd // 2
+    else:
+        dcn, tp = 1, 1
+    ctx = initialize_distributed({"dcn": dcn, "tp": tp})
+    n = dcn * tp
+    x = jnp.arange(n * 8 * 128, dtype=jnp.float32).reshape(n * 8, 128)
+
+    out = all_gather_2d_op(x, inner_axis="tp", outer_axis="dcn", ctx=ctx)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+    print(f"hierarchical all-gather over {dcn}x{tp} (dcn x ici): OK")
+
+
+if __name__ == "__main__":
+    main()

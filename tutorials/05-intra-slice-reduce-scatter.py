@@ -1,0 +1,37 @@
+"""Tutorial 05: intra-slice reduce-scatter over ICI.
+
+Parity: reference ``tutorials/05-intra-node-reduce-scatter.py`` (ring
+copy-engine / SM push reduce over NVLink, ``reduce_scatter.py:285-744``).
+TPU: one Pallas kernel runs the ring — each step sends the accumulating
+chunk to the right neighbor while reducing the chunk that just arrived;
+after n-1 hops every rank holds the fully-reduced chunk it owns.
+"""
+
+from _common import setup
+
+jax = setup()
+
+import jax.numpy as jnp
+import numpy as np
+
+from triton_distributed_tpu.ops import ReduceScatterMethod, reduce_scatter_op
+from triton_distributed_tpu.runtime.mesh import initialize_distributed
+
+
+def main():
+    ctx = initialize_distributed(tp=min(8, len(jax.devices())))
+    n = ctx.axis_size("tp")
+    rng = np.random.default_rng(0)
+    # One addend per rank; result chunk r = sum over ranks of rows r.
+    x = jnp.asarray(rng.standard_normal((n, n * 8, 128)), jnp.float32)
+
+    for method in (ReduceScatterMethod.XLA, ReduceScatterMethod.PALLAS_RING):
+        out = reduce_scatter_op(x, "tp", method, ctx)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(x).sum(0), rtol=1e-5, atol=1e-5
+        )
+        print(f"reduce_scatter[{method.name:11s}] n={n}: OK")
+
+
+if __name__ == "__main__":
+    main()

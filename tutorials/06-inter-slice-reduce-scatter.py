@@ -1,0 +1,52 @@
+"""Tutorial 06: hierarchical reduce-scatter across slices.
+
+Parity: reference ``tutorials/06-inter-node-reduce-scatter.py`` and the
+2-level multinode path ``reduce_scatter_multi_node``
+(``reduce_scatter.py:828``): intra-node ring first, then the surviving
+chunk crosses nodes. TPU: ICI Pallas ring → DCN ``psum_scatter``; chunk
+ids come back inner-major (tp-major), see
+``ops/collectives/hierarchical.py``.
+"""
+
+from _common import setup
+
+jax = setup()
+
+import functools
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu.ops.collectives.hierarchical import reduce_scatter_2d
+from triton_distributed_tpu.runtime.mesh import initialize_distributed
+
+
+def main():
+    nd = len(jax.devices())
+    dcn, tp = (2, 4) if nd >= 8 else ((2, nd // 2) if nd >= 2 else (1, 1))
+    ctx = initialize_distributed({"dcn": dcn, "tp": tp})
+    n = dcn * tp
+    rng = np.random.default_rng(0)
+    M = n * 8
+    x = jnp.asarray(rng.standard_normal((n, M, 128)), jnp.float32)
+
+    def body(xi):
+        return reduce_scatter_2d(
+            xi[0], inner_axis="tp", outer_axis="dcn", ctx=ctx
+        )
+
+    f = ctx.shard_map(
+        body,
+        in_specs=P(("dcn", "tp"), None, None),
+        out_specs=P(("tp", "dcn"), None),  # chunks land inner-major
+    )
+    out = np.asarray(f(x))
+    np.testing.assert_allclose(
+        out, np.asarray(x).sum(0), rtol=1e-4, atol=1e-4
+    )
+    print(f"hierarchical reduce-scatter over {dcn}x{tp}: OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+"""Tutorial 08: GEMM + ReduceScatter overlap — the TP output projection.
+
+Parity: reference ``tutorials/08-overlapping-gemm-reduce-scatter.py`` —
+producer GEMM notifies per-tile barriers as tiles land; scatter +
+ring-reduce consumes them (``gemm_reduce_scatter.py``,
+``reduce_scatter.py``).
+
+TPU redesign: one Pallas kernel computes this rank's contribution to
+chunk c = (me+1+s) mod n at step s and immediately pushes it into the
+owner's inbound slot over ICI — the DMA rides under the next chunk's
+GEMM. The last step reduces the n-1 landed contributions with the local
+chunk. Mirror image of tutorial 07.
+"""
+
+from _common import setup
+
+jax = setup()
+
+import jax.numpy as jnp
+import numpy as np
+
+from triton_distributed_tpu.ops import gemm_rs_op
+from triton_distributed_tpu.runtime.mesh import initialize_distributed
+
+
+def main():
+    ctx = initialize_distributed(tp=min(4, len(jax.devices())))
+    n = ctx.axis_size("tp")
+    rng = np.random.default_rng(0)
+    m, k_loc, n_cols = n * 16, 64, 128
+    # a column-sharded [M, K]; b row-sharded [K, N] — C = sum of partials.
+    a = jnp.asarray(rng.standard_normal((m, n * k_loc)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((n * k_loc, n_cols)), jnp.float32)
+
+    out = gemm_rs_op(a, b, "tp", ctx=ctx)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(a) @ np.asarray(b), rtol=2e-4, atol=2e-4
+    )
+    print(f"overlapped GEMM+RS over {n} ranks: OK")
+
+
+if __name__ == "__main__":
+    main()

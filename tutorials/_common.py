@@ -1,0 +1,28 @@
+"""Shared tutorial setup: simulated 8-device CPU mesh by default.
+
+Run any tutorial with ``--tpu`` to use the real TPU devices instead
+(single-chip environments degenerate the comm patterns to n=1).
+The environment may pin jax_platforms at interpreter startup, so the
+override must go through jax.config before first device use.
+"""
+
+import os
+import sys
+
+# Tutorials live one level below the repo root; make the package
+# importable without an install.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def setup(n_devices: int = 8):
+    if "--tpu" not in sys.argv:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n_devices}"
+        )
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    return jax
