@@ -160,3 +160,54 @@ def test_engine_serve(ctx4):
     assert out.shape == (2, 12)
     # Same prompt rows → identical greedy continuations.
     np.testing.assert_array_equal(out[0], out[1])
+
+
+class TestPagedKVCache:
+    """Parity: reference mega_triton_kernel/models/paged_kv_cache.py —
+    page-pool cache with free-list allocation and table indirection."""
+
+    def test_append_and_dense_view(self, ctx4, rng):
+        import jax.numpy as jnp
+        from triton_distributed_tpu.models.config import get_config
+        from triton_distributed_tpu.models.paged_kv_cache import (
+            append,
+            as_dense,
+            init_paged_cache,
+        )
+
+        cfg = get_config("tiny")
+        B = 2
+        cache, pool = init_paged_cache(
+            cfg, B, ctx4, max_length=64, page_size=16
+        )
+        L, hkv, hd = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim
+
+        gold_k = np.zeros((L, B, hkv, 64, hd), np.float32)
+        for t in range(20):  # crosses a page boundary (page_size=16)
+            k_new = jnp.asarray(
+                rng.standard_normal((L, B, hkv, hd)), jnp.float32
+            )
+            v_new = jnp.asarray(
+                rng.standard_normal((L, B, hkv, hd)), jnp.float32
+            )
+            gold_k[:, :, :, t] = np.asarray(k_new)
+            cache = append(cache, k_new, v_new)
+
+        k_dense, _ = as_dense(cache)
+        np.testing.assert_allclose(
+            np.asarray(k_dense)[:, :, :, :20], gold_k[:, :, :, :20], rtol=1e-6
+        )
+        assert int(cache.kv_len[0]) == 20
+
+    def test_pool_alloc_release(self):
+        from triton_distributed_tpu.models.paged_kv_cache import PagePool
+
+        pool = PagePool(4)
+        a = pool.allocate(3)
+        assert len(set(a)) == 3
+        import pytest
+
+        with pytest.raises(RuntimeError, match="exhausted"):
+            pool.allocate(2)
+        pool.release(a)
+        assert len(pool.allocate(4)) == 4

@@ -64,7 +64,7 @@ def test_routing_and_grouped_ffn(rng, moe_weights):
     np.testing.assert_allclose(np.asarray(out), gold, atol=1e-4, rtol=1e-4)
 
 
-@pytest.mark.parametrize("mode", ["xla", "pallas", "xla_ar", "pallas_ar"])
+@pytest.mark.parametrize("mode", ["xla", "pallas", "ring", "xla_ar", "pallas_ar"])
 def test_tp_moe(ctx4, rng, moe_weights, mode):
     mw = moe_weights
     t = 32

@@ -27,7 +27,7 @@ from triton_distributed_tpu.ops.moe.grouped_gemm import grouped_ffn
 from triton_distributed_tpu.ops.moe.routing import moe_combine, moe_sort, router_topk
 from triton_distributed_tpu.runtime.mesh import DistContext, current_context
 
-Mode = Literal["xla", "pallas", "pallas_ar", "xla_ar"]
+Mode = Literal["xla", "pallas", "ring", "pallas_ar", "xla_ar"]
 
 
 @dataclasses.dataclass
@@ -60,6 +60,17 @@ def tp_moe_fwd(
     replicated ``x [B, d]``.
     """
     num_experts = params.w1.shape[0]
+    if mode == "ring":
+        # Fused AG+GroupGEMM → RS: chunks + partials circulate via
+        # ppermute, XLA overlaps transfer with the grouped FFN
+        # (ops/moe/ring_moe.py; parity: allgather_group_gemm.py +
+        # moe_reduce_rs.py).
+        from triton_distributed_tpu.ops.moe.ring_moe import moe_ffn_ring
+
+        return moe_ffn_ring(
+            x, params.w_router, params.w1, params.w2, k,
+            axis=axis, norm_topk_prob=norm_topk_prob,
+        )
     seq_mode = mode in ("pallas", "xla")
     if seq_mode:
         if mode == "pallas":
@@ -152,7 +163,7 @@ class TPMoE:
 
     def forward(self, x: jax.Array, mode: Mode = "pallas") -> jax.Array:
         assert self.params is not None
-        seq = mode in ("pallas", "xla")
+        seq = mode in ("pallas", "xla", "ring")
         xs = P(self.axis, None) if seq else P()
         f = self.ctx.shard_map(
             functools.partial(

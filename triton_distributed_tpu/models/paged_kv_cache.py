@@ -1,0 +1,140 @@
+"""Paged KV cache: fixed-size pages + per-sequence page tables.
+
+Parity: reference ``mega_triton_kernel/models/paged_kv_cache.py`` — a
+page-pool cache for the megakernel decode path (pages allocated from a
+free list, indirection through a page table).
+
+TPU design: the pool is one array ``[L, num_pages, Hkv_loc, page, hd]``
+(pages are just the S axis tiled), the page table is host-side state
+(allocation is control-plane work, per sequence not per token), and
+appends are jit-safe dynamic-slice writes at ``(page_id, offset)``.
+Attention either materializes a dense view (``as_dense`` — gather by
+page table, cheap at decode sizes) or consumes pages directly via the
+table as a scalar-prefetch operand (future paged flash-decode).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from triton_distributed_tpu.models.config import ModelConfig
+from triton_distributed_tpu.runtime.mesh import DistContext
+from triton_distributed_tpu.runtime.pytree import register_param_dataclass
+
+
+@dataclasses.dataclass
+class PagedKVCache:
+    k_pages: jax.Array     # [L, P, Hkv_loc, page_size, hd]
+    v_pages: jax.Array
+    page_table: jax.Array  # [B, pages_per_seq] int32 — page ids
+    kv_len: jax.Array      # [B] int32
+
+
+register_param_dataclass(
+    PagedKVCache, ["k_pages", "v_pages", "page_table", "kv_len"]
+)
+
+
+class PagePool:
+    """Host-side free-list allocator (parity: the reference's page pool).
+
+    Page assignment is control-plane state: sequences allocate/free whole
+    page lists on admission/eviction, so this stays in Python while the
+    data plane (pool arrays + appends) is jitted.
+    """
+
+    def __init__(self, num_pages: int):
+        self.free = list(range(num_pages - 1, -1, -1))
+
+    def allocate(self, n: int) -> list[int]:
+        if n > len(self.free):
+            raise RuntimeError(f"page pool exhausted ({n} > {len(self.free)})")
+        return [self.free.pop() for _ in range(n)]
+
+    def release(self, pages: list[int]) -> None:
+        self.free.extend(pages)
+
+
+def init_paged_cache(
+    cfg: ModelConfig,
+    batch_size: int,
+    ctx: DistContext,
+    axis: str = "tp",
+    *,
+    max_length: int | None = None,
+    page_size: int = 128,
+    num_pages: int | None = None,
+) -> tuple[PagedKVCache, PagePool]:
+    """Allocate the pool + page tables for ``batch_size`` sequences."""
+    s_max = max_length or cfg.max_length
+    if s_max % page_size:
+        raise ValueError(f"max_length {s_max} not a page multiple")
+    pages_per_seq = s_max // page_size
+    num_pages = num_pages or batch_size * pages_per_seq
+    pool = PagePool(num_pages)
+    table = np.asarray(
+        [pool.allocate(pages_per_seq) for _ in range(batch_size)], np.int32
+    )
+    shape = (
+        cfg.num_layers, num_pages, cfg.num_kv_heads, page_size, cfg.head_dim
+    )
+    spec = (None, None, axis, None, None)
+    cache = PagedKVCache(
+        k_pages=ctx.shard(jnp.zeros(shape, cfg.dtype), *spec),
+        v_pages=ctx.shard(jnp.zeros(shape, cfg.dtype), *spec),
+        page_table=ctx.replicate(jnp.asarray(table)),
+        kv_len=ctx.replicate(jnp.zeros((batch_size,), jnp.int32)),
+    )
+    return cache, pool
+
+
+def append(
+    cache: PagedKVCache,
+    k_new: jax.Array,  # [L, B, Hkv_loc, hd] — one token per sequence
+    v_new: jax.Array,
+) -> PagedKVCache:
+    """Append one token per sequence at ``kv_len`` (jit-safe)."""
+    page_size = cache.k_pages.shape[3]
+    b = k_new.shape[1]
+
+    def write(pages, new):
+        def one(pages, b_idx):
+            pos = cache.kv_len[b_idx]
+            pid = cache.page_table[b_idx, pos // page_size]
+            upd = new[:, b_idx][:, None, :, None, :]  # [L, 1, H, 1, hd]
+            return jax.lax.dynamic_update_slice(
+                pages, upd.astype(pages.dtype),
+                (0, pid, 0, pos % page_size, 0),
+            )
+
+        for i in range(b):
+            pages = one(pages, i)
+        return pages
+
+    return PagedKVCache(
+        k_pages=write(cache.k_pages, k_new),
+        v_pages=write(cache.v_pages, v_new),
+        page_table=cache.page_table,
+        kv_len=cache.kv_len + 1,
+    )
+
+
+def as_dense(cache: PagedKVCache, layer=None):
+    """Materialize contiguous ``[L?, B, Hkv_loc, S_max, hd]`` views by
+    gathering pages through the table (decode feeds this to
+    ``flash_decode``; the page gather is a take on the page axis)."""
+    kp = cache.k_pages if layer is None else cache.k_pages[layer]
+    vp = cache.v_pages if layer is None else cache.v_pages[layer]
+
+    def gather(pages):
+        # pages [..., P, H, page, hd]; table [B, pps] → [..., B, H, S, hd]
+        g = jnp.take(pages, cache.page_table, axis=-4)  # [..., B, pps, H, pg, hd]
+        g = jnp.swapaxes(g, -4, -3)                     # [..., B, H, pps, pg, hd]
+        s = g.shape
+        return g.reshape(*s[:-3], s[-3] * s[-2], s[-1])
+
+    return gather(kp), gather(vp)

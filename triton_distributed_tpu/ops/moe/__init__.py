@@ -20,3 +20,4 @@ from triton_distributed_tpu.ops.moe.ep_a2a import (  # noqa: F401
     ep_dispatch,
     ep_moe_ffn,
 )
+from triton_distributed_tpu.ops.moe.ring_moe import moe_ffn_ring  # noqa: F401

@@ -1,0 +1,73 @@
+"""Ring MoE: fused AG+GroupGEMM → MoE+ReduceScatter with XLA overlap.
+
+Parity: reference ``kernels/nvidia/allgather_group_gemm.py`` (tokens
+all-gathered while a grouped GEMM consumes per-rank chunks as they
+arrive — ``kernel_consumer_m_parallel_scatter_group_gemm``:535, with the
+rank-aware tile swizzle) and ``moe_reduce_rs.py`` (grouped GEMM fused
+with the topk-reduce + reduce-scatter, :569).
+
+TPU redesign: instead of a device-side scoreboard over gathered chunks,
+the ring structure makes the overlap compiler-visible. Token chunks and
+their partial outputs circulate as ``lax.ppermute`` pairs; each step
+computes this rank's expert contribution to the visiting chunk while
+XLA's async collective engine moves the next pair over ICI — compute
+hides the transfer, the fusion the reference builds by hand. After n
+hops every pair is back home carrying the full sum: the all-gather
+(tokens visit every rank) and the reduce-scatter (partials accumulate
+along the ring) never materialize a gathered buffer.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.ops.moe.grouped_gemm import grouped_ffn
+from triton_distributed_tpu.ops.moe.routing import (
+    moe_combine,
+    moe_sort,
+    router_topk,
+)
+
+
+def moe_ffn_ring(
+    x: jax.Array,         # [t_loc, d] — this rank's token chunk
+    w_router: jax.Array,  # [d, E] replicated
+    w1: jax.Array,        # [E, d, 2*f_loc] — gate|up fused column shard
+    w2: jax.Array,        # [E, f_loc, d] — row shard
+    k: int,
+    *,
+    axis: str = "tp",
+    norm_topk_prob: bool = True,
+) -> jax.Array:
+    """Full TP-MoE FFN inside ``shard_map``: ``[t_loc, d] → [t_loc, d]``
+    with activations staying sequence-sharded (the reference's
+    AG-scatter-groupGEMM → gather-RS pipeline, ``tp_moe.py:237``,
+    collapsed into one ring)."""
+    n = jax.lax.axis_size(axis)
+    t, d = x.shape
+    num_experts = w_router.shape[1]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def contribution(tok: jax.Array) -> jax.Array:
+        """This rank's partial FFN output for a token chunk (partial over
+        the f shard; full after ring accumulation)."""
+        route = router_topk(tok, w_router, k, norm_topk_prob=norm_topk_prob)
+        st = moe_sort(route, num_experts)
+        out_rows = grouped_ffn(tok[st.token_ids], w1, w2, st.group_sizes)
+        return moe_combine(out_rows, st, t)
+
+    def step(carry, _):
+        tok, acc = carry
+        acc = acc + contribution(tok).astype(jnp.float32)
+        # Pass the pair to the right; XLA overlaps this ppermute with the
+        # next step's grouped GEMM (async collective scheduling).
+        tok = jax.lax.ppermute(tok, axis, perm)
+        acc = jax.lax.ppermute(acc, axis, perm)
+        return (tok, acc), None
+
+    init = (x, jnp.zeros((t, d), jnp.float32))
+    (tok_back, acc), _ = jax.lax.scan(step, init, None, length=n)
+    # After n hops the pair that started here is home again, carrying
+    # every rank's contribution to OUR tokens.
+    return acc.astype(x.dtype)
