@@ -67,3 +67,36 @@ def test_init_seed_deterministic():
     k1 = init_seed(7)
     k2 = init_seed(7)
     assert jnp.array_equal(jax.random.uniform(k1, (3,)), jax.random.uniform(k2, (3,)))
+
+
+class TestTeamSplit:
+    """Parity: reference NVSHMEM team split (test_team_split.py) — a mesh
+    axis splits into two named sub-axes addressable independently."""
+
+    def test_split_axis_collectives(self, ctx8, rng):
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        sub = ctx8.split_axis("tp", ("tpo", "tpi"), (2, 4))
+        assert sub.axis_size("tpo") == 2 and sub.axis_size("tpi") == 4
+        x = jnp.asarray(rng.standard_normal((8, 16)), jnp.float32)
+
+        # psum over only the inner team must not cross outer teams.
+        def body(xi):
+            return jax.lax.psum(xi, "tpi")
+
+        f = sub.shard_map(
+            body, in_specs=P(("tpo", "tpi"), None), out_specs=P("tpo", None)
+        )
+        out = np.asarray(f(x))  # [2, 16] — one row per outer team
+        xs = np.asarray(x).reshape(2, 4, 16)
+        np.testing.assert_allclose(out, xs.sum(1), rtol=1e-5)
+
+    def test_split_axis_validates(self, ctx8):
+        import pytest
+
+        with pytest.raises(ValueError, match="does not cover"):
+            ctx8.split_axis("tp", ("a", "b"), (3, 2))

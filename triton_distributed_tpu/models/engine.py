@@ -56,9 +56,13 @@ class Engine:
         input_ids,  # [B, S] int32 (list/np/jnp)
         gen_len: int,
         max_length: int | None = None,
+        profile: str | None = None,
     ) -> np.ndarray:
         """Generate ``gen_len`` tokens for each sequence; returns
-        ``[B, S + gen_len]`` (parity: ``Engine.serve``)."""
+        ``[B, S + gen_len]`` (parity: ``Engine.serve``). ``profile``
+        names a trace directory for the decode loop (parity: the
+        reference Engine's 64-step decode profile, ``engine.py:151-177``).
+        """
         input_ids = np.asarray(input_ids, np.int32)
         b, s = input_ids.shape
         n = self.model.ctx.axis_size(self.model.axis)
@@ -87,11 +91,14 @@ class Engine:
         tok = self._sample(logits)
         out.append(np.asarray(tok)[:, None])
 
+        from triton_distributed_tpu.runtime.profiling import group_profile
+
         t0 = time.perf_counter()
-        for _ in range(gen_len - 1):
-            logits, cache = self.model.decode_step(tok, cache, self.mode)
-            tok = self._sample(logits)
-            out.append(np.asarray(tok)[:, None])
+        with group_profile(profile, do_prof=profile is not None):
+            for _ in range(gen_len - 1):
+                logits, cache = self.model.decode_step(tok, cache, self.mode)
+                tok = self._sample(logits)
+                out.append(np.asarray(tok)[:, None])
         t_decode = time.perf_counter() - t0
 
         self.last_stats = {

@@ -145,6 +145,44 @@ class DistContext:
             check_vma=check_vma,
         )
 
+    # -- teams / sub-groups ----------------------------------------------
+    def split_axis(
+        self,
+        axis: str,
+        names: tuple[str, str],
+        sizes: tuple[int, int],
+        *,
+        set_as_current: bool = False,
+    ) -> "DistContext":
+        """Split a mesh axis into two (parity: NVSHMEM team split —
+        ``nvshmem_team_split_strided`` / ``team_my_pe``,
+        ``libnvshmem_device.py:130,1343``, ``test_team_split.py``).
+
+        A rank's ids along the new axes are ``(old // sizes[1],
+        old % sizes[1])`` — the strided/round-robin split of the
+        reference's 2D protocols (NUMA-aware ring, 2D allgather).
+        Collectives and remote DMAs then target either sub-axis by name.
+        """
+        if sizes[0] * sizes[1] != self.axis_size(axis):
+            raise ValueError(
+                f"split {sizes} does not cover axis {axis!r} of size "
+                f"{self.axis_size(axis)}"
+            )
+        idx = self.mesh.axis_names.index(axis)
+        new_names = (
+            self.mesh.axis_names[:idx] + names
+            + self.mesh.axis_names[idx + 1:]
+        )
+        shape = self.mesh.devices.shape
+        new_shape = shape[:idx] + sizes + shape[idx + 1:]
+        ctx = DistContext(
+            Mesh(self.mesh.devices.reshape(new_shape), new_names),
+            self.topology,
+        )
+        if set_as_current:
+            set_context(ctx)
+        return ctx
+
     def sharding(self, *spec) -> NamedSharding:
         return NamedSharding(self.mesh, P(*spec))
 
