@@ -84,10 +84,14 @@ TdtAotArchive* tdt_aot_open(const char* path) {
       remaining -= need;
       return true;
     };
-    if (!ReadExact(f, &name_len, 4) || !take(4u + name_len)) goto bad;
+    // Account length fields separately: 4u + len wraps in 32-bit
+    // arithmetic for len >= 0xFFFFFFFC, defeating the file-size bound.
+    if (!ReadExact(f, &name_len, 4) || !take(4) ||
+        !take(static_cast<uint64_t>(name_len))) goto bad;
     e.name.resize(name_len);
     if (name_len && !ReadExact(f, e.name.data(), name_len)) goto bad;
-    if (!ReadExact(f, &meta_len, 4) || !take(4u + meta_len)) goto bad;
+    if (!ReadExact(f, &meta_len, 4) || !take(4) ||
+        !take(static_cast<uint64_t>(meta_len))) goto bad;
     e.meta.resize(meta_len);
     if (meta_len && !ReadExact(f, e.meta.data(), meta_len)) goto bad;
     if (!ReadExact(f, &data_len, 8) || !take(8) || !take(data_len)) goto bad;

@@ -1,0 +1,163 @@
+"""Overlap-efficiency benchmark: how much comm does AG+GEMM / GEMM+RS hide?
+
+The north-star metric (BASELINE.md / reference ``README.md:190-205``
+charts): fused-overlap time vs (pure GEMM, GEMM + blocking collective).
+Comm-hidden fraction = (T_blocking − T_overlap) / T_comm — 1.0 means the
+collective costs nothing extra.
+
+Two modes:
+
+1. **Measured (single chip)**: at tp=1 there is no comm, but the rung
+   that CAN regress is the orchestrated Pallas kernel's compute path vs
+   the plain XLA GEMM — kernel overhead, staging pipeline stalls. We
+   measure that ratio (``kernel_efficiency``). Timing follows the axon
+   relay rules (data-dependent chaining inside one jit, host fetch as
+   fence — see bench.py).
+2. **Analytic (tp=8 projection)**: the perf model
+   (``tools/perf_model.py``, parity with the reference's
+   ``comm_perf_model.py``) prices GEMM and ring collectives at the
+   survey north-star shapes and projects the hidden fraction the fused
+   kernels target: T_overlap ≈ max(T_gemm, T_comm) + per-step latency.
+
+Usage:
+    python perf/overlap_efficiency.py [--cpu] [--m 8192 --k 4096 --n 12288]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def measured_kernel_efficiency(args, jax, jnp, np):
+    """tp=1: fused-kernel GEMM path vs plain XLA dot (no comm rung)."""
+    from triton_distributed_tpu.ops.overlap import AGGemmConfig, ag_gemm_op
+    from triton_distributed_tpu.ops.overlap.ag_gemm import create_ag_gemm_context
+    from triton_distributed_tpu.runtime.mesh import initialize_distributed
+
+    ctx = initialize_distributed(tp=1, devices=jax.devices()[:1])
+    m, k, n = args.m, args.k, args.n
+    dt = jnp.bfloat16 if not args.cpu else jnp.float32
+    key = jax.random.key(0)
+    a = jax.random.normal(key, (m, k), jnp.float32).astype(dt)
+    b = jax.random.normal(key, (k, n), jnp.float32).astype(dt)
+
+    def timed(f, iters=8):
+        # Chain iterations with a data dependency; fence by host fetch.
+        def chained(a, b):
+            def body(_, acc):
+                out = f(a + acc * 0, b)
+                return out[0, 0].astype(jnp.float32)
+
+            return jax.lax.fori_loop(0, iters, body, jnp.float32(0))
+
+        run = jax.jit(chained)
+        np.asarray(run(a, b))  # compile + warm
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(run(a, b))
+            best = min(best, (time.perf_counter() - t0) / iters)
+        return best * 1e3
+
+    t_xla = timed(lambda a, b: jnp.dot(a, b, preferred_element_type=jnp.float32))
+    cfg = create_ag_gemm_context(m, n, k, dt)
+    t_fused = timed(
+        lambda a, b: ag_gemm_op(a, b, "tp", cfg, ctx)
+    )
+    return {
+        "xla_gemm_ms": round(t_xla, 3),
+        "fused_kernel_ms": round(t_fused, 3),
+        "kernel_efficiency": round(t_xla / max(t_fused, 1e-9), 4),
+    }
+
+
+def analytic_projection(args, jnp):
+    """tp=8 projection from the perf model (reference comm_perf_model)."""
+    from triton_distributed_tpu.tools.perf_model import (
+        chip_spec,
+        estimate_all_gather_time_ms,
+        estimate_gemm_time_ms,
+        estimate_reduce_scatter_time_ms,
+    )
+
+    tp = args.tp
+    m, k, n = args.m, args.k, args.n
+    dt = jnp.bfloat16
+    spec = chip_spec(args.chip)
+    out = {}
+
+    # AG+GEMM: gather A rows [m, k], each device computes [m, k]@[k, n/tp].
+    t_gemm = estimate_gemm_time_ms(m, n // tp, k, dt, spec)
+    t_comm = estimate_all_gather_time_ms(m * k * 2, tp, spec=spec)
+    # Fused: compute starts on the local chunk immediately; per-chunk
+    # arrival latency exposes ~1/tp of the comm on the critical path when
+    # comm is slower than compute.
+    t_overlap = max(t_gemm, t_comm) + min(t_gemm, t_comm) / tp
+    t_blocking = t_gemm + t_comm
+    out["ag_gemm"] = {
+        "gemm_ms": round(t_gemm, 3),
+        "comm_ms": round(t_comm, 3),
+        "blocking_ms": round(t_blocking, 3),
+        "overlap_ms": round(t_overlap, 3),
+        "comm_hidden_frac": round(
+            (t_blocking - t_overlap) / max(t_comm, 1e-9), 4
+        ),
+    }
+
+    # GEMM+RS: [m, k/tp]@[k/tp, n] partials reduced+scattered over rows.
+    t_gemm = estimate_gemm_time_ms(m, n, k // tp, dt, spec)
+    t_comm = estimate_reduce_scatter_time_ms(m * n * 2, tp, spec=spec)
+    t_overlap = max(t_gemm, t_comm) + min(t_gemm, t_comm) / tp
+    t_blocking = t_gemm + t_comm
+    out["gemm_rs"] = {
+        "gemm_ms": round(t_gemm, 3),
+        "comm_ms": round(t_comm, 3),
+        "blocking_ms": round(t_blocking, 3),
+        "overlap_ms": round(t_overlap, 3),
+        "comm_hidden_frac": round(
+            (t_blocking - t_overlap) / max(t_comm, 1e-9), 4
+        ),
+    }
+    out["chip"] = spec.name
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--m", type=int, default=8192)
+    p.add_argument("--k", type=int, default=4096)
+    p.add_argument("--n", type=int, default=12288)
+    p.add_argument("--tp", type=int, default=8)
+    p.add_argument("--chip", default=None, help="chip kind for the model")
+    p.add_argument("--cpu", action="store_true")
+    p.add_argument("--skip-measure", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.cpu:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=1"
+        )
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    result = {
+        "shapes": {"m": args.m, "k": args.k, "n": args.n, "tp": args.tp},
+        "projection_tp8": analytic_projection(args, jnp),
+    }
+    if not args.skip_measure:
+        result["measured_tp1"] = measured_kernel_efficiency(args, jax, jnp, np)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -125,7 +125,8 @@ class TestMegaQwen3:
         mega = MegaQwen3(model)
         compiled, _ = mega.build(1, 64)
         L = model.cfg.num_layers
-        # embed + 9 per layer + final norm + lm_head
-        assert compiled.num_tasks == 1 + 9 * L + 2
+        # entry barrier (tp>1) + embed + 9 per layer + final norm + lm_head
+        assert compiled.num_tasks == 1 + 1 + 9 * L + 2
         types = {t.task_type for t in compiled.order}
         assert TaskType.ALLREDUCE in types and TaskType.ATTN in types
+        assert compiled.order[0].task_type == TaskType.BARRIER

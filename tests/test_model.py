@@ -162,6 +162,22 @@ def test_engine_serve(ctx4):
     np.testing.assert_array_equal(out[0], out[1])
 
 
+def test_engine_prompt_padding_inert(ctx4):
+    """Left-padded prompts with prompt_start generate the same
+    continuation as the unpadded prompt (pads must not be attended)."""
+    model = AutoLLM.from_pretrained("tiny", ctx=ctx4)
+    eng = Engine(model, temperature=0.0, mode="xla")
+    real = np.arange(3, 11, dtype=np.int32)  # length 8 (tp-divisible)
+    gold = eng.serve(real[None], gen_len=4)[0, 8:]
+    # Same prompt left-padded by 4 junk tokens to length 12 (pad to 12).
+    padded = np.concatenate([np.full(4, 77, np.int32), real])[None]
+    out = eng.serve(padded, gen_len=4, prompt_start=[4])[0, 12:]
+    np.testing.assert_array_equal(out, gold)
+    # Sanity: WITHOUT prompt_start the junk perturbs generation.
+    out_bad = eng.serve(padded, gen_len=4)[0, 12:]
+    assert not np.array_equal(out_bad, gold)
+
+
 class TestPagedKVCache:
     """Parity: reference mega_triton_kernel/models/paged_kv_cache.py —
     page-pool cache with free-list allocation and table indirection."""

@@ -1,0 +1,371 @@
+"""Mosaic lowering proof for every Pallas kernel (VERDICT r1 #2).
+
+Every comm/overlap/attention kernel — and the megakernel — must LOWER
+for the TPU platform, not just run in interpret mode. ``jax.export``
+with ``platforms=["tpu"]`` drives the real Mosaic lowering rules from
+the CPU host: tracing errors, unsupported Mosaic constructs at the
+lowering layer, and shape/memory-space violations all surface here.
+(The Mosaic→LLO compile inside libtpu still only happens on-device;
+this is the strongest check available without a chip.)
+
+Technique: patch the context's topology to claim ``platform="tpu"`` so
+``ctx.pallas_interpret()`` returns False (kernels take the Mosaic path),
+then export a jitted shard_map'd call with sharded ShapeDtypeStructs.
+"""
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import export
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu.runtime import mesh as mesh_mod
+
+
+@pytest.fixture
+def tpu_ctx():
+    """8-device tp mesh whose topology claims TPU (forces Mosaic path)."""
+    ctx = mesh_mod.initialize_distributed(tp=8)
+    ctx.topology = dataclasses.replace(ctx.topology, platform="tpu")
+    yield ctx
+    mesh_mod.finalize_distributed()
+
+
+@pytest.fixture
+def tpu_ctx4():
+    ctx = mesh_mod.initialize_distributed(
+        tp=4, devices=jax.devices()[:4]
+    )
+    ctx.topology = dataclasses.replace(ctx.topology, platform="tpu")
+    yield ctx
+    mesh_mod.finalize_distributed()
+
+
+def _lower(ctx, fn, *specs):
+    """Export ``fn`` for TPU; any Mosaic lowering rejection raises."""
+    exp = export.export(jax.jit(fn), platforms=["tpu"])(*specs)
+    assert len(exp.mlir_module_serialized) > 0
+    return exp
+
+
+def _sds(ctx, shape, spec, dtype=jnp.float32):
+    return jax.ShapeDtypeStruct(shape, dtype, sharding=ctx.sharding(*spec))
+
+
+# -- collectives ----------------------------------------------------------
+
+class TestCollectivesLower:
+    @pytest.mark.parametrize(
+        "method", ["pallas_ring", "pallas_bidir_ring", "pallas_full_mesh"]
+    )
+    def test_all_gather(self, tpu_ctx, method):
+        from triton_distributed_tpu.ops.collectives.all_gather import (
+            AllGatherMethod, all_gather,
+        )
+
+        f = tpu_ctx.shard_map(
+            functools.partial(
+                all_gather, axis="tp", method=AllGatherMethod(method),
+                ctx=tpu_ctx,
+            ),
+            in_specs=P("tp", None),
+            out_specs=P(None, None),
+        )
+        _lower(tpu_ctx, f, _sds(tpu_ctx, (8 * 16, 128), ("tp", None)))
+
+    @pytest.mark.parametrize("method", ["pallas_ring", "pallas_ring_hbm"])
+    def test_reduce_scatter(self, tpu_ctx, method):
+        from triton_distributed_tpu.ops.collectives.reduce_scatter import (
+            ReduceScatterMethod, reduce_scatter,
+        )
+
+        f = tpu_ctx.shard_map(
+            functools.partial(
+                reduce_scatter, axis="tp",
+                method=ReduceScatterMethod(method), ctx=tpu_ctx,
+            ),
+            in_specs=P(None, None),
+            out_specs=P("tp", None),
+        )
+        _lower(tpu_ctx, f, _sds(tpu_ctx, (8 * 16, 128), (None, None)))
+
+    @pytest.mark.parametrize("method", ["one_shot", "two_shot"])
+    def test_all_reduce(self, tpu_ctx, method):
+        from triton_distributed_tpu.ops.collectives.all_reduce import (
+            AllReduceMethod, all_reduce,
+        )
+
+        f = tpu_ctx.shard_map(
+            functools.partial(
+                all_reduce, axis="tp", method=AllReduceMethod(method),
+                ctx=tpu_ctx,
+            ),
+            in_specs=P(None, None),
+            out_specs=P(None, None),
+        )
+        _lower(tpu_ctx, f, _sds(tpu_ctx, (16, 128), (None, None)))
+
+    def test_all_to_all(self, tpu_ctx):
+        from triton_distributed_tpu.ops.collectives.all_to_all import all_to_all
+
+        f = tpu_ctx.shard_map(
+            functools.partial(
+                all_to_all, axis="tp", method="pallas", ctx=tpu_ctx
+            ),
+            in_specs=P("tp", None),
+            out_specs=P("tp", None),
+        )
+        _lower(tpu_ctx, f, _sds(tpu_ctx, (8 * 8, 128), ("tp", None)))
+
+
+# -- overlap kernels ------------------------------------------------------
+
+class TestOverlapLower:
+    def test_ag_gemm(self, tpu_ctx):
+        from triton_distributed_tpu.ops.overlap import AGGemmConfig, ag_gemm
+
+        f = tpu_ctx.shard_map(
+            functools.partial(
+                ag_gemm, axis="tp", config=AGGemmConfig(tile_n=128),
+                ctx=tpu_ctx,
+            ),
+            in_specs=(P("tp", None), P(None, "tp")),
+            out_specs=P(None, "tp"),
+        )
+        _lower(
+            tpu_ctx, f,
+            _sds(tpu_ctx, (8 * 16, 128), ("tp", None)),
+            _sds(tpu_ctx, (128, 8 * 128), (None, "tp")),
+        )
+
+    def test_gemm_rs(self, tpu_ctx):
+        from triton_distributed_tpu.ops.overlap import GemmRSConfig, gemm_rs
+
+        f = tpu_ctx.shard_map(
+            functools.partial(
+                gemm_rs, axis="tp", config=GemmRSConfig(tile_n=128),
+                ctx=tpu_ctx,
+            ),
+            in_specs=(P(None, "tp"), P("tp", None)),
+            out_specs=P("tp", None),
+        )
+        _lower(
+            tpu_ctx, f,
+            _sds(tpu_ctx, (8 * 16, 8 * 32), (None, "tp")),
+            _sds(tpu_ctx, (8 * 32, 128), ("tp", None)),
+        )
+
+    @pytest.mark.parametrize("method", ["one_shot", "two_shot"])
+    def test_gemm_ar(self, tpu_ctx, method):
+        from triton_distributed_tpu.ops.overlap import (
+            GemmARConfig, GemmARMethod, gemm_ar,
+        )
+
+        f = tpu_ctx.shard_map(
+            functools.partial(
+                gemm_ar, axis="tp", method=GemmARMethod(method),
+                config=GemmARConfig(tile_n=128), ctx=tpu_ctx,
+            ),
+            in_specs=(P(None, "tp"), P("tp", None)),
+            out_specs=P(None, None),
+        )
+        _lower(
+            tpu_ctx, f,
+            _sds(tpu_ctx, (16, 8 * 32), (None, "tp")),
+            _sds(tpu_ctx, (8 * 32, 128), ("tp", None)),
+        )
+
+
+# -- attention ------------------------------------------------------------
+
+class TestAttentionLower:
+    def test_flash_attention(self, tpu_ctx):
+        # Single-device kernel: export unsharded (1 logical device) —
+        # a sharded export would ask XLA to auto-partition the Mosaic
+        # custom call, which is unsupported by design.
+        from triton_distributed_tpu.ops.attention import flash_attention
+
+        def f(q, k, v):
+            return flash_attention(
+                q, k, v, causal=True, block_q=128, block_k=128
+            )
+
+        s = jax.ShapeDtypeStruct((1, 4, 256, 128), jnp.float32)
+        _lower(tpu_ctx, f, s, s, s)
+
+    def test_flash_decode(self, tpu_ctx):
+        from triton_distributed_tpu.ops.attention import flash_decode
+
+        def f(q, k, v, kv_len):
+            return flash_decode(q, k, v, kv_len, chunk_k=128)
+
+        kv = jax.ShapeDtypeStruct((2, 2, 512, 128), jnp.float32)
+        _lower(
+            tpu_ctx, f,
+            jax.ShapeDtypeStruct((2, 8, 128), jnp.float32),
+            kv, kv,
+            jax.ShapeDtypeStruct((2,), jnp.int32),
+        )
+
+    def test_distributed_flash_decode(self, tpu_ctx):
+        from triton_distributed_tpu.ops.attention import distributed_flash_decode
+
+        f = tpu_ctx.shard_map(
+            functools.partial(
+                distributed_flash_decode, axis="tp", chunk_k=128
+            ),
+            in_specs=(
+                P(), P(None, None, "tp", None), P(None, None, "tp", None), P(),
+            ),
+            out_specs=P(),
+        )
+        _lower(
+            tpu_ctx, f,
+            _sds(tpu_ctx, (2, 8, 128), ()),
+            _sds(tpu_ctx, (2, 2, 8 * 128, 128), (None, None, "tp", None)),
+            _sds(tpu_ctx, (2, 2, 8 * 128, 128), (None, None, "tp", None)),
+            jax.ShapeDtypeStruct((2,), jnp.int32),
+        )
+
+    def test_sp_ag_attention(self, tpu_ctx4):
+        from triton_distributed_tpu.ops.attention import sp_ag_attention
+
+        f = tpu_ctx4.shard_map(
+            functools.partial(
+                sp_ag_attention, axis="tp", block_q=64, ctx=tpu_ctx4
+            ),
+            in_specs=(P(None, "tp", None),) * 3,
+            out_specs=P(None, "tp", None),
+        )
+        _lower(
+            tpu_ctx4, f,
+            *[_sds(tpu_ctx4, (4, 256, 128), (None, "tp", None))] * 3,
+        )
+
+    def test_ring_attention(self, tpu_ctx4):
+        from triton_distributed_tpu.ops.attention import ring_attention
+
+        f = tpu_ctx4.shard_map(
+            functools.partial(
+                ring_attention, axis="tp", causal=True, block_q=64,
+                block_k=64,
+            ),
+            in_specs=(P(None, "tp", None),) * 3,
+            out_specs=P(None, "tp", None),
+        )
+        _lower(
+            tpu_ctx4, f,
+            *[_sds(tpu_ctx4, (4, 256, 128), (None, "tp", None))] * 3,
+        )
+
+
+# -- p2p / pp -------------------------------------------------------------
+
+class TestP2PLower:
+    def test_pp_shift(self, tpu_ctx):
+        from triton_distributed_tpu.parallel import pp_shift
+
+        f = tpu_ctx.shard_map(
+            functools.partial(pp_shift, axis="tp", method="pallas"),
+            in_specs=P("tp", None),
+            out_specs=P("tp", None),
+        )
+        _lower(tpu_ctx, f, _sds(tpu_ctx, (8 * 8, 128), ("tp", None)))
+
+
+# -- megakernel -----------------------------------------------------------
+
+class TestMegakernelLower:
+    def test_mega_decode_step(self, tpu_ctx4):
+        from triton_distributed_tpu.megakernel import MegaQwen3
+        from triton_distributed_tpu.models import AutoLLM
+
+        model = AutoLLM.from_pretrained("tiny", ctx=tpu_ctx4)
+        mega = MegaQwen3(model)
+        _, step = mega.build(1, 64)
+        cache = jax.eval_shape(lambda: model.new_cache(1, 64))
+        tok = jax.ShapeDtypeStruct((1,), jnp.int32)
+        params = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+            model.params,
+        )
+        exp = export.export(step, platforms=["tpu"])(params, tok, cache)
+        assert len(exp.mlir_module_serialized) > 0
+
+
+class TestBaselineShapesLower:
+    """The survey north-star shapes (M=8192, K=4096, N=12288, tp=8,
+    bf16 — VERDICT r1 #3/#5) must lower for TPU: tiled staging keeps
+    VMEM bounded no matter how big m_per × K grows."""
+
+    def test_ag_gemm_baseline_shape(self, tpu_ctx):
+        from triton_distributed_tpu.ops.overlap import ag_gemm
+        from triton_distributed_tpu.ops.overlap.ag_gemm import (
+            create_ag_gemm_context,
+        )
+
+        M, K, N = 8192, 4096, 12288
+        cfg = create_ag_gemm_context(M // 8, N // 8, K, jnp.bfloat16)
+        assert cfg.tile_m < M // 8  # staging must actually be chunked
+        f = tpu_ctx.shard_map(
+            functools.partial(ag_gemm, axis="tp", config=cfg, ctx=tpu_ctx),
+            in_specs=(P("tp", None), P(None, "tp")),
+            out_specs=P(None, "tp"),
+        )
+        _lower(
+            tpu_ctx, f,
+            _sds(tpu_ctx, (M, K), ("tp", None), jnp.bfloat16),
+            _sds(tpu_ctx, (K, N), (None, "tp"), jnp.bfloat16),
+        )
+
+    def test_gemm_rs_baseline_shape(self, tpu_ctx):
+        from triton_distributed_tpu.ops.overlap import gemm_rs
+        from triton_distributed_tpu.ops.overlap.gemm_rs import (
+            create_gemm_rs_context,
+        )
+
+        M, K, N = 8192, 12288, 4096  # down-proj: k_loc = K/8
+        cfg = create_gemm_rs_context(M, N, K // 8, jnp.bfloat16, n_ranks=8)
+        f = tpu_ctx.shard_map(
+            functools.partial(gemm_rs, axis="tp", config=cfg, ctx=tpu_ctx),
+            in_specs=(P(None, "tp"), P("tp", None)),
+            out_specs=P("tp", None),
+        )
+        _lower(
+            tpu_ctx, f,
+            _sds(tpu_ctx, (M, K), (None, "tp"), jnp.bfloat16),
+            _sds(tpu_ctx, (K, N), ("tp", None), jnp.bfloat16),
+        )
+
+
+class TestLowLatencyLower:
+    def test_ll_all_gather_barrier_free(self, tpu_ctx):
+        """The TPU (barrier-free, ack-semaphore) variant must lower."""
+        from triton_distributed_tpu.ops import (
+            ll_all_gather, ll_all_gather_workspace,
+        )
+
+        def body(x, ws, phase):
+            return ll_all_gather(
+                x, ws, phase, axis="tp", ctx=tpu_ctx, barrier_free=True
+            )
+
+        f = tpu_ctx.shard_map(
+            body,
+            in_specs=(P("tp", None), P(), P()),
+            out_specs=(P(None, None), P()),
+        )
+        ws = jax.eval_shape(
+            lambda: ll_all_gather_workspace(8, 16, 128, jnp.float32)
+        )
+        ws = jax.ShapeDtypeStruct(ws.shape, ws.dtype, sharding=tpu_ctx.sharding())
+        _lower(
+            tpu_ctx, f,
+            _sds(tpu_ctx, (8 * 16, 128), ("tp", None)),
+            ws,
+            jax.ShapeDtypeStruct((), jnp.int32, sharding=tpu_ctx.sharding()),
+        )

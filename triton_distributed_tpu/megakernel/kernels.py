@@ -75,12 +75,16 @@ def _stream_cols(kctx, x_f32, w_hbm, n: int, tn: int, consume, col0: int = 0):
     jax.lax.fori_loop(0, n, body, 0, unroll=False)
 
 
-def _stream_rows(kctx, x_f32, w_hbm, out_ref, n: int, tk: int):
+def _stream_rows(kctx, x_ref, w_hbm, out_ref, n: int, tk: int):
     """Row-streamed GEMM with accumulation: ``out += x [B, K] @ w [K, d]``
-    streaming K tiles (o-proj / fc2 shape class). Overwrites ``out_ref``."""
+    streaming K tiles (o-proj / fc2 shape class). Overwrites ``out_ref``.
+
+    ``x_ref`` must be a (VMEM) ref: the K tile is sliced per step with a
+    dynamic ``pl.ds`` on the ref — Mosaic has no lowering for
+    ``dynamic_slice`` on register values, only for ref loads.
+    """
     stage, sem = kctx.rowstage, kctx.wsem
     d = out_ref.shape[-1]
-    xa = x_f32.astype(kctx.wdtype)
 
     def copy(j, slot):
         return pltpu.make_async_copy(
@@ -101,7 +105,7 @@ def _stream_rows(kctx, x_f32, w_hbm, out_ref, n: int, tk: int):
 
         copy(j, slot).wait()
         val = jnp.dot(
-            jax.lax.dynamic_slice_in_dim(xa, j * tk, tk, 1),
+            x_ref[:, pl.ds(j * tk, tk)].astype(kctx.wdtype),
             stage[slot, :tk, :d],
             preferred_element_type=jnp.float32,
         )
@@ -196,8 +200,12 @@ def attn_body(kctx):
         knew = headnorm(knew, kctx.kn[layer])
 
         # iota (not arange): concrete arrays would be captured consts,
-        # which pallas_call rejects.
-        i2 = jax.lax.broadcasted_iota(jnp.float32, (1, hd // 2), 1) * 2.0
+        # which pallas_call rejects. Integer iota only — Mosaic's
+        # tpu.iota verifier rejects float result types.
+        i2 = (
+            jax.lax.broadcasted_iota(jnp.int32, (1, hd // 2), 1)
+            .astype(jnp.float32) * 2.0
+        )
         inv = 1.0 / (theta ** (i2 / hd))  # [1, hd/2]
 
         def rope(t, p):  # t [h, hd], p scalar
@@ -325,7 +333,7 @@ def o_proj_body(kctx):
         tk = kctx.cfg.tk_o
         n = (dims.hq_loc * dims.head_dim) // tk
         _stream_rows(
-            kctx, kctx.ao[...], kctx.wo.at[kctx.layer], kctx.h, n, tk
+            kctx, kctx.ao, kctx.wo.at[kctx.layer], kctx.h, n, tk
         )
 
     return body
@@ -366,7 +374,7 @@ def fc2_body(kctx):
         tk = kctx.cfg.tk_fc2
         n = dims.f_loc // tk
         _stream_rows(
-            kctx, kctx.mlp[...], kctx.w2.at[kctx.layer], kctx.h, n, tk
+            kctx, kctx.mlp, kctx.w2.at[kctx.layer], kctx.h, n, tk
         )
 
     return body

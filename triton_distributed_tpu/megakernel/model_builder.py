@@ -122,6 +122,13 @@ class ModelBuilder:
     def build_decoder_graph(self) -> None:
         """The standard dense decode-step chain (parity:
         ``models/qwen3.py:108`` build_fwd)."""
+        if self.dims.n_ranks > 1:
+            # Entry barrier: the first ALLREDUCE issues remote puts into
+            # peers' VMEM scratch; without this, launch skew could land a
+            # put before the peer has entered the kernel (scratch/semaphores
+            # still owned by the previous program). Trailing barriers cover
+            # all subsequent allreduces within the launch.
+            self.make_barrier()
         self.make_embed()
         for l in range(self.dims.num_layers):
             self.make_norm(l, 0)

@@ -57,11 +57,18 @@ class Engine:
         gen_len: int,
         max_length: int | None = None,
         profile: str | None = None,
+        prompt_start: list | np.ndarray | None = None,
     ) -> np.ndarray:
         """Generate ``gen_len`` tokens for each sequence; returns
         ``[B, S + gen_len]`` (parity: ``Engine.serve``). ``profile``
         names a trace directory for the decode loop (parity: the
         reference Engine's 64-step decode profile, ``engine.py:151-177``).
+
+        ``prompt_start[i]`` marks where row i's real prompt begins
+        (everything before it is client left-padding, e.g. for tp
+        divisibility). Rows are rolled so pads sit on the RIGHT, where
+        causal masking makes them inert, and the real length rides to
+        ``prefill(true_len=...)`` — pad tokens never influence output.
         """
         input_ids = np.asarray(input_ids, np.int32)
         b, s = input_ids.shape
@@ -69,7 +76,15 @@ class Engine:
         if s % n:
             raise ValueError(
                 f"prompt length {s} must be divisible by tp={n} "
-                f"(pad with BOS upstream)"
+                f"(pad upstream and pass prompt_start)"
+            )
+        starts = np.zeros(b, np.int64) if prompt_start is None else (
+            np.asarray(prompt_start, np.int64)
+        )
+        if starts.shape != (b,) or (starts < 0).any() or (starts >= s).any():
+            raise ValueError(
+                f"prompt_start must be [batch={b}] ints in [0, {s}); got "
+                f"{starts.tolist()}"
             )
         max_length = max_length or self.model.cfg.max_length
         cache = self.model.new_cache(b, max_length)
@@ -79,8 +94,10 @@ class Engine:
         t0 = time.perf_counter()
         last_logits = []
         for i in range(b):
+            row = np.roll(input_ids[i], -int(starts[i]))  # pads → right
             logits_i, cache_i = self.model.prefill(
-                jnp.asarray(input_ids[i]), _take_batch(cache, i), self.mode
+                jnp.asarray(row), _take_batch(cache, i), self.mode,
+                true_len=int(s - starts[i]),
             )
             cache = _put_batch(cache, cache_i, i)
             last_logits.append(logits_i)

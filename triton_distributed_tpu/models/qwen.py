@@ -204,16 +204,21 @@ class Qwen3:
         logits = self._logits(params, x)
         return logits, KVCache(k=k_new, v=v_new, kv_len=cache.kv_len + 1)
 
-    def _prefill_shard(self, params, tokens, cache: KVCache, *, mode: Mode):
+    def _prefill_shard(
+        self, params, tokens, cache: KVCache, true_len, *, mode: Mode
+    ):
         """Prefill one sequence (batch entry 0), per-shard.
 
         ``tokens [s_loc]`` is this device's sequence slice; activations
         stay sequence-sharded through all layers (ag_gemm gathers rows on
-        the fly — reference ``dist_triton_fwd`` layout). Returns last-token
+        the fly — reference ``dist_triton_fwd`` layout). ``true_len``
+        (scalar) is the real prompt length: positions past it are
+        right-padding, inert under causal masking; logits are taken at
+        position ``true_len - 1`` and ``kv_len`` set to ``true_len`` so
+        decode overwrites the pad KV slots. Returns last-real-token
         logits [V] and the filled cache.
         """
         cfg = self.cfg
-        n = self.ctx.axis_size(self.axis)
         me = jax.lax.axis_index(self.axis)
         x = self._embed(params, tokens)  # [s_loc, d]
 
@@ -242,12 +247,15 @@ class Qwen3:
             layer_fn, x, (params.layers, cache.k, cache.v)
         )
         x = rms_norm(x, params.norm, cfg.rms_eps)
-        # Last token lives on the last rank's shard; broadcast it.
-        last = jnp.where(me == n - 1, 1.0, 0.0).astype(jnp.float32)
-        x_last = jax.lax.psum(x[-1].astype(jnp.float32) * last, self.axis)
+        # The last real token lives at global position true_len - 1 on
+        # shard (idx // s_loc); select its row and broadcast via psum.
+        s_loc = tokens.shape[0]
+        idx = true_len - 1
+        own = jnp.where(me == idx // s_loc, 1.0, 0.0).astype(jnp.float32)
+        row = jnp.take(x, idx % s_loc, axis=0)
+        x_last = jax.lax.psum(row.astype(jnp.float32) * own, self.axis)
         logits = self._logits(params, x_last[None].astype(x.dtype))[0]
-        s = tokens.shape[0] * n
-        kv_len = cache.kv_len.at[0].set(s)
+        kv_len = cache.kv_len.at[0].set(true_len)
         return logits, KVCache(k=k_new, v=v_new, kv_len=kv_len)
 
     # -- jitted SPMD entry points ----------------------------------------
@@ -271,22 +279,36 @@ class Qwen3:
             )
         return self._decode_jit[mode](self.params, tokens, cache)
 
-    def prefill(self, tokens: jax.Array, cache: KVCache, mode: Mode = "xla"):
-        """Prefill one sequence (``tokens [S]``, S divisible by tp).
-        Returns (last-token logits [V], cache with entry 0 filled)."""
+    def prefill(
+        self,
+        tokens: jax.Array,
+        cache: KVCache,
+        mode: Mode = "xla",
+        true_len: jax.Array | int | None = None,
+    ):
+        """Prefill one sequence (``tokens [S]``, S divisible by tp;
+        right-pad to reach divisibility and pass the real length as
+        ``true_len`` — trailing pads are inert under causal masking).
+        Returns (last-real-token logits [V], cache with entry 0 filled)."""
         key = (mode, int(tokens.shape[0]))
+        if true_len is None:
+            true_len = tokens.shape[0]
         if key not in self._prefill_jit:
             f = self.ctx.shard_map(
                 functools.partial(self._prefill_shard, mode=mode),
-                in_specs=(self.param_specs, P(self.axis), cache_specs(self.axis)),
+                in_specs=(
+                    self.param_specs, P(self.axis), cache_specs(self.axis), P(),
+                ),
                 out_specs=(P(), cache_specs(self.axis)),
             )
             # No cache donation here: callers pass batch-1 cache slices
             # (engine prefill loop) that can alias the full cache when
             # B == 1 — donating would delete the caller's buffer. The
             # per-token donation win lives in decode_step.
-            self._prefill_jit[key] = jax.jit(lambda p, t, c: f(p, t, c))
-        return self._prefill_jit[key](self.params, tokens, cache)
+            self._prefill_jit[key] = jax.jit(lambda p, t, c, tl: f(p, t, c, tl))
+        return self._prefill_jit[key](
+            self.params, tokens, cache, jnp.asarray(true_len, jnp.int32)
+        )
 
     def new_cache(self, batch_size: int, max_length: int | None = None) -> KVCache:
         return init_cache(

@@ -40,6 +40,11 @@ from triton_distributed_tpu.ops.collectives.hierarchical import (  # noqa: F401
     all_reduce_2level_op,
     reduce_scatter_2d,
 )
+from triton_distributed_tpu.ops.collectives.low_latency import (  # noqa: F401
+    ll_all_gather,
+    ll_all_gather_op,
+    ll_all_gather_workspace,
+)
 from triton_distributed_tpu.ops.overlap.ag_gemm import (  # noqa: F401
     AGGemmConfig,
     ag_gemm,

@@ -25,7 +25,6 @@ from jax.sharding import PartitionSpec as P
 
 from triton_distributed_tpu import language as dl
 from triton_distributed_tpu.ops.common import (
-    VMEM_COMM_MAX_BYTES,
     comm_pallas_call,
     next_collective_id,
     _on_tpu,
@@ -46,23 +45,32 @@ class AllGatherMethod(enum.Enum):
 _AG_COLLECTIVE_ID = next_collective_id()
 
 
-def _ring_kernel(x_ref, o_ref, send_sems, recv_sems, *, axis: str):
+def _ring_kernel(x_ref, o_ref, copy_sem, send_sems, recv_sems, *, axis: str):
     """Unidirectional ring: at step s forward the chunk received at step
     s-1 to the right neighbor; chunks land at their global row offset.
 
     Equivalent role: ``cp_engine_producer_all_gather_ring_push_1d``
     (reference ``allgather.py:140``), with the copy engine replaced by the
     ICI DMA engine and the tile barrier by per-step recv semaphores.
+
+    All refs live in ANY/HBM and every byte moves by DMA — the kernel is
+    pure orchestration, so payload size is bounded by HBM, not VMEM.
     """
     me = dl.rank(axis)
     n = dl.num_ranks(axis)
     m_per = x_ref.shape[0]
     right = jax.lax.rem(me + 1, n)
 
+    # Own shard lands at its global offset (local HBM→HBM DMA), started
+    # under the barrier.
+    cp = pltpu.make_async_copy(
+        x_ref, o_ref.at[pl.ds(me * m_per, m_per)], copy_sem
+    )
+    cp.start()
     # Entry barrier: peers must have entered (their o_ref allocated and
     # no longer owned by preceding XLA ops) before any remote write.
     dl.barrier_all(axis)
-    o_ref[pl.ds(me * m_per, m_per)] = x_ref[:]
+    cp.wait()
 
     dmas = []
     for s in range(n - 1):
@@ -81,14 +89,16 @@ def _ring_kernel(x_ref, o_ref, send_sems, recv_sems, *, axis: str):
     dl.quiet(*dmas)
 
 
-def _bidir_ring_kernel(x_ref, o_ref, send_sems, recv_sems, *, axis: str):
+def _bidir_ring_kernel(
+    x_ref, o_ref, copy_sem, send_sems, recv_sems, *, axis: str
+):
     """Bidirectional ring: each shard's top half travels clockwise and
     bottom half counter-clockwise, using both directions of the torus
     axis — 2x effective ICI bandwidth, (n-1) steps of half-chunks.
 
     Equivalent role: the reference's NUMA-aware 2D rings
     (``allgather.py:196``) — different topology, same idea: use every
-    link concurrently.
+    link concurrently. ANY/HBM refs, DMA-only (no VMEM ceiling).
     """
     me = dl.rank(axis)
     n = dl.num_ranks(axis)
@@ -97,8 +107,12 @@ def _bidir_ring_kernel(x_ref, o_ref, send_sems, recv_sems, *, axis: str):
     right = jax.lax.rem(me + 1, n)
     left = jax.lax.rem(me - 1 + n, n)
 
+    cp = pltpu.make_async_copy(
+        x_ref, o_ref.at[pl.ds(me * m_per, m_per)], copy_sem
+    )
+    cp.start()
     dl.barrier_all(axis)
-    o_ref[pl.ds(me * m_per, m_per)] = x_ref[:]
+    cp.wait()
 
     dmas = []
     for s in range(n - 1):
@@ -128,7 +142,9 @@ def _bidir_ring_kernel(x_ref, o_ref, send_sems, recv_sems, *, axis: str):
     dl.quiet(*dmas)
 
 
-def _full_mesh_kernel(x_ref, o_ref, send_sems, recv_sems, *, axis: str):
+def _full_mesh_kernel(
+    x_ref, o_ref, copy_sem, send_sems, recv_sems, *, axis: str
+):
     """Every device pushes its shard directly to every peer (1 hop).
 
     Equivalent role: ``cp_engine_producer_all_gather_full_mesh_push``
@@ -136,15 +152,17 @@ def _full_mesh_kernel(x_ref, o_ref, send_sems, recv_sems, *, axis: str):
     latency dominates; the fabric routes concurrent DMAs.
 
     All arrivals share one recv semaphore: shards are equal-sized, so
-    waiting (n-1) shard-sizes is order-independent.
+    waiting (n-1) shard-sizes is order-independent. ANY/HBM, DMA-only.
     """
     me = dl.rank(axis)
     n = dl.num_ranks(axis)
     m_per = x_ref.shape[0]
     own = pl.ds(me * m_per, m_per)
 
+    cp = pltpu.make_async_copy(x_ref, o_ref.at[own], copy_sem)
+    cp.start()
     dl.barrier_all(axis)
-    o_ref[own] = x_ref[:]
+    cp.wait()
 
     dmas = []
     for i in range(1, n):
@@ -178,11 +196,9 @@ def all_gather(
             # also take the XLA path the Pallas kernels don't cover.
             method = AllGatherMethod.XLA
         else:
+            # DMA-only kernels: no VMEM ceiling (payload stays in HBM).
             nbytes = x.size * x.dtype.itemsize
-            if n * nbytes > VMEM_COMM_MAX_BYTES:
-                # Gathered result must fit VMEM; larger goes through XLA.
-                method = AllGatherMethod.XLA
-            elif n <= 2 or nbytes <= 64 * 1024:
+            if n <= 2 or nbytes <= 64 * 1024:
                 method = AllGatherMethod.PALLAS_FULL_MESH
             else:
                 method = AllGatherMethod.PALLAS_BIDIR_RING
@@ -200,13 +216,22 @@ def all_gather(
 
     if method == AllGatherMethod.PALLAS_RING:
         kernel = functools.partial(_ring_kernel, axis=axis)
-        scratch = [pltpu.SemaphoreType.DMA((max(n - 1, 1),))] * 2
+        scratch = [
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+        ]
     elif method == AllGatherMethod.PALLAS_BIDIR_RING:
         kernel = functools.partial(_bidir_ring_kernel, axis=axis)
-        scratch = [pltpu.SemaphoreType.DMA((2, max(n - 1, 1)))] * 2
+        scratch = [
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((2, max(n - 1, 1))),
+            pltpu.SemaphoreType.DMA((2, max(n - 1, 1))),
+        ]
     elif method == AllGatherMethod.PALLAS_FULL_MESH:
         kernel = functools.partial(_full_mesh_kernel, axis=axis)
         scratch = [
+            pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
             pltpu.SemaphoreType.DMA(()),
         ]
@@ -216,8 +241,8 @@ def all_gather(
     return comm_pallas_call(
         kernel,
         out_shape,
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
         scratch_shapes=scratch,
         collective_id=_AG_COLLECTIVE_ID,
         ctx=ctx,

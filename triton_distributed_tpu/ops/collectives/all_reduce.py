@@ -60,10 +60,9 @@ _ONE_SHOT_MAX_BYTES = 256 * 1024
 def get_auto_allreduce_method(nbytes: int, n: int) -> AllReduceMethod:
     if nbytes <= _ONE_SHOT_MAX_BYTES:
         return AllReduceMethod.ONE_SHOT
-    if nbytes <= VMEM_COMM_MAX_BYTES:
-        return AllReduceMethod.TWO_SHOT
-    # Payload exceeds what the VMEM-resident kernels can hold.
-    return AllReduceMethod.XLA
+    # TWO_SHOT composes ring RS + ring AG; above the VMEM ceiling the RS
+    # leg switches to its HBM-slot variant, so no payload cap remains.
+    return AllReduceMethod.TWO_SHOT
 
 
 def _one_shot_kernel(
@@ -157,7 +156,12 @@ def all_reduce(
             if nbytes <= _ONE_SHOT_MAX_BYTES:
                 return all_reduce(x, axis, AllReduceMethod.ONE_SHOT, ctx)
             return jax.lax.psum(x, axis)
-        reduced = reduce_scatter(x, axis, ReduceScatterMethod.PALLAS_RING, ctx)
+        rs_method = (
+            ReduceScatterMethod.PALLAS_RING
+            if nbytes <= VMEM_COMM_MAX_BYTES
+            else ReduceScatterMethod.PALLAS_RING_HBM  # no VMEM ceiling
+        )
+        reduced = reduce_scatter(x, axis, rs_method, ctx)
         return all_gather(reduced, axis, AllGatherMethod.PALLAS_BIDIR_RING, ctx)
 
     raise ValueError(f"unknown method {method}")

@@ -28,11 +28,27 @@ def next_collective_id() -> int:
     return next(_collective_ids)
 
 
-# VMEM-resident comm kernels (payload + peer slots all on-chip) are only
-# selected by AUTO below this per-device payload size; larger payloads
-# fall back to the XLA collective, which tiles through HBM. (Future:
-# HBM-chunked ring kernels lift this ceiling.)
+# Crossover between VMEM-resident comm kernels (payload + peer slots all
+# on-chip — lowest latency) and the HBM-chunked / DMA-only variants that
+# have no payload ceiling (all_gather ANY-kernels, reduce_scatter
+# PALLAS_RING_HBM, tiled overlap staging). AUTO dispatch switches
+# variant here, never to XLA on size grounds.
 VMEM_COMM_MAX_BYTES = 4 * 1024 * 1024
+
+
+def pick_stage_tile(
+    m: int, row_bytes: int, budget: int, floor: int = 128
+) -> int:
+    """Largest divisor tile of ``m`` (by halving) whose staging buffer
+    ``tile * row_bytes`` fits ``budget``; never below ``floor`` unless
+    divisibility demands it. Shared by the HBM-chunked kernels
+    (ag_gemm / gemm_rs staging, reduce_scatter tiled adds)."""
+    tile = m
+    while tile > floor and tile * row_bytes > budget:
+        tile //= 2
+    while m % tile:
+        tile //= 2
+    return max(tile, 1)
 
 
 def pick_tile(n: int, preferred: int = 512) -> int:
@@ -92,6 +108,7 @@ def comm_pallas_call(
     vmem_limit_bytes: int | None = None,
     cost_estimate: pl.CostEstimate | None = None,
     dimension_semantics: Sequence[str] | None = None,
+    input_output_aliases: dict[int, int] | None = None,
 ):
     """Build a pallas_call configured for communication kernels.
 
@@ -114,6 +131,8 @@ def comm_pallas_call(
         kwargs["grid"] = grid
     if cost_estimate is not None:
         kwargs["cost_estimate"] = cost_estimate
+    if input_output_aliases is not None:
+        kwargs["input_output_aliases"] = input_output_aliases
     return pl.pallas_call(
         kernel,
         out_shape=out_shape,

@@ -5,17 +5,26 @@ Parity: reference ``kernels/nvidia/ep_a2a.py`` —
 ``kernel_combine_token``:152 (return + weighted reduce),
 ``kernel_get_ag_splits_and_recv_offset``:244 (splits exchange) — and the
 low-latency variant ``low_latency_all_to_all.py`` (putmem_signal +
-double buffering, README.md:101-187).
+fp8+scale payloads, README.md:101-187).
 
 TPU design (SURVEY.md §7 hard part "dynamic shapes"): XLA wants static
-shapes, so the variable per-rank splits become a fixed per-destination
-``capacity`` with drop-on-overflow (the reference also pads its grouped
-GEMM batches). Dispatch builds ``[n_ranks, capacity]`` send buffers with
-a cumulative-occurrence slot assignment (the ``bincount``+offset logic of
-the CUDA align kernel), exchanges them with one all-to-all (XLA or the
-device-initiated Pallas ring), runs the local expert FFN expert-sorted,
-and combine reverses the same slots — no splits exchange needed because
-slots, not offsets, carry identity.
+shapes, so receive buffers are max-padded — but like the reference the
+protocol is LOSSLESS: real splits are exchanged (the
+``kernel_get_ag_splits_and_recv_offset`` analog) and the static
+per-source segment is sized at the provable worst case ``t*k`` (one
+source rank can never send more than its own assignment count), so no
+token is ever dropped. EP a2a is a decode-scale op (the reference's
+headline is 128 tokens/rank), so worst-case padding costs MBs, not GBs.
+
+A bounded-memory ``capacity`` mode remains for experimentation: it
+KEEPS the overflow count (``DispatchState.num_dropped``) so exceeding
+capacity is a *detected error* the caller can assert on, never silent
+corruption.
+
+Low-latency payload mode (``payload_dtype="fp8"``): tokens are
+quantized to float8_e4m3 with per-row scales before the exchange and
+dequantized after — half the ICI bytes, the reference's
+``low_latency_all_to_all`` fp8+scales codec (:36-125) in XLA form.
 """
 
 from __future__ import annotations
@@ -35,29 +44,45 @@ class DispatchState(NamedTuple):
 
     dest: jax.Array      # [T*k] destination rank per assignment
     slot: jax.Array      # [T*k] slot in the dest buffer
-    valid: jax.Array     # [T*k] bool — False when dropped (over capacity)
+    valid: jax.Array     # [T*k] bool — False only in capacity mode
     weights: jax.Array   # [T*k] f32 gate weights
     token_ids: jax.Array  # [T*k] source token index
+    num_dropped: jax.Array  # [] int32 — 0 in lossless mode, by construction
+
+
+def _fp8_encode(x: jax.Array):
+    """Per-row fp8 quantization (reference LL codec: fp8 + scales)."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax, 1e-12) / 448.0  # e4m3 max normal
+    q = (x.astype(jnp.float32) / scale).astype(jnp.float8_e4m3fn)
+    return q, scale.astype(jnp.float32)
 
 
 def ep_dispatch(
     x: jax.Array,        # [T, d] — this rank's tokens
     route: RouterOut,
     num_experts: int,
-    capacity: int,
+    capacity: int | None = None,
     axis: str = "ep",
     method: str = "auto",
     ctx=None,
+    payload_dtype: str | None = None,
 ):
     """Send each (token, expert) assignment to the expert's owner rank.
 
+    ``capacity=None`` (default) is the lossless path: per-source segments
+    are ``t*k`` wide and real splits ride along, so nothing can drop.
     Returns ``(recv_x [n*C, d], recv_expert [n*C] local expert ids,
-    recv_valid [n*C], state)`` — parity: ``kernel_dispatch_token``.
+    recv_valid [n*C], state)`` — parity: ``kernel_dispatch_token`` +
+    ``kernel_get_ag_splits_and_recv_offset``.
     """
     n = jax.lax.axis_size(axis)
     t, d = x.shape
     k = route.expert_ids.shape[1]
     epr = num_experts // n  # experts per rank
+    lossless = capacity is None
+    if lossless:
+        capacity = t * k  # provable per-source worst case
 
     flat_e = route.expert_ids.reshape(-1)      # [T*k]
     dest = (flat_e // epr).astype(jnp.int32)
@@ -69,27 +94,49 @@ def ep_dispatch(
     occ = jnp.cumsum(onehot, axis=0) - onehot          # exclusive
     slot = jnp.take_along_axis(occ, dest[:, None], axis=1)[:, 0]
     valid = slot < capacity
+    splits = jnp.sum(onehot, axis=0).astype(jnp.int32)  # [n] true counts
+    num_dropped = jnp.sum(
+        jnp.maximum(splits - capacity, 0), dtype=jnp.int32
+    )
 
-    # Scatter into per-destination buffers; out-of-capacity rows drop.
+    # Scatter into per-destination buffers. In lossless mode "drop" can
+    # never trigger (slot < t*k = capacity by construction).
     send_x = jnp.zeros((n, capacity, d), x.dtype)
     send_x = send_x.at[dest, slot].set(
         x[token_ids], mode="drop", unique_indices=True
     )
     local_e = (flat_e % epr).astype(jnp.int32)
-    # Invalid slots carry expert 0 with zero payload (harmless rows).
     send_e = jnp.zeros((n, capacity), jnp.int32)
     send_e = send_e.at[dest, slot].set(local_e, mode="drop", unique_indices=True)
-    send_v = jnp.zeros((n, capacity), jnp.int32)
-    send_v = send_v.at[dest, slot].set(1, mode="drop", unique_indices=True)
 
-    recv_x = all_to_all(send_x, axis=axis, method=method, ctx=ctx)
-    meta = jnp.concatenate(
-        [send_e.astype(jnp.int32)[..., None], send_v[..., None]], axis=-1
+    # Splits exchange (tiny [n] payload, XLA path): receiver learns each
+    # source segment's true fill. Replaces per-slot valid bytes.
+    recv_counts = all_to_all(
+        jnp.minimum(splits, capacity)[:, None, None],
+        axis=axis, method="xla", ctx=ctx,
+    )[:, 0, 0]  # [n]
+
+    if payload_dtype == "fp8":
+        q, scale = _fp8_encode(send_x.reshape(n * capacity, d))
+        recv_q = all_to_all(
+            q.reshape(n, capacity, d), axis=axis, method="xla", ctx=ctx
+        )
+        recv_scale = all_to_all(
+            scale.reshape(n, capacity, 1), axis=axis, method="xla", ctx=ctx
+        )
+        recv_x = (recv_q.astype(jnp.float32) * recv_scale).astype(x.dtype)
+    else:
+        recv_x = all_to_all(send_x, axis=axis, method=method, ctx=ctx)
+    recv_e = all_to_all(
+        send_e[..., None], axis=axis, method="xla", ctx=ctx
+    )[..., 0].reshape(n * capacity)
+    recv_v = (
+        jax.lax.broadcasted_iota(jnp.int32, (n, capacity), 1)
+        < recv_counts[:, None]
+    ).reshape(n * capacity)
+    state = DispatchState(
+        dest, slot, valid, route.weights.reshape(-1), token_ids, num_dropped
     )
-    recv_meta = all_to_all(meta, axis=axis, method=method, ctx=ctx)
-    recv_e = recv_meta[..., 0].reshape(n * capacity)
-    recv_v = recv_meta[..., 1].reshape(n * capacity).astype(bool)
-    state = DispatchState(dest, slot, valid, route.weights.reshape(-1), token_ids)
     return recv_x.reshape(n * capacity, d), recv_e, recv_v, state
 
 
@@ -123,30 +170,43 @@ def ep_moe_ffn(
     w2: jax.Array,        # [E_loc, f, d]
     k: int,
     *,
-    capacity_factor: float = 1.3,
+    capacity_factor: float | None = None,
     axis: str = "ep",
     method: str = "auto",
     norm_topk_prob: bool = True,
+    payload_dtype: str | None = None,
     ctx=None,
 ) -> jax.Array:
     """Full EP MoE FFN inside ``shard_map`` (parity:
-    ``EPAll2AllLayer.forward`` — ``ep_a2a_layer.py:195/240``)."""
+    ``EPAll2AllLayer.forward`` — ``ep_a2a_layer.py:195/240``).
+
+    ``capacity_factor=None`` (default): lossless splits-exchange path.
+    A float bounds memory instead; overflow then surfaces in
+    ``DispatchState.num_dropped`` (detected, never silent) — see module
+    docstring.
+    """
     from triton_distributed_tpu.ops.moe.routing import router_topk
 
     n = jax.lax.axis_size(axis)
     t, d = x.shape
     num_experts = w1.shape[0] * n
     epr = w1.shape[0]
-    # Expected load per destination is t*k/n; round capacity to a
-    # lane-friendly multiple of 8.
-    capacity = int(-(-(t * k * capacity_factor / n) // 8) * 8)
+    if capacity_factor is None:
+        capacity = None
+    else:
+        # Expected load per destination is t*k/n; round capacity to a
+        # lane-friendly multiple of 8.
+        capacity = int(-(-(t * k * capacity_factor / n) // 8) * 8)
 
     route = router_topk(x, w_router, k, norm_topk_prob=norm_topk_prob)
     recv_x, recv_e, recv_v, state = ep_dispatch(
-        x, route, num_experts, capacity, axis, method, ctx
+        x, route, num_experts, capacity, axis, method, ctx,
+        payload_dtype=payload_dtype,
     )
-    # Expert-sort received rows (invalid rows ride along in expert 0 with
-    # zero payload — they contribute nothing and cost one extra group row).
+    # Mask invalid (padding) rows to expert 0 with zero payload so they
+    # contribute nothing and cost one extra group row.
+    recv_e = jnp.where(recv_v, recv_e, 0)
+    recv_x = jnp.where(recv_v[:, None], recv_x, 0)
     order = jnp.argsort(recv_e, stable=True)
     inv = jnp.argsort(order)
     sorted_x = recv_x[order]

@@ -67,7 +67,12 @@ def moe_ffn_ring(
         return (tok, acc), None
 
     init = (x, jnp.zeros((t, d), jnp.float32))
-    (tok_back, acc), _ = jax.lax.scan(step, init, None, length=n)
-    # After n hops the pair that started here is home again, carrying
-    # every rank's contribution to OUR tokens.
+    # n-1 full hops (tok + acc travel together), then a final local
+    # contribution with an acc-only hop home — the token chunk's last
+    # ppermute would be unused payload, so it is skipped.
+    (tok, acc), _ = jax.lax.scan(step, init, None, length=n - 1)
+    acc = acc + contribution(tok).astype(jnp.float32)
+    acc = jax.lax.ppermute(acc, axis, perm)
+    # After n hops the accumulator that started here is home again,
+    # carrying every rank's contribution to OUR tokens.
     return acc.astype(x.dtype)

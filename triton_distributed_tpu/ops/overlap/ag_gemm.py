@@ -62,10 +62,14 @@ class AGGemmConfig:
 
     The reference context also owns symmetric workspace tensors; here the
     workspace is kernel-scratch HBM, allocated by Mosaic per call site, so
-    the config is pure numbers.
+    the config is pure numbers. ``tile_m`` chunks the per-rank A shard's
+    HBM→VMEM staging (parity: the reference's persistent M tiling,
+    ``allgather_gemm.py:158``) so baseline shapes — m_per×K far beyond
+    VMEM — stream instead of resident-staging.
     """
 
     tile_n: int = 512
+    tile_m: int | None = None  # None → whole m_per (small shapes)
     acc_dtype: jnp.dtype = jnp.float32
     # Race-provocation fixtures (parity: ``for_correctness`` producer
     # sleeps, ``allgather_gemm.py:507-508``, and ``straggler_option``,
@@ -75,21 +79,35 @@ class AGGemmConfig:
     straggler_nanos: int = 500_000
 
 
+# Per-buffer VMEM staging budget for the A double buffer. Tiles are
+# shrunk until 2 * tile_m * K * itemsize fits.
+_AG_STAGE_BUDGET = 2 * 1024 * 1024
+
+
 def create_ag_gemm_context(
     m_per: int, n_loc: int, k: int, dtype=jnp.bfloat16, tile_n: int | None = None
 ) -> AGGemmConfig:
     """Pick tiles for the shapes (parity: ``create_ag_gemm_context``:489)."""
-    return AGGemmConfig(tile_n=pick_tile(n_loc) if tile_n is None else tile_n)
+    itemsize = jnp.dtype(dtype).itemsize
+    tile_m = m_per
+    while tile_m > 128 and tile_m * k * itemsize > _AG_STAGE_BUDGET:
+        tile_m //= 2
+    while m_per % tile_m:
+        tile_m //= 2
+    return AGGemmConfig(
+        tile_n=pick_tile(n_loc) if tile_n is None else tile_n,
+        tile_m=max(tile_m, 1),
+    )
 
 
 def _ag_gemm_kernel(
     a_ref,      # [m_per, K] ANY/HBM — this device's A shard
     b_ref,      # [K, tile_n] VMEM — B tile j (pipelined by BlockSpec)
-    c_ref,      # [1, m_per, tile_n] VMEM — output tile (s, j)
+    c_ref,      # [1, tile_m, tile_n] VMEM — output tile (s, i, j)
     ws,         # [n, m_per, K] ANY/HBM output — gathered A chunks
                 # (a workspace; Mosaic only allows VMEM/SMEM/semaphore
                 # scratch, so HBM workspaces are extra outputs)
-    a_vmem,     # [2, m_per, K] VMEM — double-buffered compute chunk
+    a_vmem,     # [2, tile_m, K] VMEM — double-buffered compute M-tile
     load_sems,  # DMA (2,) — HBM→VMEM stage
     send_sems,  # DMA (n-1,)
     recv_sems,  # DMA (n,) — slot r signaled when chunk r lands
@@ -103,13 +121,31 @@ def _ag_gemm_kernel(
     me = dl.rank(axis)
     n = dl.num_ranks(axis)
     s = pl.program_id(0)
-    j = pl.program_id(1)
-    num_j = pl.num_programs(1)
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    num_i = pl.num_programs(1)
+    num_j = pl.num_programs(2)
+    tile_m = a_vmem.shape[1]
 
-    @pl.when(jnp.logical_and(s == 0, j == 0))
+    def rows(ti):
+        return pl.ds(ti * tile_m, tile_m)
+
+    def buf(step, ti):
+        return jax.lax.rem(step * num_i + ti, 2)
+
+    def stage(step, ti, chunk=None):
+        """HBM→VMEM stage of chunk's M-tile ``ti`` (own shard at step 0)."""
+        b = buf(step, ti)
+        if chunk is None:  # step 0: own chunk, straight from a_ref
+            src = a_ref.at[rows(ti)]
+        else:
+            src = ws.at[chunk, rows(ti)]
+        return pltpu.make_async_copy(src, a_vmem.at[b], load_sems.at[b])
+
+    @pl.when(jnp.logical_and(s == 0, jnp.logical_and(i == 0, j == 0)))
     def _start():
-        # Stage own chunk for immediate compute (overlaps the barrier).
-        pltpu.make_async_copy(a_ref, a_vmem.at[0], load_sems.at[0]).start()
+        # Stage own first tile for immediate compute (overlaps barrier).
+        stage(0, 0).start()
         # Entry barrier: peers' ws outputs must be allocated before any
         # remote write lands.
         dl.barrier_all(axis)
@@ -119,43 +155,61 @@ def _ag_gemm_kernel(
         dl.straggle_if_rank(straggler_rank, axis, straggler_nanos)
         if for_correctness:
             dl.maybe_delay(200_000)
-        # Copy own chunk into the workspace and push it to every peer
+        # Push own chunk (whole shard, HBM→HBM over ICI) to every peer
         # (slot index = source rank, so consumers wait per-chunk).
-        for i in range(1, n):
-            peer = jax.lax.rem(me + i, n)
+        for p in range(1, n):
+            peer = jax.lax.rem(me + p, n)
             dl.put_signal(
                 a_ref, ws.at[me], peer,
-                send_sems.at[i - 1], recv_sems.at[me], axis=axis,
+                send_sems.at[p - 1], recv_sems.at[me], axis=axis,
             )
-        pltpu.make_async_copy(a_ref, a_vmem.at[0], load_sems.at[0]).wait()
+        stage(0, 0).wait()
 
-    @pl.when(jnp.logical_and(s > 0, j == 0))
+    @pl.when(jnp.logical_and(s + i > 0, j == 0))
     def _land_current():
-        # VMEM stage started at (s-1, num_j-1).
+        # VMEM stage for (s, i) was started at the previous tile's last j.
+        b = buf(s, i)
         pltpu.make_async_copy(
-            ws.at[0], a_vmem.at[s % 2], load_sems.at[s % 2]
+            a_vmem.at[b], a_vmem.at[b], load_sems.at[b]
         ).wait()
 
     c_ref[0] = jnp.dot(
-        a_vmem[s % 2], b_ref[:], preferred_element_type=acc_dtype
+        a_vmem[buf(s, i)], b_ref[:], preferred_element_type=acc_dtype
     ).astype(c_ref.dtype)
 
-    @pl.when(jnp.logical_and(s + 1 < n, j == num_j - 1))
-    def _prefetch_next():
-        # Arrival fence + VMEM stage for the next chunk, placed after this
-        # step's last tile is issued so the blocking wait sits at the end
-        # of the step's compute, not ahead of it (keeps the MXU busy while
-        # the ICI push is in flight).
+    @pl.when(jnp.logical_and(i + 1 < num_i, j == num_j - 1))
+    def _prefetch_same_chunk():
+        # Next M-tile of the current chunk — already resident in HBM.
+        @pl.when(s == 0)
+        def _():
+            stage(s, i + 1).start()
+
+        @pl.when(s > 0)
+        def _():
+            stage(s, i + 1, chunk=jax.lax.rem(me + s, n)).start()
+
+    @pl.when(
+        jnp.logical_and(
+            i == num_i - 1, jnp.logical_and(s + 1 < n, j == num_j - 1)
+        )
+    )
+    def _prefetch_next_chunk():
+        # Arrival fence + first-tile stage for the next chunk, placed
+        # after this step's last tile is issued so the blocking wait sits
+        # at the end of the step's compute, not ahead of it (keeps the
+        # MXU busy while the ICI push is in flight).
         nxt = jax.lax.rem(me + s + 1, n)
         dl.wait_recv(recv_sems.at[nxt], ws.at[nxt])
-        pltpu.make_async_copy(
-            ws.at[nxt], a_vmem.at[(s + 1) % 2], load_sems.at[(s + 1) % 2]
-        ).start()
+        stage(s + 1, 0, chunk=nxt).start()
 
-    @pl.when(jnp.logical_and(s == n - 1, j == num_j - 1))
+    @pl.when(
+        jnp.logical_and(
+            s == n - 1, jnp.logical_and(i == num_i - 1, j == num_j - 1)
+        )
+    )
     def _drain():
-        for i in range(1, n):
-            pltpu.make_async_copy(a_ref, a_ref, send_sems.at[i - 1]).wait()
+        for p in range(1, n):
+            pltpu.make_async_copy(a_ref, a_ref, send_sems.at[p - 1]).wait()
 
 
 def ag_gemm(
@@ -182,8 +236,12 @@ def ag_gemm(
     if n_loc % tile_n:
         raise ValueError(f"n_loc={n_loc} not divisible by tile_n={tile_n}")
     num_j = n_loc // tile_n
+    tile_m = min(config.tile_m or m_per, m_per)
+    if m_per % tile_m:
+        raise ValueError(f"m_per={m_per} not divisible by tile_m={tile_m}")
+    num_i = m_per // tile_m
 
-    grid = (n, num_j)
+    grid = (n, num_i, num_j)
     out, _ws = comm_pallas_call(
         functools.partial(
             _ag_gemm_kernel, axis=axis, acc_dtype=config.acc_dtype,
@@ -198,22 +256,26 @@ def ag_gemm(
         grid=grid,
         in_specs=[
             pl.BlockSpec(memory_space=pl.ANY),  # a: manual DMA
-            pl.BlockSpec((k, tile_n), lambda s, j: (0, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (k, tile_n), lambda s, i, j: (0, j), memory_space=pltpu.VMEM
+            ),
         ],
         out_specs=(
             pl.BlockSpec(
-                (1, m_per, tile_n), lambda s, j: (s, 0, j), memory_space=pltpu.VMEM
+                (1, tile_m, tile_n),
+                lambda s, i, j: (s, i, j),
+                memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(memory_space=pl.ANY),
         ),
         scratch_shapes=[
-            pltpu.VMEM((2, m_per, k), a.dtype),
+            pltpu.VMEM((2, tile_m, k), a.dtype),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
             pltpu.SemaphoreType.DMA((n,)),
         ],
         collective_id=_AG_GEMM_COLLECTIVE_ID,
-        dimension_semantics=("arbitrary", "arbitrary"),
+        dimension_semantics=("arbitrary", "arbitrary", "arbitrary"),
         ctx=ctx,
     )(a, b)
 

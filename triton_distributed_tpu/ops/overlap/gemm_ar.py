@@ -196,7 +196,10 @@ def gemm_ar(
 
     if method == GemmARMethod.TWO_SHOT:
         reduced = gemm_rs(
-            a, b, axis=axis, config=GemmRSConfig(config.tile_n, config.acc_dtype),
+            a, b, axis=axis,
+            config=GemmRSConfig(
+                tile_n=config.tile_n, acc_dtype=config.acc_dtype
+            ),
             ctx=ctx,
         )
         # AUTO applies the VMEM-size / on-TPU guards inside all_gather.

@@ -15,6 +15,11 @@ contribution, while the MXU stays busy producing the next chunk. The
 final step's chunk is the device's own output. Per-step receive slots in
 HBM make the protocol flow-control-free (slot s is written exactly once,
 by the left neighbor's step s-1).
+
+Scale: the accumulated partial lives in HBM (``accbuf``), streamed
+through VMEM in (tile_m × tile_n) tiles (parity: the reference's
+persistent M tiling, ``gemm_reduce_scatter.py:122``) — baseline shapes
+(m_per × N ≫ VMEM) never resident-stage.
 """
 
 from __future__ import annotations
@@ -44,13 +49,28 @@ class GemmRSConfig:
     """Parity: tile fields of ``GEMMReduceScatterTensorParallelContext``."""
 
     tile_n: int = 512
+    tile_m: int | None = None  # None → whole m_per (small shapes)
     acc_dtype: jnp.dtype = jnp.float32
 
 
+_RS_STAGE_BUDGET = 2 * 1024 * 1024
+
+
 def create_gemm_rs_context(
-    m: int, n_out: int, k_loc: int, dtype=jnp.bfloat16, tile_n: int | None = None
+    m: int, n_out: int, k_loc: int, dtype=jnp.bfloat16, tile_n: int | None = None,
+    n_ranks: int = 8,
 ) -> GemmRSConfig:
-    return GemmRSConfig(tile_n=pick_tile(n_out) if tile_n is None else tile_n)
+    itemsize = jnp.dtype(dtype).itemsize
+    m_per = max(m // max(n_ranks, 1), 1)
+    tile_m = m_per
+    while tile_m > 128 and tile_m * k_loc * itemsize > _RS_STAGE_BUDGET:
+        tile_m //= 2
+    while m_per % tile_m:
+        tile_m //= 2
+    return GemmRSConfig(
+        tile_n=pick_tile(n_out) if tile_n is None else tile_n,
+        tile_m=max(tile_m, 1),
+    )
 
 
 def _gemm_rs_kernel(
@@ -59,11 +79,13 @@ def _gemm_rs_kernel(
     o_ref,      # [m_per, N] ANY/HBM — final reduced chunk (written once)
     ws,         # [n-1, m_per, N] ANY/HBM output — per-step inbound slots
                 # (workspace-as-output; Mosaic forbids HBM scratch)
-    a_vmem,     # [2, m_per, k_loc] VMEM — A chunk double buffer
-    acc,        # [2, m_per, N] VMEM — outbound accumulated partial
-    inbound,    # [m_per, N] VMEM — staged inbound partial
+    accbuf,     # [2, m_per, N] ANY/HBM output — outbound partial (dbl buf)
+    a_vmem,     # [2, tile_m, k_loc] VMEM — A tile double buffer
+    inb_vmem,   # [2, tile_m, tile_n] VMEM — inbound partial tile
+    out_vmem,   # [2, tile_m, tile_n] VMEM — outbound tile (DMA'd to HBM)
     load_sems,  # DMA (2,)
-    stage_sem,  # DMA ()
+    inb_sems,   # DMA (2,)
+    out_sems,   # DMA (2,)
     send_sems,  # DMA (n-1,)
     recv_sems,  # DMA (n-1,)
     *,
@@ -73,91 +95,167 @@ def _gemm_rs_kernel(
     me = dl.rank(axis)
     n = dl.num_ranks(axis)
     s = pl.program_id(0)
-    j = pl.program_id(1)
-    num_j = pl.num_programs(1)
-    m_per = o_ref.shape[0]
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    num_i = pl.num_programs(1)
+    num_j = pl.num_programs(2)
+    tile_m = a_vmem.shape[1]
     tile_n = b_ref.shape[1]
     right = jax.lax.rem(me + 1, n)
+    t = i * num_j + j          # tile linear index within the step
+    num_t = num_i * num_j
+    p = jax.lax.rem(t, 2)      # inbound/outbound buffer parity
 
-    def chunk_rows(c):
-        return pl.ds(c * m_per, m_per)
+    def rows(ti):
+        return pl.ds(ti * tile_m, tile_m)
+
+    def cols(tj):
+        return pl.ds(tj * tile_n, tile_n)
 
     def a_chunk(step):
         return jax.lax.rem(me - 1 - step + 2 * n, n)
 
-    @pl.when(jnp.logical_and(s == 0, j == 0))
+    def a_buf(step, ti):
+        return jax.lax.rem(step * num_i + ti, 2)
+
+    def stage_a(step, ti):
+        b = a_buf(step, ti)
+        return pltpu.make_async_copy(
+            a_ref.at[pl.ds(a_chunk(step) * (num_i * tile_m) + ti * tile_m,
+                           tile_m)],
+            a_vmem.at[b],
+            load_sems.at[b],
+        )
+
+    def stage_inb(step, ti, tj, par):
+        return pltpu.make_async_copy(
+            ws.at[step - 1, rows(ti), cols(tj)],
+            inb_vmem.at[par],
+            inb_sems.at[par],
+        )
+
+    @pl.when(jnp.logical_and(s == 0, t == 0))
     def _start():
         # Entry barrier: the first remote put (end of step 0) targets the
         # right neighbor's ws output, which must already be allocated.
         dl.barrier_all(axis)
-        dma = pltpu.make_async_copy(
-            a_ref.at[chunk_rows(a_chunk(0))], a_vmem.at[0], load_sems.at[0]
-        )
+        dma = stage_a(0, 0)
         dma.start()
         dma.wait()
 
-    @pl.when(jnp.logical_and(s + 1 < n, j == 0))
-    def _prefetch_next_a():
+    @pl.when(jnp.logical_and(s > 0, t == 0))
+    def _step_begin():
+        # A tile 0 staged at the end of the previous step.
+        b = a_buf(s, 0)
         pltpu.make_async_copy(
-            a_ref.at[chunk_rows(a_chunk(s + 1))],
-            a_vmem.at[(s + 1) % 2],
-            load_sems.at[(s + 1) % 2],
-        ).start()
-
-    @pl.when(jnp.logical_and(s > 0, j == 0))
-    def _land():
-        # A chunk staged during the previous step.
-        pltpu.make_async_copy(
-            a_ref.at[chunk_rows(0)], a_vmem.at[s % 2], load_sems.at[s % 2]
+            a_vmem.at[b], a_vmem.at[b], load_sems.at[b]
         ).wait()
-        # Inbound accumulated partial for this step's chunk (left's step s-1).
+        # Inbound accumulated partial (left's step s-1) must have landed.
         dl.wait_recv(recv_sems.at[s - 1], ws.at[s - 1])
-        dma = pltpu.make_async_copy(ws.at[s - 1], inbound, stage_sem)
+        dma = stage_inb(s, 0, 0, 0)
         dma.start()
         dma.wait()
-        # Before reusing acc slot s%2 (last used at step s-2), drain its send.
+        # accbuf slot s%2 was last pushed at step s-2; drain before reuse.
         @pl.when(s >= 2)
         def _():
             pltpu.make_async_copy(
-                acc.at[s % 2], acc.at[s % 2], send_sems.at[s - 2]
+                accbuf.at[s % 2], accbuf.at[s % 2], send_sems.at[s - 2]
             ).wait()
 
+    @pl.when(jnp.logical_and(jnp.logical_and(s > 0, t > 0), t < num_t))
+    def _land_inb():
+        # Inbound tile t staged at tile t-1.
+        pltpu.make_async_copy(
+            inb_vmem.at[p], inb_vmem.at[p], inb_sems.at[p]
+        ).wait()
+
+    @pl.when(jnp.logical_and(t > 0, j == 0))
+    def _land_a():
+        b = a_buf(s, i)
+        pltpu.make_async_copy(
+            a_vmem.at[b], a_vmem.at[b], load_sems.at[b]
+        ).wait()
+
+    # Prefetches for tile t+1 (inbound) and row-tile i+1 (A), issued
+    # before the matmul so the DMA engines run under MXU work.
+    @pl.when(jnp.logical_and(s > 0, t + 1 < num_t))
+    def _prefetch_inb():
+        ni = (t + 1) // num_j
+        nj = jax.lax.rem(t + 1, num_j)
+        stage_inb(s, ni, nj, 1 - p).start()
+
+    @pl.when(jnp.logical_and(i + 1 < num_i, j == num_j - 1))
+    def _prefetch_a():
+        stage_a(s, i + 1).start()
+
+    @pl.when(jnp.logical_and(s + 1 < n, t == num_t - 1))
+    def _prefetch_a_next_step():
+        stage_a(s + 1, 0).start()
+
     partial = jnp.dot(
-        a_vmem[s % 2], b_ref[:], preferred_element_type=acc_dtype
+        a_vmem[a_buf(s, i)], b_ref[:], preferred_element_type=acc_dtype
     )
 
-    jsl = pl.ds(j * tile_n, tile_n)
+    # Reuse of out_vmem[p]: its previous DMA-out (tile t-2) must be done.
+    @pl.when(t >= 2)
+    def _drain_out():
+        pltpu.make_async_copy(
+            out_vmem.at[p], out_vmem.at[p], out_sems.at[p]
+        ).wait()
 
     @pl.when(s == 0)
     def _first_step():
-        acc[0, :, jsl] = partial.astype(acc.dtype)
+        out_vmem[p] = partial.astype(out_vmem.dtype)
 
     @pl.when(s > 0)
     def _accumulate():
-        acc[s % 2, :, jsl] = (
-            partial + inbound[:, jsl].astype(acc_dtype)
-        ).astype(acc.dtype)
+        out_vmem[p] = (
+            partial + inb_vmem[p].astype(acc_dtype)
+        ).astype(out_vmem.dtype)
 
-    @pl.when(jnp.logical_and(s < n - 1, j == num_j - 1))
-    def _forward():
-        # Receiver consumes this at its step s+1 from slot s.
-        dl.put_signal(
-            acc.at[s % 2], ws.at[s], right,
-            send_sems.at[s], recv_sems.at[s], axis=axis,
-        )
-
-    @pl.when(jnp.logical_and(s == n - 1, j == num_j - 1))
-    def _finish():
-        # Write the final chunk out in one DMA (o_ref lives in HBM; its
-        # block is never revisited across grid steps).
-        dma = pltpu.make_async_copy(acc.at[(n - 1) % 2], o_ref, stage_sem)
-        dma.start()
-        dma.wait()
-        # Steps 0..n-3 were drained on acc-slot reuse; only n-2 remains.
-        step = n - 2
+    @pl.when(s < n - 1)
+    def _to_accbuf():
         pltpu.make_async_copy(
-            acc.at[step % 2], acc.at[step % 2], send_sems.at[step]
+            out_vmem.at[p], accbuf.at[s % 2, rows(i), cols(j)],
+            out_sems.at[p],
+        ).start()
+
+    @pl.when(s == n - 1)
+    def _to_out():
+        pltpu.make_async_copy(
+            out_vmem.at[p], o_ref.at[rows(i), cols(j)], out_sems.at[p]
+        ).start()
+
+    @pl.when(t == num_t - 1)
+    def _step_end():
+        # All outbound tile DMAs of this step must have landed in HBM
+        # before the chunk is forwarded (or the kernel exits).
+        pltpu.make_async_copy(
+            out_vmem.at[p], out_vmem.at[p], out_sems.at[p]
         ).wait()
+
+        @pl.when(num_t > 1)
+        def _():
+            pltpu.make_async_copy(
+                out_vmem.at[1 - p], out_vmem.at[1 - p], out_sems.at[1 - p]
+            ).wait()
+
+        @pl.when(s < n - 1)
+        def _forward():
+            # Receiver consumes this at its step s+1 from slot s.
+            dl.put_signal(
+                accbuf.at[s % 2], ws.at[s], right,
+                send_sems.at[s], recv_sems.at[s], axis=axis,
+            )
+
+        @pl.when(s == n - 1)
+        def _finish():
+            # Steps 0..n-3 drained on accbuf reuse; only n-2 remains.
+            step = n - 2
+            pltpu.make_async_copy(
+                accbuf.at[step % 2], accbuf.at[step % 2],
+                send_sems.at[step],
+            ).wait()
 
 
 def gemm_rs(
@@ -179,43 +277,52 @@ def gemm_rs(
     if m % n:
         raise ValueError(f"M={m} not divisible by axis size {n}")
     m_per = m // n
-    config = config or create_gemm_rs_context(m, n_out, k_loc, a.dtype)
+    config = config or create_gemm_rs_context(
+        m, n_out, k_loc, a.dtype, n_ranks=n
+    )
     tile_n = min(config.tile_n, n_out)
     if n_out % tile_n:
         raise ValueError(f"n_out={n_out} not divisible by tile_n={tile_n}")
     num_j = n_out // tile_n
+    tile_m = min(config.tile_m or m_per, m_per)
+    if m_per % tile_m:
+        raise ValueError(f"m_per={m_per} not divisible by tile_m={tile_m}")
+    num_i = m_per // tile_m
 
     if n == 1:
         return jnp.dot(a, b, preferred_element_type=config.acc_dtype).astype(a.dtype)
 
-    out, _ws = comm_pallas_call(
+    out, _ws, _acc = comm_pallas_call(
         functools.partial(_gemm_rs_kernel, axis=axis, acc_dtype=config.acc_dtype),
         (
             jax.ShapeDtypeStruct((m_per, n_out), a.dtype),
             jax.ShapeDtypeStruct((n - 1, m_per, n_out), a.dtype),
+            jax.ShapeDtypeStruct((2, m_per, n_out), a.dtype),
         ),
-        grid=(n, num_j),
+        grid=(n, num_i, num_j),
         in_specs=[
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(
-                (k_loc, tile_n), lambda s, j: (0, j), memory_space=pltpu.VMEM
+                (k_loc, tile_n), lambda s, i, j: (0, j), memory_space=pltpu.VMEM
             ),
         ],
         out_specs=(
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ),
         scratch_shapes=[
-            pltpu.VMEM((2, m_per, k_loc), a.dtype),
-            pltpu.VMEM((2, m_per, n_out), a.dtype),
-            pltpu.VMEM((m_per, n_out), a.dtype),
+            pltpu.VMEM((2, tile_m, k_loc), a.dtype),
+            pltpu.VMEM((2, tile_m, tile_n), a.dtype),
+            pltpu.VMEM((2, tile_m, tile_n), a.dtype),
             pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((n - 1,)),
             pltpu.SemaphoreType.DMA((n - 1,)),
         ],
         collective_id=_GEMM_RS_COLLECTIVE_ID,
-        dimension_semantics=("arbitrary", "arbitrary"),
+        dimension_semantics=("arbitrary", "arbitrary", "arbitrary"),
         ctx=ctx,
     )(a, b)
     return out

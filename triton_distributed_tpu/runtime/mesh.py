@@ -68,6 +68,8 @@ class MeshTopology:
     process_index: int
     platform: str  # "tpu" | "cpu" | ...
     devices_per_process: int
+    torus_shape: tuple[int, ...] | None = None  # physical ICI grid dims
+    has_wraparound: bool | None = None  # any torus dim with wrap links
 
     @property
     def on_tpu(self) -> bool:
@@ -210,15 +212,84 @@ def current_context() -> DistContext:
     return _CURRENT
 
 
+def snake_ring_order(coords: np.ndarray) -> np.ndarray:
+    """Permutation of device indices whose consecutive entries are physical
+    ICI neighbors (boustrophedon walk of the torus).
+
+    Parity role: the reference's topology probes (``utils.py:592-867``)
+    answer "which ranks are one NVLink hop apart"; on TPU the analog is
+    "which chips are one ICI hop apart", answered from device coords.
+    Works for any full n-D grid; the closing hop (last → first) is also
+    distance 1 whenever every inner dim is even (the usual torus case).
+    """
+    coords = np.asarray(coords)
+    lo = coords.min(axis=0)
+    sizes = coords.max(axis=0) - lo + 1
+    norm = coords - lo
+
+    def snake_key(c) -> int:
+        key = 0
+        for v, s in zip(c, sizes):
+            vv = int(s) - 1 - int(v) if key % 2 else int(v)
+            key = key * int(s) + vv
+        return key
+
+    return np.argsort([snake_key(c) for c in norm], kind="stable")
+
+
+def _tpu_device_grid(
+    devices: list[jax.Device], shape: tuple[int, ...]
+) -> np.ndarray:
+    """Arrange TPU devices so the innermost mesh axis rides contiguous ICI.
+
+    ``jax.experimental.mesh_utils.create_device_mesh`` does the real
+    assignment from physical coords; it requires the full device set of
+    the slice. For subsets (or when it declines), fall back to the snake
+    ring over coords so consecutive innermost-axis entries are still
+    one-hop neighbors; last resort is enumeration order.
+    """
+    if len(devices) == len(jax.devices()):
+        try:
+            from jax.experimental import mesh_utils
+
+            return mesh_utils.create_device_mesh(shape, devices=devices)
+        except Exception:
+            pass
+    try:
+        coords = np.asarray([d.coords for d in devices])
+        order = snake_ring_order(coords)
+        return np.asarray(devices)[order].reshape(shape)
+    except Exception:
+        return np.asarray(devices).reshape(shape)
+
+
 def _detect_topology(devices: Sequence[jax.Device]) -> MeshTopology:
     platform = devices[0].platform
     num_processes = jax.process_count()
+    torus_shape = None
+    has_wrap = None
+    if platform == "tpu":
+        try:
+            coords = np.asarray([d.coords for d in devices])
+            dims = tuple(int(x) for x in coords.max(0) - coords.min(0) + 1)
+            torus_shape = dims
+            kind = devices[0].device_kind.lower()
+            if "v4" in kind or "v5p" in kind:
+                # 3D-torus generations: wraparound links on dims >= 4.
+                has_wrap = any(d >= 4 for d in dims)
+            elif "lite" in kind or "v5e" in kind or "v6e" in kind:
+                has_wrap = False  # 2D-mesh generations: no wrap links
+            # else: unknown generation — leave None
+        except Exception:
+            pass
     return MeshTopology(
         num_devices=len(devices),
         num_processes=num_processes,
         process_index=jax.process_index(),
         platform=platform,
         devices_per_process=max(1, len(devices) // num_processes),
+        torus_shape=torus_shape,
+        has_wraparound=has_wrap,
     )
 
 
@@ -285,7 +356,10 @@ def initialize_distributed(
     if not ordered:
         ordered, shape = ["dp"], (n,)
 
-    dev_array = np.asarray(devices).reshape(shape)
+    if devices[0].platform == "tpu":
+        dev_array = _tpu_device_grid(devices, shape)
+    else:
+        dev_array = np.asarray(devices).reshape(shape)
     mesh = Mesh(dev_array, tuple(ordered))
     ctx = DistContext(mesh, _detect_topology(devices))
     if set_as_current:

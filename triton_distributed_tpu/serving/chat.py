@@ -59,10 +59,22 @@ def main(argv=None) -> int:
             break
         ids = tok.encode(text)
         pad = (-len(ids)) % args.pad_to
-        ids = [0] * pad + list(ids)
+        # Left-pad with the tokenizer's pad/BOS id; the engine masks
+        # padded prefill positions via prompt_start so pads are inert.
+        pad_id = next(
+            i
+            for i in (
+                getattr(tok, "pad_token_id", None),
+                getattr(tok, "bos_token_id", None),
+                0,
+            )
+            if i is not None
+        )
+        ids = [int(pad_id)] * pad + list(ids)
         resp = request(
             args.host, args.port,
-            {"input_ids": [ids], "gen_len": args.gen_len},
+            {"input_ids": [ids], "gen_len": args.gen_len,
+             "prompt_start": [pad]},
         )
         out = resp["output_ids"][0][len(ids):]
         stats = resp.get("stats", {})

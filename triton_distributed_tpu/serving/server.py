@@ -47,13 +47,26 @@ class ModelServer:
             return {"ok": True}
         input_ids = np.asarray(req["input_ids"], np.int32)
         gen_len = int(req.get("gen_len", 16))
-        out = self.engine.serve(input_ids, gen_len)
+        out = self.engine.serve(
+            input_ids, gen_len, prompt_start=req.get("prompt_start")
+        )
         return {
             "output_ids": out.tolist(),
             "stats": self.engine.last_stats,
         }
 
+    # An idle client must not wedge the single-threaded accept loop: a
+    # connection that sends nothing within this window is dropped.
+    IDLE_TIMEOUT_S = 10.0
+
     def _serve_conn(self, conn: socket.socket) -> None:
+        conn.settimeout(self.IDLE_TIMEOUT_S)
+        try:
+            self._serve_lines(conn)
+        except (socket.timeout, TimeoutError, OSError):
+            conn.close()
+
+    def _serve_lines(self, conn: socket.socket) -> None:
         with conn, conn.makefile("rwb") as f:
             for line in f:
                 line = line.strip()

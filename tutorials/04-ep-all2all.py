@@ -43,7 +43,7 @@ def main():
 
     fn = ctx.shard_map(
         functools.partial(
-            ep_moe_ffn, k=k, capacity_factor=4.0, axis="ep", ctx=ctx
+            ep_moe_ffn, k=k, axis="ep", ctx=ctx  # lossless splits-exchange path
         ),
         in_specs=(P("ep", None), P(), P("ep", None, None), P("ep", None, None)),
         out_specs=P("ep", None),
