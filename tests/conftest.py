@@ -17,6 +17,9 @@ import os
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
+# Keep the autotuner's persistent cache out of ~/.cache during tests;
+# the persistence test opts back in with a tmp_path dir.
+os.environ.setdefault("TDT_AUTOTUNE_CACHE", "0")
 
 import jax
 

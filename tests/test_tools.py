@@ -134,3 +134,36 @@ def test_prune_and_chip_spec_fallback():
     assert chip_spec("TPU v5 lite").name == "v5e"
     assert chip_spec("TPU v5p").name == "v5p"
     assert chip_spec("weird device").name == "v5e"
+
+
+def test_autotune_persistent_cache(tmp_path, monkeypatch):
+    """A fresh Autotuner (new process stand-in) replays the argmin from
+    disk without re-sweeping; a changed config space re-tunes."""
+    monkeypatch.setenv("TDT_AUTOTUNE_CACHE", "1")
+    monkeypatch.setenv("TDT_AUTOTUNE_CACHE_DIR", str(tmp_path))
+    calls = []
+
+    def op(x, tile=128):
+        calls.append(tile)
+        import time
+
+        time.sleep(0.02 if tile == 64 else 0.001)
+        return x * tile
+
+    configs = [Config({"tile": 64}), Config({"tile": 128})]
+    x = jnp.ones((4, 4))
+    Autotuner(op, configs, n_warmup=1, n_repeat=2)(x)
+    assert (tmp_path / "op.json").exists()
+    swept = len(calls)
+    assert swept > 2  # both configs benched
+
+    # Fresh instance: disk hit — exactly one replay call, no sweep.
+    out = Autotuner(op, configs, n_warmup=1, n_repeat=2)(x)
+    assert len(calls) == swept + 1
+    np.testing.assert_allclose(np.asarray(out), 128.0)
+
+    # Config space changed: stored argmin no longer resolves → re-tune.
+    calls.clear()
+    Autotuner(op, [Config({"tile": 32}), Config({"tile": 256})],
+              n_warmup=1, n_repeat=2)(x)
+    assert len(calls) > 2

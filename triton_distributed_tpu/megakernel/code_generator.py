@@ -230,9 +230,25 @@ def build_mega_call(
         ],
     )
 
+    # FLOPs/bytes annotation (parity: the reference's launch_metadata on
+    # its megakernel): decode is one pass over every weight shard plus
+    # the KV context; flops ≈ 2·B·(weight params) per matmul chain.
+    L = dims.num_layers
+    wparams = L * (
+        dims.d * dims.qkv_loc + dims.o_k * dims.d + 3 * dims.d * dims.f_loc
+    ) + dims.d * dims.v_loc
+    kv_elems = 2 * L * B * hkv * dims.s_max * hd
+    cost = pl.CostEstimate(
+        flops=2 * B * wparams + 4 * B * L * dims.hq_loc * dims.s_max * hd,
+        bytes_accessed=wparams * jnp.dtype(wdtype).itemsize
+        + kv_elems * jnp.dtype(cdtype).itemsize,
+        transcendentals=B * L * (dims.hq_loc * dims.s_max + dims.f_loc),
+    )
+
     call = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
+        cost_estimate=cost,
         out_shape=[
             jax.ShapeDtypeStruct((B, dims.v_loc), jnp.float32),
             jax.ShapeDtypeStruct(

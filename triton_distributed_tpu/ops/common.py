@@ -145,6 +145,20 @@ def comm_pallas_call(
     )
 
 
+def comm_cost(
+    flops: int = 0, bytes_accessed: int = 0, transcendentals: int = 0
+) -> pl.CostEstimate:
+    """FLOPs/bytes annotation for a comm kernel so profiles and XLA's
+    scheduler see real costs (parity: the reference's ``launch_metadata``
+    hooks, e.g. ``allgather_gemm.py:145-156``, which label each kernel
+    launch with its flop/byte counts for nsys traces)."""
+    return pl.CostEstimate(
+        flops=int(flops),
+        bytes_accessed=int(bytes_accessed),
+        transcendentals=int(transcendentals),
+    )
+
+
 def _on_tpu(ctx: DistContext | None = None) -> bool:
     """True when kernels will compile through Mosaic (real TPU)."""
     if ctx is not None:

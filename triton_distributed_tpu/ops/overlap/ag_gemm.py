@@ -46,6 +46,7 @@ from jax.sharding import PartitionSpec as P
 
 from triton_distributed_tpu import language as dl
 from triton_distributed_tpu.ops.common import (
+    comm_cost,
     comm_pallas_call,
     next_collective_id,
     pick_tile,
@@ -276,6 +277,13 @@ def ag_gemm(
         ],
         collective_id=_AG_GEMM_COLLECTIVE_ID,
         dimension_semantics=("arbitrary", "arbitrary", "arbitrary"),
+        cost_estimate=comm_cost(
+            flops=2 * n * m_per * k * n_loc,
+            # A streamed in + pushed around the ring, B read per step,
+            # gathered A and the output written once.
+            bytes_accessed=(2 * n * a.size + n * b.size + n * a.size
+                            + n * m_per * n_loc) * a.dtype.itemsize,
+        ),
         ctx=ctx,
     )(a, b)
 

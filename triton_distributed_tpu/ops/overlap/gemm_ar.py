@@ -39,6 +39,7 @@ from jax.sharding import PartitionSpec as P
 from triton_distributed_tpu import language as dl
 from triton_distributed_tpu.ops.common import (
     VMEM_COMM_MAX_BYTES,
+    comm_cost,
     comm_pallas_call,
     next_collective_id,
     pick_tile,
@@ -245,6 +246,14 @@ def gemm_ar(
         ],
         collective_id=_GEMM_AR_COLLECTIVE_ID,
         dimension_semantics=("arbitrary",),
+        cost_estimate=comm_cost(
+            flops=2 * m * k_loc * n_out,
+            # A + B read, partials broadcast to n peers, n landed
+            # partials re-read for the reduction, output written.
+            bytes_accessed=(a.size + b.size
+                            + 2 * n * m * n_out + m * n_out)
+            * a.dtype.itemsize,
+        ),
         ctx=ctx,
     )(a, b)
     return out

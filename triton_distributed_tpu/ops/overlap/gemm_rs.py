@@ -35,6 +35,7 @@ from jax.sharding import PartitionSpec as P
 
 from triton_distributed_tpu import language as dl
 from triton_distributed_tpu.ops.common import (
+    comm_cost,
     comm_pallas_call,
     next_collective_id,
     pick_tile,
@@ -323,6 +324,13 @@ def gemm_rs(
         ],
         collective_id=_GEMM_RS_COLLECTIVE_ID,
         dimension_semantics=("arbitrary", "arbitrary", "arbitrary"),
+        cost_estimate=comm_cost(
+            flops=2 * m * k_loc * n_out,
+            # A + B read once, partials pushed around the ring and
+            # re-read for the local adds, reduced chunk written.
+            bytes_accessed=(a.size + b.size + 3 * (n - 1) * m_per * n_out
+                            + m_per * n_out) * a.dtype.itemsize,
+        ),
         ctx=ctx,
     )(a, b)
     return out
