@@ -55,7 +55,8 @@ def test_reduce_scatter(ctx4, rng, method):
 
 @pytest.mark.parametrize(
     "method",
-    [AllReduceMethod.XLA, AllReduceMethod.ONE_SHOT, AllReduceMethod.TWO_SHOT],
+    [AllReduceMethod.XLA, AllReduceMethod.ONE_SHOT, AllReduceMethod.TWO_SHOT,
+     AllReduceMethod.DOUBLING],
 )
 def test_all_reduce(ctx4, rng, method):
     n = 4
@@ -70,6 +71,9 @@ def test_all_reduce_auto_dispatch():
     from triton_distributed_tpu.ops import get_auto_allreduce_method
 
     assert get_auto_allreduce_method(1024, 8) == AllReduceMethod.ONE_SHOT
+    # mid-size band on a power-of-two axis: log-depth butterfly
+    assert get_auto_allreduce_method(1 << 19, 8) == AllReduceMethod.DOUBLING
+    assert get_auto_allreduce_method(1 << 19, 6) == AllReduceMethod.TWO_SHOT
     assert get_auto_allreduce_method(1 << 21, 8) == AllReduceMethod.TWO_SHOT
     # no XLA fallback on size: beyond the VMEM ceiling the TWO_SHOT RS
     # leg switches to the HBM-slot ring internally

@@ -153,7 +153,9 @@ def test_autotune_persistent_cache(tmp_path, monkeypatch):
     configs = [Config({"tile": 64}), Config({"tile": 128})]
     x = jnp.ones((4, 4))
     Autotuner(op, configs, n_warmup=1, n_repeat=2)(x)
-    assert (tmp_path / "op.json").exists()
+    import os
+    cached = os.listdir(tmp_path)
+    assert len(cached) == 1 and cached[0].endswith(".json")
     swept = len(calls)
     assert swept > 2  # both configs benched
 

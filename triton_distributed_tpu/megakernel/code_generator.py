@@ -131,14 +131,13 @@ def make_mega_kernel(
         task_tab, kv_len, tokens,                      # scalar prefetch
         embed, wqkv, wo, w1, w2, lm_head,              # ANY (HBM)
         ln1, ln2, normf, qn, kn,                       # VMEM (small)
-        kc_in, vc_in,                                  # ANY, aliased
-        logits, kc, vc,                                # outputs
+        kc, vc,                                        # ANY (read-only)
+        logits, knew_out, vnew_out,                    # outputs
         x, h, qkv, ao, mlp, estage,                    # VMEM state
         colstage, rowstage, kstage, vstage,            # weight/KV staging
-        knew_st, vnew_st, arsrc, cbuf,                 # attn + AR staging
+        arsrc, cbuf,                                   # AR staging
         wsem, esem, osem, ksem, vsem, arsend, arrecv,  # DMA semaphores
     ):
-        del kc_in, vc_in  # aliased: bodies use the output refs
         step = pl.program_id(0)
         kctx.kv_len = kv_len
         kctx.tokens = tokens
@@ -147,10 +146,10 @@ def make_mega_kernel(
         kctx.ln1, kctx.ln2, kctx.normf = ln1, ln2, normf
         kctx.qn, kctx.kn = qn, kn
         kctx.logits, kctx.kc, kctx.vc = logits, kc, vc
+        kctx.knew_out, kctx.vnew_out = knew_out, vnew_out
         kctx.x, kctx.h, kctx.qkv, kctx.ao, kctx.mlp = x, h, qkv, ao, mlp
         kctx.estage, kctx.colstage, kctx.rowstage = estage, colstage, rowstage
         kctx.kstage, kctx.vstage = kstage, vstage
-        kctx.knew_st, kctx.vnew_st = knew_st, vnew_st
         kctx.arsrc, kctx.cbuf = arsrc, cbuf
         kctx.wsem, kctx.esem, kctx.osem = wsem, esem, osem
         kctx.ksem, kctx.vsem = ksem, vsem
@@ -182,8 +181,9 @@ def build_mega_call(
     """Assemble the pallas_call for a scheduled task list.
 
     Returns ``f(kv_len, tokens, embed, wqkv, wo, w1, w2, lm_head, ln1,
-    ln2, normf, qn, kn, kc, vc) → (logits, kc, vc)`` — a per-shard
-    function to run under ``shard_map``.
+    ln2, normf, qn, kn, kc, vc) → (logits, knew, vnew)`` — a per-shard
+    function to run under ``shard_map``; ``knew``/``vnew`` are the new
+    token's K/V rows ``[L, B, hkv, hd]`` for the caller to append.
     """
     cfg = mcfg.resolve(dims)
     used = tuple({t.task_type for t in tasks})
@@ -202,8 +202,8 @@ def build_mega_call(
         + [pl.BlockSpec(memory_space=pl.ANY)] * 2,
         out_specs=[
             pl.BlockSpec(memory_space=pltpu.VMEM),  # logits
-            pl.BlockSpec(memory_space=pl.ANY),      # k cache (aliased)
-            pl.BlockSpec(memory_space=pl.ANY),      # v cache (aliased)
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # new K rows
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # new V rows
         ],
         scratch_shapes=[
             pltpu.VMEM((B, d), jnp.float32),                   # x
@@ -211,13 +211,11 @@ def build_mega_call(
             pltpu.VMEM((B, dims.qkv_loc), jnp.float32),        # qkv
             pltpu.VMEM((B, dims.o_k), jnp.float32),            # ao
             pltpu.VMEM((B, dims.f_loc), jnp.float32),          # mlp
-            pltpu.VMEM((B, d), wdtype),                        # estage
+            pltpu.VMEM((B, 8, d), wdtype),                     # estage
             pltpu.VMEM((2, d, cfg.tn_max), wdtype),            # colstage
             pltpu.VMEM((2, cfg.tk_max, d), wdtype),            # rowstage
             pltpu.VMEM((2, B, hkv, cfg.s_blk, hd), cdtype),    # kstage
             pltpu.VMEM((2, B, hkv, cfg.s_blk, hd), cdtype),    # vstage
-            pltpu.VMEM((B, hkv, hd), cdtype),                  # knew_st
-            pltpu.VMEM((B, hkv, hd), cdtype),                  # vnew_st
             pltpu.VMEM((B, d), jnp.float32),                   # arsrc
             pltpu.VMEM((n, B, d), jnp.float32),                # cbuf
             pltpu.SemaphoreType.DMA((2,)),                     # wsem
@@ -249,18 +247,17 @@ def build_mega_call(
         kernel,
         grid_spec=grid_spec,
         cost_estimate=cost,
+        # The kernel reads the KV cache but does not write it: appending
+        # one row at a dynamic position inside a (8,128)-tiled cache
+        # plane is an unaligned slice Mosaic rejects, so new K/V rows
+        # come out as [L, B, hkv, hd] and the caller merges them with
+        # one XLA dynamic_update_slice (which aliases in place when the
+        # cache is donated).
         out_shape=[
             jax.ShapeDtypeStruct((B, dims.v_loc), jnp.float32),
-            jax.ShapeDtypeStruct(
-                (dims.num_layers, B, hkv, dims.s_max, hd), cdtype
-            ),
-            jax.ShapeDtypeStruct(
-                (dims.num_layers, B, hkv, dims.s_max, hd), cdtype
-            ),
+            jax.ShapeDtypeStruct((dims.num_layers, B, hkv, hd), cdtype),
+            jax.ShapeDtypeStruct((dims.num_layers, B, hkv, hd), cdtype),
         ],
-        # Input indices include the 3 scalar-prefetch args:
-        # kc is input 14 (3 prefetch + 11 arrays before it), vc is 15.
-        input_output_aliases={14: 1, 15: 2},
         compiler_params=pltpu.CompilerParams(
             has_side_effects=True,
             dimension_semantics=("arbitrary",),

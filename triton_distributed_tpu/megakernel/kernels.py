@@ -119,19 +119,35 @@ def _stream_rows(kctx, x_ref, w_hbm, out_ref, n: int, tk: int):
 
 @register_task(TaskType.EMBED)
 def embed_body(kctx):
+    """Token embedding lookup.
+
+    The table arrives as ``[V/8, 8, d]`` (see ``MegaQwen3.build``): a
+    single-row slice of the ``[V, d]`` HBM table breaks Mosaic's (8,128)
+    tiling (bf16 packs row pairs), so the DMA fetches the aligned 8-row
+    group and a one-hot ``[1, 8] @ [8, d]`` matmul selects the row — a
+    dynamic sublane extract Mosaic can't otherwise prove aligned.
+    """
+
     def body():
         B = kctx.dims.batch
 
-        def row(b):
+        def group(b):
             return pltpu.make_async_copy(
-                kctx.embed.at[kctx.tokens[b]], kctx.estage.at[b], kctx.esem
+                kctx.embed.at[kctx.tokens[b] // 8], kctx.estage.at[b],
+                kctx.esem,
             )
 
         for b in range(B):
-            row(b).start()
+            group(b).start()
         for b in range(B):
-            row(b).wait()
-        kctx.x[...] = kctx.estage[...].astype(jnp.float32)
+            group(b).wait()
+        sub = jax.lax.broadcasted_iota(jnp.int32, (1, 8), 1)
+        for b in range(B):
+            onehot = (sub == kctx.tokens[b] % 8).astype(jnp.float32)
+            kctx.x[b:b + 1, :] = jnp.dot(
+                onehot, kctx.estage[b].astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            )
 
     return body
 
@@ -141,14 +157,19 @@ def norm_body(kctx):
     def body():
         eps = kctx.dims.rms_eps
         xv = kctx.x[...]
+        # Weights arrive as [L, 1, d] (see MegaQwen3.build): indexing
+        # the untiled leading dim with the traced layer id yields a
+        # [1, d] vector — a dynamic sublane slice of [L, d] would need
+        # an 8-aligned index Mosaic can't prove.
+        layer = kctx.layer
 
         @pl.when(kctx.arg0 == 0)
         def _ln1():
-            kctx.h[...] = _rms(xv, kctx.ln1[kctx.layer], eps)
+            kctx.h[...] = _rms(xv, kctx.ln1[layer], eps)
 
         @pl.when(kctx.arg0 == 1)
         def _ln2():
-            kctx.h[...] = _rms(xv, kctx.ln2[kctx.layer], eps)
+            kctx.h[...] = _rms(xv, kctx.ln2[layer], eps)
 
         @pl.when(kctx.arg0 == 2)
         def _final():
@@ -186,63 +207,79 @@ def attn_body(kctx):
         layer = kctx.layer
         pos = [kctx.kv_len[b] for b in range(B)]
 
+        # Mosaic has no lane-splitting shape casts ([B, h·hd] → [B, h,
+        # hd] is rejected by infer-vector-layout), so heads stay 2-D
+        # throughout: per (batch, kv-head) the q group is assembled from
+        # [1, hd] lane slices of the qkv vector (offsets are multiples
+        # of hd = 128 on real configs) and all attention math runs on
+        # [g, ·] tiles.
         qkv = kctx.qkv[...]  # [B, (hq + 2 hkv) hd] f32
-        q = qkv[:, : hq * hd].reshape(B, hq, hd)
-        knew = qkv[:, hq * hd:(hq + hkv) * hd].reshape(B, hkv, hd)
-        vnew = qkv[:, (hq + hkv) * hd:].reshape(B, hkv, hd)
+        qn = kctx.qn[layer]  # [L, 1, hd] ref → [1, hd]
+        kn = kctx.kn[layer]
 
-        def headnorm(t, w):
+        def headnorm(t, w):  # t [r, hd]
             return t * jax.lax.rsqrt(
                 jnp.mean(t * t, axis=-1, keepdims=True) + eps
             ) * w.astype(jnp.float32)
 
-        q = headnorm(q, kctx.qn[layer])
-        knew = headnorm(knew, kctx.kn[layer])
+        # RoPE over the full lane width: angle repeats per half, the
+        # rotate-half operand is a lane roll + sign flip — one
+        # tpu.rotate instead of the unaligned hd/2 lane slices Mosaic
+        # can't form. iota (not arange): concrete arrays would be
+        # captured consts, which pallas_call rejects; integer iota only
+        # — Mosaic's tpu.iota verifier rejects float result types.
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, hd), 1)
+        half = jnp.remainder(lane, hd // 2).astype(jnp.float32)
+        inv = 1.0 / (theta ** (2.0 * half / hd))  # [1, hd]
+        sign = jnp.where(lane < hd // 2, -1.0, 1.0)
 
-        # iota (not arange): concrete arrays would be captured consts,
-        # which pallas_call rejects. Integer iota only — Mosaic's
-        # tpu.iota verifier rejects float result types.
-        i2 = (
-            jax.lax.broadcasted_iota(jnp.int32, (1, hd // 2), 1)
-            .astype(jnp.float32) * 2.0
-        )
-        inv = 1.0 / (theta ** (i2 / hd))  # [1, hd/2]
-
-        def rope(t, p):  # t [h, hd], p scalar
+        def rope(t, p):  # t [r, hd], p scalar position
             ang = p.astype(jnp.float32) * inv
-            cos, sin = jnp.cos(ang), jnp.sin(ang)
-            t1, t2 = t[:, : hd // 2], t[:, hd // 2:]
-            return jnp.concatenate(
-                [t1 * cos - t2 * sin, t2 * cos + t1 * sin], axis=-1
-            )
+            rot = pltpu.roll(t, hd // 2, 1) * sign
+            return t * jnp.cos(ang) + rot * jnp.sin(ang)
 
-        q = jnp.stack([rope(q[b], pos[b]) for b in range(B)])
-        knew = jnp.stack([rope(knew[b], pos[b]) for b in range(B)])
+        def head(i):  # q head i as [1, hd] rows per batch
+            return [
+                qkv[b:b + 1, i * hd:(i + 1) * hd] for b in range(B)
+            ]
 
-        # Append at position kv_len[b] via staged DMA into the cache.
-        kctx.knew_st[...] = knew.astype(kctx.cdtype)
-        kctx.vnew_st[...] = vnew.astype(kctx.cdtype)
+        scale = hd ** -0.5
+        # q groups: qg[b][h] = [g, hd], normed + roped + prescaled.
+        qg = [
+            [
+                rope(
+                    headnorm(
+                        jnp.concatenate(
+                            [head(h * g + i)[b] for i in range(g)], axis=0
+                        ),
+                        qn,
+                    ),
+                    pos[b],
+                ) * scale
+                for h in range(hkv)
+            ]
+            for b in range(B)
+        ]
 
-        def appends(b):
-            return (
-                pltpu.make_async_copy(
-                    kctx.knew_st.at[b], kctx.kc.at[layer, b, :, pos[b], :],
-                    kctx.osem,
-                ),
-                pltpu.make_async_copy(
-                    kctx.vnew_st.at[b], kctx.vc.at[layer, b, :, pos[b], :],
-                    kctx.osem,
-                ),
-            )
-
+        # New K (normed + roped) and V per (b, kv-head). The cache is
+        # NOT written here — appending one row at a dynamic position in
+        # a (8,128)-tiled plane is an unaligned slice Mosaic rejects —
+        # so the rows go to the knew/vnew outputs (caller appends via
+        # XLA dynamic_update_slice) and the new token's own attention
+        # contribution is merged analytically after the block loop.
+        knew_v: list[list] = []
+        vnew_v: list[list] = []
         for b in range(B):
-            ka, va = appends(b)
-            ka.start()
-            va.start()
-        for b in range(B):
-            ka, va = appends(b)
-            ka.wait()
-            va.wait()
+            krow, vrow = [], []
+            for h in range(hkv):
+                kbh = rope(headnorm(head(hq + h)[b], kn), pos[b])
+                vbh = head(hq + hkv + h)[b]
+                kctx.knew_out[layer, b, h:h + 1, :] = kbh.astype(kctx.cdtype)
+                kctx.vnew_out[layer, b, h:h + 1, :] = vbh.astype(kctx.cdtype)
+                krow.append(kbh)
+                vrow.append(vbh)
+            knew_v.append(krow)
+            vnew_v.append(vrow)
 
         # Online-softmax decode over KV blocks, double-buffered. The
         # block loop is bounded by the furthest live position, not
@@ -254,7 +291,6 @@ def attn_body(kctx):
         for b in range(1, B):
             maxpos = jnp.maximum(maxpos, pos[b])
         nblk = maxpos // sblk + 1  # blocks overlapping [0, maxpos]
-        scale = hd ** -0.5
 
         def kv_copy(j, slot):
             return (
@@ -273,12 +309,17 @@ def attn_body(kctx):
         vc0.start()
 
         neg = jnp.float32(-1e30)
-        m0 = jnp.full((B, hq, 1), neg, jnp.float32)
-        l0 = jnp.zeros((B, hq, 1), jnp.float32)
-        a0 = jnp.zeros((B, hq, hd), jnp.float32)
+        nt = (((1,), (1,)), ((), ()))  # q [g, hd] · k [sblk, hd]ᵀ
+        init = tuple(
+            (
+                jnp.full((g, 1), neg, jnp.float32),
+                jnp.zeros((g, 1), jnp.float32),
+                jnp.zeros((g, hd), jnp.float32),
+            )
+            for _ in range(B * hkv)
+        )
 
         def blk(j, carry):
-            m, l, acc = carry
             slot = jax.lax.rem(j, 2)
 
             @pl.when(j + 1 < nblk)
@@ -290,38 +331,57 @@ def attn_body(kctx):
             kc_, vc_ = kv_copy(j, slot)
             kc_.wait()
             vc_.wait()
-            kb = kctx.kstage[slot].astype(jnp.float32)  # [B, hkv, sblk, hd]
-            vb = kctx.vstage[slot].astype(jnp.float32)
             idx = j * sblk + jax.lax.broadcasted_iota(jnp.int32, (1, sblk), 1)
 
-            rows = []
+            out = []
             for b in range(B):
-                valid = idx <= pos[b]  # [1, sblk] — includes appended token
+                valid = idx < pos[b]  # [1, sblk] — cached tokens only
                 for h in range(hkv):
-                    s = jnp.dot(
-                        q[b, h * g:(h + 1) * g], kb[b, h].T,
+                    m, l, acc = carry[b * hkv + h]
+                    kb = kctx.kstage[slot, b, h].astype(jnp.float32)
+                    vb = kctx.vstage[slot, b, h].astype(jnp.float32)
+                    s = jax.lax.dot_general(
+                        qg[b][h], kb, nt,
                         preferred_element_type=jnp.float32,
-                    ) * scale  # [g, sblk]
-                    rows.append(jnp.where(valid, s, neg))
-            s_all = jnp.stack(rows).reshape(B, hq, sblk)
+                    )  # [g, sblk]
+                    s = jnp.where(valid, s, neg)
+                    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+                    # Re-mask p: with every position masked (pos lands
+                    # on a block boundary) exp(neg - neg) would be 1.
+                    p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+                    corr = jnp.exp(m - m_new)
+                    l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+                    acc = acc * corr + jnp.dot(
+                        p, vb, preferred_element_type=jnp.float32
+                    )
+                    out.append((m_new, l, acc))
+            return tuple(out)
 
-            m_new = jnp.maximum(m, jnp.max(s_all, axis=-1, keepdims=True))
-            p = jnp.exp(s_all - m_new)
-            corr = jnp.exp(m - m_new)
-            l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-            pv_rows = []
-            for b in range(B):
-                for h in range(hkv):
-                    pv_rows.append(jnp.dot(
-                        p[b, h * g:(h + 1) * g], vb[b, h],
-                        preferred_element_type=jnp.float32,
-                    ))  # [g, hd]
-            pv = jnp.stack(pv_rows).reshape(B, hq, hd)
-            acc = acc * corr + pv
-            return m_new, l, acc
+        final = jax.lax.fori_loop(0, nblk, blk, init, unroll=False)
 
-        _, l, acc = jax.lax.fori_loop(0, nblk, blk, (m0, l0, a0), unroll=False)
-        kctx.ao[...] = (acc / l).reshape(B, hq * hd)
+        # Merge the new token's own K/V contribution (it never entered
+        # the cache) and write the normalized output.
+        for b in range(B):
+            for h in range(hkv):
+                m, l, acc = final[b * hkv + h]
+                s_self = jax.lax.dot_general(
+                    qg[b][h], knew_v[b][h], nt,
+                    preferred_element_type=jnp.float32,
+                )  # [g, 1]
+                m_f = jnp.maximum(m, s_self)
+                corr = jnp.exp(m - m_f)
+                p_self = jnp.exp(s_self - m_f)
+                l = l * corr + p_self
+                # Outer product as a K=1 matmul: the [g,1]×[1,hd]
+                # vector.broadcast path trips Mosaic's layout inference
+                # on the sliced vnew row.
+                pv_self = jnp.dot(
+                    p_self, vnew_v[b][h], preferred_element_type=jnp.float32
+                )
+                o = (acc * corr + pv_self) / l  # [g, hd]
+                for i in range(g):
+                    col = (h * g + i) * hd
+                    kctx.ao[b:b + 1, col:col + hd] = o[i:i + 1]
 
     return body
 

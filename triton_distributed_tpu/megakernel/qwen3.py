@@ -74,13 +74,40 @@ class MegaQwen3:
 
         def shard_fn(params: Qwen3Params, tokens, cache: KVCache):
             lp = params.layers
-            logits, k_new, v_new = per_shard(
+            V, d = params.embed.shape
+            if V % 8:
+                raise ValueError(
+                    f"megakernel needs vocab_size % 8 == 0, got {V}"
+                )
+            # Per-layer norm weights go in as [L, 1, d] / [L, 1, hd]:
+            # the kernel indexes the layer with a traced scalar, and
+            # Mosaic only allows dynamic indices on untiled leading
+            # dims (a dynamic sublane slice of a [L, d] ref needs a
+            # statically 8-aligned index it can't prove).
+            logits, k_rows, v_rows = per_shard(
                 cache.kv_len, tokens,
-                params.embed, lp.attn.wqkv, lp.attn.wo, lp.mlp.w1, lp.mlp.w2,
-                params.lm_head, lp.ln1, lp.ln2, params.norm,
-                lp.attn.q_norm, lp.attn.k_norm,
+                params.embed.reshape(V // 8, 8, d),
+                lp.attn.wqkv, lp.attn.wo, lp.mlp.w1, lp.mlp.w2,
+                params.lm_head,
+                lp.ln1[:, None, :], lp.ln2[:, None, :], params.norm[None, :],
+                lp.attn.q_norm[:, None, :], lp.attn.k_norm[:, None, :],
                 cache.k, cache.v,
             )
+            # Append the new rows [L, B, hkv, hd] at each row's position
+            # — one dynamic_update_slice per batch row; XLA updates the
+            # donated cache in place (the kernel cannot: a one-row write
+            # at a dynamic offset in a tiled cache plane is an unaligned
+            # slice Mosaic rejects).
+            k_new, v_new = cache.k, cache.v
+            B = tokens.shape[0]
+            for b in range(B):
+                at = (0, b, 0, cache.kv_len[b], 0)
+                k_new = jax.lax.dynamic_update_slice(
+                    k_new, k_rows[:, b, :, None, :][:, None], at
+                )
+                v_new = jax.lax.dynamic_update_slice(
+                    v_new, v_rows[:, b, :, None, :][:, None], at
+                )
             return logits, KVCache(k=k_new, v=v_new, kv_len=cache.kv_len + 1)
 
         f = m.ctx.shard_map(

@@ -48,18 +48,28 @@ class AllReduceMethod(enum.Enum):
     XLA = "xla"  # jax.lax.psum — XLA's own ICI collective
     ONE_SHOT = "one_shot"  # full-mesh exchange + local reduce (small msgs)
     TWO_SHOT = "two_shot"  # ring RS + ring AG (large msgs)
+    DOUBLING = "doubling"  # recursive doubling — log-depth (mid msgs)
 
 
 _ONESHOT_COLLECTIVE_ID = next_collective_id()
+_DOUBLING_COLLECTIVE_ID = next_collective_id()
 
 # Below this payload size the single-hop exchange beats the ring's
 # 2(n-1) hops (parity: get_auto_allreduce_method, allreduce.py:1101).
 _ONE_SHOT_MAX_BYTES = 256 * 1024
 
+# Band where log-depth beats both: above the one-shot sweet spot (n
+# simultaneous incoming puts congest a small mesh) but below where the
+# ring's 2·(n-1)/n bytes-per-rank bandwidth optimality dominates the
+# log₂(n) hop saving.
+_DOUBLING_MAX_BYTES = 1024 * 1024
+
 
 def get_auto_allreduce_method(nbytes: int, n: int) -> AllReduceMethod:
     if nbytes <= _ONE_SHOT_MAX_BYTES:
         return AllReduceMethod.ONE_SHOT
+    if nbytes <= _DOUBLING_MAX_BYTES and n & (n - 1) == 0:
+        return AllReduceMethod.DOUBLING
     # TWO_SHOT composes ring RS + ring AG; above the VMEM ceiling the RS
     # leg switches to its HBM-slot variant, so no payload cap remains.
     return AllReduceMethod.TWO_SHOT
@@ -97,6 +107,44 @@ def _one_shot_kernel(
     acc = gather[0].astype(jnp.float32)
     for i in range(1, n):
         acc = acc + gather[i].astype(jnp.float32)
+    o_ref[:] = acc.astype(o_ref.dtype)
+
+
+def _doubling_kernel(
+    x_ref, o_ref, src, recv, send_sems, recv_sems, *,
+    axis: str, straggler_rank: int | None = None, straggler_nanos: int = 0,
+):
+    """Recursive halving-doubling (butterfly) allreduce: log₂(n) rounds,
+    round k exchanges the running sum with partner ``me XOR 2^k``.
+
+    This is the TPU redesign of the reference's double-binary-tree method
+    (``allreduce.py:145-215``): same log-depth latency class, but the
+    butterfly keeps every rank's program identical (partner is computed
+    from the rank id, no parent/child tables) — a better fit for SPMD
+    Pallas where all ranks trace one kernel. Power-of-two axis sizes
+    only; AUTO falls back to ring methods otherwise.
+    """
+    me = dl.rank(axis)
+    n = dl.num_ranks(axis)
+    lg = n.bit_length() - 1  # n is a power of two
+
+    dl.barrier_all(axis)  # peers' recv slots must exist before any put
+    dl.straggle_if_rank(straggler_rank, axis, straggler_nanos)
+
+    acc = x_ref[:].astype(jnp.float32)
+    dmas = []
+    for k in range(lg):
+        partner = jax.lax.bitwise_xor(me, 1 << k)
+        src[k] = acc.astype(src.dtype)
+        dmas.append(
+            dl.put_signal(
+                src.at[k], recv.at[k], partner,
+                send_sems.at[k], recv_sems.at[k], axis=axis,
+            )
+        )
+        dl.wait_recv(recv_sems.at[k], recv.at[k])
+        acc = acc + recv[k].astype(jnp.float32)
+    dl.quiet(*dmas)
     o_ref[:] = acc.astype(o_ref.dtype)
 
 
@@ -145,6 +193,31 @@ def all_reduce(
                 pltpu.SemaphoreType.DMA(()),
             ],
             collective_id=_ONESHOT_COLLECTIVE_ID,
+            ctx=ctx,
+        )(x)
+
+    if method == AllReduceMethod.DOUBLING:
+        if x.ndim < 2:
+            raise ValueError("pallas all_reduce needs >=2D input")
+        if n & (n - 1):
+            raise ValueError(f"DOUBLING needs power-of-two axis, got {n}")
+        lg = max(n.bit_length() - 1, 1)
+        return comm_pallas_call(
+            functools.partial(
+                _doubling_kernel, axis=axis,
+                straggler_rank=straggler_rank,
+                straggler_nanos=straggler_nanos,
+            ),
+            jax.ShapeDtypeStruct(x.shape, x.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=[
+                pltpu.VMEM((lg, *x.shape), x.dtype),  # per-round send
+                pltpu.VMEM((lg, *x.shape), x.dtype),  # per-round recv
+                pltpu.SemaphoreType.DMA((lg,)),
+                pltpu.SemaphoreType.DMA((lg,)),
+            ],
+            collective_id=_DOUBLING_COLLECTIVE_ID,
             ctx=ctx,
         )(x)
 

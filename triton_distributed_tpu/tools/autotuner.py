@@ -127,11 +127,27 @@ class Autotuner:
     # survives process restarts (VERDICT r1 "no persistent cache").
 
     def _cache_path(self) -> str | None:
+        # Multi-host: a disk hit on one host but not another would
+        # desynchronize the sweep (the missing host blocks alone in the
+        # cross-host MAX allgather) — hosts re-tune instead.
+        if jax.process_count() > 1:
+            return None
         d = _cache_dir()
         if d is None:
             return None
-        name = getattr(self.fn, "__name__", "fn")
-        return os.path.join(d, f"{name}.json")
+        # Qualified name + config-space digest: two tuned functions that
+        # share a bare __name__ (closures, decorators) must not replay
+        # each other's argmin.
+        import hashlib
+
+        qual = "{}.{}".format(
+            getattr(self.fn, "__module__", ""),
+            getattr(self.fn, "__qualname__", getattr(self.fn, "__name__", "fn")),
+        ).replace("<", "").replace(">", "")
+        space = hashlib.sha1(
+            "|".join(sorted(str(c) for c in self.configs)).encode()
+        ).hexdigest()[:10]
+        return os.path.join(d, f"{qual}-{space}.json")
 
     def _load_disk(self) -> dict[str, str]:
         if self._disk is None:
@@ -156,11 +172,21 @@ class Autotuner:
 
     def _disk_store(self, key: Any, cfg: Config) -> None:
         path = self._cache_path()
-        if path is None or jax.process_index() != 0:
+        if path is None:
             return
-        disk = self._load_disk()
-        disk[repr(key)] = str(cfg)
         try:
+            # Merge over the CURRENT file contents, not the snapshot
+            # loaded at first access — another instance may have stored
+            # entries in between (lost-update hazard).
+            disk: dict[str, str] = {}
+            if os.path.exists(path):
+                try:
+                    with open(path) as f:
+                        disk = dict(json.load(f))
+                except (OSError, ValueError):
+                    disk = {}
+            disk[repr(key)] = str(cfg)
+            self._disk = disk
             os.makedirs(os.path.dirname(path), exist_ok=True)
             fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
             with os.fdopen(fd, "w") as f:
