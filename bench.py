@@ -148,22 +148,30 @@ def main() -> None:
         except Exception as e:  # keep the ladder going rung by rung
             errors[name] = f"{type(e).__name__}: {e}"[:300]
 
-    # Megakernel rung: whole decode step as ONE Pallas kernel. Host loop
-    # per step (its step fn manages its own buffers), skipped off-TPU
-    # (interpret mode there is semantics-only, not a timing rung).
+    # Megakernel rung: whole decode step as ONE Pallas kernel, with the
+    # same fori_loop chaining as the other rungs (greedy feedback keeps
+    # the steps data-dependent; one jit dispatch for all STEPS). Skipped
+    # off-TPU (interpret mode is semantics-only, not a timing rung).
     if on_tpu:
         try:
             from triton_distributed_tpu.megakernel import MegaQwen3
 
             mega = MegaQwen3(model)
+            mstep = mega.decode_fn(1, int(cache0.k.shape[3]))
+
+            def mega_decode_n(params, tok, cache, n):
+                def body(_, carry):
+                    tok, cache = carry
+                    logits, cache = mstep(params, tok, cache)
+                    return jnp.argmax(logits, -1).astype(jnp.int32), cache
+
+                return jax.lax.fori_loop(0, n, body, (tok, cache))
+
+            mrun = jax.jit(mega_decode_n, static_argnums=3)
 
             def mega_once():
-                # mega.decode_step donates the cache; re-snapshot per run.
-                tok, cache = tok0, jax.tree.map(jnp.copy, cache0)
-                for _ in range(STEPS):
-                    logits, cache = mega.decode_step(tok, cache)
-                    tok = jnp.argmax(logits, -1).astype(jnp.int32)
-                np.asarray(tok)
+                out_tok, _ = mrun(model.params, tok0, cache0, STEPS)
+                np.asarray(out_tok)
 
             ladder["mega"] = time_rung(mega_once)
         except Exception as e:

@@ -123,7 +123,7 @@ class TestMegaQwen3:
     def test_task_graph_shape(self, ctx4):
         model = AutoLLM.from_pretrained("tiny", ctx=ctx4)
         mega = MegaQwen3(model)
-        compiled, _ = mega.build(1, 64)
+        compiled, _, _ = mega.build(1, 64)
         L = model.cfg.num_layers
         # entry barrier (tp>1) + embed + 9 per layer + final norm + lm_head
         assert compiled.num_tasks == 1 + 1 + 9 * L + 2

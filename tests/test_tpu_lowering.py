@@ -286,7 +286,7 @@ class TestMegakernelLower:
 
         model = AutoLLM.from_pretrained("tiny", ctx=tpu_ctx4)
         mega = MegaQwen3(model)
-        _, step = mega.build(1, 64)
+        _, step, _ = mega.build(1, 64)
         cache = jax.eval_shape(lambda: model.new_cache(1, 64))
         tok = jax.ShapeDtypeStruct((1,), jnp.int32)
         params = jax.tree.map(

@@ -116,13 +116,24 @@ class MegaQwen3:
             out_specs=(P(None, ax), cache_specs(ax)),
         )
         step = jax.jit(f, donate_argnums=(2,))
-        return compiled, step
+        return compiled, step, f
+
+    def _built(self, batch: int, s_max: int):
+        key = (batch, s_max)
+        if key not in self._jit:
+            self._jit[key] = self.build(*key)
+        return self._jit[key]
 
     def decode_step(self, tokens: jax.Array, cache: KVCache):
         """One decode step for the whole batch: ``tokens [B] int32 →
         (logits [B, V] f32, cache)`` — the megakernel rung of the decode
         ladder."""
-        key = (int(tokens.shape[0]), int(cache.k.shape[3]))
-        if key not in self._jit:
-            self._jit[key] = self.build(*key)[1]
-        return self._jit[key](self.model.params, tokens, cache)
+        step = self._built(int(tokens.shape[0]), int(cache.k.shape[3]))[1]
+        return step(self.model.params, tokens, cache)
+
+    def decode_fn(self, batch: int, s_max: int):
+        """The raw (unjitted) step ``f(params, tokens, cache) →
+        (logits, cache)`` — same contract as ``Qwen3.decode_fn``, so
+        callers can chain steps inside one jit (``lax.fori_loop`` greedy
+        decode) instead of dispatching per step."""
+        return self._built(batch, s_max)[2]

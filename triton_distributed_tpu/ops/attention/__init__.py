@@ -14,9 +14,11 @@ from triton_distributed_tpu.ops.attention.flash_decode import (  # noqa: F401
     flash_decode,
     gqa_decode_reference,
     distributed_flash_decode,
+    distributed_flash_decode_2level,
 )
 from triton_distributed_tpu.ops.attention.sp_ag_attention import (  # noqa: F401
     sp_ag_attention,
+    sp_ag_attention_2level,
 )
 from triton_distributed_tpu.ops.attention.ring_attention import (  # noqa: F401
     ring_attention,

@@ -174,6 +174,26 @@ def flash_decode(
     return o
 
 
+def _gather_merge(o, lse, axis: str, method: str, ctx=None):
+    """Gather per-rank partial (O, LSE) over ``axis`` and LSE-merge.
+
+    ``method='pallas'`` packs the partials into one [b·hq, d+1] payload
+    and rides the device-initiated ring all-gather; ``'xla'`` uses the
+    XLA collective. Shared by the one- and two-level decode merges.
+    """
+    b, hq, d = o.shape
+    if method == "pallas":
+        flat = jnp.concatenate([o.reshape(b * hq, d), lse.reshape(b * hq, 1)], 1)
+        gathered = all_gather(flat, axis=axis, ctx=ctx)  # [n*b*hq, d+1]
+        gathered = gathered.reshape(-1, b * hq, d + 1)
+        o_all = gathered[..., :d].reshape(-1, b, hq, d)
+        lse_all = gathered[..., d].reshape(-1, b, hq)
+    else:
+        o_all = jax.lax.all_gather(o, axis)      # [n, B, Hq, D]
+        lse_all = jax.lax.all_gather(lse, axis)  # [n, B, Hq]
+    return lse_combine(o_all, lse_all, part_axis=0)
+
+
 def distributed_flash_decode(
     q: jax.Array,        # [B, Hq, D] replicated
     k_shard: jax.Array,  # [B, Hkv, S_loc, D] — this rank's KV slice
@@ -203,18 +223,48 @@ def distributed_flash_decode(
         q, k_shard, v_shard, local_len,
         sm_scale=sm_scale, chunk_k=chunk_k, return_lse=True,
     )
-    b, hq, d = q.shape
-    o = o.astype(jnp.float32)
-    if method == "pallas":
-        flat = jnp.concatenate([o.reshape(b * hq, d), lse.reshape(b * hq, 1)], 1)
-        gathered = all_gather(flat, axis=axis, ctx=ctx)  # [n*b*hq, d+1]
-        gathered = gathered.reshape(-1, b * hq, d + 1)
-        o_all = gathered[..., :d].reshape(-1, b, hq, d)
-        lse_all = gathered[..., d].reshape(-1, b, hq)
-    else:
-        o_all = jax.lax.all_gather(o, axis)      # [n, B, Hq, D]
-        lse_all = jax.lax.all_gather(lse, axis)  # [n, B, Hq]
-    merged, _ = lse_combine(o_all, lse_all, part_axis=0)
+    merged, _ = _gather_merge(o.astype(jnp.float32), lse, axis, method, ctx)
+    return merged.astype(q.dtype)
+
+
+def distributed_flash_decode_2level(
+    q: jax.Array,        # [B, Hq, D] replicated
+    k_shard: jax.Array,  # [B, Hkv, S_loc, D] — this rank's KV slice
+    v_shard: jax.Array,
+    kv_len: jax.Array,   # [B] int32 GLOBAL context length
+    *,
+    inner_axis: str = "sp",
+    outer_axis: str = "dcn",
+    sm_scale: float | None = None,
+    chunk_k: int = 256,
+    method: str = "xla",
+    ctx=None,
+):
+    """Decode attention with the KV cache sequence-sharded over
+    ``(outer_axis, inner_axis)`` in rank order — slices over DCN, ranks
+    within a slice over ICI.
+
+    Parity: the reference's multi-node flash-decode scaling
+    (``README.md:202-209``, 32 GPUs = 4 nodes × 8) with its two-level
+    combine: each rank reduces its local split-KV partials, partial
+    (O, LSE) merge first across the fast intra-slice fabric (optionally
+    the device-initiated Pallas ring when ``method='pallas'``), then the
+    per-slice results merge once over DCN with XLA collectives.
+    """
+    n_in = jax.lax.axis_size(inner_axis)
+    me = jax.lax.axis_index(outer_axis) * n_in + jax.lax.axis_index(inner_axis)
+    s_loc = k_shard.shape[2]
+    local_len = jnp.clip(kv_len - me * s_loc, 0, s_loc)
+    o, lse = flash_decode(
+        q, k_shard, v_shard, local_len,
+        sm_scale=sm_scale, chunk_k=chunk_k, return_lse=True,
+    )
+    # Level 1: intra-slice merge over ICI; level 2: one inter-slice
+    # merge over DCN (always XLA — DCN traffic is XLA's domain).
+    o_sl, lse_sl = _gather_merge(
+        o.astype(jnp.float32), lse, inner_axis, method, ctx
+    )
+    merged, _ = _gather_merge(o_sl, lse_sl, outer_axis, "xla", ctx)
     return merged.astype(q.dtype)
 
 

@@ -40,6 +40,7 @@ def _sp_ag_attn_kernel(
     q_ref,     # [1, bq, hd] VMEM — q block (head h, block qb)
     kv_ref,    # [2, hkv, s_loc, hd] ANY — local KV shard (k=0, v=1)
     o_ref,     # [1, bq, hd] VMEM — output block (written at r == me)
+    lse_ref,   # [1, bq, 1] VMEM — log-sum-exp per q row (same schedule)
     ws,        # [n, 2, hkv, s_loc, hd] ANY out — arrived KV chunks
     k_vmem,    # [s_loc, hd] VMEM scratch
     v_vmem,    # [s_loc, hd] VMEM scratch
@@ -132,6 +133,7 @@ def _sp_ag_attn_kernel(
     def _finalize():
         l = jnp.maximum(l_i[:], 1e-30)
         o_ref[0] = (acc[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_i[:] + jnp.log(l)
 
     @pl.when(
         jnp.logical_and(
@@ -153,10 +155,13 @@ def sp_ag_attention(
     axis: str = "sp",
     sm_scale: float | None = None,
     block_q: int = 256,
+    return_lse: bool = False,
     ctx=None,
 ) -> jax.Array:
     """Causal SP attention inside ``shard_map``; sequence sharded over
-    ``axis`` in rank order. Returns ``o [hq, s_loc, hd]`` (q layout).
+    ``axis`` in rank order. Returns ``o [hq, s_loc, hd]`` (q layout),
+    plus the per-row log-sum-exp ``[hq, s_loc]`` when ``return_lse``
+    (for hierarchical/DCN-level merges).
 
     Parity: ``fused_sp_ag_attn_intra_node``
     (``sp_ag_attention_intra_node.py:432``).
@@ -173,13 +178,14 @@ def sp_ag_attention(
         raise ValueError(f"s_loc={s_loc} not divisible by block_q={bq}")
     kv = jnp.stack([k, v])  # [2, hkv, s_loc, hd]
 
-    out, _ws = comm_pallas_call(
+    out, lse, _ws = comm_pallas_call(
         functools.partial(
             _sp_ag_attn_kernel,
             axis=axis, group=hq // hkv, sm_scale=sm_scale, bq=bq,
         ),
         (
             jax.ShapeDtypeStruct((hq, s_loc, hd), q.dtype),
+            jax.ShapeDtypeStruct((hq, s_loc, 1), jnp.float32),
             jax.ShapeDtypeStruct((n, 2, hkv, s_loc, hd), k.dtype),
         ),
         grid=(hq, s_loc // bq, n),
@@ -189,6 +195,7 @@ def sp_ag_attention(
         ],
         out_specs=(
             pl.BlockSpec((1, bq, hd), lambda h, qb, r: (h, qb, 0)),
+            pl.BlockSpec((1, bq, 1), lambda h, qb, r: (h, qb, 0)),
             pl.BlockSpec(memory_space=pl.ANY),
         ),
         scratch_shapes=[
@@ -206,4 +213,97 @@ def sp_ag_attention(
         dimension_semantics=("arbitrary", "arbitrary", "arbitrary"),
         ctx=ctx,
     )(q, kv)
-    return out
+    return (out, lse[..., 0]) if return_lse else out
+
+
+def sp_ag_attention_2level(
+    q: jax.Array,  # [hq, s_loc, hd] — this device's q shard
+    k: jax.Array,  # [hkv, s_loc, hd]
+    v: jax.Array,
+    *,
+    inner_axis: str = "sp",
+    outer_axis: str = "dcn",
+    sm_scale: float | None = None,
+    block_q: int = 256,
+    ctx=None,
+) -> jax.Array:
+    """Two-level causal SP attention: sequence sharded over
+    ``(outer_axis, inner_axis)`` in rank order — slices over DCN, ranks
+    within a slice over ICI.
+
+    Parity: ``fused_sp_ag_attn_inter_node``
+    (``sp_ag_attention_inter_node.py:115,504``) — there the intra-node
+    gather rides NVSHMEM while inter-node chunks arrive over IB. TPU
+    redesign: the intra-slice half runs the fused one-kernel Pallas
+    gather+attention (ICI); the inter-slice half attends the q shard
+    over earlier slices' KV gathered with XLA collectives (DCN), and the
+    two partial softmaxes merge by log-sum-exp — the reference's
+    combine step (``flash_decode.py:482`` pattern) at slice granularity.
+    """
+    n_out = jax.lax.axis_size(outer_axis)
+    me_out = jax.lax.axis_index(outer_axis)
+    hq, s_loc, hd = q.shape
+    hkv = k.shape[0]
+    g = hq // hkv
+    if sm_scale is None:
+        sm_scale = hd**-0.5
+
+    # Intra-slice: fused Pallas kernel over the ICI axis.
+    o_intra, lse_intra = sp_ag_attention(
+        q, k, v, axis=inner_axis, sm_scale=sm_scale, block_q=block_q,
+        return_lse=True, ctx=ctx,
+    )
+    o_intra = o_intra.astype(jnp.float32)
+    if n_out == 1:
+        return o_intra.astype(q.dtype)
+
+    # Inter-slice: earlier slices are fully visible (causal order). KV
+    # is gathered slice-major over both axes with XLA collectives (the
+    # DCN leg — the reference's inter-node buffer likewise holds the
+    # gathered sequence, sp_ag_attention_inter_node.py:115), then the
+    # online softmax streams slice by slice: score memory stays
+    # O(g·s_loc × s_slice) instead of one dense matrix over the global
+    # sequence, and the fori upper bound is me_out, so slice 0 does no
+    # masked busywork.
+    k_slice = jax.lax.all_gather(k, inner_axis, axis=1, tiled=True)
+    v_slice = jax.lax.all_gather(v, inner_axis, axis=1, tiled=True)
+    k_all = jax.lax.all_gather(k_slice, outer_axis)  # [n_out, hkv, s_sl, hd]
+    v_all = jax.lax.all_gather(v_slice, outer_axis)
+    s_slice = k_slice.shape[1]
+
+    qg = q.reshape(hkv, g * s_loc, hd).astype(jnp.float32)
+    rows = g * s_loc
+
+    def slice_step(r, carry):
+        m, l, acc = carry
+        kr = k_all[r].astype(jnp.float32)  # [hkv, s_slice, hd]
+        vr = v_all[r].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            qg, kr, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale  # [hkv, g*s_loc, s_slice]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, vr, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l, acc
+
+    m0 = jnp.full((hkv, rows, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((hkv, rows, 1), jnp.float32)
+    a0 = jnp.zeros((hkv, rows, hd), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, me_out, slice_step, (m0, l0, a0))
+    o_prev = (acc / jnp.maximum(l, 1e-30)).reshape(hq, s_loc, hd)
+    lse_prev = (m + jnp.log(jnp.maximum(l, 1e-30))).reshape(hq, s_loc)
+
+    from triton_distributed_tpu.ops.attention.flash_decode import lse_combine
+
+    o, _ = lse_combine(
+        jnp.stack([o_intra, o_prev]),
+        jnp.stack([lse_intra, lse_prev]),
+        part_axis=0,
+    )
+    return o.astype(q.dtype)

@@ -39,7 +39,7 @@ def main():
         _, cache = step_gold(model.params, jnp.asarray(toks, jnp.int32), cache)
 
     mega = MegaQwen3(model, policy=SchedulePolicy.ZIG_ZAG)
-    compiled, _ = mega.build(B, 64)
+    compiled, _, _ = mega.build(B, 64)
     counts = {}
     for t in compiled.order:
         counts[t.task_type.name] = counts.get(t.task_type.name, 0) + 1
