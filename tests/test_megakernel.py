@@ -130,3 +130,79 @@ class TestMegaQwen3:
         types = {t.task_type for t in compiled.order}
         assert TaskType.ALLREDUCE in types and TaskType.ATTN in types
         assert compiled.order[0].task_type == TaskType.BARRIER
+
+
+class TestMegaPaged:
+    @pytest.mark.parametrize("s_max", [64, 128])  # 128: pick_tile's 128
+    # floor must not widen s_blk past the 16-wide page
+    def test_decode_parity_paged(self, ctx4, s_max):
+        """Megakernel over a paged pool (table-indexed block DMAs) vs
+        the dense XLA golden (parity: reference megakernel paged decode,
+        mega_triton_kernel/models/paged_kv_cache.py)."""
+        from triton_distributed_tpu.models.paged_kv_cache import (
+            as_dense,
+            init_paged_cache,
+            write_prefill,
+        )
+
+        model = AutoLLM.from_pretrained("tiny", ctx=ctx4)
+        B, page = 2, 16
+
+        # Golden path: dense cache, a few decode steps for context.
+        cache = model.new_cache(B, max_length=s_max)
+        step_gold = model.decode_fn("xla")
+        toks = jnp.asarray([[3, 5], [7, 11], [13, 17]], jnp.int32)
+        for i in range(toks.shape[0]):
+            _, cache = step_gold(model.params, toks[i], cache)
+
+        # Mirror that context into pages (one write_prefill per row).
+        paged, _pool = init_paged_cache(
+            model.cfg, B, ctx4, max_length=s_max, page_size=page
+        )
+        for b in range(B):
+            row = jax.tree.map(lambda x: x[:, b:b + 1], 
+                               {"k": cache.k, "v": cache.v})
+            paged = write_prefill(
+                paged, b, row["k"], row["v"], int(cache.kv_len[b])
+            )
+
+        tok = jnp.asarray([19, 23], jnp.int32)
+        logits_gold, cache_gold = step_gold(model.params, tok, cache)
+
+        mega = MegaQwen3(model)
+        logits_mega, paged_out = mega.decode_step(tok, paged)
+
+        np.testing.assert_allclose(
+            np.asarray(logits_mega), np.asarray(logits_gold),
+            rtol=2e-3, atol=2e-3,
+        )
+        k_dense, v_dense = as_dense(paged_out)
+        np.testing.assert_allclose(
+            np.asarray(k_dense), np.asarray(cache_gold.k),
+            rtol=2e-3, atol=2e-3,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(paged_out.kv_len), np.asarray(cache_gold.kv_len)
+        )
+
+    def test_paged_decode_fn_qwen(self, ctx4):
+        """Model-level paged decode (paged_flash_decode path) matches
+        the dense decode step."""
+        model = AutoLLM.from_pretrained("tiny", ctx=ctx4)
+        from triton_distributed_tpu.models.paged_kv_cache import (
+            init_paged_cache,
+        )
+
+        B = 2
+        cache = model.new_cache(B, max_length=64)
+        paged, _pool = init_paged_cache(
+            model.cfg, B, ctx4, max_length=64, page_size=16
+        )
+        toks = jnp.asarray([[3, 5], [7, 11], [19, 23]], jnp.int32)
+        for i in range(toks.shape[0]):
+            logits_d, cache = model.decode_step(toks[i], cache, "xla")
+            logits_p, paged = model.decode_step(toks[i], paged, "xla")
+            np.testing.assert_allclose(
+                np.asarray(logits_p), np.asarray(logits_d),
+                rtol=2e-3, atol=2e-3,
+            )

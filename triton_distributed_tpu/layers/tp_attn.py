@@ -179,6 +179,65 @@ def tp_attn_decode(
     return out, k_cache, v_cache
 
 
+def tp_attn_decode_paged(
+    params: TPAttnParams,
+    x: jax.Array,          # [B, d] replicated — one new token per sequence
+    k_pages: jax.Array,    # [P, hkv_loc, page, hd] — this layer's pool shard
+    v_pages: jax.Array,
+    page_table: jax.Array,  # [B, pages_per_seq] int32
+    kv_len: jax.Array,      # [B] int32
+    dims: TPAttnDims,
+    *,
+    axis: str = "tp",
+    mode: Mode = "pallas_ar",
+    ctx: DistContext | None = None,
+):
+    """Per-shard decode step over a paged KV pool (inside ``shard_map``).
+
+    Same dataflow as :func:`tp_attn_decode`, but the cache is the page
+    pool: the append scatters through the page table and the attention
+    is :func:`paged_flash_decode` (table-indexed BlockSpecs — no dense
+    gather). Parity: the reference megakernel's paged decode
+    (``mega_triton_kernel/models/paged_kv_cache.py``).
+    """
+    from triton_distributed_tpu.ops.attention import paged_flash_decode
+
+    b = x.shape[0]
+    page = k_pages.shape[2]
+    qkv = jnp.dot(x, params.wqkv, preferred_element_type=jnp.float32).astype(
+        x.dtype
+    )
+    q, k, v = dims.split_qkv(qkv)  # [B, h, hd]
+    q = _rms_head(q, params.q_norm)
+    k = _rms_head(k, params.k_norm)
+    q = apply_rope(q, kv_len[:, None], dims.rope_theta)
+    k = apply_rope(k, kv_len[:, None], dims.rope_theta)
+
+    def upd(pages, new):  # pages [P, h, page, hd], new [B, h, hd]
+        for i in range(b):
+            pos = kv_len[i]
+            pid = page_table[i, pos // page]
+            pages = jax.lax.dynamic_update_slice(
+                pages, new[i][None, :, None, :].astype(pages.dtype),
+                (pid, 0, pos % page, 0),
+            )
+        return pages
+
+    k_pages = upd(k_pages, k)
+    v_pages = upd(v_pages, v)
+
+    o = paged_flash_decode(q, k_pages, v_pages, page_table, kv_len + 1)
+    o_flat = o.reshape(b, dims.hq_loc * dims.head_dim).astype(x.dtype)
+    if mode in ("xla", "xla_ar"):
+        part = jnp.dot(o_flat, params.wo, preferred_element_type=jnp.float32)
+        out = jax.lax.psum(part.astype(x.dtype), axis)
+    elif mode in ("pallas", "pallas_ar"):
+        out = gemm_ar(o_flat, params.wo, axis=axis, ctx=ctx)
+    else:
+        raise ValueError(f"unknown mode {mode!r}")
+    return out, k_pages, v_pages
+
+
 class TPAttn:
     """Host-level layer (parity: ``TP_Attn``, ``layers/nvidia/tp_attn.py:78``)."""
 

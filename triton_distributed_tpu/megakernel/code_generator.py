@@ -45,6 +45,11 @@ class MegaDims:
     n_ranks: int
     rms_eps: float = 1e-6
     rope_theta: float = 1e6
+    # Paged-KV mode: page size (0 = dense cache). When set, the KV
+    # inputs are page pools [L, P, hkv, page, hd], a page table rides as
+    # a scalar-prefetch operand, and the attention block size is the
+    # page size (parity: reference paged_kv_cache.py).
+    page: int = 0
 
     @property
     def qkv_loc(self) -> int:
@@ -71,7 +76,9 @@ class MegaConfig:
             tn_lm=pick_tile(dims.v_loc, self.tile_n),
             tk_o=pick_tile(dims.o_k, self.tile_k),
             tk_fc2=pick_tile(dims.f_loc, self.tile_k),
-            s_blk=pick_tile(dims.s_max, self.s_blk),
+            # Paged mode: the KV block IS the page — pick_tile's 128
+            # floor must not widen it past the page size.
+            s_blk=dims.page or pick_tile(dims.s_max, self.s_blk),
         )
 
 
@@ -111,6 +118,7 @@ class KernelCtx:
         self.layer: Any = None
         self.arg0: Any = None
         self.arg1: Any = None
+        self.table: Any = None  # page table (paged mode only)
 
 
 def make_mega_kernel(
@@ -129,18 +137,28 @@ def make_mega_kernel(
 
     def kernel(
         task_tab, kv_len, tokens,                      # scalar prefetch
-        embed, wqkv, wo, w1, w2, lm_head,              # ANY (HBM)
-        ln1, ln2, normf, qn, kn,                       # VMEM (small)
-        kc, vc,                                        # ANY (read-only)
-        logits, knew_out, vnew_out,                    # outputs
-        x, h, qkv, ao, mlp, estage,                    # VMEM state
-        colstage, rowstage, kstage, vstage,            # weight/KV staging
-        arsrc, cbuf,                                   # AR staging
-        wsem, esem, osem, ksem, vsem, arsend, arrecv,  # DMA semaphores
+        *rest,
     ):
+        # Paged mode inserts the page table as a 4th scalar-prefetch
+        # operand; the array operand order is otherwise identical.
+        if dims.page:
+            page_tab, *rest = rest
+        else:
+            page_tab = None
+        (
+            embed, wqkv, wo, w1, w2, lm_head,              # ANY (HBM)
+            ln1, ln2, normf, qn, kn,                       # VMEM (small)
+            kc, vc,                                        # ANY (read-only)
+            logits, knew_out, vnew_out,                    # outputs
+            x, h, qkv, ao, mlp, estage,                    # VMEM state
+            colstage, rowstage, kstage, vstage,            # weight/KV staging
+            arsrc, cbuf,                                   # AR staging
+            wsem, esem, osem, ksem, vsem, arsend, arrecv,  # DMA semaphores
+        ) = rest
         step = pl.program_id(0)
         kctx.kv_len = kv_len
         kctx.tokens = tokens
+        kctx.table = page_tab
         kctx.embed, kctx.wqkv, kctx.wo = embed, wqkv, wo
         kctx.w1, kctx.w2, kctx.lm_head = w1, w2, lm_head
         kctx.ln1, kctx.ln2, kctx.normf = ln1, ln2, normf
@@ -195,7 +213,7 @@ def build_mega_call(
     hkv, hd = dims.hkv_loc, dims.head_dim
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=4 if dims.page else 3,
         grid=(len(tasks),),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 6
         + [pl.BlockSpec(memory_space=pltpu.VMEM)] * 5
@@ -267,11 +285,19 @@ def build_mega_call(
         interpret=interpret_mode(ctx),
     )
 
-    def run(kv_len, tokens, embed, wqkv, wo, w1, w2, lm_head,
-            ln1, ln2, normf, qn, kn, kc, vc):
-        return call(
-            table, kv_len, tokens, embed, wqkv, wo, w1, w2, lm_head,
-            ln1, ln2, normf, qn, kn, kc, vc,
-        )
+    if dims.page:
+        def run(kv_len, tokens, page_table, embed, wqkv, wo, w1, w2,
+                lm_head, ln1, ln2, normf, qn, kn, kc, vc):
+            return call(
+                table, kv_len, tokens, page_table, embed, wqkv, wo, w1, w2,
+                lm_head, ln1, ln2, normf, qn, kn, kc, vc,
+            )
+    else:
+        def run(kv_len, tokens, embed, wqkv, wo, w1, w2, lm_head,
+                ln1, ln2, normf, qn, kn, kc, vc):
+            return call(
+                table, kv_len, tokens, embed, wqkv, wo, w1, w2, lm_head,
+                ln1, ln2, normf, qn, kn, kc, vc,
+            )
 
     return run

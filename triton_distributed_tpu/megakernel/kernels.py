@@ -292,21 +292,44 @@ def attn_body(kctx):
             maxpos = jnp.maximum(maxpos, pos[b])
         nblk = maxpos // sblk + 1  # blocks overlapping [0, maxpos]
 
-        def kv_copy(j, slot):
-            return (
-                pltpu.make_async_copy(
-                    kctx.kc.at[layer, :, :, pl.ds(j * sblk, sblk), :],
-                    kctx.kstage.at[slot], kctx.ksem.at[slot],
-                ),
-                pltpu.make_async_copy(
-                    kctx.vc.at[layer, :, :, pl.ds(j * sblk, sblk), :],
-                    kctx.vstage.at[slot], kctx.vsem.at[slot],
-                ),
-            )
+        # Dense: one DMA per buffer covering all (b, h) for the block.
+        # Paged (kctx.table set): block j of row b is pool page
+        # table[b, j] — one [hkv, page, hd] DMA per batch row, with
+        # s_blk == page_size (enforced by MegaQwen3.build).
+        def kv_dmas(j, slot):
+            if kctx.table is None:
+                return [
+                    pltpu.make_async_copy(
+                        kctx.kc.at[layer, :, :, pl.ds(j * sblk, sblk), :],
+                        kctx.kstage.at[slot], kctx.ksem.at[slot],
+                    ),
+                    pltpu.make_async_copy(
+                        kctx.vc.at[layer, :, :, pl.ds(j * sblk, sblk), :],
+                        kctx.vstage.at[slot], kctx.vsem.at[slot],
+                    ),
+                ]
+            dmas = []
+            for b in range(B):
+                pid = kctx.table[b, j]
+                dmas.append(pltpu.make_async_copy(
+                    kctx.kc.at[layer, pid],
+                    kctx.kstage.at[slot, b], kctx.ksem.at[slot],
+                ))
+                dmas.append(pltpu.make_async_copy(
+                    kctx.vc.at[layer, pid],
+                    kctx.vstage.at[slot, b], kctx.vsem.at[slot],
+                ))
+            return dmas
 
-        kc0, vc0 = kv_copy(0, 0)
-        kc0.start()
-        vc0.start()
+        def kv_start(j, slot):
+            for dma in kv_dmas(j, slot):
+                dma.start()
+
+        def kv_wait(j, slot):
+            for dma in kv_dmas(j, slot):
+                dma.wait()
+
+        kv_start(0, 0)
 
         neg = jnp.float32(-1e30)
         nt = (((1,), (1,)), ((), ()))  # q [g, hd] · k [sblk, hd]ᵀ
@@ -324,13 +347,9 @@ def attn_body(kctx):
 
             @pl.when(j + 1 < nblk)
             def _prefetch():
-                kn_, vn_ = kv_copy(j + 1, 1 - slot)
-                kn_.start()
-                vn_.start()
+                kv_start(j + 1, 1 - slot)
 
-            kc_, vc_ = kv_copy(j, slot)
-            kc_.wait()
-            vc_.wait()
+            kv_wait(j, slot)
             idx = j * sblk + jax.lax.broadcasted_iota(jnp.int32, (1, sblk), 1)
 
             out = []

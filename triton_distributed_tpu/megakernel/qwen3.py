@@ -12,6 +12,8 @@ and sharding, so the megakernel is a drop-in third decode mode next to
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax
 from jax.sharding import PartitionSpec as P
 
@@ -19,6 +21,11 @@ from triton_distributed_tpu.megakernel.code_generator import MegaConfig, MegaDim
 from triton_distributed_tpu.megakernel.model_builder import ModelBuilder
 from triton_distributed_tpu.megakernel.scheduler import SchedulePolicy
 from triton_distributed_tpu.models.kv_cache import KVCache, cache_specs
+from triton_distributed_tpu.models.paged_kv_cache import (
+    PagedKVCache,
+    paged_cache_specs,
+)
+from triton_distributed_tpu.models import paged_kv_cache as _paged
 from triton_distributed_tpu.models.qwen import Qwen3, Qwen3Params
 
 
@@ -39,7 +46,7 @@ class MegaQwen3:
         self.policy = policy
         self._jit: dict = {}
 
-    def _dims(self, batch: int, s_max: int) -> MegaDims:
+    def _dims(self, batch: int, s_max: int, page: int = 0) -> MegaDims:
         m = self.model
         c = m.cfg
         n = m.ctx.axis_size(m.axis)
@@ -56,15 +63,21 @@ class MegaQwen3:
             n_ranks=n,
             rms_eps=c.rms_eps,
             rope_theta=c.rope_theta,
+            page=page,
         )
 
-    def build(self, batch: int, s_max: int):
+    def build(self, batch: int, s_max: int, page: int = 0):
         """Build + schedule the task graph and jit the SPMD step
-        (parity: ``Qwen3Model.build_fwd`` + ``compile``)."""
+        (parity: ``Qwen3Model.build_fwd`` + ``compile``). ``page`` > 0
+        builds the paged-cache variant (KV read through the page table,
+        attention block size = page size)."""
         m = self.model
-        dims = self._dims(batch, s_max)
+        dims = self._dims(batch, s_max, page)
+        cfg = self.cfg
+        if page:
+            cfg = dataclasses.replace(cfg, s_blk=page)
         mb = ModelBuilder(
-            dims, cfg=self.cfg, axis=m.axis, ctx=m.ctx,
+            dims, cfg=cfg, axis=m.axis, ctx=m.ctx,
             wdtype=m.cfg.dtype, cdtype=m.cfg.dtype,
         )
         mb.build_decoder_graph()
@@ -72,7 +85,7 @@ class MegaQwen3:
         per_shard = compiled.per_shard
         ax = m.axis
 
-        def shard_fn(params: Qwen3Params, tokens, cache: KVCache):
+        def kernel_args(params: Qwen3Params):
             lp = params.layers
             V, d = params.embed.shape
             if V % 8:
@@ -84,56 +97,84 @@ class MegaQwen3:
             # Mosaic only allows dynamic indices on untiled leading
             # dims (a dynamic sublane slice of a [L, d] ref needs a
             # statically 8-aligned index it can't prove).
-            logits, k_rows, v_rows = per_shard(
-                cache.kv_len, tokens,
+            return (
                 params.embed.reshape(V // 8, 8, d),
                 lp.attn.wqkv, lp.attn.wo, lp.mlp.w1, lp.mlp.w2,
                 params.lm_head,
                 lp.ln1[:, None, :], lp.ln2[:, None, :], params.norm[None, :],
                 lp.attn.q_norm[:, None, :], lp.attn.k_norm[:, None, :],
-                cache.k, cache.v,
             )
-            # Append the new rows [L, B, hkv, hd] at each row's position
-            # — one dynamic_update_slice per batch row; XLA updates the
-            # donated cache in place (the kernel cannot: a one-row write
-            # at a dynamic offset in a tiled cache plane is an unaligned
-            # slice Mosaic rejects).
-            k_new, v_new = cache.k, cache.v
-            B = tokens.shape[0]
-            for b in range(B):
-                at = (0, b, 0, cache.kv_len[b], 0)
-                k_new = jax.lax.dynamic_update_slice(
-                    k_new, k_rows[:, b, :, None, :][:, None], at
+
+        if page:
+            def shard_fn(params: Qwen3Params, tokens, cache: PagedKVCache):
+                logits, k_rows, v_rows = per_shard(
+                    cache.kv_len, tokens, cache.page_table,
+                    *kernel_args(params), cache.k_pages, cache.v_pages,
                 )
-                v_new = jax.lax.dynamic_update_slice(
-                    v_new, v_rows[:, b, :, None, :][:, None], at
+                # Page-table append of the new rows [L, B, hkv, hd]
+                # (the kernel never writes the pool — same reasoning as
+                # the dense path below).
+                return logits, _paged.append(cache, k_rows, v_rows)
+
+            specs = paged_cache_specs(ax)
+        else:
+            def shard_fn(params: Qwen3Params, tokens, cache: KVCache):
+                logits, k_rows, v_rows = per_shard(
+                    cache.kv_len, tokens,
+                    *kernel_args(params), cache.k, cache.v,
                 )
-            return logits, KVCache(k=k_new, v=v_new, kv_len=cache.kv_len + 1)
+                # Append the new rows [L, B, hkv, hd] at each row's
+                # position — one dynamic_update_slice per batch row; XLA
+                # updates the donated cache in place (the kernel cannot:
+                # a one-row write at a dynamic offset in a tiled cache
+                # plane is an unaligned slice Mosaic rejects).
+                k_new, v_new = cache.k, cache.v
+                B = tokens.shape[0]
+                for b in range(B):
+                    at = (0, b, 0, cache.kv_len[b], 0)
+                    k_new = jax.lax.dynamic_update_slice(
+                        k_new, k_rows[:, b, :, None, :][:, None], at
+                    )
+                    v_new = jax.lax.dynamic_update_slice(
+                        v_new, v_rows[:, b, :, None, :][:, None], at
+                    )
+                return logits, KVCache(
+                    k=k_new, v=v_new, kv_len=cache.kv_len + 1
+                )
+
+            specs = cache_specs(ax)
 
         f = m.ctx.shard_map(
             shard_fn,
-            in_specs=(m.param_specs, P(), cache_specs(ax)),
-            out_specs=(P(None, ax), cache_specs(ax)),
+            in_specs=(m.param_specs, P(), specs),
+            out_specs=(P(None, ax), specs),
         )
         step = jax.jit(f, donate_argnums=(2,))
         return compiled, step, f
 
-    def _built(self, batch: int, s_max: int):
-        key = (batch, s_max)
+    def _built(self, batch: int, s_max: int, page: int = 0):
+        key = (batch, s_max, page)
         if key not in self._jit:
             self._jit[key] = self.build(*key)
         return self._jit[key]
 
-    def decode_step(self, tokens: jax.Array, cache: KVCache):
+    def decode_step(self, tokens: jax.Array, cache):
         """One decode step for the whole batch: ``tokens [B] int32 →
         (logits [B, V] f32, cache)`` — the megakernel rung of the decode
-        ladder."""
-        step = self._built(int(tokens.shape[0]), int(cache.k.shape[3]))[1]
+        ladder. Accepts a dense :class:`KVCache` or a
+        :class:`PagedKVCache` (pool read through the page table)."""
+        b = int(tokens.shape[0])
+        if isinstance(cache, PagedKVCache):
+            page = int(cache.k_pages.shape[3])
+            s_max = int(cache.page_table.shape[1]) * page
+            step = self._built(b, s_max, page)[1]
+        else:
+            step = self._built(b, int(cache.k.shape[3]))[1]
         return step(self.model.params, tokens, cache)
 
-    def decode_fn(self, batch: int, s_max: int):
+    def decode_fn(self, batch: int, s_max: int, page: int = 0):
         """The raw (unjitted) step ``f(params, tokens, cache) →
         (logits, cache)`` — same contract as ``Qwen3.decode_fn``, so
         callers can chain steps inside one jit (``lax.fori_loop`` greedy
         decode) instead of dispatching per step."""
-        return self._built(batch, s_max)[2]
+        return self._built(batch, s_max, page)[2]

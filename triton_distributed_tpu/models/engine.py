@@ -36,6 +36,8 @@ class Engine:
         mode: Mode = "xla",
         verbose: bool = False,
         seed: int = 0,
+        paged: bool = False,
+        page_size: int = 128,
     ):
         self.model = model
         self.temperature = temperature
@@ -44,6 +46,14 @@ class Engine:
         self.verbose = verbose
         self.key = jax.random.key(seed)
         self.last_stats: dict = {}
+        # Paged serving (parity: the reference megakernel's page-pool
+        # cache): prefill runs dense per sequence, pages are scattered
+        # through the table, decode attends the pool directly.
+        self.paged = paged
+        self.page_size = page_size
+        # Page-pool free list, populated by the first paged serve();
+        # continuous-batching admission/eviction draws from it.
+        self._pool = None
 
     def _sample(self, logits: jax.Array) -> jax.Array:
         if self.temperature <= 0.0:
@@ -87,20 +97,43 @@ class Engine:
                 f"{starts.tolist()}"
             )
         max_length = max_length or self.model.cfg.max_length
-        cache = self.model.new_cache(b, max_length)
 
         # Prefill per sequence (parity: engine prefill loop), collecting
         # each sequence's last-token logits.
         t0 = time.perf_counter()
-        last_logits = []
-        for i in range(b):
-            row = np.roll(input_ids[i], -int(starts[i]))  # pads → right
-            logits_i, cache_i = self.model.prefill(
-                jnp.asarray(row), _take_batch(cache, i), self.mode,
-                true_len=int(s - starts[i]),
+        if self.paged:
+            from triton_distributed_tpu.models.paged_kv_cache import (
+                init_paged_cache,
+                write_prefill,
             )
-            cache = _put_batch(cache, cache_i, i)
-            last_logits.append(logits_i)
+
+            cache, self._pool = init_paged_cache(
+                self.model.cfg, b, self.model.ctx, self.model.axis,
+                max_length=max_length, page_size=self.page_size,
+            )
+            # One dense scratch sequence, reused per row then scattered
+            # into pages.
+            dense1 = self.model.new_cache(1, max_length)
+            last_logits = []
+            for i in range(b):
+                row = np.roll(input_ids[i], -int(starts[i]))
+                true_len = int(s - starts[i])
+                logits_i, filled = self.model.prefill(
+                    jnp.asarray(row), dense1, self.mode, true_len=true_len
+                )
+                cache = write_prefill(cache, i, filled.k, filled.v, true_len)
+                last_logits.append(logits_i)
+        else:
+            cache = self.model.new_cache(b, max_length)
+            last_logits = []
+            for i in range(b):
+                row = np.roll(input_ids[i], -int(starts[i]))  # pads → right
+                logits_i, cache_i = self.model.prefill(
+                    jnp.asarray(row), _take_batch(cache, i), self.mode,
+                    true_len=int(s - starts[i]),
+                )
+                cache = _put_batch(cache, cache_i, i)
+                last_logits.append(logits_i)
         logits = jnp.stack(last_logits)  # [B, V]
         t_prefill = time.perf_counter() - t0
 

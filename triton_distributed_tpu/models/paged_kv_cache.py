@@ -92,6 +92,64 @@ def init_paged_cache(
     return cache, pool
 
 
+def paged_cache_specs(axis: str = "tp"):
+    """shard_map PartitionSpecs matching :func:`init_paged_cache`."""
+    from jax.sharding import PartitionSpec as P
+
+    return PagedKVCache(
+        k_pages=P(None, None, axis, None, None),
+        v_pages=P(None, None, axis, None, None),
+        page_table=P(),
+        kv_len=P(),
+    )
+
+
+def write_prefill(
+    cache: PagedKVCache,
+    b_idx: int,
+    k_dense: jax.Array,  # [L, 1, Hkv_loc, S, hd] — one filled sequence
+    v_dense: jax.Array,
+    true_len: int,
+) -> PagedKVCache:
+    """Scatter a dense-prefilled sequence into its pages (host-level;
+    pages are contiguous S tiles so each page is one slice copy).
+    ``true_len`` is a static prompt length; ceil(true_len/page) pages
+    are written (the dense source must cover that many positions)."""
+    page = cache.k_pages.shape[3]
+    npages = -(-int(true_len) // page)
+    if k_dense.shape[3] < npages * page:
+        raise ValueError(
+            f"dense prefill holds {k_dense.shape[3]} positions; "
+            f"{npages * page} needed for true_len={true_len}"
+        )
+
+    row = cache.page_table[b_idx]
+    return PagedKVCache(
+        k_pages=_scatter_jit(cache.k_pages, k_dense, row, npages, page),
+        v_pages=_scatter_jit(cache.v_pages, v_dense, row, npages, page),
+        page_table=cache.page_table,
+        kv_len=cache.kv_len.at[b_idx].set(jnp.asarray(true_len, jnp.int32)),
+    )
+
+
+def _scatter(pages, dense, table_row, npages: int, page: int):
+    for j in range(npages):
+        pid = table_row[j]
+        chunk = jax.lax.dynamic_slice_in_dim(
+            dense, j * page, page, axis=3
+        )[:, 0][:, None]
+        pages = jax.lax.dynamic_update_slice(
+            pages, chunk.astype(pages.dtype), (0, pid, 0, 0, 0)
+        )
+    return pages
+
+
+# Donated + jitted: the page-by-page scatter updates the pool in place;
+# eager dynamic_update_slices would copy the whole (GB-scale) pool once
+# per page.
+_scatter_jit = jax.jit(_scatter, static_argnums=(3, 4), donate_argnums=(0,))
+
+
 def append(
     cache: PagedKVCache,
     k_new: jax.Array,  # [L, B, Hkv_loc, hd] — one token per sequence
@@ -127,14 +185,13 @@ def as_dense(cache: PagedKVCache, layer=None):
     """Materialize contiguous ``[L?, B, Hkv_loc, S_max, hd]`` views by
     gathering pages through the table (decode feeds this to
     ``flash_decode``; the page gather is a take on the page axis)."""
+    from triton_distributed_tpu.ops.attention.flash_decode import (
+        pages_to_dense,
+    )
+
     kp = cache.k_pages if layer is None else cache.k_pages[layer]
     vp = cache.v_pages if layer is None else cache.v_pages[layer]
-
-    def gather(pages):
-        # pages [..., P, H, page, hd]; table [B, pps] → [..., B, H, S, hd]
-        g = jnp.take(pages, cache.page_table, axis=-4)  # [..., B, pps, H, pg, hd]
-        g = jnp.swapaxes(g, -4, -3)                     # [..., B, H, pps, pg, hd]
-        s = g.shape
-        return g.reshape(*s[:-3], s[-3] * s[-2], s[-1])
-
-    return gather(kp), gather(vp)
+    return (
+        pages_to_dense(kp, cache.page_table),
+        pages_to_dense(vp, cache.page_table),
+    )

@@ -28,6 +28,7 @@ from triton_distributed_tpu.layers.tp_attn import (
     TPAttnDims,
     TPAttnParams,
     tp_attn_decode,
+    tp_attn_decode_paged,
     tp_attn_prefill,
 )
 from triton_distributed_tpu.layers.tp_mlp import TPMLPParams, tp_mlp_fwd
@@ -204,6 +205,43 @@ class Qwen3:
         logits = self._logits(params, x)
         return logits, KVCache(k=k_new, v=v_new, kv_len=cache.kv_len + 1)
 
+    def _decode_shard_paged(self, params, tokens, cache, *, mode: Mode):
+        """One decode step over a :class:`PagedKVCache`, per-shard.
+
+        Same layer scan as :meth:`_decode_shard`, but the attention
+        appends through the page table and reads the pool directly
+        (``paged_flash_decode``). Parity: the reference megakernel's
+        paged decode (``mega_triton_kernel/models/paged_kv_cache.py``).
+        """
+        from triton_distributed_tpu.models.paged_kv_cache import PagedKVCache
+
+        cfg = self.cfg
+        x = self._embed(params, tokens)
+        ar = "pallas_ar" if mode == "pallas" else "xla_ar"
+
+        def layer_fn(carry, inp):
+            x = carry
+            lp, kp, vp = inp  # kp/vp: [P, hkv_loc, page, hd] layer pool
+            h = rms_norm(x, lp.ln1, cfg.rms_eps)
+            a, kp, vp = tp_attn_decode_paged(
+                lp.attn, h, kp, vp, cache.page_table, cache.kv_len,
+                self.dims, axis=self.axis, mode=ar, ctx=self.ctx,
+            )
+            x = x + a
+            h = rms_norm(x, lp.ln2, cfg.rms_eps)
+            x = x + self._mlp_fwd(lp.mlp, h, ar)
+            return x, (kp, vp)
+
+        x, (k_new, v_new) = jax.lax.scan(
+            layer_fn, x, (params.layers, cache.k_pages, cache.v_pages)
+        )
+        x = rms_norm(x, params.norm, cfg.rms_eps)
+        logits = self._logits(params, x)
+        return logits, PagedKVCache(
+            k_pages=k_new, v_pages=v_new,
+            page_table=cache.page_table, kv_len=cache.kv_len + 1,
+        )
+
     def _prefill_shard(
         self, params, tokens, cache: KVCache, true_len, *, mode: Mode
     ):
@@ -269,15 +307,33 @@ class Qwen3:
             out_specs=(P(), cache_specs(self.axis)),
         )
 
-    def decode_step(self, tokens: jax.Array, cache: KVCache, mode: Mode = "xla"):
+    def decode_fn_paged(self, mode: Mode = "xla"):
+        """Paged-cache analog of :meth:`decode_fn`:
+        ``(params, tokens, PagedKVCache) → (logits, PagedKVCache)``."""
+        from triton_distributed_tpu.models.paged_kv_cache import (
+            paged_cache_specs,
+        )
+
+        return self.ctx.shard_map(
+            functools.partial(self._decode_shard_paged, mode=mode),
+            in_specs=(self.param_specs, P(), paged_cache_specs(self.axis)),
+            out_specs=(P(), paged_cache_specs(self.axis)),
+        )
+
+    def decode_step(self, tokens: jax.Array, cache, mode: Mode = "xla"):
         """Jitted one-token step for the whole batch (CUDA-graph analog).
-        ``tokens [B]`` int32 → ``(logits [B, V] f32, cache)``."""
-        if mode not in self._decode_jit:
-            f = self.decode_fn(mode)
-            self._decode_jit[mode] = jax.jit(
+        ``tokens [B]`` int32 → ``(logits [B, V] f32, cache)``. Accepts a
+        dense :class:`KVCache` or a :class:`PagedKVCache`."""
+        from triton_distributed_tpu.models.paged_kv_cache import PagedKVCache
+
+        paged = isinstance(cache, PagedKVCache)
+        key = (mode, "paged") if paged else mode
+        if key not in self._decode_jit:
+            f = self.decode_fn_paged(mode) if paged else self.decode_fn(mode)
+            self._decode_jit[key] = jax.jit(
                 lambda p, t, c: f(p, t, c), donate_argnums=(2,)
             )
-        return self._decode_jit[mode](self.params, tokens, cache)
+        return self._decode_jit[key](self.params, tokens, cache)
 
     def prefill(
         self,

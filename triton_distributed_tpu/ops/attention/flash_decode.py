@@ -174,6 +174,114 @@ def flash_decode(
     return o
 
 
+def paged_flash_decode(
+    q: jax.Array,        # [B, Hq, D]
+    k_pages: jax.Array,  # [P, Hkv, page, D] — page pool (one layer)
+    v_pages: jax.Array,
+    page_table: jax.Array,  # [B, pages_per_seq] int32
+    kv_len: jax.Array,      # [B] int32 — valid context length
+    *,
+    sm_scale: float | None = None,
+    return_lse: bool = False,
+    interpret=None,
+):
+    """Single-token GQA decode attention straight over a paged KV pool.
+
+    Parity: the reference megakernel's paged decode
+    (``mega_triton_kernel/models/paged_kv_cache.py:58`` + its attention
+    task reading through the page table). TPU design: the page table
+    rides as a scalar-prefetch operand and the K/V BlockSpec index maps
+    dereference it — ``block ci of sequence b`` fetches pool page
+    ``table[b, ci]``, so the kernel body is exactly the dense split-KV
+    kernel with ``chunk_k = page_size`` and no gather materializes.
+    """
+    b, hq, d = q.shape
+    p, hkv, page, _ = k_pages.shape
+    if hq % hkv:
+        raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
+    group = hq // hkv
+    if sm_scale is None:
+        sm_scale = d**-0.5
+    pps = page_table.shape[1]
+    kv_len = jnp.broadcast_to(jnp.asarray(kv_len, jnp.int32), (b,))
+
+    resolved = interpret_mode() if interpret is None else interpret
+    if resolved and exporting_portable():
+        k_d, v_d = _pages_to_dense(k_pages, v_pages, page_table)
+        return gqa_decode_reference(
+            q, k_d, v_d, kv_len, sm_scale=sm_scale, return_lse=return_lse
+        )
+
+    qg = q.reshape(b, hkv, group, d)
+    grid = (b, hkv, pps)
+    o_parts, lse_parts = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, sm_scale=sm_scale,
+                          chunk_k=page),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,  # kv_len, page_table
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(
+                    (1, 1, group, d), lambda b, h, ci, _, __: (b, h, 0, 0)
+                ),
+                # The paged part: block ci of row b is pool page
+                # table[b, ci].
+                pl.BlockSpec(
+                    (1, 1, page, d),
+                    lambda b, h, ci, _, tab: (tab[b, ci], h, 0, 0),
+                ),
+                pl.BlockSpec(
+                    (1, 1, page, d),
+                    lambda b, h, ci, _, tab: (tab[b, ci], h, 0, 0),
+                ),
+            ],
+            out_specs=[
+                pl.BlockSpec(
+                    (1, 1, 1, group, d), lambda b, h, ci, _, __: (b, h, ci, 0, 0)
+                ),
+                pl.BlockSpec(
+                    (1, 1, pps, group), lambda b, h, ci, _, __: (b, h, 0, 0)
+                ),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hkv, pps, group, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, hkv, pps, group), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=resolved,
+    )(kv_len, page_table, qg, k_pages, v_pages)
+
+    o, lse = lse_combine(o_parts, lse_parts, part_axis=2)
+    o = o.reshape(b, hq, d).astype(q.dtype)
+    if return_lse:
+        return o, lse.reshape(b, hq)
+    return o
+
+
+def _paged_decode_kernel(kv_len_ref, table_ref, *args, **kw):
+    del table_ref  # consumed by the BlockSpec index maps
+    return _decode_kernel(kv_len_ref, *args, **kw)
+
+
+def pages_to_dense(pages: jax.Array, page_table: jax.Array) -> jax.Array:
+    """Gather a page pool ``[..., P, H, page, d]`` into a dense
+    ``[..., B, H, S, d]`` view through the table. Single source of the
+    gather layout — ``models.paged_kv_cache.as_dense`` delegates here."""
+    g = jnp.take(pages, page_table, axis=-4)  # [..., B, pps, H, page, d]
+    g = jnp.swapaxes(g, -4, -3)               # [..., B, H, pps, page, d]
+    s = g.shape
+    return g.reshape(*s[:-3], s[-3] * s[-2], s[-1])
+
+
+def _pages_to_dense(k_pages, v_pages, page_table):
+    return pages_to_dense(k_pages, page_table), pages_to_dense(
+        v_pages, page_table
+    )
+
+
 def _gather_merge(o, lse, axis: str, method: str, ctx=None):
     """Gather per-rank partial (O, LSE) over ``axis`` and LSE-merge.
 
