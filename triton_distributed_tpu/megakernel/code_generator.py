@@ -50,6 +50,11 @@ class MegaDims:
     # a scalar-prefetch operand, and the attention block size is the
     # page size (parity: reference paged_kv_cache.py).
     page: int = 0
+    # Prefill mode: ``batch`` is the prompt length S (rows = positions),
+    # the embedded prompt arrives as an extra input (LOAD_X task), the
+    # cache is not read, K/V come out as [L, hkv, S, hd], and the LM
+    # head projects only the last real row → logits [1, v_loc].
+    prefill: bool = False
 
     @property
     def qkv_loc(self) -> int:
@@ -65,8 +70,12 @@ class MegaConfig:
     """Tile configuration (parity: the reference's per-task tile configs
     in its TaskBuilders). Resolved against dims by :func:`resolve`."""
 
-    tile_n: int = 512
-    tile_k: int = 512
+    # Defaults from a v5e sweep on Qwen3-0.6B decode (1024/1024/256 ran
+    # 3.0 ms/step vs 4.1 at 512/512): wide tiles amortize the per-tile
+    # DMA turnaround in the weight streams; 2048-wide tiles fail to
+    # compile and s_blk=512 regresses the KV pipeline.
+    tile_n: int = 1024
+    tile_k: int = 1024
     s_blk: int = 256
 
     def resolve(self, dims: MegaDims) -> "ResolvedConfig":
@@ -140,7 +149,8 @@ def make_mega_kernel(
         *rest,
     ):
         # Paged mode inserts the page table as a 4th scalar-prefetch
-        # operand; the array operand order is otherwise identical.
+        # operand; prefill mode inserts the embedded prompt rows x0
+        # before the weights. The operand order is otherwise identical.
         if dims.page:
             page_tab, *rest = rest
         else:
@@ -148,6 +158,13 @@ def make_mega_kernel(
         (
             embed, wqkv, wo, w1, w2, lm_head,              # ANY (HBM)
             ln1, ln2, normf, qn, kn,                       # VMEM (small)
+            *rest,
+        ) = rest
+        if dims.prefill:  # embedded prompt rows, after the weights
+            x0, *rest = rest
+        else:
+            x0 = None
+        (
             kc, vc,                                        # ANY (read-only)
             logits, knew_out, vnew_out,                    # outputs
             x, h, qkv, ao, mlp, estage,                    # VMEM state
@@ -159,6 +176,7 @@ def make_mega_kernel(
         kctx.kv_len = kv_len
         kctx.tokens = tokens
         kctx.table = page_tab
+        kctx.x0 = x0
         kctx.embed, kctx.wqkv, kctx.wo = embed, wqkv, wo
         kctx.w1, kctx.w2, kctx.lm_head = w1, w2, lm_head
         kctx.ln1, kctx.ln2, kctx.normf = ln1, ln2, normf
@@ -217,6 +235,7 @@ def build_mega_call(
         grid=(len(tasks),),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 6
         + [pl.BlockSpec(memory_space=pltpu.VMEM)] * 5
+        + ([pl.BlockSpec(memory_space=pltpu.VMEM)] if dims.prefill else [])
         + [pl.BlockSpec(memory_space=pl.ANY)] * 2,
         out_specs=[
             pl.BlockSpec(memory_space=pltpu.VMEM),  # logits
@@ -229,11 +248,22 @@ def build_mega_call(
             pltpu.VMEM((B, dims.qkv_loc), jnp.float32),        # qkv
             pltpu.VMEM((B, dims.o_k), jnp.float32),            # ao
             pltpu.VMEM((B, dims.f_loc), jnp.float32),          # mlp
-            pltpu.VMEM((B, 8, d), wdtype),                     # estage
+            # estage + KV staging serve the decode-only EMBED/ATTN
+            # tasks; prefill shrinks them to placeholders (B = S would
+            # otherwise blow VMEM on buffers no task reads).
+            pltpu.VMEM(
+                (1, 8, d) if dims.prefill else (B, 8, d), wdtype
+            ),                                                 # estage
             pltpu.VMEM((2, d, cfg.tn_max), wdtype),            # colstage
             pltpu.VMEM((2, cfg.tk_max, d), wdtype),            # rowstage
-            pltpu.VMEM((2, B, hkv, cfg.s_blk, hd), cdtype),    # kstage
-            pltpu.VMEM((2, B, hkv, cfg.s_blk, hd), cdtype),    # vstage
+            pltpu.VMEM(
+                (1,) * 5 if dims.prefill
+                else (2, B, hkv, cfg.s_blk, hd), cdtype
+            ),                                                 # kstage
+            pltpu.VMEM(
+                (1,) * 5 if dims.prefill
+                else (2, B, hkv, cfg.s_blk, hd), cdtype
+            ),                                                 # vstage
             pltpu.VMEM((B, d), jnp.float32),                   # arsrc
             pltpu.VMEM((n, B, d), jnp.float32),                # cbuf
             pltpu.SemaphoreType.DMA((2,)),                     # wsem
@@ -272,9 +302,18 @@ def build_mega_call(
         # one XLA dynamic_update_slice (which aliases in place when the
         # cache is donated).
         out_shape=[
-            jax.ShapeDtypeStruct((B, dims.v_loc), jnp.float32),
-            jax.ShapeDtypeStruct((dims.num_layers, B, hkv, hd), cdtype),
-            jax.ShapeDtypeStruct((dims.num_layers, B, hkv, hd), cdtype),
+            jax.ShapeDtypeStruct(
+                (1 if dims.prefill else B, dims.v_loc), jnp.float32
+            ),
+            # Prefill: all S rows per head; decode: one row per (b, h).
+            jax.ShapeDtypeStruct(
+                (dims.num_layers, hkv, B, hd) if dims.prefill
+                else (dims.num_layers, B, hkv, hd), cdtype
+            ),
+            jax.ShapeDtypeStruct(
+                (dims.num_layers, hkv, B, hd) if dims.prefill
+                else (dims.num_layers, B, hkv, hd), cdtype
+            ),
         ],
         compiler_params=pltpu.CompilerParams(
             has_side_effects=True,
@@ -285,7 +324,16 @@ def build_mega_call(
         interpret=interpret_mode(ctx),
     )
 
-    if dims.page:
+    if dims.page and dims.prefill:
+        raise NotImplementedError("paged prefill: prefill then scatter")
+    if dims.prefill:
+        def run(kv_len, tokens, x0, embed, wqkv, wo, w1, w2,
+                lm_head, ln1, ln2, normf, qn, kn, kc, vc):
+            return call(
+                table, kv_len, tokens, embed, wqkv, wo, w1, w2,
+                lm_head, ln1, ln2, normf, qn, kn, x0, kc, vc,
+            )
+    elif dims.page:
         def run(kv_len, tokens, page_table, embed, wqkv, wo, w1, w2,
                 lm_head, ln1, ln2, normf, qn, kn, kc, vc):
             return call(

@@ -36,6 +36,42 @@ def _rms(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
     return x * w.astype(jnp.float32)
 
 
+def _headnorm(t: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    """Per-head QK RMS-norm on ``[r, hd]`` rows (shared by the decode
+    and prefill attention tasks)."""
+    return t * jax.lax.rsqrt(
+        jnp.mean(t * t, axis=-1, keepdims=True) + eps
+    ) * w.astype(jnp.float32)
+
+
+def _make_rope(hd: int, theta: float):
+    """RoPE over the full lane width as ``rope(t, ang_{cos,sin})``.
+
+    The angle repeats per half and the rotate-half operand is a lane
+    roll + sign flip — one tpu.rotate instead of the unaligned hd/2
+    lane slices Mosaic can't form. iota (not arange): concrete arrays
+    would be captured consts, which pallas_call rejects; integer iota
+    only — Mosaic's tpu.iota verifier rejects float result types.
+
+    Returns ``(angle, rope)``: ``angle(p)`` maps positions ``p``
+    (broadcastable against ``[·, hd]``) to the per-lane angle, and
+    ``rope(t, ang)`` applies the rotation.
+    """
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, hd), 1)
+    half = jnp.remainder(lane, hd // 2).astype(jnp.float32)
+    inv = 1.0 / (theta ** (2.0 * half / hd))  # [1, hd]
+    sign = jnp.where(lane < hd // 2, -1.0, 1.0)
+
+    def angle(p):
+        return p.astype(jnp.float32) * inv
+
+    def rope(t, ang):
+        rot = pltpu.roll(t, hd // 2, 1) * sign
+        return t * jnp.cos(ang) + rot * jnp.sin(ang)
+
+    return angle, rope
+
+
 def _stream_cols(kctx, x_f32, w_hbm, n: int, tn: int, consume, col0: int = 0):
     """Column-streamed GEMM: ``x [B, K] @ w_hbm [K, col0:col0+n*tn]``
     tile-by-tile.
@@ -216,27 +252,13 @@ def attn_body(kctx):
         qkv = kctx.qkv[...]  # [B, (hq + 2 hkv) hd] f32
         qn = kctx.qn[layer]  # [L, 1, hd] ref → [1, hd]
         kn = kctx.kn[layer]
+        angle, rope_fn = _make_rope(hd, theta)
 
-        def headnorm(t, w):  # t [r, hd]
-            return t * jax.lax.rsqrt(
-                jnp.mean(t * t, axis=-1, keepdims=True) + eps
-            ) * w.astype(jnp.float32)
-
-        # RoPE over the full lane width: angle repeats per half, the
-        # rotate-half operand is a lane roll + sign flip — one
-        # tpu.rotate instead of the unaligned hd/2 lane slices Mosaic
-        # can't form. iota (not arange): concrete arrays would be
-        # captured consts, which pallas_call rejects; integer iota only
-        # — Mosaic's tpu.iota verifier rejects float result types.
-        lane = jax.lax.broadcasted_iota(jnp.int32, (1, hd), 1)
-        half = jnp.remainder(lane, hd // 2).astype(jnp.float32)
-        inv = 1.0 / (theta ** (2.0 * half / hd))  # [1, hd]
-        sign = jnp.where(lane < hd // 2, -1.0, 1.0)
+        def headnorm(t, w):
+            return _headnorm(t, w, eps)
 
         def rope(t, p):  # t [r, hd], p scalar position
-            ang = p.astype(jnp.float32) * inv
-            rot = pltpu.roll(t, hd // 2, 1) * sign
-            return t * jnp.cos(ang) + rot * jnp.sin(ang)
+            return rope_fn(t, angle(p))
 
         def head(i):  # q head i as [1, hd] rows per batch
             return [
@@ -405,6 +427,86 @@ def attn_body(kctx):
     return body
 
 
+@register_task(TaskType.LOAD_X)
+def load_x_body(kctx):
+    """Prefill entry: the embedded prompt rows arrive as a kernel input
+    (XLA does the S-row gather — an in-kernel per-row embed DMA would
+    need S unrolled dynamic-sublane stores Mosaic can't prove aligned)."""
+
+    def body():
+        kctx.x[...] = kctx.x0[...].astype(jnp.float32)
+
+    return body
+
+
+@register_task(TaskType.ATTN_PREFILL)
+def attn_prefill_body(kctx):
+    """Causal self-attention over the S prompt rows in the qkv scratch.
+
+    Parity: the reference megakernel's prefill attention tasks
+    (``mega_triton_kernel/models/model_builder.py:189-352``). The whole
+    [S, S] score tile fits VMEM at prompt scale, so no KV streaming —
+    per (kv-head, q-head) everything is 2-D: lane slices of qkv, the
+    roll-based RoPE from the decode task applied with per-row
+    positions, one masked softmax, and [S, hd] writes of K/V to the
+    ``knew``/``vnew`` outputs (the caller scatters them into the cache,
+    same contract as decode).
+    """
+
+    def body():
+        dims = kctx.dims
+        S = dims.batch  # prefill: rows are the prompt positions
+        hq, hkv, hd = dims.hq_loc, dims.hkv_loc, dims.head_dim
+        g = hq // hkv
+        eps, theta = dims.rms_eps, dims.rope_theta
+        layer = kctx.layer
+
+        qkv = kctx.qkv[...]  # [S, (hq + 2 hkv) hd] f32
+        qn = kctx.qn[layer]  # [1, hd]
+        kn = kctx.kn[layer]
+        angle, rope_fn = _make_rope(hd, theta)
+        pos = jax.lax.broadcasted_iota(jnp.int32, (S, 1), 0)
+        ang = angle(pos)  # [S, hd] — row r rotated by position r
+
+        def headnorm(t, w):
+            return _headnorm(t, w, eps)
+
+        def rope(t):  # [S, hd]
+            return rope_fn(t, ang)
+
+        def head(i):  # [S, hd]
+            return qkv[:, i * hd:(i + 1) * hd]
+
+        rows = jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
+        causal = cols <= rows
+        neg = jnp.float32(-1e30)
+        scale = hd ** -0.5
+        nt = (((1,), (1,)), ((), ()))
+
+        for h in range(hkv):
+            kh = rope(headnorm(head(hq + h), kn))       # [S, hd]
+            vh = head(hq + hkv + h)
+            kctx.knew_out[layer, h] = kh.astype(kctx.cdtype)
+            kctx.vnew_out[layer, h] = vh.astype(kctx.cdtype)
+            for i in range(g):
+                qi = rope(headnorm(head(h * g + i), qn)) * scale
+                s = jax.lax.dot_general(
+                    qi, kh, nt, preferred_element_type=jnp.float32
+                )  # [S, S]
+                s = jnp.where(causal, s, neg)
+                m = jnp.max(s, axis=-1, keepdims=True)
+                p = jnp.exp(s - m)
+                l = jnp.sum(p, axis=-1, keepdims=True)
+                o = jnp.dot(
+                    p, vh, preferred_element_type=jnp.float32
+                ) / l  # [S, hd]
+                col = (h * g + i) * hd
+                kctx.ao[:, col:col + hd] = o
+
+    return body
+
+
 @register_task(TaskType.O_PROJ)
 def o_proj_body(kctx):
     def body():
@@ -516,10 +618,23 @@ def lm_head_body(kctx):
         tn = kctx.cfg.tn_lm
         n = dims.v_loc // tn
 
+        if dims.prefill:
+            # Project only the last real prompt row (position
+            # kv_len[0] - 1): a one-hot [1, S] @ [S, d] row select —
+            # logits over all S rows would be an [S, v_loc] output.
+            S = dims.batch
+            sel = jax.lax.broadcasted_iota(jnp.int32, (1, S), 1)
+            onehot = (sel == kctx.kv_len[0] - 1).astype(jnp.float32)
+            x_in = jnp.dot(
+                onehot, kctx.h[...], preferred_element_type=jnp.float32
+            )  # [1, d]
+        else:
+            x_in = kctx.h[...]
+
         def sink(j, val):
             kctx.logits[:, pl.ds(j * tn, tn)] = val
 
-        _stream_cols(kctx, kctx.h[...], kctx.lm_head, n, tn, sink)
+        _stream_cols(kctx, x_in, kctx.lm_head, n, tn, sink)
 
     return body
 

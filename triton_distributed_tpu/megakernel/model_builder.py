@@ -116,6 +116,12 @@ class ModelBuilder:
     def make_lm_head(self, **kw) -> int:
         return self._add(TaskType.LM_HEAD, **kw)
 
+    def make_attn_prefill(self, layer: int, **kw) -> int:
+        return self._add(TaskType.ATTN_PREFILL, layer, **kw)
+
+    def make_load_x(self, **kw) -> int:
+        return self._add(TaskType.LOAD_X, **kw)
+
     def make_barrier(self, **kw) -> int:
         return self._add(TaskType.BARRIER, **kw)
 
@@ -141,6 +147,30 @@ class ModelBuilder:
             self.make_fc2(l)
             self.make_allreduce(l)
         self.make_norm(0, 2)
+        self.make_lm_head()
+
+    def build_prefill_graph(self) -> None:
+        """The prompt-prefill chain (parity: the reference's prefill
+        TaskBuilders, ``model_builder.py:189-352``): same per-layer
+        pipeline as decode with causal self-attention over the S token
+        rows; the embedding arrives as an input (LOAD_X) and the LM head
+        projects only the last real row (arg0=1)."""
+        if self.dims.n_ranks > 1:
+            self.make_barrier()  # same entry-skew reasoning as decode
+        self.make_load_x()
+        for l in range(self.dims.num_layers):
+            self.make_norm(l, 0)
+            self.make_qkv_proj(l)
+            self.make_attn_prefill(l)
+            self.make_o_proj(l)
+            self.make_allreduce(l)
+            self.make_norm(l, 1)
+            self.make_fc1(l)
+            self.make_fc2(l)
+            self.make_allreduce(l)
+        self.make_norm(0, 2)
+        # The LM head projects only the last real row in prefill graphs
+        # (driven by dims.prefill inside lm_head_body, not a task arg).
         self.make_lm_head()
 
     # -- compile ---------------------------------------------------------

@@ -15,6 +15,7 @@ from __future__ import annotations
 import dataclasses
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from triton_distributed_tpu.megakernel.code_generator import MegaConfig, MegaDims
@@ -73,11 +74,10 @@ class MegaQwen3:
         attention block size = page size)."""
         m = self.model
         dims = self._dims(batch, s_max, page)
-        cfg = self.cfg
-        if page:
-            cfg = dataclasses.replace(cfg, s_blk=page)
+        # (s_blk == page is enforced by MegaConfig.resolve when
+        # dims.page is set — single owner of that invariant.)
         mb = ModelBuilder(
-            dims, cfg=cfg, axis=m.axis, ctx=m.ctx,
+            dims, cfg=self.cfg, axis=m.axis, ctx=m.ctx,
             wdtype=m.cfg.dtype, cdtype=m.cfg.dtype,
         )
         mb.build_decoder_graph()
@@ -85,25 +85,7 @@ class MegaQwen3:
         per_shard = compiled.per_shard
         ax = m.axis
 
-        def kernel_args(params: Qwen3Params):
-            lp = params.layers
-            V, d = params.embed.shape
-            if V % 8:
-                raise ValueError(
-                    f"megakernel needs vocab_size % 8 == 0, got {V}"
-                )
-            # Per-layer norm weights go in as [L, 1, d] / [L, 1, hd]:
-            # the kernel indexes the layer with a traced scalar, and
-            # Mosaic only allows dynamic indices on untiled leading
-            # dims (a dynamic sublane slice of a [L, d] ref needs a
-            # statically 8-aligned index it can't prove).
-            return (
-                params.embed.reshape(V // 8, 8, d),
-                lp.attn.wqkv, lp.attn.wo, lp.mlp.w1, lp.mlp.w2,
-                params.lm_head,
-                lp.ln1[:, None, :], lp.ln2[:, None, :], params.norm[None, :],
-                lp.attn.q_norm[:, None, :], lp.attn.k_norm[:, None, :],
-            )
+        kernel_args = self._kernel_args
 
         if page:
             def shard_fn(params: Qwen3Params, tokens, cache: PagedKVCache):
@@ -152,6 +134,27 @@ class MegaQwen3:
         step = jax.jit(f, donate_argnums=(2,))
         return compiled, step, f
 
+    @staticmethod
+    def _kernel_args(params: Qwen3Params):
+        lp = params.layers
+        V, d = params.embed.shape
+        if V % 8:
+            raise ValueError(
+                f"megakernel needs vocab_size % 8 == 0, got {V}"
+            )
+        # Per-layer norm weights go in as [L, 1, d] / [L, 1, hd]:
+        # the kernel indexes the layer with a traced scalar, and
+        # Mosaic only allows dynamic indices on untiled leading
+        # dims (a dynamic sublane slice of a [L, d] ref needs a
+        # statically 8-aligned index it can't prove).
+        return (
+            params.embed.reshape(V // 8, 8, d),
+            lp.attn.wqkv, lp.attn.wo, lp.mlp.w1, lp.mlp.w2,
+            params.lm_head,
+            lp.ln1[:, None, :], lp.ln2[:, None, :], params.norm[None, :],
+            lp.attn.q_norm[:, None, :], lp.attn.k_norm[:, None, :],
+        )
+
     def _built(self, batch: int, s_max: int, page: int = 0):
         key = (batch, s_max, page)
         if key not in self._jit:
@@ -178,3 +181,61 @@ class MegaQwen3:
         callers can chain steps inside one jit (``lax.fori_loop`` greedy
         decode) instead of dispatching per step."""
         return self._built(batch, s_max, page)[2]
+
+    # -- prefill ---------------------------------------------------------
+    def _build_prefill(self, s: int):
+        """Build the prompt-prefill megakernel for an S-token prompt
+        (parity: the reference's prefill TaskBuilders,
+        ``model_builder.py:189-352``)."""
+        m = self.model
+        dims = dataclasses.replace(self._dims(s, s), prefill=True)
+        mb = ModelBuilder(
+            dims, cfg=self.cfg, axis=m.axis, ctx=m.ctx,
+            wdtype=m.cfg.dtype, cdtype=m.cfg.dtype,
+        )
+        mb.build_prefill_graph()
+        per_shard = mb.compile(self.policy).per_shard
+        ax = m.axis
+
+        def shard_fn(params: Qwen3Params, tokens, true_len, cache: KVCache):
+            x0 = jnp.take(params.embed, tokens, axis=0)  # [S, d] XLA gather
+            logits, k_rows, v_rows = per_shard(
+                true_len[None], jnp.zeros((1,), jnp.int32), x0,
+                *self._kernel_args(params),
+                # The prefill kernel never reads the cache; tiny
+                # placeholders keep the operand list uniform.
+                jnp.zeros((1, 1, 1, 8, 128), m.cfg.dtype),
+                jnp.zeros((1, 1, 1, 8, 128), m.cfg.dtype),
+            )
+            # k_rows [L, hkv, S, hd] → cache entry 0, positions [0, S).
+            k_new = jax.lax.dynamic_update_slice(
+                cache.k, k_rows[:, None].astype(cache.k.dtype), (0, 0, 0, 0, 0)
+            )
+            v_new = jax.lax.dynamic_update_slice(
+                cache.v, v_rows[:, None].astype(cache.v.dtype), (0, 0, 0, 0, 0)
+            )
+            kv_len = cache.kv_len.at[0].set(true_len)
+            return logits[0], KVCache(k=k_new, v=v_new, kv_len=kv_len)
+
+        f = m.ctx.shard_map(
+            shard_fn,
+            in_specs=(m.param_specs, P(), P(), cache_specs(ax)),
+            out_specs=(P(ax), cache_specs(ax)),
+        )
+        return jax.jit(f)
+
+    def prefill(self, tokens: jax.Array, cache: KVCache, *, true_len=None):
+        """Prefill one prompt (``tokens [S]``) through the megakernel;
+        returns (last-real-token logits [V], cache with entry 0 filled)
+        — the same return contract as ``Qwen3.prefill``. ``true_len``
+        is keyword-only (there is no ``mode`` parameter here; the
+        megakernel IS the mode)."""
+        s = int(tokens.shape[0])
+        key = ("prefill", s)
+        if key not in self._jit:
+            self._jit[key] = self._build_prefill(s)
+        if true_len is None:
+            true_len = s
+        return self._jit[key](
+            self.model.params, tokens, jnp.asarray(true_len, jnp.int32), cache
+        )

@@ -46,6 +46,8 @@ class TaskType(enum.IntEnum):
     ALLREDUCE = 7    # x ← x + psum(h);  arg0: parity slot
     LM_HEAD = 8      # logits ← rms_norm(x) stage then tiled GEMM
     BARRIER = 9      # standalone cross-chip barrier (stress/test fixture)
+    ATTN_PREFILL = 10  # causal self-attn over the S token rows + K/V out
+    LOAD_X = 11      # x ← x0 input (prefill: embedding arrives via XLA)
 
 
 # Resource class used by the zig-zag scheduler: tasks whose cost is
