@@ -276,6 +276,10 @@ def ag_gemm(
             pltpu.SemaphoreType.DMA((n,)),
         ],
         collective_id=_AG_GEMM_COLLECTIVE_ID,
+        # Mosaic double-buffers the BlockSpec-pipelined operands; at
+        # north-star shapes that exceeds the 16 MB default scoped-VMEM
+        # limit (v5e/v5p have 128 MB physical).
+        vmem_limit_bytes=64 * 1024 * 1024,
         dimension_semantics=("arbitrary", "arbitrary", "arbitrary"),
         cost_estimate=comm_cost(
             flops=2 * n * m_per * k * n_loc,

@@ -323,6 +323,10 @@ def gemm_rs(
             pltpu.SemaphoreType.DMA((n - 1,)),
         ],
         collective_id=_GEMM_RS_COLLECTIVE_ID,
+        # Mosaic double-buffers the BlockSpec-pipelined operands; at
+        # north-star shapes that exceeds the 16 MB default scoped-VMEM
+        # limit (v5e/v5p have 128 MB physical).
+        vmem_limit_bytes=64 * 1024 * 1024,
         dimension_semantics=("arbitrary", "arbitrary", "arbitrary"),
         cost_estimate=comm_cost(
             flops=2 * m * k_loc * n_out,
