@@ -263,3 +263,32 @@ class TestMegaPrefill:
             tok_g = jnp.argmax(lg, -1).astype(jnp.int32)
             tok_m = jnp.argmax(lm, -1).astype(jnp.int32)
             np.testing.assert_array_equal(np.asarray(tok_g), np.asarray(tok_m))
+
+
+def test_lm_head_remainder_tile(ctx4):
+    """Wide LM tiles on an unround vocab: tn_lm = tile_n with a final
+    remainder tile (per-shard vocab 384, tile 256 → rem 128) must match the
+    golden decode step."""
+    from triton_distributed_tpu.megakernel.code_generator import MegaConfig
+
+    model = AutoLLM.from_pretrained("tiny", ctx=ctx4, vocab_size=1536)
+    cache = model.new_cache(1, max_length=64)
+    step_gold = model.decode_fn("xla")
+    for t in (3, 5):
+        _, cache = step_gold(model.params, jnp.asarray([t], jnp.int32), cache)
+
+    tok = jnp.asarray([7], jnp.int32)
+    logits_gold, _ = step_gold(model.params, tok, jax.tree.map(jnp.copy, cache))
+
+    mega = MegaQwen3(model, cfg=MegaConfig(tile_n=256))
+    built = mega._built(1, 64)[0]
+    from triton_distributed_tpu.megakernel.code_generator import MegaDims
+    resolved = mega.cfg.resolve(mega._dims(1, 64))
+    assert resolved.tn_lm == 256  # wide tile, not pick_tile's 128
+    assert 1536 // 4 % 256 == 128  # the tail this test exercises
+
+    logits_mega, _ = mega.decode_step(tok, cache)
+    np.testing.assert_allclose(
+        np.asarray(logits_mega), np.asarray(logits_gold),
+        rtol=2e-3, atol=2e-3,
+    )

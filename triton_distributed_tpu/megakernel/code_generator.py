@@ -82,7 +82,21 @@ class MegaConfig:
         return ResolvedConfig(
             tn_qkv=pick_tile(dims.qkv_loc, self.tile_n),
             tn_fc1=pick_tile(dims.f_loc, self.tile_n),
-            tn_lm=pick_tile(dims.v_loc, self.tile_n),
+            # The vocab axis rarely divides by a wide tile (Qwen3:
+            # 151936 = 128·1187), so the LM head streams a wide main
+            # tile plus one remainder tile (lm_head_body) instead of
+            # collapsing to the largest pow-2 divisor (128-wide tiles
+            # halve HBM stream efficiency on the largest weight). The
+            # remainder must itself be a 128-multiple for lane
+            # alignment, hence the v_loc % 128 gate — Qwen3's v_loc
+            # only satisfies it at tp=1 (151936/tp carries a 64/96/48
+            # residue); pad the vocab to 128·tp at load time to widen
+            # lm tiles under TP.
+            tn_lm=(
+                min(self.tile_n, dims.v_loc)
+                if dims.v_loc % 128 == 0
+                else pick_tile(dims.v_loc, self.tile_n)
+            ),
             tk_o=pick_tile(dims.o_k, self.tile_k),
             tk_fc2=pick_tile(dims.f_loc, self.tile_k),
             # Paged mode: the KV block IS the page — pick_tile's 128

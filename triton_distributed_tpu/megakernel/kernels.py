@@ -72,27 +72,32 @@ def _make_rope(hd: int, theta: float):
     return angle, rope
 
 
-def _stream_cols(kctx, x_f32, w_hbm, n: int, tn: int, consume, col0: int = 0):
+def _stream_cols(kctx, x_f32, w_hbm, n: int, tn: int, consume,
+                 col0: int = 0, tail: int = 0):
     """Column-streamed GEMM: ``x [B, K] @ w_hbm [K, col0:col0+n*tn]``
-    tile-by-tile.
+    tile-by-tile, plus an optional ``tail``-wide final tile when ``tn``
+    doesn't divide the column count (the LM head's vocab axis).
 
     Double-buffered: tile ``j+1``'s DMA runs under tile ``j``'s matmul
     (parity role: the reference linear task's tile pipeline,
-    ``mega_triton_kernel/kernels/linear.py``). ``consume(j, val)`` sinks
-    each ``[B, tn]`` f32 product.
+    ``mega_triton_kernel/kernels/linear.py``); the tail tile joins the
+    same pipeline (prefetched under the last main tile's matmul).
+    ``consume(j, val)`` sinks each f32 product — ``val.shape[1]`` is
+    ``tn`` for main tiles and ``tail`` for the final one.
     """
     stage, sem = kctx.colstage, kctx.wsem
     k = x_f32.shape[1]
     xa = x_f32.astype(kctx.wdtype)
 
-    def copy(j, slot):
+    def copy(j, slot, w=None):
+        w = tn if w is None else w
         return pltpu.make_async_copy(
-            w_hbm.at[:, pl.ds(col0 + j * tn, tn)],
-            stage.at[slot, :k, :tn],
+            w_hbm.at[:, pl.ds(col0 + j * tn, w)],
+            stage.at[slot, :k, :w],
             sem.at[slot],
         )
 
-    copy(0, 0).start()
+    copy(0, 0, tail if n == 0 else None).start()
 
     def body(j, carry):
         slot = jax.lax.rem(j, 2)
@@ -100,6 +105,11 @@ def _stream_cols(kctx, x_f32, w_hbm, n: int, tn: int, consume, col0: int = 0):
         @pl.when(j + 1 < n)
         def _prefetch():
             copy(j + 1, 1 - slot).start()
+
+        if tail:
+            @pl.when(j + 1 == n)
+            def _prefetch_tail():
+                copy(n, 1 - slot, tail).start()
 
         copy(j, slot).wait()
         val = jnp.dot(
@@ -109,6 +119,13 @@ def _stream_cols(kctx, x_f32, w_hbm, n: int, tn: int, consume, col0: int = 0):
         return carry
 
     jax.lax.fori_loop(0, n, body, 0, unroll=False)
+
+    if tail:
+        slot = n % 2
+        copy(n, slot, tail).wait()
+        consume(n, jnp.dot(
+            xa, stage[slot, :k, :tail], preferred_element_type=jnp.float32
+        ))
 
 
 def _stream_rows(kctx, x_ref, w_hbm, out_ref, n: int, tk: int):
@@ -632,9 +649,13 @@ def lm_head_body(kctx):
             x_in = kctx.h[...]
 
         def sink(j, val):
-            kctx.logits[:, pl.ds(j * tn, tn)] = val
+            kctx.logits[:, pl.ds(j * tn, val.shape[1])] = val
 
-        _stream_cols(kctx, x_in, kctx.lm_head, n, tn, sink)
+        # Tail tile when tn doesn't divide v_loc (wide lm tiles on an
+        # unround vocab axis); must stay a 128-multiple for lane
+        # alignment — guaranteed by the resolve() gate.
+        rem = dims.v_loc - n * tn
+        _stream_cols(kctx, x_in, kctx.lm_head, n, tn, sink, tail=rem)
 
     return body
 

@@ -37,7 +37,8 @@ from triton_distributed_tpu.runtime.mesh import initialize_distributed
 
 def main():
     n = len(jax.devices())
-    ctx = initialize_distributed({"dcn": 2, "tp": max(n // 2, 1)})
+    dcn = 2 if n >= 2 else 1  # single-chip: degenerate to one slice
+    ctx = initialize_distributed({"dcn": dcn, "tp": max(n // dcn, 1)})
     rng = np.random.default_rng(0)
 
     # Long-context prefill: causal SP attention across 2 slices.
