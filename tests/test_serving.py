@@ -41,10 +41,19 @@ def test_server_reports_errors(ctx4):
     try:
         import pytest
 
+        # Indivisible prompt lengths are auto-padded now — serve works.
+        resp = request(
+            server.host, server.port,
+            {"input_ids": [[1, 2, 3]], "gen_len": 2},  # len 3 % tp4 != 0
+        )
+        assert np.asarray(resp["output_ids"]).shape == (1, 5)
+
+        # A malformed request still surfaces as a server error.
         with pytest.raises(RuntimeError, match="server error"):
             request(
                 server.host, server.port,
-                {"input_ids": [[1, 2, 3]], "gen_len": 2},  # len 3 % tp4 != 0
+                {"input_ids": [[1, 2, 3]], "gen_len": 2,
+                 "prompt_start": [7]},  # out of range for s=3
             )
     finally:
         server.shutdown()

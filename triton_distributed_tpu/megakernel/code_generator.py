@@ -94,7 +94,7 @@ class MegaConfig:
             # lm tiles under TP.
             tn_lm=(
                 min(self.tile_n, dims.v_loc)
-                if dims.v_loc % 128 == 0
+                if dims.v_loc % 128 == 0 and self.tile_n % 128 == 0
                 else pick_tile(dims.v_loc, self.tile_n)
             ),
             tk_o=pick_tile(dims.o_k, self.tile_k),

@@ -51,6 +51,10 @@ class MegaQwen3:
         m = self.model
         c = m.cfg
         n = m.ctx.axis_size(m.axis)
+        # The lm_head's vocab axis is padded to 128·tp by set_params;
+        # v_loc follows the padded width (the step wrappers slice the
+        # pad logits back off).
+        v_pad = m.params.lm_head.shape[1]
         return MegaDims(
             batch=batch,
             d=c.hidden_size,
@@ -58,7 +62,7 @@ class MegaQwen3:
             hkv_loc=m.dims.hkv_loc,
             head_dim=c.head_dim,
             f_loc=c.intermediate_size // n,
-            v_loc=c.vocab_size // n,
+            v_loc=v_pad // n,
             num_layers=c.num_layers,
             s_max=s_max,
             n_ranks=n,
@@ -126,11 +130,19 @@ class MegaQwen3:
 
             specs = cache_specs(ax)
 
-        f = m.ctx.shard_map(
+        g = m.ctx.shard_map(
             shard_fn,
             in_specs=(m.param_specs, P(), specs),
             out_specs=(P(None, ax), specs),
         )
+        V = m.cfg.vocab_size
+
+        def f(params, tokens, cache):
+            logits, cache = g(params, tokens, cache)
+            # Drop vocab-pad logits (zero-weight columns score 0 and
+            # could beat real logits under greedy sampling).
+            return logits[:, :V], cache
+
         step = jax.jit(f, donate_argnums=(2,))
         return compiled, step, f
 
@@ -217,11 +229,17 @@ class MegaQwen3:
             kv_len = cache.kv_len.at[0].set(true_len)
             return logits[0], KVCache(k=k_new, v=v_new, kv_len=kv_len)
 
-        f = m.ctx.shard_map(
+        g = m.ctx.shard_map(
             shard_fn,
             in_specs=(m.param_specs, P(), P(), cache_specs(ax)),
             out_specs=(P(ax), cache_specs(ax)),
         )
+        V = m.cfg.vocab_size
+
+        def f(params, tokens, true_len, cache):
+            logits, cache = g(params, tokens, true_len, cache)
+            return logits[:V], cache  # drop vocab-pad logits
+
         return jax.jit(f)
 
     def prefill(self, tokens: jax.Array, cache: KVCache, *, true_len=None):

@@ -83,11 +83,6 @@ class Engine:
         input_ids = np.asarray(input_ids, np.int32)
         b, s = input_ids.shape
         n = self.model.ctx.axis_size(self.model.axis)
-        if s % n:
-            raise ValueError(
-                f"prompt length {s} must be divisible by tp={n} "
-                f"(pad upstream and pass prompt_start)"
-            )
         starts = np.zeros(b, np.int64) if prompt_start is None else (
             np.asarray(prompt_start, np.int64)
         )
@@ -98,9 +93,20 @@ class Engine:
             )
         max_length = max_length or self.model.cfg.max_length
 
-        # Prefill per sequence (parity: engine prefill loop), collecting
-        # each sequence's last-token logits.
+        # Batched prefill (one jitted program for all rows — the
+        # reference engine loops rows from host, engine.py:113). Client
+        # left-padding rolls to the right where causal masking makes it
+        # inert; tp divisibility is met by further right-padding.
         t0 = time.perf_counter()
+        rows = np.stack(
+            [np.roll(input_ids[i], -int(starts[i])) for i in range(b)]
+        )
+        pad = (-s) % n
+        if pad:
+            rows = np.concatenate(
+                [rows, np.zeros((b, pad), np.int32)], axis=1
+            )
+        true_lens = (s - starts).astype(np.int32)
         if self.paged:
             from triton_distributed_tpu.models.paged_kv_cache import (
                 init_paged_cache,
@@ -111,30 +117,26 @@ class Engine:
                 self.model.cfg, b, self.model.ctx, self.model.axis,
                 max_length=max_length, page_size=self.page_size,
             )
-            # One dense scratch sequence, reused per row then scattered
-            # into pages.
+            # One batch-1 dense scratch, reused per row then scattered
+            # into pages — a full-batch dense cache alongside the pool
+            # would double peak KV memory, defeating paging.
             dense1 = self.model.new_cache(1, max_length)
             last_logits = []
             for i in range(b):
-                row = np.roll(input_ids[i], -int(starts[i]))
-                true_len = int(s - starts[i])
-                logits_i, filled = self.model.prefill(
-                    jnp.asarray(row), dense1, self.mode, true_len=true_len
+                logits_i, dense1 = self.model.prefill_batched(
+                    jnp.asarray(rows[i : i + 1]), dense1, self.mode,
+                    jnp.asarray(true_lens[i : i + 1]),
                 )
-                cache = write_prefill(cache, i, filled.k, filled.v, true_len)
-                last_logits.append(logits_i)
+                cache = write_prefill(
+                    cache, i, dense1.k, dense1.v, int(true_lens[i])
+                )
+                last_logits.append(logits_i[0])
+            logits = jnp.stack(last_logits)
         else:
             cache = self.model.new_cache(b, max_length)
-            last_logits = []
-            for i in range(b):
-                row = np.roll(input_ids[i], -int(starts[i]))  # pads → right
-                logits_i, cache_i = self.model.prefill(
-                    jnp.asarray(row), _take_batch(cache, i), self.mode,
-                    true_len=int(s - starts[i]),
-                )
-                cache = _put_batch(cache, cache_i, i)
-                last_logits.append(logits_i)
-        logits = jnp.stack(last_logits)  # [B, V]
+            logits, cache = self.model.prefill_batched(
+                jnp.asarray(rows), cache, self.mode, jnp.asarray(true_lens)
+            )
         t_prefill = time.perf_counter() - t0
 
         out = [input_ids]
@@ -163,18 +165,3 @@ class Engine:
             print(f"[engine] {self.last_stats}")
         return np.concatenate(out, axis=1)
 
-
-def _take_batch(cache: KVCache, i: int) -> KVCache:
-    return KVCache(
-        k=cache.k[:, i : i + 1],
-        v=cache.v[:, i : i + 1],
-        kv_len=cache.kv_len[i : i + 1],
-    )
-
-
-def _put_batch(cache: KVCache, one: KVCache, i: int) -> KVCache:
-    return KVCache(
-        k=jax.lax.dynamic_update_slice_in_dim(cache.k, one.k, i, axis=1),
-        v=jax.lax.dynamic_update_slice_in_dim(cache.v, one.v, i, axis=1),
-        kv_len=cache.kv_len.at[i].set(one.kv_len[0]),
-    )

@@ -156,6 +156,20 @@ class Qwen3:
         return self.set_params(params)
 
     def set_params(self, params: Qwen3Params) -> Qwen3Params:
+        # Pad the LM head's vocab axis to a multiple of 128·tp: each
+        # shard's column count becomes a 128-multiple, so tiled kernels
+        # (the megakernel's wide lm stream) stay lane-aligned under TP
+        # (Qwen3's 151936 = 2^7·1187 leaves a 64/96/48 residue at
+        # tp=2/4/8). ``_logits`` slices the pads back off — zero-weight
+        # columns would otherwise score 0 and could beat real logits.
+        n = self.ctx.axis_size(self.axis)
+        v = params.lm_head.shape[1]
+        align = 128 * n
+        vp = -(-v // align) * align
+        if vp != v:
+            params = dataclasses.replace(
+                params, lm_head=jnp.pad(params.lm_head, ((0, 0), (0, vp - v)))
+            )
         self.params = jax.tree.map(
             lambda x, s: jax.device_put(x, self.ctx.sharding(*s)),
             params,
@@ -173,11 +187,13 @@ class Qwen3:
         return jnp.take(params.embed, tokens, axis=0)
 
     def _logits(self, params: Qwen3Params, x: jax.Array) -> jax.Array:
-        """[B, d] → full logits [B, V] (lm_head column-sharded + gather)."""
+        """[B, d] → full logits [B, V] (lm_head column-sharded + gather;
+        vocab padding from ``set_params`` sliced back off)."""
         loc = jnp.dot(
             x, params.lm_head, preferred_element_type=jnp.float32
         )
-        return jax.lax.all_gather(loc, self.axis, axis=1, tiled=True)
+        full = jax.lax.all_gather(loc, self.axis, axis=1, tiled=True)
+        return full[:, : self.cfg.vocab_size]
 
     def _decode_shard(self, params, tokens, cache: KVCache, *, mode: Mode):
         """One decode step, per-shard: ``tokens [B]`` → logits [B, V]."""
@@ -242,58 +258,68 @@ class Qwen3:
             page_table=cache.page_table, kv_len=cache.kv_len + 1,
         )
 
-    def _prefill_shard(
-        self, params, tokens, cache: KVCache, true_len, *, mode: Mode
+    def _prefill_batch_shard(
+        self, params, tokens, cache: KVCache, true_lens, *, mode: Mode
     ):
-        """Prefill one sequence (batch entry 0), per-shard.
+        """Prefill ``B_rows`` sequences in ONE program, per-shard (the
+        single-sequence :meth:`prefill` is the B_rows=1 case).
 
-        ``tokens [s_loc]`` is this device's sequence slice; activations
-        stay sequence-sharded through all layers (ag_gemm gathers rows on
-        the fly — reference ``dist_triton_fwd`` layout). ``true_len``
-        (scalar) is the real prompt length: positions past it are
-        right-padding, inert under causal masking; logits are taken at
-        position ``true_len - 1`` and ``kv_len`` set to ``true_len`` so
-        decode overwrites the pad KV slots. Returns last-real-token
-        logits [V] and the filled cache.
+        ``tokens [B_rows, s_loc]``: each row's sequence slice;
+        activations stay sequence-sharded through all layers (ag_gemm
+        gathers rows on the fly — reference ``dist_triton_fwd`` layout).
+        Rows run as a ``lax.scan`` (sequential on device — prefill rows
+        are compute-bound, so row parallelism buys little — but one
+        dispatch replaces the host loop the reference engine also pays,
+        ``models/engine.py:113``). ``true_lens[i]`` is row i's real
+        prompt length: positions past it are right-padding, inert under
+        causal masking; logits are taken at ``true_lens[i] - 1`` and
+        ``kv_len[i]`` set to ``true_lens[i]`` so decode overwrites the
+        pad KV slots. Each row computes its K/V stack without touching
+        the cache; one batched write lands entries [0, B_rows) (the
+        cache batch may be larger).
         """
         cfg = self.cfg
         me = jax.lax.axis_index(self.axis)
-        x = self._embed(params, tokens)  # [s_loc, d]
+        s_loc = tokens.shape[1]
 
-        def layer_fn(carry, inp):
-            x = carry
-            lp, kc, vc = inp  # kc/vc: [B, hkv_loc, S_max, hd] layer slice
-            h = rms_norm(x, lp.ln1, cfg.rms_eps)
-            a, k_full, v_full = tp_attn_prefill(
-                lp.attn, h, self.dims, axis=self.axis, mode=mode, ctx=self.ctx
-            )
-            # k_full [hkv_loc, S, hd] → cache entry 0, positions [0, S).
-            kc = jax.lax.dynamic_update_slice(
-                kc, k_full.swapaxes(0, 1)[None].swapaxes(1, 2).astype(kc.dtype),
-                (0, 0, 0, 0),
-            )
-            vc = jax.lax.dynamic_update_slice(
-                vc, v_full.swapaxes(0, 1)[None].swapaxes(1, 2).astype(vc.dtype),
-                (0, 0, 0, 0),
-            )
-            x = x + a
-            h = rms_norm(x, lp.ln2, cfg.rms_eps)
-            x = x + self._mlp_fwd(lp.mlp, h, mode)
-            return x, (kc, vc)
+        def row_fn(_, inp):
+            toks, true_len = inp
+            x = self._embed(params, toks)  # [s_loc, d]
 
-        x, (k_new, v_new) = jax.lax.scan(
-            layer_fn, x, (params.layers, cache.k, cache.v)
+            def layer_fn(x, lp):
+                h = rms_norm(x, lp.ln1, cfg.rms_eps)
+                a, k_full, v_full = tp_attn_prefill(
+                    lp.attn, h, self.dims, axis=self.axis, mode=mode,
+                    ctx=self.ctx,
+                )
+                x = x + a
+                h = rms_norm(x, lp.ln2, cfg.rms_eps)
+                x = x + self._mlp_fwd(lp.mlp, h, mode)
+                return x, (k_full, v_full)
+
+            x, (k_all, v_all) = jax.lax.scan(layer_fn, x, params.layers)
+            x = rms_norm(x, params.norm, cfg.rms_eps)
+            # The last real token lives at global position true_len - 1
+            # on shard (idx // s_loc); select its row, broadcast by psum.
+            idx = true_len - 1
+            own = jnp.where(me == idx // s_loc, 1.0, 0.0).astype(jnp.float32)
+            row = jnp.take(x, idx % s_loc, axis=0)
+            x_last = jax.lax.psum(row.astype(jnp.float32) * own, self.axis)
+            logits = self._logits(params, x_last[None].astype(x.dtype))[0]
+            # k_all [L, hkv_loc, S, hd] per row.
+            return None, (logits, k_all, v_all)
+
+        _, (logits, ks, vs) = jax.lax.scan(row_fn, None, (tokens, true_lens))
+        # ks [B_rows, L, hkv, S, hd] → [L, B_rows, hkv, S, hd] at [0, S).
+        k_new = jax.lax.dynamic_update_slice(
+            cache.k, jnp.swapaxes(ks, 0, 1).astype(cache.k.dtype),
+            (0, 0, 0, 0, 0),
         )
-        x = rms_norm(x, params.norm, cfg.rms_eps)
-        # The last real token lives at global position true_len - 1 on
-        # shard (idx // s_loc); select its row and broadcast via psum.
-        s_loc = tokens.shape[0]
-        idx = true_len - 1
-        own = jnp.where(me == idx // s_loc, 1.0, 0.0).astype(jnp.float32)
-        row = jnp.take(x, idx % s_loc, axis=0)
-        x_last = jax.lax.psum(row.astype(jnp.float32) * own, self.axis)
-        logits = self._logits(params, x_last[None].astype(x.dtype))[0]
-        kv_len = cache.kv_len.at[0].set(true_len)
+        v_new = jax.lax.dynamic_update_slice(
+            cache.v, jnp.swapaxes(vs, 0, 1).astype(cache.v.dtype),
+            (0, 0, 0, 0, 0),
+        )
+        kv_len = jax.lax.dynamic_update_slice(cache.kv_len, true_lens, (0,))
         return logits, KVCache(k=k_new, v=v_new, kv_len=kv_len)
 
     # -- jitted SPMD entry points ----------------------------------------
@@ -345,25 +371,59 @@ class Qwen3:
         """Prefill one sequence (``tokens [S]``, S divisible by tp;
         right-pad to reach divisibility and pass the real length as
         ``true_len`` — trailing pads are inert under causal masking).
-        Returns (last-real-token logits [V], cache with entry 0 filled)."""
+        Returns (last-real-token logits [V], cache with entry 0 filled).
+        The B_rows=1 case of :meth:`prefill_batched` (one forward path)."""
         key = (mode, int(tokens.shape[0]))
         if true_len is None:
             true_len = tokens.shape[0]
         if key not in self._prefill_jit:
             f = self.ctx.shard_map(
-                functools.partial(self._prefill_shard, mode=mode),
+                functools.partial(self._prefill_batch_shard, mode=mode),
                 in_specs=(
-                    self.param_specs, P(self.axis), cache_specs(self.axis), P(),
+                    self.param_specs, P(None, self.axis),
+                    cache_specs(self.axis), P(),
                 ),
                 out_specs=(P(), cache_specs(self.axis)),
             )
-            # No cache donation here: callers pass batch-1 cache slices
-            # (engine prefill loop) that can alias the full cache when
-            # B == 1 — donating would delete the caller's buffer. The
+            # No cache donation here: callers may alias slices of a
+            # larger cache — donating would delete their buffer. The
             # per-token donation win lives in decode_step.
-            self._prefill_jit[key] = jax.jit(lambda p, t, c, tl: f(p, t, c, tl))
-        return self._prefill_jit[key](
+            self._prefill_jit[key] = jax.jit(
+                lambda p, t, c, tl: f(p, t[None], c, tl[None])
+            )
+        logits, cache = self._prefill_jit[key](
             self.params, tokens, cache, jnp.asarray(true_len, jnp.int32)
+        )
+        return logits[0], cache
+
+    def prefill_batched(
+        self,
+        tokens: jax.Array,  # [B, S] int32, S divisible by tp
+        cache: KVCache,
+        mode: Mode = "xla",
+        true_lens: jax.Array | None = None,
+    ):
+        """Prefill every sequence of the batch in ONE jitted program
+        (row scan on device; see ``_prefill_batch_shard``). Returns
+        (last-real-token logits [B, V], filled cache)."""
+        b, s = tokens.shape
+        if true_lens is None:
+            true_lens = jnp.full((b,), s, jnp.int32)
+        key = ("batched", mode, b, s)
+        if key not in self._prefill_jit:
+            f = self.ctx.shard_map(
+                functools.partial(self._prefill_batch_shard, mode=mode),
+                in_specs=(
+                    self.param_specs, P(None, self.axis),
+                    cache_specs(self.axis), P(),
+                ),
+                out_specs=(P(), cache_specs(self.axis)),
+            )
+            self._prefill_jit[key] = jax.jit(
+                lambda p, t, c, tl: f(p, t, c, tl), donate_argnums=(2,)
+            )
+        return self._prefill_jit[key](
+            self.params, tokens, cache, jnp.asarray(true_lens, jnp.int32)
         )
 
     def new_cache(self, batch_size: int, max_length: int | None = None) -> KVCache:
