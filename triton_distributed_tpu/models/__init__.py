@@ -14,6 +14,9 @@ import os
 import jax
 
 from triton_distributed_tpu.models.config import ModelConfig, get_config  # noqa: F401
+from triton_distributed_tpu.models.continuous import (  # noqa: F401
+    ContinuousEngine,
+)
 from triton_distributed_tpu.models.engine import Engine  # noqa: F401
 from triton_distributed_tpu.models.kv_cache import KVCache, init_cache  # noqa: F401
 from triton_distributed_tpu.models.qwen import (  # noqa: F401

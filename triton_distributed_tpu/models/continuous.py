@@ -1,0 +1,210 @@
+"""Continuous batching over the paged KV pool.
+
+Beyond-parity subsystem: the reference Engine (``models/engine.py:113``)
+serves fixed batches; modern serving interleaves requests — admit a new
+sequence the moment pool pages free up, evict on completion, and step
+the union every iteration. Its paged cache
+(``mega_triton_kernel/models/paged_kv_cache.py``) is the natural
+substrate, and this module is the TPU build's admission/eviction loop on
+top of ours.
+
+Design: the decode step stays ONE jitted program over a fixed
+``max_batch`` of slots (static shapes — XLA's requirement). Slot state
+(page table rows, kv_len, free list) lives host-side; admission writes a
+slot's table row + kv_len and prefises the prompt into its pages,
+eviction releases the pages. Inactive slots keep table row 0 and point
+at a reserved trash page, so their (masked-out) appends land harmlessly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from triton_distributed_tpu.models import sampling
+from triton_distributed_tpu.models.paged_kv_cache import (
+    PagedKVCache,
+    PagePool,
+    init_paged_cache,
+    write_prefill,
+)
+from triton_distributed_tpu.models.qwen import Mode, Qwen3
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request and its accumulated output."""
+
+    prompt: np.ndarray  # [S] int32
+    gen_len: int
+    out: list[int] = dataclasses.field(default_factory=list)
+    slot: int | None = None
+    pages: list[int] = dataclasses.field(default_factory=list)
+
+    @property
+    def done(self) -> bool:
+        return len(self.out) >= self.gen_len
+
+
+class ContinuousEngine:
+    """Admission/eviction serving loop over the paged pool.
+
+    ``max_batch`` decode slots share ``num_pages`` pool pages; a request
+    is admitted when a slot AND enough pages for its prompt+gen_len are
+    free. Page 0 is reserved as the trash page for inactive slots.
+    """
+
+    def __init__(
+        self,
+        model: Qwen3,
+        *,
+        max_batch: int = 4,
+        page_size: int = 128,
+        max_length: int | None = None,
+        num_pages: int | None = None,
+        mode: Mode = "xla",
+        temperature: float = 0.0,
+        eos_id: int | None = None,
+        seed: int = 0,
+    ):
+        self.model = model
+        self.mode = mode
+        self.temperature = temperature
+        self.eos_id = eos_id
+        self.key = jax.random.key(seed)
+        self.max_batch = max_batch
+        self.page_size = page_size
+        self.max_length = max_length or model.cfg.max_length
+        self.pps = self.max_length // page_size
+
+        # +1: page 0 is reserved as the trash page every inactive slot's
+        # table points at, and must not shave serviceable capacity.
+        n_pages = (num_pages or max_batch * self.pps) + 1
+        self.cache, self.pool = init_paged_cache(
+            model.cfg, max_batch, model.ctx, model.axis,
+            max_length=self.max_length, page_size=page_size,
+            num_pages=n_pages, assign_pages=False,
+        )
+        self.pool.free = [p for p in self.pool.free if p != 0]
+        self._capacity = len(self.pool.free)
+        self._table = np.zeros((max_batch, self.pps), np.int32)
+        self._kv_len = np.zeros((max_batch,), np.int32)
+        self._dense1 = model.new_cache(1, self.max_length)
+        self._slots: list[Request | None] = [None] * max_batch
+
+    # -- slot management -------------------------------------------------
+
+    def _sync_tables(self) -> None:
+        self.cache = dataclasses.replace(
+            self.cache,
+            page_table=jnp.asarray(self._table),
+            kv_len=jnp.asarray(self._kv_len),
+        )
+
+    def _admit(self, req: Request, slot: int) -> jax.Array:
+        """Prefill ``req`` into ``slot``; returns the first sampled token."""
+        s = len(req.prompt)
+        n = self.model.ctx.axis_size(self.model.axis)
+        pad = (-s) % n
+        row = np.concatenate([req.prompt, np.zeros(pad, np.int32)])
+        need = -(-(s + req.gen_len) // self.page_size)
+        req.pages = self.pool.allocate(need)
+        req.slot = slot
+        self._table[slot] = 0
+        self._table[slot, : len(req.pages)] = req.pages
+        self._kv_len[slot] = s
+        self._sync_tables()
+
+        logits, self._dense1 = self.model.prefill_batched(
+            jnp.asarray(row[None]), self._dense1, self.mode,
+            jnp.asarray([s], jnp.int32),
+        )
+        self.cache = write_prefill(
+            self.cache, slot, self._dense1.k, self._dense1.v, s
+        )
+        self._slots[slot] = req
+        return self._sample(logits)[0]
+
+    def _evict(self, req: Request) -> None:
+        slot = req.slot
+        self.pool.release(req.pages)
+        self._table[slot] = 0  # back to the trash page
+        self._kv_len[slot] = 0
+        req.pages, req.slot = [], None
+        self._slots[slot] = None
+
+    def _sample(self, logits: jax.Array) -> jax.Array:
+        if self.temperature <= 0.0:
+            return sampling.greedy(logits)
+        self.key, sub = jax.random.split(self.key)
+        return sampling.sample(logits, sub, self.temperature, 1.0)
+
+    # -- the loop --------------------------------------------------------
+
+    def run(self, requests: list[tuple[np.ndarray, int]]) -> list[np.ndarray]:
+        """Serve ``(prompt, gen_len)`` requests to completion; returns
+        each request's generated tokens (prompt excluded), in order."""
+        reqs = [Request(np.asarray(p, np.int32), g) for p, g in requests]
+        for r in reqs:
+            total = len(r.prompt) + r.gen_len
+            if total > self.max_length:
+                raise ValueError(
+                    f"prompt+gen_len = {total} exceeds max_length "
+                    f"{self.max_length}"
+                )
+            if -(-total // self.page_size) > self._capacity:
+                raise ValueError(
+                    f"request needs {-(-total // self.page_size)} pages; "
+                    f"pool capacity is {self._capacity} (unservable)"
+                )
+        queue = deque(reqs)
+        tok = np.zeros((self.max_batch,), np.int32)
+
+        def try_admit() -> bool:
+            admitted = False
+            for slot in range(self.max_batch):
+                if self._slots[slot] is None and queue:
+                    need = -(-(len(queue[0].prompt) + queue[0].gen_len)
+                             // self.page_size)
+                    if need > len(self.pool.free):
+                        break  # head-of-line waits for pages
+                    req = queue.popleft()
+                    first = self._admit(req, slot)
+                    req.out.append(int(first))
+                    tok[slot] = int(first)
+                    admitted = True
+            return admitted
+
+        try_admit()
+        while any(r is not None for r in self._slots):
+            logits, self.cache = self.model.decode_step(
+                jnp.asarray(tok), self.cache, self.mode
+            )
+            self._kv_len += (
+                np.asarray([r is not None for r in self._slots], np.int32)
+            )
+            # decode_step bumped every row on device; mirror tracks the
+            # active ones (inactive rows append into the trash page).
+            nxt = np.asarray(self._sample(logits))
+            changed = False
+            for slot, req in enumerate(self._slots):
+                if req is None:
+                    continue
+                req.out.append(int(nxt[slot]))
+                tok[slot] = int(nxt[slot])
+                if req.done or (
+                    self.eos_id is not None and int(nxt[slot]) == self.eos_id
+                ):
+                    self._evict(req)  # eos/gen_len: free pages NOW
+                    changed = True
+            if changed:
+                # Slot state changed: the device cache threads k/v
+                # pages, but table + kv_len are host-authoritative.
+                try_admit()
+                self._sync_tables()
+
+        return [np.asarray(r.out, np.int32) for r in reqs]

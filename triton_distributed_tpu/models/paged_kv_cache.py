@@ -68,17 +68,28 @@ def init_paged_cache(
     max_length: int | None = None,
     page_size: int = 128,
     num_pages: int | None = None,
+    assign_pages: bool = True,
 ) -> tuple[PagedKVCache, PagePool]:
-    """Allocate the pool + page tables for ``batch_size`` sequences."""
+    """Allocate the pool + page tables for ``batch_size`` sequences.
+
+    ``assign_pages=False`` leaves the pool full and the table zeroed —
+    for callers that manage page assignment themselves (continuous
+    batching admits/evicts per request, possibly with ``num_pages``
+    oversubscribed below ``batch_size * pages_per_seq``).
+    """
     s_max = max_length or cfg.max_length
     if s_max % page_size:
         raise ValueError(f"max_length {s_max} not a page multiple")
     pages_per_seq = s_max // page_size
     num_pages = num_pages or batch_size * pages_per_seq
     pool = PagePool(num_pages)
-    table = np.asarray(
-        [pool.allocate(pages_per_seq) for _ in range(batch_size)], np.int32
-    )
+    if assign_pages:
+        table = np.asarray(
+            [pool.allocate(pages_per_seq) for _ in range(batch_size)],
+            np.int32,
+        )
+    else:
+        table = np.zeros((batch_size, pages_per_seq), np.int32)
     shape = (
         cfg.num_layers, num_pages, cfg.num_kv_heads, page_size, cfg.head_dim
     )
