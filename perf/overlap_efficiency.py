@@ -45,29 +45,57 @@ def measured_kernel_efficiency(args, jax, jnp, np):
     a = jax.random.normal(key, (m, k), jnp.float32).astype(dt)
     b = jax.random.normal(key, (k, n), jnp.float32).astype(dt)
 
-    def timed(f, iters=8):
+    iters = 8
+
+    def make(f):
         # Chain iterations with a data dependency; fence by host fetch.
+        # Two traps make naive chaining flatter the pure-XLA rung:
+        # (1) `a + acc * 0` folds, letting XLA hoist the GEMM out of the
+        #     loop — the perturbation below is sub-ulp but not foldable;
+        # (2) carrying `out[0, 0]` leaves all but one dot product DEAD —
+        #     XLA can slice through the GEMM and compute a vector dot.
+        #     `jnp.sum(out)` keeps every element live (the side-effecting
+        #     Pallas rung never had this hazard, which silently skews the
+        #     comparison).
         def chained(a, b):
             def body(_, acc):
-                out = f(a + acc * 0, b)
-                return out[0, 0].astype(jnp.float32)
+                out = f(a + (acc * 1e-30).astype(a.dtype), b)
+                return jnp.sum(out.astype(jnp.float32))
 
             return jax.lax.fori_loop(0, iters, body, jnp.float32(0))
 
         run = jax.jit(chained)
         np.asarray(run(a, b))  # compile + warm
-        best = float("inf")
-        for _ in range(3):
+        return run
+
+    # Interleave the rungs and take medians: the relay occasionally lets
+    # one call's work leak into the next measurement window (an inflated
+    # rep immediately followed by an impossibly fast one), so min() over
+    # sequential reps is untrustworthy.
+    cfg = create_ag_gemm_context(m, n, k, dt)
+    runs = {
+        # Cast back to the input dtype so both rungs pay the same
+        # epilogue (the fused kernel's output is bf16).
+        "xla": make(
+            lambda a, b: jnp.dot(
+                a, b, preferred_element_type=jnp.float32
+            ).astype(a.dtype)
+        ),
+        "fused": make(lambda a, b: ag_gemm_op(a, b, "tp", cfg, ctx)),
+    }
+    samples = {name: [] for name in runs}
+    for _ in range(7):
+        for name, run in runs.items():
             t0 = time.perf_counter()
             np.asarray(run(a, b))
-            best = min(best, (time.perf_counter() - t0) / iters)
-        return best * 1e3
+            samples[name].append((time.perf_counter() - t0) / iters * 1e3)
 
-    t_xla = timed(lambda a, b: jnp.dot(a, b, preferred_element_type=jnp.float32))
-    cfg = create_ag_gemm_context(m, n, k, dt)
-    t_fused = timed(
-        lambda a, b: ag_gemm_op(a, b, "tp", cfg, ctx)
-    )
+    def median(xs):
+        xs = sorted(xs)
+        return xs[len(xs) // 2]
+
+    t_xla = median(samples["xla"])
+    t_fused = median(samples["fused"])
     return {
         "xla_gemm_ms": round(t_xla, 3),
         "fused_kernel_ms": round(t_fused, 3),

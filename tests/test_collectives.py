@@ -40,6 +40,7 @@ def test_all_gather(ctx4, rng, method):
     "method",
     [
         ReduceScatterMethod.XLA,
+        ReduceScatterMethod.ONE_SHOT,
         ReduceScatterMethod.PALLAS_RING,
         ReduceScatterMethod.PALLAS_RING_HBM,
     ],

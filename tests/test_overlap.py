@@ -42,6 +42,29 @@ def test_ag_gemm_8dev(ctx8, rng):
     )
 
 
+def test_ag_gemm_chunked_staging(ctx4, rng):
+    # tile_m < m_per forces the multi-M-tile staging path (_land_current
+    # / _prefetch_same_chunk buffer parity) that the sweep-tuned default
+    # configs skip at small shapes.
+    M, K, N = 4 * 32, 128, 256
+    a = jnp.asarray(rng.standard_normal((M, K), dtype=np.float32))
+    b = jnp.asarray(rng.standard_normal((K, N), dtype=np.float32))
+    out = ag_gemm_op(a, b, "tp", AGGemmConfig(tile_n=128, tile_m=8), ctx4)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(a) @ np.asarray(b), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_gemm_rs_chunked_staging(ctx4, rng):
+    M, K, N = 4 * 32, 256, 256
+    a = jnp.asarray(rng.standard_normal((M, K), dtype=np.float32))
+    b = jnp.asarray(rng.standard_normal((K, N), dtype=np.float32))
+    out = gemm_rs_op(a, b, "tp", GemmRSConfig(tile_n=128, tile_m=8), ctx4)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(a) @ np.asarray(b), rtol=1e-4, atol=1e-4
+    )
+
+
 @pytest.mark.parametrize("tile_n", [128, 256])
 def test_gemm_rs(ctx4, rng, tile_n):
     M, K, N = 4 * 32, 256, 256

@@ -36,15 +36,23 @@ from triton_distributed_tpu.runtime.mesh import DistContext, current_context
 class ReduceScatterMethod(enum.Enum):
     AUTO = "auto"
     XLA = "xla"
+    ONE_SHOT = "one_shot"                # single-hop scatter + local add
     PALLAS_RING = "pallas_ring"          # VMEM-resident (small payloads)
     PALLAS_RING_HBM = "pallas_ring_hbm"  # HBM slots + tiled VMEM adds
 
 
 _RS_COLLECTIVE_ID = next_collective_id()
 _RS_HBM_COLLECTIVE_ID = next_collective_id()
+_RS_ONESHOT_COLLECTIVE_ID = next_collective_id()
 
 # Per-buffer budget for the HBM ring's VMEM add tiles.
 _RS_TILE_BUDGET = 1024 * 1024
+
+# Below this total payload the single-hop scatter beats the ring's n-1
+# serialized hops (same latency-class crossover as the allreduce
+# one-shot; parity: the reference's method dispatch by message size,
+# ``reduce_scatter.py:857`` choosing a2a-style vs ring consumers).
+_RS_ONE_SHOT_MAX_BYTES = 256 * 1024
 
 
 def _ring_rs_kernel(x_ref, o_ref, bufs, send_sems, recv_sems, *, axis: str):
@@ -75,6 +83,47 @@ def _ring_rs_kernel(x_ref, o_ref, bufs, send_sems, recv_sems, *, axis: str):
         o_ref[:] = bufs[n - 2]
     else:
         o_ref[:] = x_ref[:]
+
+
+def _one_shot_rs_kernel(x_ref, o_ref, bufs, send_sems, recv_sems, *, axis: str):
+    """Single-hop scatter + local add — the latency method.
+
+    Each device pushes chunk ``r`` of its partials straight to device
+    ``r`` (one software step, all sends in flight at once), then adds
+    the ``n`` received contributions locally in f32. Beats the ring's
+    ``n-1`` serialized hops for small messages; loses above the
+    crossover because non-neighbor hops share ICI links. Parity role:
+    the reference's a2a-style reduce-scatter consumer
+    (``reduce_scatter.py:674`` ``kernel_ring_reduce_tma`` run in its
+    a2a ordering) and the one-shot allreduce's latency class.
+    """
+    me = dl.rank(axis)
+    n = dl.num_ranks(axis)
+    m_per = o_ref.shape[0]
+
+    def chunk(idx):
+        return pl.ds(idx * m_per, m_per)
+
+    dl.barrier_all(axis)  # peers' bufs must exist before any put
+    bufs[me] = x_ref[chunk(me)]
+    dmas = []
+    for p in range(1, n):
+        peer = jax.lax.rem(me + p, n)
+        # Our chunk destined for ``peer`` lands in peer's bufs[me].
+        dmas.append(
+            dl.put_signal(
+                x_ref.at[chunk(peer)], bufs.at[me], peer,
+                send_sems.at[p - 1], recv_sems, axis=axis,
+            )
+        )
+    for _ in range(1, n):
+        dl.wait_recv(recv_sems, bufs.at[0])
+    dl.quiet(*dmas)
+
+    acc = bufs[0].astype(jnp.float32)
+    for i in range(1, n):
+        acc = acc + bufs[i].astype(jnp.float32)
+    o_ref[:] = acc.astype(o_ref.dtype)
 
 
 def _ring_rs_hbm_kernel(
@@ -221,6 +270,8 @@ def reduce_scatter(
     if method == ReduceScatterMethod.AUTO:
         if not _on_tpu(ctx) or x.ndim < 2:
             method = ReduceScatterMethod.XLA
+        elif x.size * x.dtype.itemsize <= _RS_ONE_SHOT_MAX_BYTES:
+            method = ReduceScatterMethod.ONE_SHOT
         elif x.size * x.dtype.itemsize <= VMEM_COMM_MAX_BYTES:
             method = ReduceScatterMethod.PALLAS_RING
         else:
@@ -235,6 +286,23 @@ def reduce_scatter(
         raise ValueError(f"rows {x.shape[0]} not divisible by axis size {n}")
     m_per = x.shape[0] // n
     out_shape = jax.ShapeDtypeStruct((m_per, *x.shape[1:]), x.dtype)
+
+    if method == ReduceScatterMethod.ONE_SHOT:
+        if n == 1:
+            return x
+        return comm_pallas_call(
+            functools.partial(_one_shot_rs_kernel, axis=axis),
+            out_shape,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=[
+                pltpu.VMEM((n, m_per, *x.shape[1:]), x.dtype),
+                pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+                pltpu.SemaphoreType.DMA(()),
+            ],
+            collective_id=_RS_ONESHOT_COLLECTIVE_ID,
+            ctx=ctx,
+        )(x)
 
     if method == ReduceScatterMethod.PALLAS_RING_HBM:
         if n == 1:

@@ -51,6 +51,28 @@ def pick_stage_tile(
     return max(tile, 1)
 
 
+def overlap_vmem_limit(
+    tile_m: int, k: int, tile_n: int, itemsize: int, out_tile_bufs: int = 3
+) -> int:
+    """Scoped-VMEM limit for the fused overlap GEMM kernels.
+
+    Mosaic's own accounting runs ~1.5x the raw buffer bytes (pipelined
+    operand copies, stack), hence the 3x-per-double-buffer coefficients
+    plus a fixed margin; capped below v5e's 128 MB physical VMEM.
+    ``out_tile_bufs`` scales the (tile_m, tile_n) term — gemm_rs keeps
+    three double-buffered output-sized tiles where ag_gemm keeps one.
+    """
+    return min(
+        110 * 1024 * 1024,
+        max(
+            64 * 1024 * 1024,
+            (3 * tile_m * k + 3 * k * tile_n
+             + 3 * out_tile_bufs * tile_m * tile_n) * itemsize
+            + 16 * 1024 * 1024,
+        ),
+    )
+
+
 def pick_tile(n: int, preferred: int = 512) -> int:
     """Largest power-of-two-ish tile dividing ``n`` (shared by the
     overlap-GEMM context builders; parity: the reference's per-shape tile

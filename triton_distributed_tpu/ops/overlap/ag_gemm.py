@@ -49,6 +49,7 @@ from triton_distributed_tpu.ops.common import (
     comm_cost,
     comm_pallas_call,
     next_collective_id,
+    overlap_vmem_limit,
     pick_tile,
 )
 from triton_distributed_tpu.runtime.mesh import DistContext, current_context
@@ -81,8 +82,12 @@ class AGGemmConfig:
 
 
 # Per-buffer VMEM staging budget for the A double buffer. Tiles are
-# shrunk until 2 * tile_m * K * itemsize fits.
-_AG_STAGE_BUDGET = 2 * 1024 * 1024
+# shrunk until tile_m * K * itemsize fits (each of the two buffers gets
+# this much). 8 MB (tile_m=1024 at K=4096 bf16) measured best on v5e at
+# north-star shapes (perf/sweep_overlap_tiles.py): larger M tiles cut
+# the per-(step, tile) B re-streaming, and 1024-wide B tiles keep the
+# MXU pipeline full.
+_AG_STAGE_BUDGET = 8 * 1024 * 1024
 
 
 def create_ag_gemm_context(
@@ -96,7 +101,7 @@ def create_ag_gemm_context(
     while m_per % tile_m:
         tile_m //= 2
     return AGGemmConfig(
-        tile_n=pick_tile(n_loc) if tile_n is None else tile_n,
+        tile_n=pick_tile(n_loc, 1024) if tile_n is None else tile_n,
         tile_m=max(tile_m, 1),
     )
 
@@ -278,8 +283,11 @@ def ag_gemm(
         collective_id=_AG_GEMM_COLLECTIVE_ID,
         # Mosaic double-buffers the BlockSpec-pipelined operands; at
         # north-star shapes that exceeds the 16 MB default scoped-VMEM
-        # limit (v5e/v5p have 128 MB physical).
-        vmem_limit_bytes=64 * 1024 * 1024,
+        # limit (v5e/v5p have 128 MB physical). Large-tile configs (the
+        # sweep-tuned defaults) need headroom above 64 MB.
+        vmem_limit_bytes=overlap_vmem_limit(
+            tile_m, k, tile_n, a.dtype.itemsize, out_tile_bufs=1
+        ),
         dimension_semantics=("arbitrary", "arbitrary", "arbitrary"),
         cost_estimate=comm_cost(
             flops=2 * n * m_per * k * n_loc,

@@ -38,6 +38,7 @@ from triton_distributed_tpu.ops.common import (
     comm_cost,
     comm_pallas_call,
     next_collective_id,
+    overlap_vmem_limit,
     pick_tile,
 )
 from triton_distributed_tpu.runtime.mesh import DistContext, current_context
@@ -54,7 +55,9 @@ class GemmRSConfig:
     acc_dtype: jnp.dtype = jnp.float32
 
 
-_RS_STAGE_BUDGET = 2 * 1024 * 1024
+# 8 MB (tile_m=1024 at K=4096 bf16) measured best on v5e — see
+# perf/sweep_overlap_tiles.py and the ag_gemm budget note.
+_RS_STAGE_BUDGET = 8 * 1024 * 1024
 
 
 def create_gemm_rs_context(
@@ -69,7 +72,7 @@ def create_gemm_rs_context(
     while m_per % tile_m:
         tile_m //= 2
     return GemmRSConfig(
-        tile_n=pick_tile(n_out) if tile_n is None else tile_n,
+        tile_n=pick_tile(n_out, 1024) if tile_n is None else tile_n,
         tile_m=max(tile_m, 1),
     )
 
@@ -325,8 +328,11 @@ def gemm_rs(
         collective_id=_GEMM_RS_COLLECTIVE_ID,
         # Mosaic double-buffers the BlockSpec-pipelined operands; at
         # north-star shapes that exceeds the 16 MB default scoped-VMEM
-        # limit (v5e/v5p have 128 MB physical).
-        vmem_limit_bytes=64 * 1024 * 1024,
+        # limit (v5e/v5p have 128 MB physical). Large-tile configs (the
+        # sweep-tuned defaults) need headroom above 64 MB.
+        vmem_limit_bytes=overlap_vmem_limit(
+            tile_m, k_loc, tile_n, a.dtype.itemsize, out_tile_bufs=3
+        ),
         dimension_semantics=("arbitrary", "arbitrary", "arbitrary"),
         cost_estimate=comm_cost(
             flops=2 * m * k_loc * n_out,
