@@ -127,12 +127,14 @@ def main() -> None:
 
     def time_rung(run_once) -> float:
         run_once()  # compile + warm
-        best = float("inf")
-        for _ in range(3):
+        # Median, not min: the relay can leak one call's device work into
+        # the next measurement window (see perf/OVERLAP_RESULTS.md).
+        ts = []
+        for _ in range(5):
             t0 = time.perf_counter()
             run_once()
-            best = min(best, (time.perf_counter() - t0) / STEPS)
-        return best * 1e3
+            ts.append((time.perf_counter() - t0) / STEPS)
+        return sorted(ts)[len(ts) // 2] * 1e3
 
     ladder: dict[str, float] = {}
     errors: dict[str, str] = {}

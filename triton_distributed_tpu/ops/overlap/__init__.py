@@ -17,3 +17,7 @@ from triton_distributed_tpu.ops.overlap.gemm_rs import (  # noqa: F401
     gemm_rs,
     gemm_rs_op,
 )
+from triton_distributed_tpu.ops.overlap.tuned import (  # noqa: F401
+    ag_gemm_tuned,
+    gemm_rs_tuned,
+)

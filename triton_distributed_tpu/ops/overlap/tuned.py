@@ -1,0 +1,170 @@
+"""Autotuned entry points for the overlap ops.
+
+Parity: the reference wires its kernels to ``contextual_autotune``
+inside the tests/layers (``test/nvidia/test_ag_gemm.py`` wrapping
+``ag_gemm`` runs; ``autotuner.py:97``); here the tuned entry points are
+part of the op library so layers/models can opt in directly.
+
+The config space is the tile grid the on-chip sweep explores
+(``perf/sweep_overlap_tiles.py``); configs whose staging buffers
+cannot fit the scoped-VMEM cap are pruned before compiling anything
+(parity role: the reference pruning sweeps by ``gemm_perf_model``).
+Winning configs persist to the autotuner's disk cache keyed by
+(shard shapes, dtype, axis name + size).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.ops.overlap.ag_gemm import AGGemmConfig, ag_gemm_op
+from triton_distributed_tpu.ops.overlap.gemm_rs import GemmRSConfig, gemm_rs_op
+from triton_distributed_tpu.runtime.mesh import DistContext, current_context
+from triton_distributed_tpu.tools.autotuner import Autotuner, Config
+
+_TILE_MS = (256, 512, 1024, 2048)
+_TILE_NS = (256, 512, 1024)
+
+
+def _tile_grid(m_per: int, n_loc: int) -> list[tuple[int, int]]:
+    """Valid, deduplicated (tile_m, tile_n) pairs (tiles clamp to the
+    shard dims, so several grid points can collapse to one config)."""
+    seen = set()
+    for tm in _TILE_MS:
+        tm = min(tm, m_per)
+        if m_per % tm:
+            continue
+        for tn in _TILE_NS:
+            tn = min(tn, n_loc)
+            if n_loc % tn:
+                continue
+            seen.add((tm, tn))
+    return sorted(seen)
+
+
+def _ag_configs(m_per: int, n_loc: int, k: int) -> list[Config]:
+    out = [
+        Config({"config": AGGemmConfig(tile_n=tn, tile_m=tm)})
+        for tm, tn in _tile_grid(m_per, n_loc)
+    ]
+    return out or [Config({"config": None})]
+
+
+def _fits_vmem(cfg, k: int, itemsize: int, out_tile_bufs: int) -> bool:
+    """Config's staging buffers fit the scoped-VMEM cap (the same
+    formula ``overlap_vmem_limit`` sizes the limit with)."""
+    from triton_distributed_tpu.ops.common import overlap_vmem_limit
+
+    need = (
+        (3 * cfg.tile_m * k + 3 * k * cfg.tile_n
+         + 3 * out_tile_bufs * cfg.tile_m * cfg.tile_n) * itemsize
+        + 16 * 1024 * 1024
+    )
+    return need <= overlap_vmem_limit(
+        cfg.tile_m, k, cfg.tile_n, itemsize, out_tile_bufs
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _ag_tuner(
+    m_per: int, n_loc: int, k: int, axis: str, n_ranks: int, dtype: str,
+    is_dist: bool,
+):
+    def run(a, b, config=None, *, _ctx=None):
+        return ag_gemm_op(a, b, axis, config, _ctx or current_context())
+
+    itemsize = jnp.dtype(dtype).itemsize
+
+    def prune(configs):
+        kept = [
+            c for c in configs
+            if c.kwargs["config"] is None
+            or _fits_vmem(c.kwargs["config"], k, itemsize, 1)
+        ]
+        return kept or list(configs)[:1]
+
+    return Autotuner(
+        run,
+        _ag_configs(m_per, n_loc, k),
+        key=lambda *a, **kw: (m_per, n_loc, k, axis, n_ranks, dtype),
+        prune=prune,
+        is_dist=is_dist,
+    )
+
+
+def ag_gemm_tuned(
+    a: jax.Array,
+    b: jax.Array,
+    axis: str = "tp",
+    ctx: DistContext | None = None,
+) -> jax.Array:
+    """``ag_gemm_op`` with the tile config autotuned per shape.
+
+    ``a`` ``[M, K]`` row-sharded over ``axis``, ``b`` ``[K, N]``
+    column-sharded (host shapes). First call per (shape, axis) sweeps
+    the tile grid; later calls (and later processes, via the disk
+    cache) replay the argmin.
+    """
+    ctx = ctx or current_context()
+    n = ctx.mesh.shape[axis]
+    m_per = a.shape[0] // n
+    n_loc = b.shape[1] // n
+    tuner = _ag_tuner(
+        m_per, n_loc, a.shape[1], axis, n, jnp.dtype(a.dtype).name,
+        jax.process_count() > 1,
+    )
+    return tuner(a, b, _ctx=ctx)
+
+
+def _rs_configs(m: int, n_out: int, k_loc: int, n_ranks: int) -> list[Config]:
+    m_per = max(m // max(n_ranks, 1), 1)
+    out = [
+        Config({"config": GemmRSConfig(tile_n=tn, tile_m=tm)})
+        for tm, tn in _tile_grid(m_per, n_out)
+    ]
+    return out or [Config({"config": None})]
+
+
+@functools.lru_cache(maxsize=64)
+def _rs_tuner(m: int, n_out: int, k_loc: int, axis: str, n_ranks: int,
+              dtype: str, is_dist: bool):
+    def run(a, b, config=None, *, _ctx=None):
+        return gemm_rs_op(a, b, axis, config, _ctx or current_context())
+
+    itemsize = jnp.dtype(dtype).itemsize
+
+    def prune(configs):
+        kept = [
+            c for c in configs
+            if c.kwargs["config"] is None
+            or _fits_vmem(c.kwargs["config"], k_loc, itemsize, 3)
+        ]
+        return kept or list(configs)[:1]
+
+    return Autotuner(
+        run,
+        _rs_configs(m, n_out, k_loc, n_ranks),
+        key=lambda *a, **kw: (m, n_out, k_loc, axis, n_ranks, dtype),
+        prune=prune,
+        is_dist=is_dist,
+    )
+
+
+def gemm_rs_tuned(
+    a: jax.Array,
+    b: jax.Array,
+    axis: str = "tp",
+    ctx: DistContext | None = None,
+) -> jax.Array:
+    """``gemm_rs_op`` with the tile config autotuned per shape."""
+    ctx = ctx or current_context()
+    n = ctx.mesh.shape[axis]
+    k_loc = a.shape[1] // n
+    tuner = _rs_tuner(
+        a.shape[0], b.shape[1], k_loc, axis, n, jnp.dtype(a.dtype).name,
+        jax.process_count() > 1,
+    )
+    return tuner(a, b, _ctx=ctx)
