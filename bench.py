@@ -125,16 +125,10 @@ def main() -> None:
 
         return jax.jit(decode_n, static_argnums=3)
 
+    from triton_distributed_tpu.runtime.utils import median_time
+
     def time_rung(run_once) -> float:
-        run_once()  # compile + warm
-        # Median, not min: the relay can leak one call's device work into
-        # the next measurement window (see perf/OVERLAP_RESULTS.md).
-        ts = []
-        for _ in range(5):
-            t0 = time.perf_counter()
-            run_once()
-            ts.append((time.perf_counter() - t0) / STEPS)
-        return sorted(ts)[len(ts) // 2] * 1e3
+        return median_time(run_once) / STEPS * 1e3
 
     ladder: dict[str, float] = {}
     errors: dict[str, str] = {}

@@ -88,3 +88,26 @@ def test_barrier_all(ctx4):
     f = jax.jit(ctx4.shard_map(body, in_specs=P("tp", None), out_specs=P("tp", None)))
     x = jnp.ones((4, 128), jnp.float32)
     np.testing.assert_allclose(np.asarray(f(x)), 2 * np.ones((4, 128)))
+
+
+def test_translate_rank(ctx2x4):
+    """Device-side team translation (parity: nvshmem_team_translate_pe).
+
+    On the 2x4 dp×tp mesh: tp-peer r of a device keeps the device's dp
+    coordinate, so its world rank is dp*4 + r; translating from the
+    world team back to tp extracts the tp coordinate.
+    """
+    def body():
+        r = jnp.int32(2)
+        world = dl.translate_rank(r, "tp", ("dp", "tp"))
+        back = dl.translate_rank(world, ("dp", "tp"), "tp")
+        me_world = dl.translate_rank(dl.rank("tp"), "tp", ("dp", "tp"))
+        return jnp.stack([world, back, me_world])[None]
+
+    f = ctx2x4.shard_map(body, in_specs=(), out_specs=P(("dp", "tp")))
+    out = np.asarray(f()).reshape(8, 3)
+    for w in range(8):
+        dp, tp = divmod(w, 4)
+        assert out[w, 0] == dp * 4 + 2      # tp-peer 2's world rank
+        assert out[w, 1] == 2               # round-trip back to tp team
+        assert out[w, 2] == w               # own tp rank → own world rank

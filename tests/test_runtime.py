@@ -130,3 +130,22 @@ class TestSnakeRing:
         ctx = initialize_distributed(tp=8)
         assert ctx.topology.torus_shape is None  # cpu: no coords
         finalize_distributed()
+
+
+def test_probe_topology_and_ici(ctx4):
+    """Probe suite (parity: reference topology/bandwidth probes,
+    utils.py:592-867): static summary everywhere, ICI probe runs the
+    ring permute (memcpy-rate on the sim mesh, ICI on hardware)."""
+    from triton_distributed_tpu.runtime.probe import (
+        measure_ici_bandwidth_gbs,
+        probe_topology,
+    )
+
+    info = probe_topology(ctx4)
+    assert info["mesh"] == {"tp": 4}
+    assert info["platform"] == "cpu"
+    assert info["spec"]["hbm_gbs"] > 0
+    assert "measured" not in info  # HBM probe is TPU-only
+
+    gbs = measure_ici_bandwidth_gbs("tp", nbytes=64 * 1024, iters=2, ctx=ctx4)
+    assert gbs > 0

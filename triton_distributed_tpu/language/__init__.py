@@ -18,6 +18,7 @@ from triton_distributed_tpu.language.primitives import (  # noqa: F401
     remote_copy,
     signal,
     straggle_if_rank,
+    translate_rank,
     wait,
     wait_recv,
 )

@@ -60,6 +60,42 @@ def num_ranks(axis: str | Sequence[str]) -> int:
     return out
 
 
+def translate_rank(
+    r: int | jax.Array,
+    from_axis: str | Sequence[str],
+    to_axis: str | Sequence[str],
+) -> jax.Array:
+    """Translate rank ``r`` in team ``from_axis`` to its index in team
+    ``to_axis`` — device-side team translation (parity:
+    ``nvshmem_team_translate_pe``, ``libnvshmem_device.py:1343``; the
+    host-side analog is ``DistContext.split_axis``).
+
+    Teams are mesh axes (or axis tuples): "PE ``r`` of team
+    ``from_axis``" is the device sharing the caller's coordinates on
+    every other axis, with its ``from_axis`` coordinate(s) replaced by
+    ``r`` (row-major when ``from_axis`` is a tuple). Returns that
+    device's row-major index within ``to_axis``. Axes of ``to_axis``
+    not covered by ``from_axis`` keep the caller's coordinate — e.g.
+    ``translate_rank(r, "tp", ("dp", "tp"))`` is the world rank of
+    this device's tp-peer ``r``.
+    """
+    axes_from = (from_axis,) if isinstance(from_axis, str) else tuple(from_axis)
+    axes_to = (to_axis,) if isinstance(to_axis, str) else tuple(to_axis)
+    # Decompose r into the target device's coords along `axes_from`.
+    coords = {}
+    rem = jnp.asarray(r)
+    for a in reversed(axes_from):
+        s = jax.lax.axis_size(a)
+        coords[a] = jax.lax.rem(rem, s)
+        rem = rem // s
+    # Row-major linearization along `axes_to`.
+    idx = jnp.zeros((), rem.dtype)
+    for a in axes_to:
+        c = coords[a] if a in coords else jax.lax.axis_index(a)
+        idx = idx * jax.lax.axis_size(a) + c
+    return idx
+
+
 # -- signal / wait ----------------------------------------------------------
 
 def signal(

@@ -51,24 +51,38 @@ def pick_stage_tile(
     return max(tile, 1)
 
 
-def overlap_vmem_limit(
+# Hard ceiling for the overlap kernels' scoped VMEM (below v5e's 128 MB
+# physical VMEM); configs whose estimated need exceeds it can't compile.
+OVERLAP_VMEM_CAP = 110 * 1024 * 1024
+
+
+def overlap_vmem_bytes(
     tile_m: int, k: int, tile_n: int, itemsize: int, out_tile_bufs: int = 3
 ) -> int:
-    """Scoped-VMEM limit for the fused overlap GEMM kernels.
+    """Estimated scoped-VMEM need of a fused overlap GEMM config.
 
     Mosaic's own accounting runs ~1.5x the raw buffer bytes (pipelined
     operand copies, stack), hence the 3x-per-double-buffer coefficients
-    plus a fixed margin; capped below v5e's 128 MB physical VMEM.
-    ``out_tile_bufs`` scales the (tile_m, tile_n) term — gemm_rs keeps
-    three double-buffered output-sized tiles where ag_gemm keeps one.
+    plus a fixed margin. ``out_tile_bufs`` scales the (tile_m, tile_n)
+    term — gemm_rs keeps three double-buffered output-sized tiles where
+    ag_gemm keeps one.
     """
+    return (
+        (3 * tile_m * k + 3 * k * tile_n
+         + 3 * out_tile_bufs * tile_m * tile_n) * itemsize
+        + 16 * 1024 * 1024
+    )
+
+
+def overlap_vmem_limit(
+    tile_m: int, k: int, tile_n: int, itemsize: int, out_tile_bufs: int = 3
+) -> int:
+    """Scoped-VMEM limit for the fused overlap GEMM kernels."""
     return min(
-        110 * 1024 * 1024,
+        OVERLAP_VMEM_CAP,
         max(
             64 * 1024 * 1024,
-            (3 * tile_m * k + 3 * k * tile_n
-             + 3 * out_tile_bufs * tile_m * tile_n) * itemsize
-            + 16 * 1024 * 1024,
+            overlap_vmem_bytes(tile_m, k, tile_n, itemsize, out_tile_bufs),
         ),
     )
 

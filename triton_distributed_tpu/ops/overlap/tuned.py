@@ -54,18 +54,16 @@ def _ag_configs(m_per: int, n_loc: int, k: int) -> list[Config]:
 
 
 def _fits_vmem(cfg, k: int, itemsize: int, out_tile_bufs: int) -> bool:
-    """Config's staging buffers fit the scoped-VMEM cap (the same
-    formula ``overlap_vmem_limit`` sizes the limit with)."""
-    from triton_distributed_tpu.ops.common import overlap_vmem_limit
-
-    need = (
-        (3 * cfg.tile_m * k + 3 * k * cfg.tile_n
-         + 3 * out_tile_bufs * cfg.tile_m * cfg.tile_n) * itemsize
-        + 16 * 1024 * 1024
+    """Config's staging buffers fit the scoped-VMEM cap."""
+    from triton_distributed_tpu.ops.common import (
+        OVERLAP_VMEM_CAP,
+        overlap_vmem_bytes,
     )
-    return need <= overlap_vmem_limit(
+
+    need = overlap_vmem_bytes(
         cfg.tile_m, k, cfg.tile_n, itemsize, out_tile_bufs
     )
+    return need <= OVERLAP_VMEM_CAP
 
 
 @functools.lru_cache(maxsize=64)
@@ -119,7 +117,7 @@ def ag_gemm_tuned(
     return tuner(a, b, _ctx=ctx)
 
 
-def _rs_configs(m: int, n_out: int, k_loc: int, n_ranks: int) -> list[Config]:
+def _rs_configs(m: int, n_out: int, n_ranks: int) -> list[Config]:
     m_per = max(m // max(n_ranks, 1), 1)
     out = [
         Config({"config": GemmRSConfig(tile_n=tn, tile_m=tm)})
@@ -146,7 +144,7 @@ def _rs_tuner(m: int, n_out: int, k_loc: int, axis: str, n_ranks: int,
 
     return Autotuner(
         run,
-        _rs_configs(m, n_out, k_loc, n_ranks),
+        _rs_configs(m, n_out, n_ranks),
         key=lambda *a, **kw: (m, n_out, k_loc, axis, n_ranks, dtype),
         prune=prune,
         is_dist=is_dist,

@@ -81,6 +81,25 @@ def perf_func(
     return output, elapsed_ms
 
 
+def median_time(run: Callable[[], object], reps: int = 5) -> float:
+    """Median wall-time (seconds) of ``run()`` over ``reps`` calls after
+    one warmup. ``run`` must fence its own device work (host fetch).
+
+    Median, not min: high-overhead transports (the axon relay) can leak
+    one call's device work into the next measurement window — min()
+    latches onto the leaked, impossibly-fast rep (see
+    perf/OVERLAP_RESULTS.md methodology notes). Shared by bench.py and
+    runtime/probe.py.
+    """
+    run()  # warm (compile on first use)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        run()
+        ts.append(time.perf_counter() - t0)
+    return sorted(ts)[len(ts) // 2]
+
+
 def assert_allclose(x, y, atol=1e-3, rtol=1e-3, verbose: bool = True) -> None:
     """Tolerant comparison with a mismatch report (parity: utils.py:870-899)."""
     x = np.asarray(jax.device_get(x), dtype=np.float64)
