@@ -173,6 +173,36 @@ def main() -> None:
         except Exception as e:
             errors["mega"] = f"{type(e).__name__}: {e}"[:300]
 
+        # Multi-step megakernel: NS greedy steps per kernel launch
+        # (in-kernel argmax + SMEM token feedback) — amortizes the
+        # platform's per-launch/per-op dispatch tax, the dominant cost
+        # of single-step decode on this chip.
+        try:
+            from triton_distributed_tpu.megakernel import MegaQwen3
+
+            NS = 8
+            mmulti = MegaQwen3(model).decode_multi_fn(
+                1, int(cache0.k.shape[3]), NS
+            )
+
+            def mega_multi_n(params, tok, cache, nl):
+                def body(_, carry):
+                    tok, cache = carry
+                    toks, _lg, cache = mmulti(params, tok, cache)
+                    return toks[NS - 1], cache
+
+                return jax.lax.fori_loop(0, nl, body, (tok, cache))
+
+            mmrun = jax.jit(mega_multi_n, static_argnums=3)
+
+            def mega_multi_once():
+                out_tok, _ = mmrun(model.params, tok0, cache0, STEPS // NS)
+                np.asarray(out_tok)
+
+            ladder["mega_multi"] = time_rung(mega_multi_once)
+        except Exception as e:
+            errors["mega_multi"] = f"{type(e).__name__}: {e}"[:300]
+
     if not ladder:
         print(json.dumps({
             "metric": "qwen3_decode_ms_per_step",

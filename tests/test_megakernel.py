@@ -292,3 +292,62 @@ def test_lm_head_remainder_tile(ctx4):
         np.asarray(logits_mega), np.asarray(logits_gold),
         rtol=2e-3, atol=2e-3,
     )
+
+
+class TestMultiStepDecode:
+    """Multi-step greedy decode: nsteps whole steps in one kernel launch
+    (in-kernel argmax + SMEM token feedback + knew/vnew band)."""
+
+    @pytest.fixture
+    def ctx1(self):
+        from triton_distributed_tpu.runtime import mesh as mesh_mod
+
+        ctx = mesh_mod.initialize_distributed(tp=1, devices=jax.devices()[:1])
+        yield ctx
+        mesh_mod.finalize_distributed()
+
+    def test_multi_matches_chained_single(self, ctx1):
+        model = AutoLLM.from_pretrained("tiny", ctx=ctx1)
+        B, NS = 2, 4
+        cache = model.new_cache(B, max_length=64)
+        step_gold = model.decode_fn("xla")
+        warm = jnp.asarray([[3, 5], [7, 11], [13, 17]], jnp.int32)
+        for i in range(warm.shape[0]):
+            _, cache = step_gold(model.params, warm[i], cache)
+
+        mega = MegaQwen3(model)
+        s_max = int(cache.k.shape[3])
+        tok0 = jnp.asarray([19, 23], jnp.int32)
+
+        # Reference: chained single-step mega with argmax outside.
+        step = mega.decode_fn(B, s_max)
+        t, c = tok0, jax.tree.map(jnp.copy, cache)
+        ref_toks = []
+        for _ in range(NS):
+            lg, c = step(model.params, t, c)
+            t = jnp.argmax(lg, -1).astype(jnp.int32)
+            ref_toks.append(np.asarray(t))
+        ref_logits = np.asarray(lg)
+
+        multi = mega.decode_multi_fn(B, s_max, NS)
+        mtoks, mlogits, mc = multi(
+            model.params, tok0, jax.tree.map(jnp.copy, cache)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(mtoks), np.stack(ref_toks)
+        )
+        np.testing.assert_allclose(
+            np.asarray(mlogits), ref_logits, rtol=2e-3, atol=2e-3
+        )
+        np.testing.assert_allclose(
+            np.asarray(mc.k), np.asarray(c.k), rtol=2e-3, atol=2e-3
+        )
+        np.testing.assert_array_equal(
+            np.asarray(mc.kv_len), np.asarray(c.kv_len)
+        )
+
+    def test_multi_rejects_tp(self, ctx4):
+        model = AutoLLM.from_pretrained("tiny", ctx=ctx4)
+        mega = MegaQwen3(model)
+        with pytest.raises(ValueError, match="single-rank"):
+            mega.build_multi(1, 64, 4)

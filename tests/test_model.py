@@ -291,3 +291,26 @@ def test_engine_autopads_indivisible_prompts(ctx4):
     # engine pads AFTER rolling; equivalence golden: serve the 7-token
     # prompt via a single batch row against per-row reference.
     np.testing.assert_array_equal(out[0], out[1])
+
+
+def test_engine_serve_mega_multi_matches_xla():
+    """Engine mode="mega" greedy at tp=1 takes the multi-step fast path
+    (several steps per launch, in-kernel argmax) and must produce the
+    same tokens as the xla mode."""
+    import jax as _jax
+
+    from triton_distributed_tpu.runtime import mesh as mesh_mod
+
+    ctx = mesh_mod.initialize_distributed(tp=1, devices=_jax.devices()[:1])
+    try:
+        model = AutoLLM.from_pretrained("tiny", ctx=ctx)
+        prompt = np.arange(8, dtype=np.int32)[None].repeat(2, 0)
+        gold = Engine(model, temperature=0.0, mode="xla").serve(
+            prompt, gen_len=12, max_length=64
+        )
+        mega = Engine(model, temperature=0.0, mode="mega").serve(
+            prompt, gen_len=12, max_length=64
+        )
+        np.testing.assert_array_equal(mega, gold)
+    finally:
+        mesh_mod.finalize_distributed()

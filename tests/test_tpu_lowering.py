@@ -45,6 +45,16 @@ def tpu_ctx4():
     mesh_mod.finalize_distributed()
 
 
+@pytest.fixture
+def tpu_ctx1():
+    ctx = mesh_mod.initialize_distributed(
+        tp=1, devices=jax.devices()[:1]
+    )
+    ctx.topology = dataclasses.replace(ctx.topology, platform="tpu")
+    yield ctx
+    mesh_mod.finalize_distributed()
+
+
 def _lower(ctx, fn, *specs):
     """Export ``fn`` for TPU; any Mosaic lowering rejection raises."""
     exp = export.export(jax.jit(fn), platforms=["tpu"])(*specs)
@@ -384,3 +394,21 @@ class TestLowLatencyLower:
             ws,
             jax.ShapeDtypeStruct((), jnp.int32, sharding=tpu_ctx.sharding()),
         )
+
+    def test_mega_multi_step_decode(self, tpu_ctx1):
+        """The multi-step kernel (2-D grid, SMEM token feedback, band
+        attention, in-kernel argmax) must lower for TPU."""
+        from triton_distributed_tpu.megakernel import MegaQwen3
+        from triton_distributed_tpu.models import AutoLLM
+
+        model = AutoLLM.from_pretrained("tiny", ctx=tpu_ctx1)
+        mega = MegaQwen3(model)
+        f = jax.jit(mega.build_multi(1, 64, 4))
+        cache = jax.eval_shape(lambda: model.new_cache(1, 64))
+        tok = jax.ShapeDtypeStruct((1,), jnp.int32)
+        params = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+            model.params,
+        )
+        exp = export.export(f, platforms=["tpu"])(params, tok, cache)
+        assert len(exp.mlir_module_serialized) > 0

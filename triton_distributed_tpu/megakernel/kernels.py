@@ -73,7 +73,7 @@ def _make_rope(hd: int, theta: float):
 
 
 def _stream_cols(kctx, x_f32, w_hbm, n: int, tn: int, consume,
-                 col0: int = 0, tail: int = 0):
+                 col0: int = 0, tail: int = 0, carry=None):
     """Column-streamed GEMM: ``x [B, K] @ w_hbm [K, col0:col0+n*tn]``
     tile-by-tile, plus an optional ``tail``-wide final tile when ``tn``
     doesn't divide the column count (the LM head's vocab axis).
@@ -83,11 +83,15 @@ def _stream_cols(kctx, x_f32, w_hbm, n: int, tn: int, consume,
     ``mega_triton_kernel/kernels/linear.py``); the tail tile joins the
     same pipeline (prefetched under the last main tile's matmul).
     ``consume(j, val)`` sinks each f32 product — ``val.shape[1]`` is
-    ``tn`` for main tiles and ``tail`` for the final one.
+    ``tn`` for main tiles and ``tail`` for the final one. With
+    ``carry`` set, ``consume(j, val, carry) -> carry`` threads loop
+    state through the tiles (the LM head's running argmax) and the
+    final carry is returned.
     """
     stage, sem = kctx.colstage, kctx.wsem
     k = x_f32.shape[1]
     xa = x_f32.astype(kctx.wdtype)
+    stateful = carry is not None
 
     def copy(j, slot, w=None):
         w = tn if w is None else w
@@ -99,7 +103,7 @@ def _stream_cols(kctx, x_f32, w_hbm, n: int, tn: int, consume,
 
     copy(0, 0, tail if n == 0 else None).start()
 
-    def body(j, carry):
+    def body(j, c):
         slot = jax.lax.rem(j, 2)
 
         @pl.when(j + 1 < n)
@@ -115,17 +119,26 @@ def _stream_cols(kctx, x_f32, w_hbm, n: int, tn: int, consume,
         val = jnp.dot(
             xa, stage[slot, :k, :tn], preferred_element_type=jnp.float32
         )
+        if stateful:
+            return consume(j, val, c)
         consume(j, val)
-        return carry
+        return c
 
-    jax.lax.fori_loop(0, n, body, 0, unroll=False)
+    carry = jax.lax.fori_loop(
+        0, n, body, carry if stateful else 0, unroll=False
+    )
 
     if tail:
         slot = n % 2
         copy(n, slot, tail).wait()
-        consume(n, jnp.dot(
+        val = jnp.dot(
             xa, stage[slot, :k, :tail], preferred_element_type=jnp.float32
-        ))
+        )
+        if stateful:
+            carry = consume(n, val, carry)
+        else:
+            consume(n, val)
+    return carry
 
 
 def _stream_rows(kctx, x_ref, w_hbm, out_ref, n: int, tk: int):
@@ -184,9 +197,19 @@ def embed_body(kctx):
     def body():
         B = kctx.dims.batch
 
+        def tok(b):
+            # Multi-step: steps after the first read the token the LM
+            # head's in-kernel argmax fed back through SMEM.
+            t = kctx.tokens[b]
+            if kctx.dims.nsteps > 1:
+                t = jnp.where(kctx.step == 0, t, kctx.tok_smem[0, b])
+            return t
+
+        toks = [tok(b) for b in range(B)]
+
         def group(b):
             return pltpu.make_async_copy(
-                kctx.embed.at[kctx.tokens[b] // 8], kctx.estage.at[b],
+                kctx.embed.at[toks[b] // 8], kctx.estage.at[b],
                 kctx.esem,
             )
 
@@ -196,7 +219,7 @@ def embed_body(kctx):
             group(b).wait()
         sub = jax.lax.broadcasted_iota(jnp.int32, (1, 8), 1)
         for b in range(B):
-            onehot = (sub == kctx.tokens[b] % 8).astype(jnp.float32)
+            onehot = (sub == toks[b] % 8).astype(jnp.float32)
             kctx.x[b:b + 1, :] = jnp.dot(
                 onehot, kctx.estage[b].astype(jnp.float32),
                 preferred_element_type=jnp.float32,
@@ -258,7 +281,12 @@ def attn_body(kctx):
         g = hq // hkv
         eps, theta = dims.rms_eps, dims.rope_theta
         layer = kctx.layer
-        pos = [kctx.kv_len[b] for b in range(B)]
+        # cache_len masks the cached rows (the cache never holds this
+        # launch's rows); pos is the CURRENT token's position — in
+        # multi-step launches it advances with the in-launch step
+        # (program_id(0), constant 0 in single-step builds).
+        cache_len = [kctx.kv_len[b] for b in range(B)]
+        pos = [cache_len[b] + kctx.step for b in range(B)]
 
         # Mosaic has no lane-splitting shape casts ([B, h·hd] → [B, h,
         # hd] is rejected by infer-vector-layout), so heads stay 2-D
@@ -313,8 +341,12 @@ def attn_body(kctx):
             for h in range(hkv):
                 kbh = rope(headnorm(head(hq + h)[b], kn), pos[b])
                 vbh = head(hq + hkv + h)[b]
-                kctx.knew_out[layer, b, h:h + 1, :] = kbh.astype(kctx.cdtype)
-                kctx.vnew_out[layer, b, h:h + 1, :] = vbh.astype(kctx.cdtype)
+                kctx.knew_out[kctx.step, layer, b, h:h + 1, :] = (
+                    kbh.astype(kctx.cdtype)
+                )
+                kctx.vnew_out[kctx.step, layer, b, h:h + 1, :] = (
+                    vbh.astype(kctx.cdtype)
+                )
                 krow.append(kbh)
                 vrow.append(vbh)
             knew_v.append(krow)
@@ -326,9 +358,9 @@ def attn_body(kctx):
         # traced (parity role: the reference's split-KV sizing by
         # actual seq len, ``flash_decode.py:130``).
         sblk = kctx.cfg.s_blk
-        maxpos = pos[0]
+        maxpos = cache_len[0]
         for b in range(1, B):
-            maxpos = jnp.maximum(maxpos, pos[b])
+            maxpos = jnp.maximum(maxpos, cache_len[b])
         nblk = maxpos // sblk + 1  # blocks overlapping [0, maxpos]
 
         # Dense: one DMA per buffer covering all (b, h) for the block.
@@ -393,7 +425,7 @@ def attn_body(kctx):
 
             out = []
             for b in range(B):
-                valid = idx < pos[b]  # [1, sblk] — cached tokens only
+                valid = idx < cache_len[b]  # [1, sblk] — cached tokens only
                 for h in range(hkv):
                     m, l, acc = carry[b * hkv + h]
                     kb = kctx.kstage[slot, b, h].astype(jnp.float32)
@@ -416,6 +448,55 @@ def attn_body(kctx):
             return tuple(out)
 
         final = jax.lax.fori_loop(0, nblk, blk, init, unroll=False)
+
+        # Multi-step band: this launch's earlier steps' K/V rows live in
+        # the knew/vnew outputs (never in the cache) — merge them into
+        # the online softmax. Rows at steps >= kctx.step are unwritten
+        # (arbitrary bits): the column mask drops their scores and the
+        # row mask zeroes their V so no garbage can reach the output.
+        NS = dims.nsteps
+        if NS > 1:
+            merged = []
+            bcol = jax.lax.broadcasted_iota(jnp.int32, (1, NS), 1)
+            brow = jax.lax.broadcasted_iota(jnp.int32, (NS, 1), 0)
+            col_ok = bcol < kctx.step
+            row_ok = brow < kctx.step
+            for b in range(B):
+                for h in range(hkv):
+                    m, l, acc = final[b * hkv + h]
+                    kband = jnp.concatenate(
+                        [
+                            kctx.knew_out[s2, layer, b, h:h + 1, :]
+                            .astype(jnp.float32)
+                            for s2 in range(NS)
+                        ],
+                        axis=0,
+                    )  # [NS, hd]
+                    vband = jnp.concatenate(
+                        [
+                            kctx.vnew_out[s2, layer, b, h:h + 1, :]
+                            .astype(jnp.float32)
+                            for s2 in range(NS)
+                        ],
+                        axis=0,
+                    )
+                    vband = jnp.where(row_ok, vband, 0.0)
+                    s_band = jax.lax.dot_general(
+                        qg[b][h], kband, nt,
+                        preferred_element_type=jnp.float32,
+                    )  # [g, NS]
+                    s_band = jnp.where(col_ok, s_band, neg)
+                    m_new = jnp.maximum(
+                        m, jnp.max(s_band, axis=-1, keepdims=True)
+                    )
+                    p = jnp.where(col_ok, jnp.exp(s_band - m_new), 0.0)
+                    corr = jnp.exp(m - m_new)
+                    l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+                    acc = acc * corr + jnp.dot(
+                        p, vband, preferred_element_type=jnp.float32
+                    )
+                    merged.append((m_new, l, acc))
+            final = tuple(merged)
 
         # Merge the new token's own K/V contribution (it never entered
         # the cache) and write the normalized output.
@@ -648,14 +729,62 @@ def lm_head_body(kctx):
         else:
             x_in = kctx.h[...]
 
-        def sink(j, val):
-            kctx.logits[:, pl.ds(j * tn, val.shape[1])] = val
-
         # Tail tile when tn doesn't divide v_loc (wide lm tiles on an
         # unround vocab axis); must stay a 128-multiple for lane
         # alignment — guaranteed by the resolve() gate.
         rem = dims.v_loc - n * tn
-        _stream_cols(kctx, x_in, kctx.lm_head, n, tn, sink, tail=rem)
+
+        if dims.nsteps > 1:
+            # Multi-step greedy: a running argmax threads through the
+            # tile stream; the winning index feeds the next step's
+            # EMBED via VMEM→SMEM DMA (scalar reads need SMEM) and the
+            # per-step token output. Tie-break matches jnp.argmax
+            # (first occurrence: min index within a tile, strict > for
+            # later tiles).
+            B = x_in.shape[0]
+            v_real = dims.v_real_loc or dims.v_loc
+            NEGF = jnp.float32(-3.0e38)
+
+            def sink(j, val, carry):
+                kctx.logits[:, pl.ds(j * tn, val.shape[1])] = val
+                bestv, besti = carry
+                gidx = j * tn + jax.lax.broadcasted_iota(
+                    jnp.int32, (B, val.shape[1]), 1
+                )
+                masked = jnp.where(gidx < v_real, val, NEGF)
+                tmax = jnp.max(masked, axis=-1, keepdims=True)
+                tidx = jnp.min(
+                    jnp.where(masked == tmax, gidx, jnp.int32(1 << 30)),
+                    axis=-1, keepdims=True,
+                )
+                upd = tmax > bestv
+                return (
+                    jnp.where(upd, tmax, bestv),
+                    jnp.where(upd, tidx, besti),
+                )
+
+            init = (
+                jnp.full((B, 1), NEGF, jnp.float32),
+                jnp.zeros((B, 1), jnp.int32),
+            )
+            _, besti = _stream_cols(
+                kctx, x_in, kctx.lm_head, n, tn, sink, tail=rem, carry=init
+            )
+            row = jnp.concatenate(
+                [besti[b:b + 1, :] for b in range(B)], axis=1
+            )  # [1, B]
+            kctx.tokrow[...] = row
+            kctx.toks_out[kctx.step] = row
+            cp = pltpu.make_async_copy(
+                kctx.tokrow, kctx.tok_smem, kctx.tsem
+            )
+            cp.start()
+            cp.wait()
+        else:
+            def sink(j, val):
+                kctx.logits[:, pl.ds(j * tn, val.shape[1])] = val
+
+            _stream_cols(kctx, x_in, kctx.lm_head, n, tn, sink, tail=rem)
 
     return body
 

@@ -14,6 +14,7 @@ logic in Python while each step is a single device program.
 from __future__ import annotations
 
 import time
+from typing import Literal
 
 import jax
 import jax.numpy as jnp
@@ -22,6 +23,12 @@ import numpy as np
 from triton_distributed_tpu.models import sampling
 from triton_distributed_tpu.models.kv_cache import KVCache
 from triton_distributed_tpu.models.qwen import Mode, Qwen3
+
+# Engine modes: the model's xla/pallas decode paths plus the megakernel
+# ("mega"): whole-step single-kernel decode, with a multi-step greedy
+# fast path (several steps per launch) when sampling is greedy, the
+# mesh is single-rank, and the cache is dense.
+EngineMode = Literal["xla", "pallas", "mega"]
 
 
 class Engine:
@@ -33,7 +40,7 @@ class Engine:
         *,
         temperature: float = 0.0,
         top_p: float = 1.0,
-        mode: Mode = "xla",
+        mode: EngineMode = "xla",
         verbose: bool = False,
         seed: int = 0,
         paged: bool = False,
@@ -54,6 +61,25 @@ class Engine:
         # Page-pool free list, populated by the first paged serve();
         # continuous-batching admission/eviction draws from it.
         self._pool = None
+        self._mega = None
+
+    @property
+    def _prefill_mode(self) -> Mode:
+        # The mega prefill path is single-sequence; batched serving
+        # prefills through the model's own path.
+        return "xla" if self.mode == "mega" else self.mode
+
+    def _mega_model(self):
+        if self._mega is None:
+            from triton_distributed_tpu.megakernel import MegaQwen3
+
+            self._mega = MegaQwen3(self.model)
+        return self._mega
+
+    def _decode_step(self, tok, cache):
+        if self.mode == "mega":
+            return self._mega_model().decode_step(tok, cache)
+        return self.model.decode_step(tok, cache, self.mode)
 
     def _sample(self, logits: jax.Array) -> jax.Array:
         if self.temperature <= 0.0:
@@ -124,7 +150,7 @@ class Engine:
             last_logits = []
             for i in range(b):
                 logits_i, dense1 = self.model.prefill_batched(
-                    jnp.asarray(rows[i : i + 1]), dense1, self.mode,
+                    jnp.asarray(rows[i : i + 1]), dense1, self._prefill_mode,
                     jnp.asarray(true_lens[i : i + 1]),
                 )
                 cache = write_prefill(
@@ -135,7 +161,8 @@ class Engine:
         else:
             cache = self.model.new_cache(b, max_length)
             logits, cache = self.model.prefill_batched(
-                jnp.asarray(rows), cache, self.mode, jnp.asarray(true_lens)
+                jnp.asarray(rows), cache, self._prefill_mode,
+                jnp.asarray(true_lens),
             )
         t_prefill = time.perf_counter() - t0
 
@@ -145,12 +172,40 @@ class Engine:
 
         from triton_distributed_tpu.runtime.profiling import group_profile
 
+        use_multi = (
+            self.mode == "mega"
+            and self.temperature <= 0.0
+            and not self.paged
+            and n == 1
+            and gen_len > 2
+        )
         t0 = time.perf_counter()
         with group_profile(profile, do_prof=profile is not None):
-            for _ in range(gen_len - 1):
-                logits, cache = self.model.decode_step(tok, cache, self.mode)
-                tok = self._sample(logits)
-                out.append(np.asarray(tok)[:, None])
+            if use_multi:
+                # Multi-step greedy fast path: several steps per kernel
+                # launch (in-kernel argmax), amortizing per-launch cost.
+                mega = self._mega_model()
+                s_max = int(cache.k.shape[3])
+                left = gen_len - 1
+                # One 8-step kernel covers the bulk; the remainder runs
+                # through the single-step kernel rather than paying a
+                # full extra megakernel build per distinct tail length.
+                while left >= 8:
+                    fn = mega.decode_multi_fn(b, s_max, 8)
+                    toks, logits, cache = fn(self.model.params, tok, cache)
+                    toks = np.asarray(toks)  # [8, b]
+                    out.append(toks.T)
+                    tok = jnp.asarray(toks[-1])
+                    left -= 8
+                for _ in range(left):
+                    logits, cache = self._decode_step(tok, cache)
+                    tok = self._sample(logits)
+                    out.append(np.asarray(tok)[:, None])
+            else:
+                for _ in range(gen_len - 1):
+                    logits, cache = self._decode_step(tok, cache)
+                    tok = self._sample(logits)
+                    out.append(np.asarray(tok)[:, None])
         t_decode = time.perf_counter() - t0
 
         self.last_stats = {
