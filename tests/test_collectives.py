@@ -191,3 +191,17 @@ class TestLowLatencyAllGather:
         x = jnp.asarray(rng.standard_normal((4 * 8, 128)), np.float32)
         out = ll_all_gather_op(x, steps=3, axis="tp", ctx=ctx4)
         np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+
+@pytest.mark.parametrize(
+    "method", ["xla", "one_shot"]
+)
+@pytest.mark.parametrize("root", [0, 2])
+def test_broadcast(ctx4, rng, method, root):
+    from triton_distributed_tpu.ops import BroadcastMethod, broadcast_op
+
+    x = jnp.asarray(rng.standard_normal((4, 16, 128), dtype=np.float32))
+    out = broadcast_op(x, "tp", root, BroadcastMethod(method), ctx4)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(x)[root], rtol=1e-6
+    )

@@ -346,8 +346,34 @@ class TestMultiStepDecode:
             np.asarray(mc.kv_len), np.asarray(c.kv_len)
         )
 
-    def test_multi_rejects_tp(self, ctx4):
+    def test_multi_matches_chained_single_tp4(self, ctx4):
+        """Under TP the LM head's local argmax is cross-rank exchanged;
+        tokens must still match chained single-step decode exactly."""
         model = AutoLLM.from_pretrained("tiny", ctx=ctx4)
+        B, NS = 2, 3
+        cache = model.new_cache(B, max_length=64)
+        step_gold = model.decode_fn("xla")
+        warm = jnp.asarray([[3, 5], [7, 11]], jnp.int32)
+        for i in range(warm.shape[0]):
+            _, cache = step_gold(model.params, warm[i], cache)
+
         mega = MegaQwen3(model)
-        with pytest.raises(ValueError, match="single-rank"):
-            mega.build_multi(1, 64, 4)
+        s_max = int(cache.k.shape[3])
+        tok0 = jnp.asarray([19, 23], jnp.int32)
+
+        step = mega.decode_fn(B, s_max)
+        t, c = tok0, jax.tree.map(jnp.copy, cache)
+        ref_toks = []
+        for _ in range(NS):
+            lg, c = step(model.params, t, c)
+            t = jnp.argmax(lg, -1).astype(jnp.int32)
+            ref_toks.append(np.asarray(t))
+
+        multi = mega.decode_multi_fn(B, s_max, NS)
+        mtoks, _, mc = multi(
+            model.params, tok0, jax.tree.map(jnp.copy, cache)
+        )
+        np.testing.assert_array_equal(np.asarray(mtoks), np.stack(ref_toks))
+        np.testing.assert_allclose(
+            np.asarray(mc.k), np.asarray(c.k), rtol=2e-3, atol=2e-3
+        )

@@ -121,6 +121,21 @@ class TestCollectivesLower:
         )
         _lower(tpu_ctx, f, _sds(tpu_ctx, (16, 128), (None, None)))
 
+    def test_broadcast(self, tpu_ctx):
+        from triton_distributed_tpu.ops.collectives.broadcast import (
+            BroadcastMethod, broadcast,
+        )
+
+        f = tpu_ctx.shard_map(
+            functools.partial(
+                broadcast, axis="tp", root=0,
+                method=BroadcastMethod.ONE_SHOT, ctx=tpu_ctx,
+            ),
+            in_specs=P(None, None),
+            out_specs=P(None, None),
+        )
+        _lower(tpu_ctx, f, _sds(tpu_ctx, (16, 128), (None, None)))
+
     def test_all_to_all(self, tpu_ctx):
         from triton_distributed_tpu.ops.collectives.all_to_all import all_to_all
 
@@ -395,13 +410,16 @@ class TestLowLatencyLower:
             jax.ShapeDtypeStruct((), jnp.int32, sharding=tpu_ctx.sharding()),
         )
 
-    def test_mega_multi_step_decode(self, tpu_ctx1):
+    @pytest.mark.parametrize("nranks", [1, 4])
+    def test_mega_multi_step_decode(self, request, nranks):
         """The multi-step kernel (2-D grid, SMEM token feedback, band
-        attention, in-kernel argmax) must lower for TPU."""
+        attention, in-kernel argmax) must lower for TPU — including the
+        tp>1 cross-rank argmax exchange path."""
         from triton_distributed_tpu.megakernel import MegaQwen3
         from triton_distributed_tpu.models import AutoLLM
 
-        model = AutoLLM.from_pretrained("tiny", ctx=tpu_ctx1)
+        ctx = request.getfixturevalue(f"tpu_ctx{nranks}")
+        model = AutoLLM.from_pretrained("tiny", ctx=ctx)
         mega = MegaQwen3(model)
         f = jax.jit(mega.build_multi(1, 64, 4))
         cache = jax.eval_shape(lambda: model.new_cache(1, 64))

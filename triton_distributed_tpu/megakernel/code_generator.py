@@ -57,18 +57,18 @@ class MegaDims:
     prefill: bool = False
     # Multi-step greedy decode: ``nsteps`` whole decode steps run inside
     # ONE kernel launch (grid = (nsteps, tasks)) — the LM head argmaxes
-    # in-kernel and feeds the token back through SMEM, attention covers
-    # the launch's earlier steps from the knew/vnew outputs (the
-    # "band"), and the caller appends all nsteps rows at once. Amortizes
-    # the platform's per-launch/per-op tax (measured ~2 ms/step on the
-    # v5e relay) over nsteps. Greedy + single-rank only (a TP argmax
-    # needs a cross-rank exchange; callers fall back to chained
-    # single steps under TP).
+    # in-kernel (under TP: local argmax + one-shot cross-rank
+    # (value, index) exchange) and feeds the token back through SMEM,
+    # attention covers the launch's earlier steps from the knew/vnew
+    # outputs (the "band"), and the caller appends all nsteps rows at
+    # once. Amortizes the platform's per-launch/per-op tax (measured
+    # ~2 ms/step on the v5e relay) over nsteps. Greedy sampling only.
     nsteps: int = 1
-    # Real (unpadded) vocab width of the local shard; 0 = all columns
-    # real. The in-kernel argmax masks pad columns (zero weights score
-    # 0, which could beat real negative logits).
-    v_real_loc: int = 0
+    # GLOBAL real (unpadded) vocab size; 0 = every column real. The
+    # in-kernel argmax masks this rank's pad columns (zero weights
+    # score 0, which could beat real negative logits) — rank r's real
+    # width is clamp(v_real - r*v_loc, 0, v_loc).
+    v_real: int = 0
 
     @property
     def qkv_loc(self) -> int:

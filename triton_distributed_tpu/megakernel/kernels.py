@@ -181,6 +181,44 @@ def _stream_rows(kctx, x_ref, w_hbm, out_ref, n: int, tk: int):
     jax.lax.fori_loop(0, n, body, 0, unroll=False)
 
 
+def _workspace_bcast(kctx, payload):
+    """One-shot broadcast through the allreduce workspace: every rank
+    writes ``payload`` ([B, d] f32) to peer slot ``cbuf[me]`` and waits
+    for all ``nr`` candidates to land. Returns nothing — read
+    ``kctx.cbuf[r]`` afterwards. The caller owns quiescence: traffic
+    into cbuf must be fenced (barrier) before the slots are reused.
+
+    Shared by the ALLREDUCE task and the LM head's cross-rank argmax.
+    """
+    axis = kctx.axis
+    nr = kctx.dims.n_ranks
+    me = jax.lax.axis_index(axis)
+    kctx.arsrc[...] = payload
+    kctx.cbuf[me] = payload
+
+    def put(p):
+        dst = jax.lax.rem(me + p, nr)
+        return pltpu.make_async_remote_copy(
+            src_ref=kctx.arsrc,
+            dst_ref=kctx.cbuf.at[me],
+            send_sem=kctx.arsend,
+            recv_sem=kctx.arrecv.at[me],
+            device_id={axis: dst},
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+
+    puts = [put(p) for p in range(1, nr)]
+    for dma in puts:
+        dma.start()
+    for p in range(1, nr):
+        src = jax.lax.rem(me + p, nr)
+        pltpu.make_async_copy(
+            kctx.cbuf.at[src], kctx.arsrc, kctx.arrecv.at[src]
+        ).wait()
+    for dma in puts:
+        dma.wait_send()
+
+
 # -- task bodies -------------------------------------------------------------
 
 @register_task(TaskType.EMBED)
@@ -674,36 +712,12 @@ def allreduce_body(kctx):
     def body():
         axis = kctx.axis
         n = kctx.dims.n_ranks
-        me = jax.lax.axis_index(axis)
         h = kctx.h[...]
-        kctx.arsrc[...] = h
-
-        def put(p):
-            dst = jax.lax.rem(me + p, n)
-            return pltpu.make_async_remote_copy(
-                src_ref=kctx.arsrc,
-                dst_ref=kctx.cbuf.at[me],
-                send_sem=kctx.arsend,
-                recv_sem=kctx.arrecv.at[me],
-                device_id={axis: dst},
-                device_id_type=pltpu.DeviceIdType.MESH,
-            )
-
-        for p in range(1, n):
-            put(p).start()
-
-        acc = kctx.x[...] + h
-        for p in range(1, n):
-            src = jax.lax.rem(me + p, n)
-            pltpu.make_async_copy(
-                kctx.cbuf.at[src], kctx.arsrc, kctx.arrecv.at[src]
-            ).wait()
-            # The DMA above waits arrival only (src == dst ref trick is
-            # not used here: read the landed slot directly).
-            acc = acc + kctx.cbuf[src]
+        _workspace_bcast(kctx, h)
+        acc = kctx.x[...]
+        for r in range(n):
+            acc = acc + kctx.cbuf[r]
         kctx.x[...] = acc
-        for p in range(1, n):
-            put(p).wait_send()
         dl.barrier_all(axis)
 
     return body
@@ -740,10 +754,18 @@ def lm_head_body(kctx):
             # EMBED via VMEM→SMEM DMA (scalar reads need SMEM) and the
             # per-step token output. Tie-break matches jnp.argmax
             # (first occurrence: min index within a tile, strict > for
-            # later tiles).
+            # later tiles; under TP, lower ranks hold lower global
+            # indices and the ascending exchange loop keeps strict >).
             B = x_in.shape[0]
-            v_real = dims.v_real_loc or dims.v_loc
+            nr = dims.n_ranks
             NEGF = jnp.float32(-3.0e38)
+            v_total = dims.v_real or nr * dims.v_loc
+            if nr > 1:
+                me = jax.lax.axis_index(kctx.axis)
+                # This rank's real (unpadded) column count.
+                v_real = jnp.clip(v_total - me * dims.v_loc, 0, dims.v_loc)
+            else:
+                v_real = min(v_total, dims.v_loc)
 
             def sink(j, val, carry):
                 kctx.logits[:, pl.ds(j * tn, val.shape[1])] = val
@@ -767,9 +789,34 @@ def lm_head_body(kctx):
                 jnp.full((B, 1), NEGF, jnp.float32),
                 jnp.zeros((B, 1), jnp.int32),
             )
-            _, besti = _stream_cols(
+            bestv, besti = _stream_cols(
                 kctx, x_in, kctx.lm_head, n, tn, sink, tail=rem, carry=init
             )
+
+            if nr > 1:
+                # Cross-rank argmax: every rank one-shot-broadcasts its
+                # (best value, best GLOBAL index) pair through the
+                # allreduce workspace (quiesced: the preceding
+                # allreduce task ends with a barrier) and reduces all
+                # nr candidates identically.
+                gbesti = (me * dims.v_loc + besti).astype(jnp.float32)
+                d = kctx.arsrc.shape[1]
+                pad = jnp.zeros((B, d - 2), jnp.float32)
+                cand = jnp.concatenate([bestv, gbesti, pad], axis=1)
+                _workspace_bcast(kctx, cand)
+                bestv = kctx.cbuf[0, :, 0:1]
+                besti = kctx.cbuf[0, :, 1:2].astype(jnp.int32)
+                for r in range(1, nr):
+                    v_r = kctx.cbuf[r, :, 0:1]
+                    i_r = kctx.cbuf[r, :, 1:2].astype(jnp.int32)
+                    upd = v_r > bestv
+                    bestv = jnp.where(upd, v_r, bestv)
+                    besti = jnp.where(upd, i_r, besti)
+                # Slot reuse fence: the next step's exchange (or
+                # allreduce) must not land before every rank has read
+                # this round's candidates.
+                dl.barrier_all(kctx.axis)
+
             row = jnp.concatenate(
                 [besti[b:b + 1, :] for b in range(B)], axis=1
             )  # [1, B]

@@ -200,29 +200,26 @@ class MegaQwen3:
     def build_multi(self, batch: int, s_max: int, nsteps: int):
         """``nsteps`` greedy decode steps in ONE kernel launch.
 
-        The LM head argmaxes in-kernel and feeds the token back through
-        SMEM; attention covers the launch's earlier steps from the
-        knew/vnew outputs (the in-launch band); the caller appends all
-        ``nsteps`` K/V rows with one contiguous dynamic_update_slice
-        per batch row. Amortizes the per-launch/per-op dispatch tax
-        (measured ~2 ms/step on the v5e relay — the dominant cost of
-        single-step decode at small model sizes) over ``nsteps``.
+        The LM head argmaxes in-kernel (under TP: local argmax then a
+        one-shot cross-rank (value, index) exchange over ICI) and feeds
+        the token back through SMEM; attention covers the launch's
+        earlier steps from the knew/vnew outputs (the in-launch band);
+        the caller appends all ``nsteps`` K/V rows with one contiguous
+        dynamic_update_slice per batch row. Amortizes the
+        per-launch/per-op dispatch tax (measured ~2 ms/step on the v5e
+        relay — the dominant cost of single-step decode at small model
+        sizes) over ``nsteps``.
 
-        Greedy + single-rank only: a TP argmax would need a cross-rank
-        (value, index) exchange; use chained single steps under TP.
-        Dense cache only.
+        Greedy sampling + dense cache only. Caller contract:
+        ``kv_len[b] + nsteps <= s_max`` for every row — the append is a
+        ``dynamic_update_slice``, whose clamped start would silently
+        overwrite cached rows past capacity (the Engine gates its multi
+        launches on this).
         """
         m = self.model
-        if m.ctx.axis_size(m.axis) > 1:
-            raise ValueError(
-                "multi-step megakernel decode is single-rank only "
-                "(in-kernel argmax; chain single steps under TP)"
-            )
         V = m.cfg.vocab_size
         base = self._dims(batch, s_max)
-        dims = dataclasses.replace(
-            base, nsteps=nsteps, v_real_loc=min(V, base.v_loc)
-        )
+        dims = dataclasses.replace(base, nsteps=nsteps, v_real=V)
         mb = ModelBuilder(
             dims, cfg=self.cfg, axis=m.axis, ctx=m.ctx,
             wdtype=m.cfg.dtype, cdtype=m.cfg.dtype,

@@ -26,8 +26,8 @@ from triton_distributed_tpu.models.qwen import Mode, Qwen3
 
 # Engine modes: the model's xla/pallas decode paths plus the megakernel
 # ("mega"): whole-step single-kernel decode, with a multi-step greedy
-# fast path (several steps per launch) when sampling is greedy, the
-# mesh is single-rank, and the cache is dense.
+# fast path (several steps per launch, in-kernel argmax — cross-rank
+# exchanged under TP) when sampling is greedy and the cache is dense.
 EngineMode = Literal["xla", "pallas", "mega"]
 
 
@@ -172,40 +172,42 @@ class Engine:
 
         from triton_distributed_tpu.runtime.profiling import group_profile
 
-        use_multi = (
+        NS = 8  # multi-step launch width
+        s_max = int(cache.k.shape[3]) if not self.paged else 0
+        # Capacity: the furthest row holds max(true_lens) cached tokens
+        # and gains one per decode step; a multi launch appends NS rows
+        # at once, so it must not start within NS of s_max (a clamped
+        # dynamic_update_slice would silently overwrite cached rows).
+        kv_high = int(true_lens.max())
+        multi_launches = 0
+        if (
             self.mode == "mega"
             and self.temperature <= 0.0
             and not self.paged
-            and n == 1
-            and gen_len > 2
-        )
+        ):
+            multi_launches = min(
+                (gen_len - 1) // NS, max(s_max - kv_high, 0) // NS
+            )
         t0 = time.perf_counter()
         with group_profile(profile, do_prof=profile is not None):
-            if use_multi:
-                # Multi-step greedy fast path: several steps per kernel
-                # launch (in-kernel argmax), amortizing per-launch cost.
-                mega = self._mega_model()
-                s_max = int(cache.k.shape[3])
-                left = gen_len - 1
-                # One 8-step kernel covers the bulk; the remainder runs
-                # through the single-step kernel rather than paying a
-                # full extra megakernel build per distinct tail length.
-                while left >= 8:
-                    fn = mega.decode_multi_fn(b, s_max, 8)
+            left = gen_len - 1
+            if multi_launches:
+                # Multi-step greedy fast path: NS steps per kernel
+                # launch (in-kernel argmax), amortizing per-launch
+                # cost; the remainder runs through the single-step
+                # kernel rather than paying a full extra megakernel
+                # build per distinct tail length.
+                fn = self._mega_model().decode_multi_fn(b, s_max, NS)
+                for _ in range(multi_launches):
                     toks, logits, cache = fn(self.model.params, tok, cache)
-                    toks = np.asarray(toks)  # [8, b]
+                    toks = np.asarray(toks)  # [NS, b]
                     out.append(toks.T)
                     tok = jnp.asarray(toks[-1])
-                    left -= 8
-                for _ in range(left):
-                    logits, cache = self._decode_step(tok, cache)
-                    tok = self._sample(logits)
-                    out.append(np.asarray(tok)[:, None])
-            else:
-                for _ in range(gen_len - 1):
-                    logits, cache = self._decode_step(tok, cache)
-                    tok = self._sample(logits)
-                    out.append(np.asarray(tok)[:, None])
+                    left -= NS
+            for _ in range(left):
+                logits, cache = self._decode_step(tok, cache)
+                tok = self._sample(logits)
+                out.append(np.asarray(tok)[:, None])
         t_decode = time.perf_counter() - t0
 
         self.last_stats = {

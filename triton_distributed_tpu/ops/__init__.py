@@ -33,6 +33,11 @@ from triton_distributed_tpu.ops.collectives.all_to_all import (  # noqa: F401
     all_to_all,
     all_to_all_op,
 )
+from triton_distributed_tpu.ops.collectives.broadcast import (  # noqa: F401
+    BroadcastMethod,
+    broadcast,
+    broadcast_op,
+)
 from triton_distributed_tpu.ops.collectives.hierarchical import (  # noqa: F401
     all_gather_2d,
     all_gather_2d_op,
