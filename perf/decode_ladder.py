@@ -36,7 +36,7 @@ def main(argv=None) -> int:
     p.add_argument("--steps", type=int, default=32)
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--cpu", action="store_true", help="simulated CPU mesh")
-    p.add_argument("--rungs", default="eager,jit,pallas,mega")
+    p.add_argument("--rungs", default="eager,jit,pallas,mega,mega_multi")
     args = p.parse_args(argv)
 
     if args.cpu:
@@ -100,6 +100,28 @@ def main(argv=None) -> int:
         mega = MegaQwen3(model)
         time_host_loop(mega.decode_step, fresh_cache(), 3)
         results["mega"] = time_host_loop(mega.decode_step, fresh_cache(), args.steps)
+
+    if "mega_multi" in rungs:
+        # NS greedy steps per launch (in-kernel argmax) — the rung that
+        # amortizes the per-launch dispatch tax.
+        mega = MegaQwen3(model)
+        NS = min(8, args.steps)
+        c0 = fresh_cache()
+        fn = mega.decode_multi_fn(B, int(c0.k.shape[3]), NS)
+
+        def multi_loop(cache, launches):
+            tok = tok0
+            t0 = time.perf_counter()
+            for _ in range(launches):
+                toks, _lg, cache = fn(model.params, tok, cache)
+                tok = toks[-1]
+            np.asarray(tok)
+            return (time.perf_counter() - t0) / (launches * NS) * 1e3
+
+        multi_loop(c0, 1)  # warm/compile (c0 reused, then donated away)
+        results["mega_multi"] = multi_loop(
+            fresh_cache(), max(args.steps // NS, 1)
+        )
 
     print(json.dumps({
         "model": args.model, "batch": B, "ctx": S, "tp": args.tp,

@@ -118,6 +118,14 @@ class Engine:
                 f"{starts.tolist()}"
             )
         max_length = max_length or self.model.cfg.max_length
+        # Capacity up front: decode appends gen_len - 1 KV rows past the
+        # prompt; past s_max the dynamic_update_slice append would clamp
+        # and silently overwrite cached rows (corrupt tokens, no error).
+        if s + gen_len - 1 > max_length:
+            raise ValueError(
+                f"prompt ({s}) + gen_len ({gen_len}) exceeds "
+                f"max_length={max_length}; raise max_length or shorten"
+            )
 
         # Batched prefill (one jitted program for all rows — the
         # reference engine loops rows from host, engine.py:113). Client
