@@ -377,3 +377,57 @@ class TestMultiStepDecode:
         np.testing.assert_allclose(
             np.asarray(mc.k), np.asarray(c.k), rtol=2e-3, atol=2e-3
         )
+
+    @pytest.mark.parametrize("nranks", [1, 4])
+    def test_multi_sampled_gumbel(self, request, nranks):
+        """Sampled multi-step (argmax over logits + host-drawn noise)
+        matches the host chaining tokens exactly — Gumbel-max
+        temperature sampling with JAX-land RNG."""
+        from triton_distributed_tpu.runtime import mesh as mesh_mod
+
+        if nranks == 1:
+            ctx = mesh_mod.initialize_distributed(
+                tp=1, devices=jax.devices()[:1]
+            )
+        else:
+            ctx = mesh_mod.initialize_distributed(
+                tp=4, devices=jax.devices()[:4]
+            )
+        try:
+            model = AutoLLM.from_pretrained("tiny", ctx=ctx)
+            B, NS = 2, 3
+            cache = model.new_cache(B, max_length=64)
+            step_gold = model.decode_fn("xla")
+            _, cache = step_gold(
+                model.params, jnp.asarray([3, 5], jnp.int32), cache
+            )
+            mega = MegaQwen3(model)
+            s_max = int(cache.k.shape[3])
+            tok0 = jnp.asarray([19, 23], jnp.int32)
+            V = model.cfg.vocab_size
+            v_pad = model.params.lm_head.shape[1]
+            temp = 0.7
+            noise = temp * jax.random.gumbel(
+                jax.random.key(7), (NS, B, v_pad), jnp.float32
+            )
+
+            # Host reference: chained single-step mega + noisy argmax.
+            step = mega.decode_fn(B, s_max)
+            t, c = tok0, jax.tree.map(jnp.copy, cache)
+            ref_toks = []
+            for i in range(NS):
+                lg, c = step(model.params, t, c)
+                t = jnp.argmax(
+                    lg + noise[i, :, :V], -1
+                ).astype(jnp.int32)
+                ref_toks.append(np.asarray(t))
+
+            fn = mega.decode_multi_fn(B, s_max, NS, sampled=True)
+            mtoks, _, _ = fn(
+                model.params, tok0, jax.tree.map(jnp.copy, cache), noise
+            )
+            np.testing.assert_array_equal(
+                np.asarray(mtoks), np.stack(ref_toks)
+            )
+        finally:
+            mesh_mod.finalize_distributed()

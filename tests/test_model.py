@@ -314,3 +314,28 @@ def test_engine_serve_mega_multi_matches_xla():
         np.testing.assert_array_equal(mega, gold)
     finally:
         mesh_mod.finalize_distributed()
+
+
+def test_engine_serve_mega_sampled():
+    """mode="mega" with temperature>0 takes the sampled multi path
+    (Gumbel-perturbed in-kernel argmax); output must be plausible
+    (right shape, in-vocab) and reproducible per seed."""
+    import jax as _jax
+
+    from triton_distributed_tpu.runtime import mesh as mesh_mod
+
+    ctx = mesh_mod.initialize_distributed(tp=1, devices=_jax.devices()[:1])
+    try:
+        model = AutoLLM.from_pretrained("tiny", ctx=ctx)
+        prompt = np.arange(8, dtype=np.int32)[None].repeat(2, 0)
+        a = Engine(model, temperature=0.8, mode="mega", seed=5).serve(
+            prompt, gen_len=10, max_length=64
+        )
+        b = Engine(model, temperature=0.8, mode="mega", seed=5).serve(
+            prompt, gen_len=10, max_length=64
+        )
+        assert a.shape == (2, 18)
+        assert (a[:, 8:] >= 0).all() and (a[:, 8:] < model.cfg.vocab_size).all()
+        np.testing.assert_array_equal(a, b)  # same seed → same stream
+    finally:
+        mesh_mod.finalize_distributed()

@@ -411,22 +411,29 @@ class TestLowLatencyLower:
         )
 
     @pytest.mark.parametrize("nranks", [1, 4])
-    def test_mega_multi_step_decode(self, request, nranks):
+    @pytest.mark.parametrize("sampled", [False, True])
+    def test_mega_multi_step_decode(self, request, nranks, sampled):
         """The multi-step kernel (2-D grid, SMEM token feedback, band
         attention, in-kernel argmax) must lower for TPU — including the
-        tp>1 cross-rank argmax exchange path."""
+        tp>1 cross-rank argmax exchange and the Gumbel-noise input."""
         from triton_distributed_tpu.megakernel import MegaQwen3
         from triton_distributed_tpu.models import AutoLLM
 
         ctx = request.getfixturevalue(f"tpu_ctx{nranks}")
         model = AutoLLM.from_pretrained("tiny", ctx=ctx)
         mega = MegaQwen3(model)
-        f = jax.jit(mega.build_multi(1, 64, 4))
+        f = jax.jit(mega.build_multi(1, 64, 4, sampled=sampled))
         cache = jax.eval_shape(lambda: model.new_cache(1, 64))
         tok = jax.ShapeDtypeStruct((1,), jnp.int32)
         params = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
             model.params,
         )
-        exp = export.export(f, platforms=["tpu"])(params, tok, cache)
+        args = [params, tok, cache]
+        if sampled:
+            v_pad = model.params.lm_head.shape[1]
+            args.append(
+                jax.ShapeDtypeStruct((4, 1, v_pad), jnp.float32)
+            )
+        exp = export.export(f, platforms=["tpu"])(*args)
         assert len(exp.mlir_module_serialized) > 0

@@ -69,6 +69,12 @@ class MegaDims:
     # score 0, which could beat real negative logits) — rank r's real
     # width is clamp(v_real - r*v_loc, 0, v_loc).
     v_real: int = 0
+    # Sampled multi-step decode: an extra [nsteps, B, v_loc] noise
+    # input rides along and the LM head argmaxes logits + noise — the
+    # Gumbel-max trick (noise = temperature * gumbel drawn by the
+    # host) turns the greedy machinery into temperature sampling while
+    # the RNG stays in JAX-land (reproducible, testable).
+    sampled: bool = False
 
     @property
     def qkv_loc(self) -> int:
@@ -159,6 +165,7 @@ class KernelCtx:
         self.step: Any = None   # decode step within the launch (multi-step)
         self.tok_smem: Any = None   # [B] i32 — next-token feedback
         self.toks_out: Any = None   # [nsteps, 1, B] i32 — greedy tokens
+        self.noise: Any = None  # [1, B, v_loc] VMEM — this step's noise
 
 
 def make_mega_kernel(
@@ -195,6 +202,10 @@ def make_mega_kernel(
             x0, *rest = rest
         else:
             x0 = None
+        if dims.sampled:  # per-step sampling noise, before the cache
+            noise, *rest = rest
+        else:
+            noise = None
         (
             kc, vc,                                        # ANY (read-only)
             logits, knew_out, vnew_out, toks_out,          # outputs
@@ -211,6 +222,7 @@ def make_mega_kernel(
         kctx.tokens = tokens
         kctx.table = page_tab
         kctx.x0 = x0
+        kctx.noise = noise
         kctx.toks_out = toks_out
         kctx.embed, kctx.wqkv, kctx.wo = embed, wqkv, wo
         kctx.w1, kctx.w2, kctx.lm_head = w1, w2, lm_head
@@ -276,6 +288,16 @@ def build_mega_call(
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 6
         + [pl.BlockSpec(memory_space=pltpu.VMEM)] * 5
         + ([pl.BlockSpec(memory_space=pltpu.VMEM)] if dims.prefill else [])
+        + (
+            # Per-step noise block: Mosaic pipelines the [B, v_loc]
+            # slab for step s = program_id(0) into VMEM. (Index maps
+            # under PrefetchScalarGridSpec also receive the prefetch
+            # refs after the grid indices.)
+            [pl.BlockSpec(
+                (1, B, dims.v_loc), lambda s, t, *prefetch: (s, 0, 0)
+            )]
+            if dims.sampled else []
+        )
         + [pl.BlockSpec(memory_space=pl.ANY)] * 2,
         out_specs=[
             pl.BlockSpec(memory_space=pltpu.VMEM),  # logits
@@ -380,7 +402,16 @@ def build_mega_call(
 
     if dims.page and dims.prefill:
         raise NotImplementedError("paged prefill: prefill then scatter")
-    if dims.prefill:
+    if dims.sampled and (dims.page or dims.prefill):
+        raise NotImplementedError("sampled multi-step: dense decode only")
+    if dims.sampled:
+        def run(kv_len, tokens, noise, embed, wqkv, wo, w1, w2, lm_head,
+                ln1, ln2, normf, qn, kn, kc, vc):
+            return call(
+                table, kv_len, tokens, embed, wqkv, wo, w1, w2, lm_head,
+                ln1, ln2, normf, qn, kn, noise, kc, vc,
+            )
+    elif dims.prefill:
         def run(kv_len, tokens, x0, embed, wqkv, wo, w1, w2,
                 lm_head, ln1, ln2, normf, qn, kn, kc, vc):
             return call(

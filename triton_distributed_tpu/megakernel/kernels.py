@@ -770,6 +770,12 @@ def lm_head_body(kctx):
             def sink(j, val, carry):
                 kctx.logits[:, pl.ds(j * tn, val.shape[1])] = val
                 bestv, besti = carry
+                if dims.sampled:
+                    # Gumbel-max sampling: argmax over logits + noise
+                    # (noise = temperature * gumbel, host-drawn). The
+                    # logits OUTPUT stays clean — noise only perturbs
+                    # the argmax.
+                    val = val + kctx.noise[0, :, pl.ds(j * tn, val.shape[1])]
                 gidx = j * tn + jax.lax.broadcasted_iota(
                     jnp.int32, (B, val.shape[1]), 1
                 )

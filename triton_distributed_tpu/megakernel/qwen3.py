@@ -197,7 +197,9 @@ class MegaQwen3:
         return self._built(batch, s_max, page)[2]
 
     # -- multi-step greedy decode ----------------------------------------
-    def build_multi(self, batch: int, s_max: int, nsteps: int):
+    def build_multi(
+        self, batch: int, s_max: int, nsteps: int, sampled: bool = False
+    ):
         """``nsteps`` greedy decode steps in ONE kernel launch.
 
         The LM head argmaxes in-kernel (under TP: local argmax then a
@@ -210,8 +212,14 @@ class MegaQwen3:
         relay — the dominant cost of single-step decode at small model
         sizes) over ``nsteps``.
 
-        Greedy sampling + dense cache only. Caller contract:
-        ``kv_len[b] + nsteps <= s_max`` for every row — the append is a
+        ``sampled=True`` adds a ``noise [nsteps, B, V_pad]`` argument
+        (column-sharded under TP) and the in-kernel argmax runs over
+        ``logits + noise`` — with ``noise = temperature * gumbel`` this
+        IS temperature sampling (Gumbel-max trick), with the RNG in
+        JAX-land; the returned logits stay clean.
+
+        Dense cache only. Caller contract: ``kv_len[b] + nsteps <=
+        s_max`` for every row — the append is a
         ``dynamic_update_slice``, whose clamped start would silently
         overwrite cached rows past capacity (the Engine gates its multi
         launches on this).
@@ -219,7 +227,9 @@ class MegaQwen3:
         m = self.model
         V = m.cfg.vocab_size
         base = self._dims(batch, s_max)
-        dims = dataclasses.replace(base, nsteps=nsteps, v_real=V)
+        dims = dataclasses.replace(
+            base, nsteps=nsteps, v_real=V, sampled=sampled
+        )
         mb = ModelBuilder(
             dims, cfg=self.cfg, axis=m.axis, ctx=m.ctx,
             wdtype=m.cfg.dtype, cdtype=m.cfg.dtype,
@@ -229,9 +239,9 @@ class MegaQwen3:
         ax = m.axis
         kernel_args = self._kernel_args
 
-        def shard_fn(params: Qwen3Params, tokens, cache: KVCache):
+        def shard_fn(params: Qwen3Params, tokens, cache: KVCache, *noise):
             logits, k_rows, v_rows, toks = per_shard(
-                cache.kv_len, tokens,
+                cache.kv_len, tokens, *noise,
                 *kernel_args(params), cache.k, cache.v,
             )
             # k_rows [NS, L, B, hkv, hd] → [L, B, hkv, NS, hd]: all
@@ -252,14 +262,15 @@ class MegaQwen3:
                 k=k_new, v=v_new, kv_len=cache.kv_len + nsteps
             )
 
+        noise_specs = (P(None, None, ax),) if sampled else ()
         g = m.ctx.shard_map(
             shard_fn,
-            in_specs=(m.param_specs, P(), cache_specs(ax)),
+            in_specs=(m.param_specs, P(), cache_specs(ax), *noise_specs),
             out_specs=(P(), P(None, ax), cache_specs(ax)),
         )
 
-        def f(params, tokens, cache):
-            toks, logits, cache = g(params, tokens, cache)
+        def f(params, tokens, cache, *noise):
+            toks, logits, cache = g(params, tokens, cache, *noise)
             # toks [nsteps, B]; logits are the LAST step's (pad cols
             # dropped as in the single-step path).
             return toks, logits[:, :V], cache
@@ -269,13 +280,18 @@ class MegaQwen3:
         # reasoning as the single-step build).
         return jax.jit(f, donate_argnums=(2,))
 
-    def decode_multi_fn(self, batch: int, s_max: int, nsteps: int):
-        """Jitted multi-step fn ``f(params, tokens, cache) → (tokens
-        [nsteps, B], last_logits [B, V], cache advanced nsteps)``; the
-        cache argument is DONATED. Cached per (batch, s_max, nsteps)."""
-        key = ("multi", batch, s_max, nsteps)
+    def decode_multi_fn(
+        self, batch: int, s_max: int, nsteps: int, sampled: bool = False
+    ):
+        """Jitted multi-step fn ``f(params, tokens, cache[, noise]) →
+        (tokens [nsteps, B], last_logits [B, V], cache advanced
+        nsteps)``; the cache argument is DONATED. With ``sampled``,
+        ``noise [nsteps, B, V_pad]`` f32 perturbs the in-kernel argmax
+        (Gumbel-max sampling). Cached per (batch, s_max, nsteps,
+        sampled)."""
+        key = ("multi", batch, s_max, nsteps, sampled)
         if key not in self._jit:
-            self._jit[key] = self.build_multi(batch, s_max, nsteps)
+            self._jit[key] = self.build_multi(batch, s_max, nsteps, sampled)
         return self._jit[key]
 
     # -- prefill ---------------------------------------------------------

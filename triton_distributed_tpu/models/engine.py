@@ -25,9 +25,10 @@ from triton_distributed_tpu.models.kv_cache import KVCache
 from triton_distributed_tpu.models.qwen import Mode, Qwen3
 
 # Engine modes: the model's xla/pallas decode paths plus the megakernel
-# ("mega"): whole-step single-kernel decode, with a multi-step greedy
-# fast path (several steps per launch, in-kernel argmax — cross-rank
-# exchanged under TP) when sampling is greedy and the cache is dense.
+# ("mega"): whole-step single-kernel decode, with a multi-step fast
+# path (several steps per launch, in-kernel argmax — cross-rank
+# exchanged under TP, Gumbel-perturbed for temperature sampling) when
+# the cache is dense and no top-p filter truncates the distribution.
 EngineMode = Literal["xla", "pallas", "mega"]
 
 
@@ -187,11 +188,15 @@ class Engine:
         # at once, so it must not start within NS of s_max (a clamped
         # dynamic_update_slice would silently overwrite cached rows).
         kv_high = int(true_lens.max())
+        # Sampling composes with multi-step via the Gumbel-max trick
+        # (argmax over logits + T*gumbel == categorical(logits/T)) as
+        # long as no top-p filter truncates the distribution.
+        sampled = self.temperature > 0.0
         multi_launches = 0
         if (
             self.mode == "mega"
-            and self.temperature <= 0.0
             and not self.paged
+            and (not sampled or self.top_p >= 1.0)
         ):
             multi_launches = min(
                 (gen_len - 1) // NS, max(s_max - kv_high, 0) // NS
@@ -200,14 +205,40 @@ class Engine:
         with group_profile(profile, do_prof=profile is not None):
             left = gen_len - 1
             if multi_launches:
-                # Multi-step greedy fast path: NS steps per kernel
-                # launch (in-kernel argmax), amortizing per-launch
-                # cost; the remainder runs through the single-step
-                # kernel rather than paying a full extra megakernel
-                # build per distinct tail length.
-                fn = self._mega_model().decode_multi_fn(b, s_max, NS)
+                # Multi-step fast path: NS steps per kernel launch
+                # (in-kernel argmax — Gumbel-perturbed when sampling),
+                # amortizing per-launch cost; the remainder runs
+                # through the single-step kernel rather than paying a
+                # full extra megakernel build per distinct tail length.
+                v_pad = self.model.params.lm_head.shape[1]
+                base_fn = self._mega_model().decode_multi_fn(
+                    b, s_max, NS, sampled=sampled
+                )
+                if sampled:
+                    # Draw the Gumbel noise INSIDE the jit so each rank
+                    # materializes only its vocab shard — an eager
+                    # host-side draw would commit a [NS, b, V_pad] f32
+                    # array to one device and reshard it every launch.
+                    temp = float(self.temperature)
+
+                    def fn(params, tok, cache, key):
+                        noise = temp * jax.random.gumbel(
+                            key, (NS, b, v_pad), jnp.float32
+                        )
+                        return base_fn(params, tok, cache, noise)
+
+                    fn = jax.jit(fn, donate_argnums=(2,))
+                else:
+                    fn = base_fn
                 for _ in range(multi_launches):
-                    toks, logits, cache = fn(self.model.params, tok, cache)
+                    if sampled:
+                        self.key, sub = jax.random.split(self.key)
+                        extra = (sub,)
+                    else:
+                        extra = ()
+                    toks, logits, cache = fn(
+                        self.model.params, tok, cache, *extra
+                    )
                     toks = np.asarray(toks)  # [NS, b]
                     out.append(toks.T)
                     tok = jnp.asarray(toks[-1])
