@@ -431,3 +431,68 @@ class TestMultiStepDecode:
             )
         finally:
             mesh_mod.finalize_distributed()
+
+    def test_multi_paged_matches_chained_single(self, ctx4):
+        """Paged multi-step: pool reads via the page table, all NS new
+        rows landed by one scatter (append_n) — tokens and pool match
+        chained single-step paged decode, crossing a page boundary."""
+        from triton_distributed_tpu.models.paged_kv_cache import (
+            as_dense,
+            init_paged_cache,
+            write_prefill,
+        )
+
+        model = AutoLLM.from_pretrained("tiny", ctx=ctx4)
+        B, NS, page = 2, 4, 16
+
+        # Context via the dense golden path, mirrored into pages.
+        cache = model.new_cache(B, max_length=64)
+        step_gold = model.decode_fn("xla")
+        for toks in ([3, 5], [7, 11], [13, 17]):
+            _, cache = step_gold(
+                model.params, jnp.asarray(toks, jnp.int32), cache
+            )
+        # Push row 0 near a page boundary: positions 14..17 span pages.
+        for toks in ([2, 4], [6, 8], [10, 12], [14, 1],
+                     [9, 3], [5, 7], [11, 2], [8, 6],
+                     [4, 9], [1, 5], [3, 8]):
+            _, cache = step_gold(
+                model.params, jnp.asarray(toks, jnp.int32), cache
+            )
+        paged, _pool = init_paged_cache(
+            model.cfg, B, ctx4, max_length=64, page_size=page
+        )
+        for b in range(B):
+            row = jax.tree.map(
+                lambda x: x[:, b:b + 1], {"k": cache.k, "v": cache.v}
+            )
+            paged = write_prefill(
+                paged, b, row["k"], row["v"], int(cache.kv_len[b])
+            )
+
+        mega = MegaQwen3(model)
+        tok0 = jnp.asarray([19, 23], jnp.int32)
+
+        # Reference: chained single-step paged mega decode.
+        p_ref = jax.tree.map(jnp.copy, paged)
+        t = tok0
+        ref_toks = []
+        for _ in range(NS):
+            lg, p_ref = mega.decode_step(t, p_ref)
+            t = jnp.argmax(lg, -1).astype(jnp.int32)
+            ref_toks.append(np.asarray(t))
+
+        s_max = int(paged.page_table.shape[1]) * page
+        fn = mega.decode_multi_fn(B, s_max, NS, page=page)
+        mtoks, _, p_out = fn(
+            model.params, tok0, jax.tree.map(jnp.copy, paged)
+        )
+        np.testing.assert_array_equal(np.asarray(mtoks), np.stack(ref_toks))
+        k_ref, _ = as_dense(p_ref)
+        k_out, _ = as_dense(p_out)
+        np.testing.assert_allclose(
+            np.asarray(k_out), np.asarray(k_ref), rtol=2e-3, atol=2e-3
+        )
+        np.testing.assert_array_equal(
+            np.asarray(p_out.kv_len), np.asarray(p_ref.kv_len)
+        )

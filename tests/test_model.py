@@ -339,3 +339,25 @@ def test_engine_serve_mega_sampled():
         np.testing.assert_array_equal(a, b)  # same seed → same stream
     finally:
         mesh_mod.finalize_distributed()
+
+
+def test_engine_serve_mega_paged_multi_matches_dense():
+    """mode="mega" + paged=True greedy takes the paged multi-step path
+    (append_n single-scatter) and must match dense xla serving."""
+    import jax as _jax
+
+    from triton_distributed_tpu.runtime import mesh as mesh_mod
+
+    ctx = mesh_mod.initialize_distributed(tp=1, devices=_jax.devices()[:1])
+    try:
+        model = AutoLLM.from_pretrained("tiny", ctx=ctx)
+        prompt = np.arange(8, dtype=np.int32)[None].repeat(2, 0)
+        gold = Engine(model, temperature=0.0, mode="xla").serve(
+            prompt, gen_len=12, max_length=64
+        )
+        paged = Engine(
+            model, temperature=0.0, mode="mega", paged=True, page_size=16
+        ).serve(prompt, gen_len=12, max_length=64)
+        np.testing.assert_array_equal(paged, gold)
+    finally:
+        mesh_mod.finalize_distributed()

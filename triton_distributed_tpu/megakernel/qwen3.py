@@ -198,7 +198,8 @@ class MegaQwen3:
 
     # -- multi-step greedy decode ----------------------------------------
     def build_multi(
-        self, batch: int, s_max: int, nsteps: int, sampled: bool = False
+        self, batch: int, s_max: int, nsteps: int, sampled: bool = False,
+        page: int = 0,
     ):
         """``nsteps`` greedy decode steps in ONE kernel launch.
 
@@ -218,15 +219,19 @@ class MegaQwen3:
         IS temperature sampling (Gumbel-max trick), with the RNG in
         JAX-land; the returned logits stay clean.
 
-        Dense cache only. Caller contract: ``kv_len[b] + nsteps <=
-        s_max`` for every row — the append is a
-        ``dynamic_update_slice``, whose clamped start would silently
-        overwrite cached rows past capacity (the Engine gates its multi
-        launches on this).
+        ``page`` > 0 builds the paged-cache variant (pool reads through
+        the page table; all ``nsteps`` new rows land with ONE scatter
+        via :func:`paged_kv_cache.append_n`). Sampled+paged is not
+        combined.
+
+        Caller contract: ``kv_len[b] + nsteps <= s_max`` for every row
+        — the dense append is a ``dynamic_update_slice``, whose clamped
+        start would silently overwrite cached rows past capacity (the
+        Engine gates its multi launches on this).
         """
         m = self.model
         V = m.cfg.vocab_size
-        base = self._dims(batch, s_max)
+        base = self._dims(batch, s_max, page)
         dims = dataclasses.replace(
             base, nsteps=nsteps, v_real=V, sampled=sampled
         )
@@ -239,34 +244,56 @@ class MegaQwen3:
         ax = m.axis
         kernel_args = self._kernel_args
 
-        def shard_fn(params: Qwen3Params, tokens, cache: KVCache, *noise):
-            logits, k_rows, v_rows, toks = per_shard(
-                cache.kv_len, tokens, *noise,
-                *kernel_args(params), cache.k, cache.v,
-            )
-            # k_rows [NS, L, B, hkv, hd] → [L, B, hkv, NS, hd]: all
-            # nsteps rows land with ONE contiguous update per batch row.
-            k_rows = jnp.transpose(k_rows, (1, 2, 3, 0, 4))
-            v_rows = jnp.transpose(v_rows, (1, 2, 3, 0, 4))
-            k_new, v_new = cache.k, cache.v
-            B = tokens.shape[0]
-            for b in range(B):
-                at = (0, b, 0, cache.kv_len[b], 0)
-                k_new = jax.lax.dynamic_update_slice(
-                    k_new, k_rows[:, b:b + 1], at
+        if page:
+            def shard_fn(params: Qwen3Params, tokens,
+                         cache: PagedKVCache, *noise):
+                logits, k_rows, v_rows, toks = per_shard(
+                    cache.kv_len, tokens, cache.page_table, *noise,
+                    *kernel_args(params), cache.k_pages, cache.v_pages,
                 )
-                v_new = jax.lax.dynamic_update_slice(
-                    v_new, v_rows[:, b:b + 1], at
+                # k_rows [NS, L, B, hkv, hd] → [L, B, hkv, NS, hd]:
+                # one scatter lands all nsteps rows in the pool.
+                k_rows = jnp.transpose(k_rows, (1, 2, 3, 0, 4))
+                v_rows = jnp.transpose(v_rows, (1, 2, 3, 0, 4))
+                return (
+                    toks[:, 0, :], logits,
+                    _paged.append_n(cache, k_rows, v_rows),
                 )
-            return toks[:, 0, :], logits, KVCache(
-                k=k_new, v=v_new, kv_len=cache.kv_len + nsteps
-            )
+
+            specs = paged_cache_specs(ax)
+        else:
+            def shard_fn(params: Qwen3Params, tokens, cache: KVCache,
+                         *noise):
+                logits, k_rows, v_rows, toks = per_shard(
+                    cache.kv_len, tokens, *noise,
+                    *kernel_args(params), cache.k, cache.v,
+                )
+                # k_rows [NS, L, B, hkv, hd] → [L, B, hkv, NS, hd]: all
+                # nsteps rows land with ONE contiguous update per batch
+                # row.
+                k_rows = jnp.transpose(k_rows, (1, 2, 3, 0, 4))
+                v_rows = jnp.transpose(v_rows, (1, 2, 3, 0, 4))
+                k_new, v_new = cache.k, cache.v
+                B = tokens.shape[0]
+                for b in range(B):
+                    at = (0, b, 0, cache.kv_len[b], 0)
+                    k_new = jax.lax.dynamic_update_slice(
+                        k_new, k_rows[:, b:b + 1], at
+                    )
+                    v_new = jax.lax.dynamic_update_slice(
+                        v_new, v_rows[:, b:b + 1], at
+                    )
+                return toks[:, 0, :], logits, KVCache(
+                    k=k_new, v=v_new, kv_len=cache.kv_len + nsteps
+                )
+
+            specs = cache_specs(ax)
 
         noise_specs = (P(None, None, ax),) if sampled else ()
         g = m.ctx.shard_map(
             shard_fn,
-            in_specs=(m.param_specs, P(), cache_specs(ax), *noise_specs),
-            out_specs=(P(), P(None, ax), cache_specs(ax)),
+            in_specs=(m.param_specs, P(), specs, *noise_specs),
+            out_specs=(P(), P(None, ax), specs),
         )
 
         def f(params, tokens, cache, *noise):
@@ -281,17 +308,21 @@ class MegaQwen3:
         return jax.jit(f, donate_argnums=(2,))
 
     def decode_multi_fn(
-        self, batch: int, s_max: int, nsteps: int, sampled: bool = False
+        self, batch: int, s_max: int, nsteps: int, sampled: bool = False,
+        page: int = 0,
     ):
         """Jitted multi-step fn ``f(params, tokens, cache[, noise]) →
         (tokens [nsteps, B], last_logits [B, V], cache advanced
         nsteps)``; the cache argument is DONATED. With ``sampled``,
         ``noise [nsteps, B, V_pad]`` f32 perturbs the in-kernel argmax
-        (Gumbel-max sampling). Cached per (batch, s_max, nsteps,
-        sampled)."""
-        key = ("multi", batch, s_max, nsteps, sampled)
+        (Gumbel-max sampling); ``page`` > 0 takes a
+        :class:`PagedKVCache`. Cached per (batch, s_max, nsteps,
+        sampled, page)."""
+        key = ("multi", batch, s_max, nsteps, sampled, page)
         if key not in self._jit:
-            self._jit[key] = self.build_multi(batch, s_max, nsteps, sampled)
+            self._jit[key] = self.build_multi(
+                batch, s_max, nsteps, sampled, page
+            )
         return self._jit[key]
 
     # -- prefill ---------------------------------------------------------

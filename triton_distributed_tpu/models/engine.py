@@ -63,6 +63,10 @@ class Engine:
         # continuous-batching admission/eviction draws from it.
         self._pool = None
         self._mega = None
+        # Jitted sampled-noise wrappers, keyed by (b, s_max, NS): a
+        # fresh closure per serve() would retrace + recompile the
+        # megakernel program every call.
+        self._sampled_multi: dict = {}
 
     @property
     def _prefill_mode(self) -> Mode:
@@ -182,7 +186,10 @@ class Engine:
         from triton_distributed_tpu.runtime.profiling import group_profile
 
         NS = 8  # multi-step launch width
-        s_max = int(cache.k.shape[3]) if not self.paged else 0
+        if self.paged:
+            s_max = int(cache.page_table.shape[1]) * self.page_size
+        else:
+            s_max = int(cache.k.shape[3])
         # Capacity: the furthest row holds max(true_lens) cached tokens
         # and gains one per decode step; a multi launch appends NS rows
         # at once, so it must not start within NS of s_max (a clamped
@@ -191,12 +198,12 @@ class Engine:
         # Sampling composes with multi-step via the Gumbel-max trick
         # (argmax over logits + T*gumbel == categorical(logits/T)) as
         # long as no top-p filter truncates the distribution.
+        # Sampled+paged is the one uncovered combination.
         sampled = self.temperature > 0.0
         multi_launches = 0
         if (
             self.mode == "mega"
-            and not self.paged
-            and (not sampled or self.top_p >= 1.0)
+            and (not sampled or (self.top_p >= 1.0 and not self.paged))
         ):
             multi_launches = min(
                 (gen_len - 1) // NS, max(s_max - kv_high, 0) // NS
@@ -212,28 +219,33 @@ class Engine:
                 # full extra megakernel build per distinct tail length.
                 v_pad = self.model.params.lm_head.shape[1]
                 base_fn = self._mega_model().decode_multi_fn(
-                    b, s_max, NS, sampled=sampled
+                    b, s_max, NS, sampled=sampled,
+                    page=self.page_size if self.paged else 0,
                 )
                 if sampled:
                     # Draw the Gumbel noise INSIDE the jit so each rank
                     # materializes only its vocab shard — an eager
                     # host-side draw would commit a [NS, b, V_pad] f32
                     # array to one device and reshard it every launch.
-                    temp = float(self.temperature)
+                    # Cached per shape: a fresh closure per serve()
+                    # would retrace + recompile the megakernel program.
+                    wkey = (b, s_max, NS)
+                    fn = self._sampled_multi.get(wkey)
+                    if fn is None:
+                        def fn(params, tok, cache, key, temp):
+                            noise = temp * jax.random.gumbel(
+                                key, (NS, b, v_pad), jnp.float32
+                            )
+                            return base_fn(params, tok, cache, noise)
 
-                    def fn(params, tok, cache, key):
-                        noise = temp * jax.random.gumbel(
-                            key, (NS, b, v_pad), jnp.float32
-                        )
-                        return base_fn(params, tok, cache, noise)
-
-                    fn = jax.jit(fn, donate_argnums=(2,))
+                        fn = jax.jit(fn, donate_argnums=(2,))
+                        self._sampled_multi[wkey] = fn
                 else:
                     fn = base_fn
                 for _ in range(multi_launches):
                     if sampled:
                         self.key, sub = jax.random.split(self.key)
-                        extra = (sub,)
+                        extra = (sub, jnp.float32(self.temperature))
                     else:
                         extra = ()
                     toks, logits, cache = fn(

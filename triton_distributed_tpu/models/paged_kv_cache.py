@@ -166,29 +166,47 @@ def append(
     k_new: jax.Array,  # [L, B, Hkv_loc, hd] — one token per sequence
     v_new: jax.Array,
 ) -> PagedKVCache:
-    """Append one token per sequence at ``kv_len`` (jit-safe)."""
-    page_size = cache.k_pages.shape[3]
-    b = k_new.shape[1]
+    """Append one token per sequence at ``kv_len`` (jit-safe); the
+    NS=1 case of :func:`append_n` (one scatter per pool)."""
+    return append_n(cache, k_new[:, :, :, None, :], v_new[:, :, :, None, :])
+
+
+def append_n(
+    cache: PagedKVCache,
+    k_new: jax.Array,  # [L, B, Hkv_loc, NS, hd] — NS tokens per sequence
+    v_new: jax.Array,
+) -> PagedKVCache:
+    """Append ``NS`` tokens per sequence at ``kv_len`` in ONE scatter.
+
+    The multi-step megakernel emits NS rows per launch; appending them
+    row-by-row would pay the per-op dispatch tax NS times (the very
+    cost multi-step exists to amortize). One advanced-index scatter
+    per pool handles all (b, step) rows — page-boundary crossings fall
+    out of the per-row (page_id, offset) computation.
+
+    Caller contract: ``kv_len[b] + NS`` stays within the page table's
+    capacity for every row.
+    """
+    page = cache.k_pages.shape[3]
+    L, B, H, NS, hd = k_new.shape
+    pos = cache.kv_len[:, None] + jnp.arange(NS, dtype=jnp.int32)[None]
+    pids = jnp.take_along_axis(cache.page_table, pos // page, axis=1)
+    flat_p = pids.reshape(-1)        # [B*NS]
+    flat_o = (pos % page).reshape(-1)
 
     def write(pages, new):
-        def one(pages, b_idx):
-            pos = cache.kv_len[b_idx]
-            pid = cache.page_table[b_idx, pos // page_size]
-            upd = new[:, b_idx][:, None, :, None, :]  # [L, 1, H, 1, hd]
-            return jax.lax.dynamic_update_slice(
-                pages, upd.astype(pages.dtype),
-                (0, pid, 0, pos % page_size, 0),
-            )
-
-        for i in range(b):
-            pages = one(pages, i)
-        return pages
+        # Two advanced indices split by slices → advanced axes move to
+        # the front: the indexed view is [B*NS, L, H, hd].
+        upd = new.transpose(1, 3, 0, 2, 4).reshape(B * NS, L, H, hd)
+        return pages.at[:, flat_p, :, flat_o, :].set(
+            upd.astype(pages.dtype), unique_indices=True
+        )
 
     return PagedKVCache(
         k_pages=write(cache.k_pages, k_new),
         v_pages=write(cache.v_pages, v_new),
         page_table=cache.page_table,
-        kv_len=cache.kv_len + 1,
+        kv_len=cache.kv_len + NS,
     )
 
 
