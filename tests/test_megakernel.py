@@ -488,10 +488,13 @@ class TestMultiStepDecode:
             model.params, tok0, jax.tree.map(jnp.copy, paged)
         )
         np.testing.assert_array_equal(np.asarray(mtoks), np.stack(ref_toks))
-        k_ref, _ = as_dense(p_ref)
-        k_out, _ = as_dense(p_out)
+        k_ref, v_ref = as_dense(p_ref)
+        k_out, v_out = as_dense(p_out)
         np.testing.assert_allclose(
             np.asarray(k_out), np.asarray(k_ref), rtol=2e-3, atol=2e-3
+        )
+        np.testing.assert_allclose(
+            np.asarray(v_out), np.asarray(v_ref), rtol=2e-3, atol=2e-3
         )
         np.testing.assert_array_equal(
             np.asarray(p_out.kv_len), np.asarray(p_ref.kv_len)

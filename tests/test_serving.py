@@ -135,3 +135,75 @@ def test_continuous_batching_oversubscribed_pool(ctx4):
     with pytest.raises(ValueError, match="unservable"):
         # Needs 4 pages; capacity is 3.
         small.run([(np.zeros(48, np.int32), 16)])
+
+
+def test_continuous_batching_mega_multi(ctx4):
+    """mode="mega" continuous serving decodes in NS-token chunks
+    (paged multi-step launches) with host admission at chunk
+    boundaries; outputs must match the dense per-request goldens."""
+    from triton_distributed_tpu.models.continuous import ContinuousEngine
+
+    model = AutoLLM.from_pretrained("tiny", ctx=ctx4)
+    prompts = [
+        np.asarray([5, 9, 2, 4], np.int32),
+        np.asarray([7, 1, 3, 8, 6, 2, 4, 9], np.int32),
+        np.asarray([11, 12, 13, 14], np.int32),
+    ]
+    gens = [5, 3, 4]
+    golds = []
+    for p, g in zip(prompts, gens):
+        out = Engine(model, temperature=0.0).serve(p[None], gen_len=g)
+        golds.append(out[0, len(p):])
+
+    eng = ContinuousEngine(
+        model, max_batch=2, page_size=16, max_length=64, mode="mega"
+    )
+    free0 = len(eng.pool.free)
+    outs = eng.run(list(zip(prompts, gens)))
+    for got, gold in zip(outs, golds):
+        np.testing.assert_array_equal(got, np.asarray(gold))
+    assert len(eng.pool.free) == free0  # all pages released
+
+
+def test_continuous_batching_mega_eos(ctx4):
+    """eos mid-chunk: overshoot tokens are discarded, the slot frees at
+    the chunk boundary, and the queued request still serves right."""
+    from triton_distributed_tpu.models.continuous import ContinuousEngine
+
+    model = AutoLLM.from_pretrained("tiny", ctx=ctx4)
+    p = np.asarray([5, 9, 2, 4], np.int32)
+    probe = Engine(model, temperature=0.0).serve(p[None], gen_len=3)[0, 4:]
+    eos = int(probe[1])
+
+    eng = ContinuousEngine(
+        model, max_batch=1, page_size=16, max_length=64, eos_id=eos,
+        mode="mega",
+    )
+    outs = eng.run([(p, 6), (p, 2)])
+    np.testing.assert_array_equal(outs[0], probe[:2])
+    assert len(outs[1]) == 2
+
+
+def test_continuous_batching_first_token_finishes(ctx4):
+    """gen_len=1 and first-token-eos requests complete at admission:
+    exactly one token back, and the freed slot admits the next request
+    immediately."""
+    from triton_distributed_tpu.models.continuous import ContinuousEngine
+
+    model = AutoLLM.from_pretrained("tiny", ctx=ctx4)
+    p = np.asarray([5, 9, 2, 4], np.int32)
+    first = int(
+        Engine(model, temperature=0.0).serve(p[None], gen_len=1)[0, 4]
+    )
+
+    eng = ContinuousEngine(model, max_batch=1, page_size=16, max_length=64)
+    outs = eng.run([(p, 1), (p, 2)])
+    assert len(outs[0]) == 1 and int(outs[0][0]) == first
+    assert len(outs[1]) == 2
+
+    # eos as the very first sampled token.
+    eng2 = ContinuousEngine(
+        model, max_batch=1, page_size=16, max_length=64, eos_id=first
+    )
+    outs2 = eng2.run([(p, 6), (p, 2)])
+    assert len(outs2[0]) == 1 and int(outs2[0][0]) == first

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from triton_distributed_tpu.models import sampling
+from triton_distributed_tpu.models.engine import MegaDispatch
 from triton_distributed_tpu.models.paged_kv_cache import (
     PagedKVCache,
     PagePool,
@@ -50,7 +51,7 @@ class Request:
         return len(self.out) >= self.gen_len
 
 
-class ContinuousEngine:
+class ContinuousEngine(MegaDispatch):
     """Admission/eviction serving loop over the paged pool.
 
     ``max_batch`` decode slots share ``num_pages`` pool pages; a request
@@ -66,7 +67,7 @@ class ContinuousEngine:
         page_size: int = 128,
         max_length: int | None = None,
         num_pages: int | None = None,
-        mode: Mode = "xla",
+        mode: str = "xla",  # Mode or "mega" (megakernel decode)
         temperature: float = 0.0,
         eos_id: int | None = None,
         seed: int = 0,
@@ -120,7 +121,7 @@ class ContinuousEngine:
         self._sync_tables()
 
         logits, self._dense1 = self.model.prefill_batched(
-            jnp.asarray(row[None]), self._dense1, self.mode,
+            jnp.asarray(row[None]), self._dense1, self._prefill_mode,
             jnp.asarray([s], jnp.int32),
         )
         self.cache = write_prefill(
@@ -166,41 +167,87 @@ class ContinuousEngine:
 
         def try_admit() -> bool:
             admitted = False
-            for slot in range(self.max_batch):
-                if self._slots[slot] is None and queue:
-                    need = -(-(len(queue[0].prompt) + queue[0].gen_len)
-                             // self.page_size)
-                    if need > len(self.pool.free):
-                        break  # head-of-line waits for pages
-                    req = queue.popleft()
-                    first = self._admit(req, slot)
-                    req.out.append(int(first))
-                    tok[slot] = int(first)
-                    admitted = True
+            progress = True
+            while progress:  # re-scan: a first-token eviction frees its
+                progress = False          # slot for the next request
+                for slot in range(self.max_batch):
+                    if self._slots[slot] is None and queue:
+                        need = -(-(len(queue[0].prompt) + queue[0].gen_len)
+                                 // self.page_size)
+                        if need > len(self.pool.free):
+                            return admitted  # head-of-line waits for pages
+                        req = queue.popleft()
+                        first = self._admit(req, slot)
+                        req.out.append(int(first))
+                        tok[slot] = int(first)
+                        admitted = progress = True
+                        # The admission token itself can finish the
+                        # request (gen_len=1, or eos as first token).
+                        if req.done or (
+                            self.eos_id is not None
+                            and int(first) == self.eos_id
+                        ):
+                            self._evict(req)
+            if admitted:
+                # A trailing first-token eviction leaves the device
+                # table pointing at released pages until synced.
+                self._sync_tables()
             return admitted
 
-        try_admit()
-        while any(r is not None for r in self._slots):
-            logits, self.cache = self.model.decode_step(
-                jnp.asarray(tok), self.cache, self.mode
-            )
-            self._kv_len += (
-                np.asarray([r is not None for r in self._slots], np.int32)
-            )
-            # decode_step bumped every row on device; mirror tracks the
-            # active ones (inactive rows append into the trash page).
-            nxt = np.asarray(self._sample(logits))
+        # Megakernel greedy serving decodes in NS-step chunks: one
+        # launch emits NS tokens per slot (in-kernel argmax), then the
+        # host checks eos/gen_len. A finished row's overshoot tokens
+        # are discarded; its overshoot KV rows land beyond its
+        # allocated pages, where the zeroed table entries route them to
+        # the trash page. Rows near max_length fall back to single
+        # steps for the tail.
+        NS = 8
+        use_multi = self.mode == "mega" and self.temperature <= 0.0
+        multi_fn = None
+
+        def process(slot_tokens) -> bool:
+            """Append per-slot tokens; evict on gen_len/eos. Returns
+            whether slot state changed."""
             changed = False
             for slot, req in enumerate(self._slots):
                 if req is None:
                     continue
-                req.out.append(int(nxt[slot]))
-                tok[slot] = int(nxt[slot])
-                if req.done or (
-                    self.eos_id is not None and int(nxt[slot]) == self.eos_id
-                ):
-                    self._evict(req)  # eos/gen_len: free pages NOW
-                    changed = True
+                for t in slot_tokens(slot):
+                    req.out.append(int(t))
+                    tok[slot] = int(t)
+                    if req.done or (
+                        self.eos_id is not None and int(t) == self.eos_id
+                    ):
+                        self._evict(req)  # eos/gen_len: free pages NOW
+                        changed = True
+                        break
+            return changed
+
+        try_admit()
+        while any(r is not None for r in self._slots):
+            active = np.asarray(
+                [r is not None for r in self._slots], np.int32
+            )
+            kv_high = int((self._kv_len * active).max())
+            if use_multi and kv_high + NS <= self.max_length:
+                if multi_fn is None:
+                    multi_fn = self._mega_model().decode_multi_fn(
+                        self.max_batch, self.max_length, NS,
+                        page=self.page_size,
+                    )
+                toks, _logits, self.cache = multi_fn(
+                    self.model.params, jnp.asarray(tok), self.cache
+                )
+                self._kv_len += NS * active
+                toks_np = np.asarray(toks)  # [NS, max_batch]
+                changed = process(lambda slot: toks_np[:, slot])
+            else:
+                logits, self.cache = self._decode_step(
+                    jnp.asarray(tok), self.cache
+                )
+                self._kv_len += active
+                nxt = np.asarray(self._sample(logits))
+                changed = process(lambda slot: [nxt[slot]])
             if changed:
                 # Slot state changed: the device cache threads k/v
                 # pages, but table + kv_len are host-authoritative.

@@ -32,7 +32,33 @@ from triton_distributed_tpu.models.qwen import Mode, Qwen3
 EngineMode = Literal["xla", "pallas", "mega"]
 
 
-class Engine:
+class MegaDispatch:
+    """Shared megakernel-mode dispatch (Engine + ContinuousEngine):
+    lazy MegaQwen3 construction, xla prefill fallback, and mega-vs-model
+    decode routing. Expects ``self.model`` and ``self.mode``."""
+
+    _mega = None
+
+    @property
+    def _prefill_mode(self) -> Mode:
+        # The mega prefill path is single-sequence; batched serving
+        # prefills through the model's own path.
+        return "xla" if self.mode == "mega" else self.mode
+
+    def _mega_model(self):
+        if self._mega is None:
+            from triton_distributed_tpu.megakernel import MegaQwen3
+
+            self._mega = MegaQwen3(self.model)
+        return self._mega
+
+    def _decode_step(self, tok, cache):
+        if self.mode == "mega":
+            return self._mega_model().decode_step(tok, cache)
+        return self.model.decode_step(tok, cache, self.mode)
+
+
+class Engine(MegaDispatch):
     """Parity: reference ``Engine`` (``models/engine.py:37``)."""
 
     def __init__(
@@ -62,29 +88,10 @@ class Engine:
         # Page-pool free list, populated by the first paged serve();
         # continuous-batching admission/eviction draws from it.
         self._pool = None
-        self._mega = None
         # Jitted sampled-noise wrappers, keyed by (b, s_max, NS): a
         # fresh closure per serve() would retrace + recompile the
         # megakernel program every call.
         self._sampled_multi: dict = {}
-
-    @property
-    def _prefill_mode(self) -> Mode:
-        # The mega prefill path is single-sequence; batched serving
-        # prefills through the model's own path.
-        return "xla" if self.mode == "mega" else self.mode
-
-    def _mega_model(self):
-        if self._mega is None:
-            from triton_distributed_tpu.megakernel import MegaQwen3
-
-            self._mega = MegaQwen3(self.model)
-        return self._mega
-
-    def _decode_step(self, tok, cache):
-        if self.mode == "mega":
-            return self._mega_model().decode_step(tok, cache)
-        return self.model.decode_step(tok, cache, self.mode)
 
     def _sample(self, logits: jax.Array) -> jax.Array:
         if self.temperature <= 0.0:

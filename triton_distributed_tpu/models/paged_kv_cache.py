@@ -196,11 +196,12 @@ def append_n(
 
     def write(pages, new):
         # Two advanced indices split by slices → advanced axes move to
-        # the front: the indexed view is [B*NS, L, H, hd].
+        # the front: the indexed view is [B*NS, L, H, hd]. NO
+        # unique_indices: inactive/finished rows all route to the trash
+        # page (identical indices), where duplicate writes are fine
+        # under scatter's last-write-wins but UB if claimed unique.
         upd = new.transpose(1, 3, 0, 2, 4).reshape(B * NS, L, H, hd)
-        return pages.at[:, flat_p, :, flat_o, :].set(
-            upd.astype(pages.dtype), unique_indices=True
-        )
+        return pages.at[:, flat_p, :, flat_o, :].set(upd.astype(pages.dtype))
 
     return PagedKVCache(
         k_pages=write(cache.k_pages, k_new),
