@@ -144,6 +144,16 @@ class ContinuousEngine(MegaDispatch):
         self.key, sub = jax.random.split(self.key)
         return sampling.sample(logits, sub, self.temperature, 1.0)
 
+    def _needed_pages(self, prompt_len: int, gen_len: int) -> int:
+        return -(-(prompt_len + gen_len) // self.page_size)
+
+    def _maybe_finish(self, req: Request, t: int) -> bool:
+        """Evict ``req`` if token ``t`` completed it (gen_len or eos)."""
+        if req.done or (self.eos_id is not None and t == self.eos_id):
+            self._evict(req)  # free pages NOW
+            return True
+        return False
+
     # -- the loop --------------------------------------------------------
 
     def run(self, requests: list[tuple[np.ndarray, int]]) -> list[np.ndarray]:
@@ -157,9 +167,10 @@ class ContinuousEngine(MegaDispatch):
                     f"prompt+gen_len = {total} exceeds max_length "
                     f"{self.max_length}"
                 )
-            if -(-total // self.page_size) > self._capacity:
+            need = self._needed_pages(len(r.prompt), r.gen_len)
+            if need > self._capacity:
                 raise ValueError(
-                    f"request needs {-(-total // self.page_size)} pages; "
+                    f"request needs {need} pages; "
                     f"pool capacity is {self._capacity} (unservable)"
                 )
         queue = deque(reqs)
@@ -172,10 +183,12 @@ class ContinuousEngine(MegaDispatch):
                 progress = False          # slot for the next request
                 for slot in range(self.max_batch):
                     if self._slots[slot] is None and queue:
-                        need = -(-(len(queue[0].prompt) + queue[0].gen_len)
-                                 // self.page_size)
+                        need = self._needed_pages(
+                            len(queue[0].prompt), queue[0].gen_len
+                        )
                         if need > len(self.pool.free):
-                            return admitted  # head-of-line waits for pages
+                            progress = False
+                            break  # head-of-line waits for pages
                         req = queue.popleft()
                         first = self._admit(req, slot)
                         req.out.append(int(first))
@@ -183,14 +196,13 @@ class ContinuousEngine(MegaDispatch):
                         admitted = progress = True
                         # The admission token itself can finish the
                         # request (gen_len=1, or eos as first token).
-                        if req.done or (
-                            self.eos_id is not None
-                            and int(first) == self.eos_id
-                        ):
-                            self._evict(req)
+                        self._maybe_finish(req, int(first))
             if admitted:
                 # A trailing first-token eviction leaves the device
-                # table pointing at released pages until synced.
+                # table pointing at released pages until synced — and
+                # every exit path must reach this sync (an early return
+                # here once left a zombie slot decoding into freed
+                # pages).
                 self._sync_tables()
             return admitted
 
@@ -215,10 +227,7 @@ class ContinuousEngine(MegaDispatch):
                 for t in slot_tokens(slot):
                     req.out.append(int(t))
                     tok[slot] = int(t)
-                    if req.done or (
-                        self.eos_id is not None and int(t) == self.eos_id
-                    ):
-                        self._evict(req)  # eos/gen_len: free pages NOW
+                    if self._maybe_finish(req, int(t)):
                         changed = True
                         break
             return changed
