@@ -199,6 +199,54 @@ def main() -> None:
                 out_tok, _ = mmrun(model.params, tok0, cache0, STEPS // NS)
                 np.asarray(out_tok)
 
+            # Cross-check before timing: the single- and multi-step
+            # kernels run identical math, so their greedy chains must
+            # agree token-for-token — a mismatch means the multi kernel
+            # mis-executes on this chip, and its timing would be
+            # meaningless.
+            if "mega" in ladder:
+                def single_seq(params, tok, cache, n):
+                    def body(i, carry):
+                        tok, cache, seq = carry
+                        logits, cache = mstep(params, tok, cache)
+                        tok = jnp.argmax(logits, -1).astype(jnp.int32)
+                        return tok, cache, seq.at[i].set(tok[0])
+
+                    seq0 = jnp.zeros((n,), jnp.int32)
+                    return jax.lax.fori_loop(
+                        0, n, body, (tok, cache, seq0)
+                    )[2]
+
+                def multi_seq(params, tok, cache, nl):
+                    def body(i, carry):
+                        tok, cache, seq = carry
+                        toks, _lg, cache = mmulti(params, tok, cache)
+                        seq = jax.lax.dynamic_update_slice(
+                            seq, toks[:, 0], (i * NS,)
+                        )
+                        return toks[NS - 1], cache, seq
+
+                    seq0 = jnp.zeros((nl * NS,), jnp.int32)
+                    return jax.lax.fori_loop(
+                        0, nl, body, (tok, cache, seq0)
+                    )[2]
+
+                s_seq = np.asarray(
+                    jax.jit(single_seq, static_argnums=3)(
+                        model.params, tok0, cache0, STEPS
+                    )
+                )
+                m_seq = np.asarray(
+                    jax.jit(multi_seq, static_argnums=3)(
+                        model.params, tok0, cache0, STEPS // NS
+                    )
+                )
+                if (s_seq != m_seq).any():
+                    raise RuntimeError(
+                        "multi-step tokens diverge from single-step: "
+                        f"{s_seq.tolist()} vs {m_seq.tolist()}"
+                    )
+
             ladder["mega_multi"] = time_rung(mega_multi_once)
         except Exception as e:
             errors["mega_multi"] = f"{type(e).__name__}: {e}"[:300]

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from triton_distributed_tpu.ops.common import interpret_mode
+from triton_distributed_tpu.ops.common import exporting_portable, interpret_mode
 
 _NEG_INF = -1e30
 
@@ -124,6 +124,15 @@ def flash_attention(
     group = hq // hkv
     if sm_scale is None:
         sm_scale = d**-0.5
+    # jax.export can't serialize the host callbacks interpret-mode
+    # Pallas lowers to; portable exports take the XLA-reference path
+    # (same contract as flash_decode's portable fallback).
+    interpret = interpret_mode() if interpret is None else interpret
+    if interpret and exporting_portable():
+        return mha_reference(
+            q, k, v, causal=causal, sm_scale=sm_scale,
+            kv_offset=kv_offset, return_lse=return_lse,
+        )
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     if sq % block_q or sk % block_k:
@@ -178,7 +187,7 @@ def flash_attention(
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary"),
         ),
-        interpret=interpret_mode() if interpret is None else interpret,
+        interpret=interpret,
     )(qf, kf, vf)
 
     o = res[0].reshape(b, hq, sq, d)

@@ -39,6 +39,47 @@ def build_entries(model_name: str, batch: int, max_len: int, tp: int):
             },
         )
     ]
+
+    # The prefill program (the other serving entry point).
+    prompt = jnp.zeros((batch, max_len // 2), jnp.int32)
+    true_len = jnp.full((batch,), max_len // 2, jnp.int32)
+    entries.append(
+        export_fn(
+            lambda prompt, cache, true_len: model.prefill_batched(
+                prompt, cache, "xla", true_len
+            ),
+            (prompt, cache, true_len),
+            name=f"prefill_b{batch}_s{max_len // 2}",
+            meta={
+                "model": model_name, "tp": tp, "batch": batch,
+                "kind": "prefill",
+            },
+        )
+    )
+
+    # The flash-decode kernel family at the model's shapes (parity:
+    # scripts/aot_kernels.txt — the reference precompiles exactly this
+    # family for serving).
+    from triton_distributed_tpu.ops.attention import flash_decode
+
+    c = model.cfg
+    n = ctx.axis_size(model.axis)
+    hq_loc = c.num_q_heads // n
+    hkv_loc = c.num_kv_heads // n  # model __init__ enforces divisibility
+    q = jnp.zeros((batch, hq_loc, c.head_dim), c.dtype)
+    kv = jnp.zeros((batch, hkv_loc, max_len, c.head_dim), c.dtype)
+    kv_len = jnp.full((batch,), max_len // 2, jnp.int32)
+    entries.append(
+        export_fn(
+            lambda q, k, v, kv_len: flash_decode(q, k, v, kv_len),
+            (q, kv, kv, kv_len),
+            name=f"flash_decode_b{batch}_s{max_len}",
+            meta={
+                "model": model_name, "tp": tp, "batch": batch,
+                "kind": "flash_decode",
+            },
+        )
+    )
     return entries
 
 
