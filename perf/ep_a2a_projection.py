@@ -1,0 +1,77 @@
+"""EP all-to-all latency projection at the reference's headline config.
+
+The reference's flagship number (BASELINE.md / `README.md:100-101`):
+low-latency EP AllToAll dispatch at 128 tokens/rank, topk=8, hidden
+7168, fp8 payloads, on 32x H800 — **137 us** (vs DeepEP's 182 us).
+
+Single-chip hardware can't measure a 32-rank exchange, so this script
+does what the reference's own `comm_perf_model.py` does: price the
+wire. Every rank ships 128*topk routed token copies of 7168 fp8 bytes
+(+1/512 scales overhead) split across 31 peers; on a TPU mesh the
+intra-slice share rides ICI and the cross-slice share rides DCN. The
+printed projection is the analytic floor for `ep_dispatch(payload=
+"fp8")` at that config, alongside the measured reference baseline.
+
+Usage: python perf/ep_a2a_projection.py [--ranks 32 --local 4]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--tokens", type=int, default=128, help="tokens per rank")
+    p.add_argument("--topk", type=int, default=8)
+    p.add_argument("--hidden", type=int, default=7168)
+    p.add_argument("--ranks", type=int, default=32)
+    p.add_argument("--local", type=int, default=4,
+                   help="ranks per ICI slice (v5e host = 4 chips)")
+    # Explicit default: chip_spec(None) asks jax.devices(), which hangs
+    # forever when the relay is down.
+    p.add_argument("--chip", default="v5e")
+    args = p.parse_args(argv)
+
+    from triton_distributed_tpu.tools.perf_model import chip_spec
+
+    spec = chip_spec(args.chip)
+    # fp8 payload + 1 f32 scale per 512-byte row group (the codec in
+    # ops/moe/ep_a2a.py: per-row scales, hidden >> 512 so ~hidden/512).
+    row_bytes = args.hidden * 1 + 4 * max(args.hidden // 512, 1)
+    routed = args.tokens * args.topk  # token copies leaving each rank
+    # Uniform routing: (ranks-1)/ranks of copies leave the rank; the
+    # cross-slice share rides DCN.
+    off_rank = routed * (args.ranks - 1) / args.ranks
+    local = min(args.local, args.ranks)
+    off_slice_frac = (args.ranks - local) / max(args.ranks - 1, 1)
+    ici_bytes = off_rank * (1 - off_slice_frac) * row_bytes
+    dcn_bytes = off_rank * off_slice_frac * row_bytes
+    # ICI: all neighbors push concurrently (2 directions usable).
+    ici_us = ici_bytes / (2 * spec.ici_gbs_per_link * 1e9) * 1e6
+    dcn_us = dcn_bytes / (spec.dcn_gbs * 1e9) * 1e6
+    total_us = max(ici_us, 1.0) + dcn_us  # DCN serializes after ICI
+
+    print(json.dumps({
+        "config": {
+            "tokens_per_rank": args.tokens, "topk": args.topk,
+            "hidden": args.hidden, "payload": "fp8+scales",
+            "ranks": args.ranks, "ranks_per_slice": local,
+            "chip": spec.name,
+        },
+        "wire_bytes_per_rank": int(off_rank * row_bytes),
+        "projection_us": {
+            "ici": round(ici_us, 1), "dcn": round(dcn_us, 1),
+            "total": round(total_us, 1),
+        },
+        "reference_us": {"triton_distributed_32xH800": 137,
+                         "deepep_32xH800": 182},
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
