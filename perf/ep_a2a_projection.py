@@ -36,12 +36,15 @@ def main(argv=None) -> int:
     p.add_argument("--chip", default="v5e")
     args = p.parse_args(argv)
 
-    from triton_distributed_tpu.tools.perf_model import chip_spec
+    from triton_distributed_tpu.tools.perf_model import (
+        _ring_bw_gbs,
+        chip_spec,
+    )
 
     spec = chip_spec(args.chip)
-    # fp8 payload + 1 f32 scale per 512-byte row group (the codec in
-    # ops/moe/ep_a2a.py: per-row scales, hidden >> 512 so ~hidden/512).
-    row_bytes = args.hidden * 1 + 4 * max(args.hidden // 512, 1)
+    # fp8 payload + ONE f32 scale per token row (the codec in
+    # ops/moe/ep_a2a.py _fp8_encode: axis=-1 keepdims reduction).
+    row_bytes = args.hidden * 1 + 4
     routed = args.tokens * args.topk  # token copies leaving each rank
     # Uniform routing: (ranks-1)/ranks of copies leave the rank; the
     # cross-slice share rides DCN.
@@ -50,9 +53,9 @@ def main(argv=None) -> int:
     off_slice_frac = (args.ranks - local) / max(args.ranks - 1, 1)
     ici_bytes = off_rank * (1 - off_slice_frac) * row_bytes
     dcn_bytes = off_rank * off_slice_frac * row_bytes
-    # ICI: all neighbors push concurrently (2 directions usable).
-    ici_us = ici_bytes / (2 * spec.ici_gbs_per_link * 1e9) * 1e6
-    dcn_us = dcn_bytes / (spec.dcn_gbs * 1e9) * 1e6
+    ici_us = ici_bytes / (_ring_bw_gbs(spec, True) * 1e9) * 1e6
+    # dcn_gbs is PER HOST: the slice's `local` ranks share one NIC.
+    dcn_us = dcn_bytes * local / (spec.dcn_gbs * 1e9) * 1e6
     total_us = max(ici_us, 1.0) + dcn_us  # DCN serializes after ICI
 
     print(json.dumps({
