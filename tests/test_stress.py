@@ -7,6 +7,7 @@ interpret-mode simulator executes DMAs and semaphores with faithful
 ordering, so a missing wait surfaces as wrong output here, cluster-free.
 """
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -71,3 +72,40 @@ class TestAllReduceStress:
         np.testing.assert_allclose(
             np.asarray(f(x)), np.asarray(x).sum(0), rtol=1e-5, atol=1e-5
         )
+
+
+def test_multi_step_exchange_with_straggler(ctx4):
+    """The multi-step LM-head cross-rank argmax under a lagged rank
+    (race-provocation parity: reference for_correctness/straggler
+    fixtures): the exchange's wait/barrier discipline must keep tokens
+    exact even when one rank's candidate push is late."""
+    from triton_distributed_tpu.megakernel import MegaQwen3
+    from triton_distributed_tpu.models import AutoLLM
+
+    model = AutoLLM.from_pretrained("tiny", ctx=ctx4)
+    B, NS = 2, 3
+    cache = model.new_cache(B, max_length=64)
+    step_gold = model.decode_fn("xla")
+    _, cache = step_gold(model.params, jnp.asarray([3, 5], jnp.int32), cache)
+
+    mega = MegaQwen3(model)
+    s_max = int(cache.k.shape[3])
+    tok0 = jnp.asarray([19, 23], jnp.int32)
+
+    # Gold: the single-step mega chain (same kernel math, no exchange —
+    # argmax runs on the host), so a consistently-wrong exchange can't
+    # agree with it by construction.
+    step = mega.decode_fn(B, s_max)
+    t, c = tok0, jax.tree.map(jnp.copy, cache)
+    gold = []
+    for _ in range(NS):
+        lg, c = step(model.params, t, c)
+        t = jnp.argmax(lg, -1).astype(jnp.int32)
+        gold.append(np.asarray(t))
+
+    clean = mega.build_multi(B, s_max, NS)
+    lagged = mega.build_multi(B, s_max, NS, straggler_rank=2)
+    t_clean, _, _ = clean(model.params, tok0, jax.tree.map(jnp.copy, cache))
+    t_lag, _, _ = lagged(model.params, tok0, jax.tree.map(jnp.copy, cache))
+    np.testing.assert_array_equal(np.asarray(t_clean), np.stack(gold))
+    np.testing.assert_array_equal(np.asarray(t_lag), np.stack(gold))

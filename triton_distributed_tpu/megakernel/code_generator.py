@@ -75,6 +75,12 @@ class MegaDims:
     # host) turns the greedy machinery into temperature sampling while
     # the RNG stays in JAX-land (reproducible, testable).
     sampled: bool = False
+    # Race-provocation fixture (parity: the reference's for_correctness
+    # sleeps / straggler_option): lag this rank's LM-head argmax
+    # exchange so a peer missing a wait reads stale candidates.
+    # None = fixture off (straggle_if_rank's own no-op convention).
+    straggler_rank: int | None = None
+    straggler_nanos: int = 500_000
 
     @property
     def qkv_loc(self) -> int:

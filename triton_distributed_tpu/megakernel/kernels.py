@@ -809,6 +809,12 @@ def lm_head_body(kctx):
                 d = kctx.arsrc.shape[1]
                 pad = jnp.zeros((B, d - 2), jnp.float32)
                 cand = jnp.concatenate([bestv, gbesti, pad], axis=1)
+                # Race fixture (no-op when straggler_rank is None): lag
+                # this rank's candidate push so any consumer missing
+                # its wait reads stale slots.
+                dl.straggle_if_rank(
+                    dims.straggler_rank, kctx.axis, dims.straggler_nanos
+                )
                 _workspace_bcast(kctx, cand)
                 bestv = kctx.cbuf[0, :, 0:1]
                 besti = kctx.cbuf[0, :, 1:2].astype(jnp.int32)

@@ -199,7 +199,7 @@ class MegaQwen3:
     # -- multi-step greedy decode ----------------------------------------
     def build_multi(
         self, batch: int, s_max: int, nsteps: int, sampled: bool = False,
-        page: int = 0,
+        page: int = 0, straggler_rank: int | None = None,
     ):
         """``nsteps`` greedy decode steps in ONE kernel launch.
 
@@ -233,7 +233,8 @@ class MegaQwen3:
         V = m.cfg.vocab_size
         base = self._dims(batch, s_max, page)
         dims = dataclasses.replace(
-            base, nsteps=nsteps, v_real=V, sampled=sampled
+            base, nsteps=nsteps, v_real=V, sampled=sampled,
+            straggler_rank=straggler_rank,
         )
         mb = ModelBuilder(
             dims, cfg=self.cfg, axis=m.axis, ctx=m.ctx,
