@@ -62,7 +62,8 @@ class MegaDims:
     # attention covers the launch's earlier steps from the knew/vnew
     # outputs (the "band"), and the caller appends all nsteps rows at
     # once. Amortizes the platform's per-launch/per-op tax (measured
-    # ~2 ms/step on the v5e relay) over nsteps. Greedy sampling only.
+    # ~2 ms/step on the v5e relay) over nsteps. Argmax-based: greedy,
+    # or temperature sampling via the `sampled` Gumbel noise below.
     nsteps: int = 1
     # GLOBAL real (unpadded) vocab size; 0 = every column real. The
     # in-kernel argmax masks this rank's pad columns (zero weights

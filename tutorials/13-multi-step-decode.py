@@ -21,7 +21,10 @@ tax every token. The multi-step megakernel replays it every N tokens:
 - the caller appends all N rows with one contiguous
   ``dynamic_update_slice`` per batch row.
 
-Greedy sampling + dense cache only.
+Temperature sampling composes via the Gumbel-max trick
+(``decode_multi_fn(..., sampled=True)`` + host-drawn noise), and paged
+pools via ``page=page_size`` (all N rows landed by one scatter); only
+top-p truncation stays on the single-step path.
 """
 
 from _common import setup
