@@ -7,7 +7,9 @@ low-latency EP AllToAll dispatch at 128 tokens/rank, topk=8, hidden
 Single-chip hardware can't measure a 32-rank exchange, so this script
 does what the reference's own `comm_perf_model.py` does: price the
 wire. Every rank ships 128*topk routed token copies of 7168 fp8 bytes
-(+1/512 scales overhead) split across 31 peers; on a TPU mesh the
+(+ one f32 scale per token row — the per-row keepdims codec in
+`ops/moe/ep_a2a.py` `_fp8_encode`, ~4/7168 overhead) split across 31
+peers; on a TPU mesh the
 intra-slice share rides ICI and the cross-slice share rides DCN. The
 printed projection is the analytic floor for `ep_dispatch(payload=
 "fp8")` at that config, alongside the measured reference baseline.

@@ -28,11 +28,24 @@ from triton_distributed_tpu.ops import (
         AllGatherMethod.PALLAS_RING,
         AllGatherMethod.PALLAS_BIDIR_RING,
         AllGatherMethod.PALLAS_FULL_MESH,
+        AllGatherMethod.PALLAS_PULL,
     ],
 )
 def test_all_gather(ctx4, rng, method):
     x = jnp.asarray(rng.standard_normal((4 * 8, 128), dtype=np.float32))
     out = all_gather_op(x, "tp", method, ctx4)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=1e-6)
+
+
+@pytest.mark.parametrize("window", [1, 2, 3])
+def test_all_gather_pull_windows(ctx4, rng, window):
+    """Pull (receiver-driven) gather at every pacing window, incl. the
+    fully-serialized window=1 — exercises the request/serve_get
+    rendezvous and its deadlock-freedom argument at each depth."""
+    x = jnp.asarray(rng.standard_normal((4 * 8, 128), dtype=np.float32))
+    out = all_gather_op(
+        x, "tp", AllGatherMethod.PALLAS_PULL, ctx4, pull_window=window
+    )
     np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=1e-6)
 
 

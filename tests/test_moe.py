@@ -97,7 +97,8 @@ def test_ep_moe(ctx4, rng, moe_weights, method):
     np.testing.assert_allclose(np.asarray(out), gold, atol=5e-4, rtol=5e-4)
 
 
-def test_ep_moe_lossless_adversarial(ctx4, rng, moe_weights):
+@pytest.mark.parametrize("method", ["xla", "pallas"])
+def test_ep_moe_lossless_adversarial(ctx4, rng, moe_weights, method):
     """VERDICT r1 #5: worst-case routing skew — a router biased so EVERY
     token's top-k lands on rank 0's experts — must still be bit-exact vs
     the dense golden, with zero drops (reference never drops;
@@ -113,7 +114,9 @@ def test_ep_moe_lossless_adversarial(ctx4, rng, moe_weights):
     w1 = jnp.concatenate([mw["gate"], mw["up"]], axis=2)
 
     f = ctx4.shard_map(
-        functools.partial(ep_moe_ffn, k=mw["k"], axis="tp", ctx=ctx4),
+        functools.partial(
+            ep_moe_ffn, k=mw["k"], axis="tp", method=method, ctx=ctx4,
+        ),
         in_specs=(P("tp", None), P(), P("tp", None, None), P("tp", None, None)),
         out_specs=P("tp", None),
     )
@@ -148,9 +151,12 @@ def test_ep_dispatch_overflow_detected(ctx4, rng, moe_weights):
     assert int(np.asarray(dropped).max()) > 0
 
 
-def test_ep_moe_fp8_payload(ctx4, rng, moe_weights):
+@pytest.mark.parametrize("method", ["xla", "pallas"])
+def test_ep_moe_fp8_payload(ctx4, rng, moe_weights, method):
     """LL fp8+scales codec (reference low_latency_all_to_all.py:36-125):
-    quantized dispatch stays close to the dense golden."""
+    quantized dispatch stays close to the dense golden — over both
+    transports, and bit-identically between them (same codec, different
+    wire)."""
     mw = moe_weights
     t_loc, n = 8, 4
     x = jnp.asarray(rng.standard_normal((n * t_loc, mw["d"])) * 0.1, jnp.float32)
@@ -158,7 +164,8 @@ def test_ep_moe_fp8_payload(ctx4, rng, moe_weights):
 
     f = ctx4.shard_map(
         functools.partial(
-            ep_moe_ffn, k=mw["k"], axis="tp", payload_dtype="fp8", ctx=ctx4,
+            ep_moe_ffn, k=mw["k"], axis="tp", payload_dtype="fp8",
+            method=method, ctx=ctx4,
         ),
         in_specs=(P("tp", None), P(), P("tp", None, None), P("tp", None, None)),
         out_specs=P("tp", None),
@@ -167,6 +174,57 @@ def test_ep_moe_fp8_payload(ctx4, rng, moe_weights):
     gold = _golden_moe(x, mw["w_router"], mw["gate"], mw["up"], mw["down"], mw["k"])
     # fp8 payload: ~2^-3 relative mantissa error through one FFN
     np.testing.assert_allclose(np.asarray(out), gold, atol=5e-2, rtol=5e-2)
+
+
+@pytest.mark.parametrize("payload", [None, "fp8"])
+def test_ep_transport_parity(ctx4, rng, moe_weights, payload):
+    """The device-push transport must be BIT-IDENTICAL to the XLA
+    transport (same tokens, same slots, only the wire differs) — at
+    skewed splits so partial blocks and empty segments both occur."""
+    mw = moe_weights
+    t_loc, n = 8, 4
+    x = jnp.asarray(
+        np.abs(rng.standard_normal((n * t_loc, mw["d"]))) * 0.1, jnp.float32
+    )
+    # Skew most tokens to rank 0's experts (non-uniform splits).
+    w_router = mw["w_router"].at[:, :2].add(50.0)
+    w1 = jnp.concatenate([mw["gate"], mw["up"]], axis=2)
+
+    outs = {}
+    for method in ("xla", "pallas"):
+        f = ctx4.shard_map(
+            functools.partial(
+                ep_moe_ffn, k=mw["k"], axis="tp", method=method,
+                payload_dtype=payload, ctx=ctx4,
+            ),
+            in_specs=(P("tp", None), P(), P("tp", None, None),
+                      P("tp", None, None)),
+            out_specs=P("tp", None),
+        )
+        outs[method] = np.asarray(f(x, w_router, w1, mw["down"]))
+    np.testing.assert_array_equal(outs["xla"], outs["pallas"])
+
+
+def test_ep_moe_capacity_pallas(ctx4, rng, moe_weights):
+    """Capacity (bounded-memory) mode over the device-push transport:
+    uniform routing under capacity must match the dense golden, and the
+    unwritten tail of each segment must not poison the combine."""
+    mw = moe_weights
+    t_loc, n = 8, 4
+    x = jnp.asarray(rng.standard_normal((n * t_loc, mw["d"])) * 0.1, jnp.float32)
+    w1 = jnp.concatenate([mw["gate"], mw["up"]], axis=2)
+
+    f = ctx4.shard_map(
+        functools.partial(
+            ep_moe_ffn, k=mw["k"], axis="tp", method="pallas",
+            capacity_factor=4.0, ctx=ctx4,
+        ),
+        in_specs=(P("tp", None), P(), P("tp", None, None), P("tp", None, None)),
+        out_specs=P("tp", None),
+    )
+    out = f(x, mw["w_router"], w1, mw["down"])
+    gold = _golden_moe(x, mw["w_router"], mw["gate"], mw["up"], mw["down"], mw["k"])
+    np.testing.assert_allclose(np.asarray(out), gold, atol=5e-4, rtol=5e-4)
 
 
 def test_qwen3_moe_model(ctx4):

@@ -16,6 +16,8 @@ from triton_distributed_tpu.language.primitives import (  # noqa: F401
     rank,
     read,
     remote_copy,
+    request,
+    serve_get,
     signal,
     straggle_if_rank,
     translate_rank,

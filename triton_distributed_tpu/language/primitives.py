@@ -19,6 +19,9 @@ Mapping (see SURVEY.md §2.4):
 | ``symm_at(buf, rank)`` + put     | ``remote_copy(src, dst, dst_dev, ...)`` |
 | ``putmem_signal[_nbi]``          | ``put_signal(...)`` (recv semaphore IS  |
 |                                  | the arrival signal)                     |
+| ``getmem_*`` (pull)              | ``request(...)`` + ``serve_get(...)``   |
+|                                  | (receiver-initiated rendezvous — see    |
+|                                  | the pull section below)                 |
 | ``barrier_all``                  | ``barrier_all(axis)``                   |
 | ``quiet``/``fence``              | ``quiet(*dmas)`` (drain started sends)  |
 
@@ -174,6 +177,46 @@ def put_signal(src_ref, dst_ref, dst_dev, send_sem, recv_sem, axis: str = "tp"):
     dma = remote_copy(src_ref, dst_ref, dst_dev, send_sem, recv_sem, axis=axis)
     dma.start()
     return dma
+
+
+def request(req_sem, src_dev, axis: str, inc: int | jax.Array = 1):
+    """Pull-mode request: ask peer ``src_dev`` to serve data to this rank.
+
+    Parity: the initiator side of ``nvshmem_getmem_signal``
+    (``libnvshmem_device.py:399-492``). The ICI DMA engine is push-only
+    (no remote-read descriptor), so a TPU "get" is a receiver-initiated
+    rendezvous: the receiver signals the source's request semaphore and
+    the source — running the same SPMD kernel — answers with a
+    ``put_signal`` (:func:`serve_get`). The flow-control property that
+    makes NVSHMEM pull producers worth having survives the translation:
+    no byte moves until the RECEIVER asks, so a receiver can pace its
+    requests (window them) and incast onto a hot link never builds up.
+
+    A second property comes free: a pull protocol needs NO entry
+    barrier. A push kernel must barrier first so peers' buffers exist
+    before blind writes (see ``_ring_kernel``); a served pull is gated
+    on the receiver's own request, which it can only issue after
+    entering the kernel — the request IS the proof of liveness.
+    """
+    signal(req_sem, inc, dst=src_dev, axis=axis)
+
+
+def serve_get(
+    req_sem,
+    src_ref,
+    dst_ref,
+    dst_dev,
+    send_sem,
+    recv_sem,
+    axis: str,
+    requests: int | jax.Array = 1,
+):
+    """Responder side of a pull: block until ``requests`` arrivals on the
+    local ``req_sem``, then push ``src_ref`` into ``dst_ref`` on the
+    requester (parity: the remote agent that a ``getmem`` RDMA read
+    engages in hardware). Returns the started DMA."""
+    wait(req_sem, requests)
+    return put_signal(src_ref, dst_ref, dst_dev, send_sem, recv_sem, axis=axis)
 
 
 def local_copy(src_ref, dst_ref, sem):

@@ -130,14 +130,6 @@ class Engine(MegaDispatch):
                 f"{starts.tolist()}"
             )
         max_length = max_length or self.model.cfg.max_length
-        # Capacity up front: decode appends gen_len - 1 KV rows past the
-        # prompt; past s_max the dynamic_update_slice append would clamp
-        # and silently overwrite cached rows (corrupt tokens, no error).
-        if s + gen_len - 1 > max_length:
-            raise ValueError(
-                f"prompt ({s}) + gen_len ({gen_len}) exceeds "
-                f"max_length={max_length}; raise max_length or shorten"
-            )
 
         # Batched prefill (one jitted program for all rows — the
         # reference engine loops rows from host, engine.py:113). Client
@@ -153,6 +145,24 @@ class Engine(MegaDispatch):
                 [rows, np.zeros((b, pad), np.int32)], axis=1
             )
         true_lens = (s - starts).astype(np.int32)
+        # Capacity guards: the prefill writes s + pad cache rows, and
+        # decode appends gen_len - 1 KV rows past each row's REAL
+        # prompt (kv_len starts at true_len, not the padded width s).
+        # Past max_length the dynamic_update_slice append would clamp
+        # and silently overwrite cached rows (corrupt tokens, no
+        # error). Left-padded rows therefore only need true_len +
+        # gen_len - 1 to fit, not s + gen_len - 1.
+        if s + pad > max_length:
+            raise ValueError(
+                f"padded prompt width ({s} + {pad}) exceeds "
+                f"max_length={max_length}; raise max_length or shorten"
+            )
+        if int(true_lens.max()) + gen_len - 1 > max_length:
+            raise ValueError(
+                f"longest real prompt ({int(true_lens.max())}) + gen_len "
+                f"({gen_len}) exceeds max_length={max_length}; raise "
+                f"max_length or shorten"
+            )
         if self.paged:
             from triton_distributed_tpu.models.paged_kv_cache import (
                 init_paged_cache,

@@ -40,6 +40,7 @@ class AllGatherMethod(enum.Enum):
     PALLAS_RING = "pallas_ring"
     PALLAS_BIDIR_RING = "pallas_bidir_ring"
     PALLAS_FULL_MESH = "pallas_full_mesh"
+    PALLAS_PULL = "pallas_pull"
 
 
 _AG_COLLECTIVE_ID = next_collective_id()
@@ -178,11 +179,75 @@ def _full_mesh_kernel(
     dl.quiet(*dmas)
 
 
+def _pull_kernel(
+    x_ref, o_ref, copy_sem, send_sems, recv_sems, req_sems,
+    *, axis: str, window: int
+):
+    """Receiver-driven (pull) full-mesh gather.
+
+    Equivalent role: the reference's pull producers —
+    ``cp_engine_producer_all_gather_full_mesh_pull`` (``allgather.py:106``)
+    and the LL ``_forward_pull`` (``low_latency_allgather.py:48``). The
+    ICI DMA engine is push-only, so "pull" is the :func:`dl.request` /
+    :func:`dl.serve_get` rendezvous: shard ``s`` only moves after the
+    receiver asks for it, paced ``window`` requests at a time, so a rank
+    never suffers n-1 simultaneous inbound DMAs (the incast the push
+    full-mesh creates and a straggler amplifies).
+
+    NO entry barrier — a serve is gated on the requester's own request,
+    which proves its ``o_ref`` is live (see :func:`dl.request`). At
+    ``window >= n-1`` this is latency-equivalent to full-mesh push minus
+    the barrier hop, plus one request signal.
+
+    Deadlock-freedom (serve order is ascending step ``s``): serve step
+    ``s`` consumes request #``s``, which rank ``me-s`` issues either up
+    front (``s <= window``) or after its arrival ``s-window`` — produced
+    by serve step ``s-window`` of another rank. Every wait therefore
+    depends only on strictly smaller serve steps; induction on ``s``
+    closes the cycle-free argument.
+    """
+    me = dl.rank(axis)
+    n = dl.num_ranks(axis)
+    m_per = x_ref.shape[0]
+    own = pl.ds(me * m_per, m_per)
+    # n=1: both loops must be empty (w=0) — a self-request would leave
+    # req_sems[0] signaled but never served at kernel exit.
+    w = min(max(window, 1), n - 1)
+
+    cp = pltpu.make_async_copy(x_ref, o_ref.at[own], copy_sem)
+    cp.start()
+
+    # Window of outstanding pull requests: ask peers me+1 .. me+w first.
+    for i in range(1, w + 1):
+        dl.request(req_sems.at[i - 1], jax.lax.rem(me + i, n), axis)
+
+    dmas = []
+    for s in range(1, n):
+        # Serve: requester me-s asked for my shard with its request #s.
+        requester = jax.lax.rem(me - s + n, n)
+        dmas.append(
+            dl.serve_get(
+                req_sems.at[s - 1], x_ref, o_ref.at[own], requester,
+                send_sems.at[s - 1], recv_sems.at[s - 1], axis,
+            )
+        )
+        # My own request #s has now been served by peer me+s.
+        src = jax.lax.rem(me + s, n)
+        dl.wait_recv(recv_sems.at[s - 1], o_ref.at[pl.ds(src * m_per, m_per)])
+        if s + w <= n - 1:
+            dl.request(
+                req_sems.at[s + w - 1], jax.lax.rem(me + s + w, n), axis
+            )
+    cp.wait()
+    dl.quiet(*dmas)
+
+
 def all_gather(
     x: jax.Array,
     axis: str = "tp",
     method: AllGatherMethod = AllGatherMethod.AUTO,
     ctx: DistContext | None = None,
+    pull_window: int = 2,
 ) -> jax.Array:
     """Gather shards along ``axis`` into the leading dim. Call inside
     ``shard_map``; ``x`` is this device's shard ``[m_per, ...]`` and the
@@ -235,6 +300,14 @@ def all_gather(
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
             pltpu.SemaphoreType.DMA(()),
         ]
+    elif method == AllGatherMethod.PALLAS_PULL:
+        kernel = functools.partial(_pull_kernel, axis=axis, window=pull_window)
+        scratch = [
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+            pltpu.SemaphoreType.REGULAR((max(n - 1, 1),)),
+        ]
     else:
         raise ValueError(f"unknown method {method}")
 
@@ -254,6 +327,7 @@ def all_gather_op(
     axis: str = "tp",
     method: AllGatherMethod = AllGatherMethod.AUTO,
     ctx: DistContext | None = None,
+    pull_window: int = 2,
 ) -> jax.Array:
     """Host-level wrapper: ``x`` is sharded along its leading dim over
     ``axis``; result is the gathered (replicated) array. Mainly for
@@ -263,7 +337,10 @@ def all_gather_op(
     ctx = ctx or current_context()
     rest = [None] * (x.ndim - 1)
     f = ctx.shard_map(
-        functools.partial(all_gather, axis=axis, method=method, ctx=ctx),
+        functools.partial(
+            all_gather, axis=axis, method=method, ctx=ctx,
+            pull_window=pull_window,
+        ),
         in_specs=P(axis, *rest),
         out_specs=P(None, *rest),
     )

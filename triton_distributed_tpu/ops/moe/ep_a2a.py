@@ -25,6 +25,17 @@ Low-latency payload mode (``payload_dtype="fp8"``): tokens are
 quantized to float8_e4m3 with per-row scales before the exchange and
 dequantized after — half the ICI bytes, the reference's
 ``low_latency_all_to_all`` fp8+scales codec (:36-125) in XLA form.
+
+Transports (``method=``):
+
+- ``"pallas"`` — device-initiated: payload + scales + expert ids pack
+  into one uint8 row and move through ``ep_exchange`` (per-destination
+  ``put_signal`` block pushes, only the filled prefix crosses the wire
+  — the reference's flagship ``low_latency_all_to_all.py`` shape).
+- ``"xla"`` — the whole max-padded segments ride ``lax.all_to_all``.
+- ``"auto"`` — pallas on real TPU, xla elsewhere. No size gate: the
+  segments live in ANY/HBM on both ends, so unlike the VMEM-resident
+  dense a2a there is no payload ceiling to dodge.
 """
 
 from __future__ import annotations
@@ -48,6 +59,8 @@ class DispatchState(NamedTuple):
     weights: jax.Array   # [T*k] f32 gate weights
     token_ids: jax.Array  # [T*k] source token index
     num_dropped: jax.Array  # [] int32 — 0 in lossless mode, by construction
+    splits: jax.Array       # [n] int32 — rows sent per dest (capacity-clipped)
+    recv_counts: jax.Array  # [n] int32 — rows received per source
 
 
 def _fp8_encode(x: jax.Array):
@@ -56,6 +69,16 @@ def _fp8_encode(x: jax.Array):
     scale = jnp.maximum(amax, 1e-12) / 448.0  # e4m3 max normal
     q = (x.astype(jnp.float32) / scale).astype(jnp.float8_e4m3fn)
     return q, scale.astype(jnp.float32)
+
+
+def _resolve_method(method: str, ctx) -> str:
+    """``auto`` → the device-push kernel on real TPU, XLA elsewhere
+    (interpret-mode Pallas is a correctness tool, not a fast path)."""
+    if method != "auto":
+        return method
+    from triton_distributed_tpu.ops.common import _on_tpu
+
+    return "pallas" if _on_tpu(ctx) else "xla"
 
 
 def ep_dispatch(
@@ -74,7 +97,10 @@ def ep_dispatch(
     are ``t*k`` wide and real splits ride along, so nothing can drop.
     Returns ``(recv_x [n*C, d], recv_expert [n*C] local expert ids,
     recv_valid [n*C], state)`` — parity: ``kernel_dispatch_token`` +
-    ``kernel_get_ag_splits_and_recv_offset``.
+    ``kernel_get_ag_splits_and_recv_offset``. Contract on BOTH
+    transports: rows where ``recv_valid`` is False hold expert 0 and a
+    zero payload (the XLA path by buffer construction, the pallas path
+    by masking the unwritten wire-trimmed tail).
     """
     n = jax.lax.axis_size(axis)
     t, d = x.shape
@@ -109,35 +135,79 @@ def ep_dispatch(
     send_e = jnp.zeros((n, capacity), jnp.int32)
     send_e = send_e.at[dest, slot].set(local_e, mode="drop", unique_indices=True)
 
-    # Splits exchange (tiny [n] payload, XLA path): receiver learns each
-    # source segment's true fill. Replaces per-slot valid bytes.
+    # Splits exchange (tiny [n] payload, XLA control plane — see
+    # ``ep_exchange`` module docstring): receiver learns each source
+    # segment's true fill. Replaces per-slot valid bytes.
+    splits_c = jnp.minimum(splits, capacity)
     recv_counts = all_to_all(
-        jnp.minimum(splits, capacity)[:, None, None],
-        axis=axis, method="xla", ctx=ctx,
+        splits_c[:, None, None], axis=axis, method="xla", ctx=ctx,
     )[:, 0, 0]  # [n]
 
-    if payload_dtype == "fp8":
-        q, scale = _fp8_encode(send_x.reshape(n * capacity, d))
-        recv_q = all_to_all(
-            q.reshape(n, capacity, d), axis=axis, method="xla", ctx=ctx
-        )
-        recv_scale = all_to_all(
-            scale.reshape(n, capacity, 1), axis=axis, method="xla", ctx=ctx
-        )
-        recv_x = (recv_q.astype(jnp.float32) * recv_scale).astype(x.dtype)
-    else:
-        recv_x = all_to_all(send_x, axis=axis, method=method, ctx=ctx)
-    recv_e = all_to_all(
-        send_e[..., None], axis=axis, method="xla", ctx=ctx
-    )[..., 0].reshape(n * capacity)
+    method = _resolve_method(method, ctx)
     recv_v = (
         jax.lax.broadcasted_iota(jnp.int32, (n, capacity), 1)
         < recv_counts[:, None]
     ).reshape(n * capacity)
+
+    if payload_dtype == "fp8":
+        q, scale = _fp8_encode(send_x.reshape(n * capacity, d))
+
+    if method == "pallas":
+        # Device-initiated transport: payload (+scale) + expert id pack
+        # into one uint8 row; only filled blocks cross the wire.
+        from triton_distributed_tpu.ops.moe.ep_exchange import (
+            ep_exchange,
+            pack_rows,
+            unpack_row,
+        )
+
+        if payload_dtype == "fp8":
+            parts = [
+                q.reshape(n, capacity, d),
+                scale.reshape(n, capacity, 1),
+                send_e[..., None],
+            ]
+        else:
+            parts = [send_x, send_e[..., None]]
+        rows, offs = pack_rows(parts)
+        out_rows = ep_exchange(
+            rows, splits_c, recv_counts, axis=axis, ctx=ctx
+        )
+        if payload_dtype == "fp8":
+            recv_q = unpack_row(out_rows, offs[0], jnp.float8_e4m3fn, d)
+            recv_scale = unpack_row(out_rows, offs[1], jnp.float32, 1)
+            recv_x = (recv_q.astype(jnp.float32) * recv_scale).astype(x.dtype)
+            e_off = offs[2]
+        else:
+            recv_x = unpack_row(out_rows, offs[0], x.dtype, d)
+            e_off = offs[1]
+        recv_e = unpack_row(out_rows, e_off, jnp.int32, 1)[..., 0]
+        # Rows past each source's count are unwritten garbage (the wire
+        # savings); zero them so the contract matches the XLA path.
+        recv_x = jnp.where(
+            recv_v[:, None], recv_x.reshape(n * capacity, d), 0
+        ).astype(x.dtype)
+        recv_e = jnp.where(recv_v, recv_e.reshape(n * capacity), 0)
+    else:
+        if payload_dtype == "fp8":
+            recv_q = all_to_all(
+                q.reshape(n, capacity, d), axis=axis, method="xla", ctx=ctx
+            )
+            recv_scale = all_to_all(
+                scale.reshape(n, capacity, 1), axis=axis, method="xla", ctx=ctx
+            )
+            recv_x = (recv_q.astype(jnp.float32) * recv_scale).astype(x.dtype)
+        else:
+            recv_x = all_to_all(send_x, axis=axis, method=method, ctx=ctx)
+        recv_x = recv_x.reshape(n * capacity, d)
+        recv_e = all_to_all(
+            send_e[..., None], axis=axis, method="xla", ctx=ctx
+        )[..., 0].reshape(n * capacity)
     state = DispatchState(
-        dest, slot, valid, route.weights.reshape(-1), token_ids, num_dropped
+        dest, slot, valid, route.weights.reshape(-1), token_ids, num_dropped,
+        splits_c, recv_counts,
     )
-    return recv_x.reshape(n * capacity, d), recv_e, recv_v, state
+    return recv_x, recv_e, recv_v, state
 
 
 def ep_combine(
@@ -149,13 +219,40 @@ def ep_combine(
     ctx=None,
 ) -> jax.Array:
     """Route results back and reduce weighted per token → [T, d]
-    (parity: ``kernel_combine_token``)."""
+    (parity: ``kernel_combine_token``). The combine payload stays in the
+    model dtype (the reference's combine is bf16 too — quantization
+    error must not enter the weighted reduce twice)."""
     n = jax.lax.axis_size(axis)
     capacity = expert_out.shape[0] // n
     d = expert_out.shape[1]
-    back = all_to_all(
-        expert_out.reshape(n, capacity, d), axis=axis, method=method, ctx=ctx
-    )  # [n, C, d] — slot layout mirrors what this rank sent
+    method = _resolve_method(method, ctx)
+    if method == "pallas":
+        # Return direction mirrors dispatch: this rank holds
+        # recv_counts[s] result rows for source s and gets back its own
+        # splits[p] rows from dest p — same kernel, counts swapped.
+        from triton_distributed_tpu.ops.moe.ep_exchange import (
+            ep_exchange,
+            pack_rows,
+            unpack_row,
+        )
+
+        rows, offs = pack_rows([expert_out.reshape(n, capacity, d)])
+        out_rows = ep_exchange(
+            rows, state.recv_counts, state.splits, axis=axis, ctx=ctx
+        )
+        back = unpack_row(out_rows, offs[0], expert_out.dtype, d)
+        # Unwritten rows past each dest's count would poison the
+        # weighted sum through clamped gathers (NaN * 0 = NaN).
+        sent = (
+            jax.lax.broadcasted_iota(jnp.int32, (n, capacity), 1)
+            < state.splits[:, None]
+        )
+        back = jnp.where(sent[..., None], back, 0)
+    else:
+        back = all_to_all(
+            expert_out.reshape(n, capacity, d), axis=axis, method=method,
+            ctx=ctx,
+        )  # [n, C, d] — slot layout mirrors what this rank sent
     picked = back[state.dest, state.slot]  # [T*k, d]
     w = jnp.where(state.valid, state.weights, 0.0)
     out = jnp.zeros((num_tokens, d), jnp.float32)
@@ -203,10 +300,10 @@ def ep_moe_ffn(
         x, route, num_experts, capacity, axis, method, ctx,
         payload_dtype=payload_dtype,
     )
-    # Mask invalid (padding) rows to expert 0 with zero payload so they
-    # contribute nothing and cost one extra group row.
-    recv_e = jnp.where(recv_v, recv_e, 0)
-    recv_x = jnp.where(recv_v[:, None], recv_x, 0)
+    # Invalid (padding) rows arrive as expert 0 with zero payload —
+    # ep_dispatch's contract on both transports — so they contribute
+    # nothing and cost one extra group row.
+    del recv_v  # contract: already folded into recv_x/recv_e
     order = jnp.argsort(recv_e, stable=True)
     inv = jnp.argsort(order)
     sorted_x = recv_x[order]
