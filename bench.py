@@ -14,10 +14,15 @@ decode is bandwidth-bound (weights + KV streamed once per token), the
 decode analog of the reference's "fraction of comm hidden" roofline
 framing (README.md:190-209).
 
-Robustness (round-1 lesson): the experimental 'axon' TPU plugin can be
-slow or unavailable; ``jax.devices()`` in-process either hangs or
-raises. The backend is therefore probed in a SUBPROCESS with a timeout
-and retries; on failure the bench falls back to the CPU platform so a
+Robustness (round-1 lesson; round-2 lesson: a relay OUTAGE mid-run
+hangs forever rather than raising, and one outage zeroed the round's
+perf evidence — VERDICT r2 #1). The backend is probed in a SUBPROCESS
+with timeouts and retries; the ladder itself then runs in a WORKER
+subprocess that appends one JSON line per completed rung to a progress
+file, while the parent watchdogs progress, kills a hung worker,
+re-probes the relay, and relaunches skipping completed rungs — so a
+mid-run outage costs the remaining rungs at worst, never the whole
+ladder. On total failure the bench falls back to the CPU platform so a
 parseable number is always emitted (marked ``"platform": "cpu"``).
 
 Timing notes (axon relay): ``block_until_ready`` resolves early and
@@ -42,9 +47,20 @@ _PEAK_GBS = {
     "v6e": 1640.0,
 }
 
-_PROBE_ATTEMPTS = 2
-_PROBE_TIMEOUT_S = 270
-_PROBE_SLEEP_S = 15
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+_PROBE_ATTEMPTS = _env_int("TDT_BENCH_PROBE_ATTEMPTS", 3)
+_PROBE_TIMEOUT_S = _env_int("TDT_BENCH_PROBE_TIMEOUT_S", 270)
+_PROBE_SLEEP_S = 25
+_INIT_TIMEOUT_S = 900      # worker import + model build + prefill compile
+_RUNG_TIMEOUT_S = 600      # per-rung compile + timing
+_WORKER_ATTEMPTS = 3
+_GLOBAL_DEADLINE_S = 2700  # stop relaunching workers past this
 
 
 def _probe_tpu() -> bool:
@@ -82,8 +98,15 @@ def chip_peak_gbs(jax) -> float:
     return 819.0
 
 
-def main() -> None:
-    on_tpu = _probe_tpu()
+def _emit(fh, obj) -> None:
+    fh.write(json.dumps(obj) + "\n")
+    fh.flush()
+    os.fsync(fh.fileno())
+
+
+def run_ladder(progress_fh, on_tpu: bool, skip: frozenset[str]) -> None:
+    """Run the decode ladder, emitting one JSON line per event (worker
+    body; also called in-process for the CPU fallback)."""
     if not on_tpu:
         os.environ["XLA_FLAGS"] = (
             os.environ.get("XLA_FLAGS", "")
@@ -100,6 +123,7 @@ def main() -> None:
     from triton_distributed_tpu.models import AutoLLM
     from triton_distributed_tpu.runtime.mesh import initialize_distributed
 
+    _emit(progress_fh, {"start": "init"})
     ctx = initialize_distributed(tp=1, devices=jax.devices()[:1])
     model_name = "Qwen/Qwen3-0.6B" if on_tpu else "tiny"
     model = AutoLLM.from_pretrained(model_name, ctx=ctx, max_length=1024)
@@ -111,6 +135,24 @@ def main() -> None:
     tokens = jnp.asarray(np.arange(PROMPT) % cfg.vocab_size, jnp.int32)
     logits, cache0 = model.prefill(tokens, cache0, "xla")
     tok0 = jnp.argmax(logits)[None].astype(jnp.int32)
+
+    param_bytes = sum(
+        x.size * x.dtype.itemsize for x in jax.tree.leaves(model.params)
+    )
+    kv_bytes = (
+        2 * cfg.num_layers * cfg.num_kv_heads * PROMPT * cfg.head_dim
+        * jnp.dtype(cfg.dtype).itemsize
+    )
+    _emit(progress_fh, {
+        "init": {
+            "platform": jax.default_backend(),
+            "peak_gbs": chip_peak_gbs(jax),
+            "param_bytes": int(param_bytes),
+            "kv_bytes": int(kv_bytes),
+            "model": model_name,
+            "steps": STEPS,
+        }
+    })
 
     def make_runner(mode):
         step = model.decode_fn(mode)
@@ -130,9 +172,10 @@ def main() -> None:
     def time_rung(run_once) -> float:
         return median_time(run_once) / STEPS * 1e3
 
-    ladder: dict[str, float] = {}
-    errors: dict[str, str] = {}
     for name, mode in (("jit", "xla"), ("pallas", "pallas")):
+        if name in skip:
+            continue
+        _emit(progress_fh, {"start": name})
         try:
             run = make_runner(mode)
 
@@ -140,15 +183,19 @@ def main() -> None:
                 out_tok, _ = run(model.params, tok0, cache0, STEPS)
                 np.asarray(out_tok)
 
-            ladder[name] = time_rung(once)
+            _emit(progress_fh, {"rung": name, "ms": time_rung(once)})
         except Exception as e:  # keep the ladder going rung by rung
-            errors[name] = f"{type(e).__name__}: {e}"[:300]
+            _emit(progress_fh, {
+                "rung": name, "error": f"{type(e).__name__}: {e}"[:300],
+            })
 
     # Megakernel rung: whole decode step as ONE Pallas kernel, with the
     # same fori_loop chaining as the other rungs (greedy feedback keeps
     # the steps data-dependent; one jit dispatch for all STEPS). Skipped
     # off-TPU (interpret mode is semantics-only, not a timing rung).
-    if on_tpu:
+    mega_ok = False
+    if on_tpu and "mega" not in skip:
+        _emit(progress_fh, {"start": "mega"})
         try:
             from triton_distributed_tpu.megakernel import MegaQwen3
 
@@ -169,18 +216,28 @@ def main() -> None:
                 out_tok, _ = mrun(model.params, tok0, cache0, STEPS)
                 np.asarray(out_tok)
 
-            ladder["mega"] = time_rung(mega_once)
+            _emit(progress_fh, {"rung": "mega", "ms": time_rung(mega_once)})
+            mega_ok = True
         except Exception as e:
-            errors["mega"] = f"{type(e).__name__}: {e}"[:300]
+            _emit(progress_fh, {
+                "rung": "mega", "error": f"{type(e).__name__}: {e}"[:300],
+            })
 
+    if on_tpu and "mega_multi" not in skip:
         # Multi-step megakernel: NS greedy steps per kernel launch
         # (in-kernel argmax + SMEM token feedback) — amortizes the
         # platform's per-launch/per-op dispatch tax, the dominant cost
         # of single-step decode on this chip.
+        _emit(progress_fh, {"start": "mega_multi"})
         try:
             from triton_distributed_tpu.megakernel import MegaQwen3
 
             NS = 8
+            if not mega_ok:
+                # The token cross-check below needs the single-step
+                # kernel even when its timing rung ran in an earlier
+                # worker attempt (or failed).
+                mstep = MegaQwen3(model).decode_fn(1, int(cache0.k.shape[3]))
             mmulti = MegaQwen3(model).decode_multi_fn(
                 1, int(cache0.k.shape[3]), NS
             )
@@ -204,88 +261,224 @@ def main() -> None:
             # agree token-for-token — a mismatch means the multi kernel
             # mis-executes on this chip, and its timing would be
             # meaningless.
-            if "mega" in ladder:
-                def single_seq(params, tok, cache, n):
-                    def body(i, carry):
-                        tok, cache, seq = carry
-                        logits, cache = mstep(params, tok, cache)
-                        tok = jnp.argmax(logits, -1).astype(jnp.int32)
-                        return tok, cache, seq.at[i].set(tok[0])
+            def single_seq(params, tok, cache, n):
+                def body(i, carry):
+                    tok, cache, seq = carry
+                    logits, cache = mstep(params, tok, cache)
+                    tok = jnp.argmax(logits, -1).astype(jnp.int32)
+                    return tok, cache, seq.at[i].set(tok[0])
 
-                    seq0 = jnp.zeros((n,), jnp.int32)
-                    return jax.lax.fori_loop(
-                        0, n, body, (tok, cache, seq0)
-                    )[2]
+                seq0 = jnp.zeros((n,), jnp.int32)
+                return jax.lax.fori_loop(0, n, body, (tok, cache, seq0))[2]
 
-                def multi_seq(params, tok, cache, nl):
-                    def body(i, carry):
-                        tok, cache, seq = carry
-                        toks, _lg, cache = mmulti(params, tok, cache)
-                        seq = jax.lax.dynamic_update_slice(
-                            seq, toks[:, 0], (i * NS,)
-                        )
-                        return toks[NS - 1], cache, seq
-
-                    seq0 = jnp.zeros((nl * NS,), jnp.int32)
-                    return jax.lax.fori_loop(
-                        0, nl, body, (tok, cache, seq0)
-                    )[2]
-
-                s_seq = np.asarray(
-                    jax.jit(single_seq, static_argnums=3)(
-                        model.params, tok0, cache0, STEPS
+            def multi_seq(params, tok, cache, nl):
+                def body(i, carry):
+                    tok, cache, seq = carry
+                    toks, _lg, cache = mmulti(params, tok, cache)
+                    seq = jax.lax.dynamic_update_slice(
+                        seq, toks[:, 0], (i * NS,)
                     )
+                    return toks[NS - 1], cache, seq
+
+                seq0 = jnp.zeros((nl * NS,), jnp.int32)
+                return jax.lax.fori_loop(0, nl, body, (tok, cache, seq0))[2]
+
+            s_seq = np.asarray(
+                jax.jit(single_seq, static_argnums=3)(
+                    model.params, tok0, cache0, STEPS
                 )
-                m_seq = np.asarray(
-                    jax.jit(multi_seq, static_argnums=3)(
-                        model.params, tok0, cache0, STEPS // NS
-                    )
+            )
+            m_seq = np.asarray(
+                jax.jit(multi_seq, static_argnums=3)(
+                    model.params, tok0, cache0, STEPS // NS
                 )
-                if (s_seq != m_seq).any():
-                    raise RuntimeError(
-                        "multi-step tokens diverge from single-step: "
-                        f"{s_seq.tolist()} vs {m_seq.tolist()}"
-                    )
+            )
+            if (s_seq != m_seq).any():
+                raise RuntimeError(
+                    "multi-step tokens diverge from single-step: "
+                    f"{s_seq.tolist()} vs {m_seq.tolist()}"
+                )
+            _emit(progress_fh, {"cross_check": "mega_multi", "ok": True})
 
-            ladder["mega_multi"] = time_rung(mega_multi_once)
+            _emit(progress_fh, {
+                "rung": "mega_multi", "ms": time_rung(mega_multi_once),
+                # Amortized per-step cost is the headline for this rung:
+                # NS steps ride one launch, so ms already divides by
+                # STEPS (time_rung) — record NS for the reader.
+                "steps_per_launch": NS,
+            })
         except Exception as e:
-            errors["mega_multi"] = f"{type(e).__name__}: {e}"[:300]
+            _emit(progress_fh, {
+                "rung": "mega_multi",
+                "error": f"{type(e).__name__}: {e}"[:300],
+            })
 
-    if not ladder:
+    _emit(progress_fh, {"done": True})
+
+
+def _watch_worker(progress_path: str, skip: frozenset[str]) -> tuple[bool, str | None]:
+    """Launch a TPU worker and watchdog its progress file. Returns
+    ``(finished, hung_rung)`` — ``hung_rung`` names the rung being run
+    when progress stalled (None if the stall was during init)."""
+    with open(progress_path, "a") as fh:
+        fh.write("")  # ensure exists
+    # Hang attribution must only look at THIS attempt's events — a
+    # relaunched worker that hangs before its first emit would
+    # otherwise be blamed on the previous attempt's last rung (wrong
+    # rung skipped, wrong timeout applied).
+    n_before = len(_read_events(progress_path))
+    argv = [sys.executable, os.path.abspath(__file__), "--worker",
+            progress_path, "--skip", ",".join(sorted(skip))]
+    proc = subprocess.Popen(
+        argv, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL
+    )
+
+    def _reap(kill: bool) -> None:
+        # A worker stalling in jax/relay TEARDOWN (after its work is on
+        # disk) must not crash the bench — the results are safe.
+        try:
+            if kill:
+                proc.kill()
+            proc.wait(timeout=30)
+        except (subprocess.TimeoutExpired, OSError):
+            pass
+
+    last_size = os.path.getsize(progress_path)
+    last_change = time.time()
+    while True:
+        time.sleep(5)
+        events = _read_events(progress_path)[n_before:]
+        if any("done" in e for e in events):
+            _reap(kill=False)
+            return True, None
+        if proc.poll() is not None:
+            # Worker died (crash, OOM): not a hang; its per-rung error
+            # lines are already on disk.
+            return False, None
+        size = os.path.getsize(progress_path)
+        if size != last_size:
+            last_size, last_change = size, time.time()
+            continue
+        started = [e["start"] for e in events if "start" in e]
+        current = started[-1] if started else None
+        limit = _INIT_TIMEOUT_S if current in (None, "init") else _RUNG_TIMEOUT_S
+        if time.time() - last_change > limit:
+            _reap(kill=True)
+            return False, None if current in (None, "init") else current
+
+
+def _read_events(progress_path: str) -> list[dict]:
+    events = []
+    try:
+        with open(progress_path) as fh:
+            for line in fh:
+                line = line.strip()
+                if line:
+                    try:
+                        events.append(json.loads(line))
+                    except ValueError:
+                        pass
+    except OSError:
+        pass
+    return events
+
+
+def main() -> int:
+    if len(sys.argv) > 1 and sys.argv[1] == "--worker":
+        progress_path = sys.argv[2]
+        skip = frozenset(
+            s for s in sys.argv[4].split(",") if s
+        ) if len(sys.argv) > 4 else frozenset()
+        with open(progress_path, "a") as fh:
+            run_ladder(fh, on_tpu=True, skip=skip)
+        return 0
+
+    import tempfile
+
+    t_start = time.time()
+    on_tpu = _probe_tpu()
+    progress_path = tempfile.mktemp(prefix="bench_progress_", suffix=".jsonl")
+
+    if on_tpu:
+        done: set[str] = set()
+        hang_counts: dict[str, int] = {}
+        for attempt in range(_WORKER_ATTEMPTS):
+            if time.time() - t_start > _GLOBAL_DEADLINE_S:
+                sys.stderr.write("[bench] global deadline reached\n")
+                break
+            skip = done | {r for r, c in hang_counts.items() if c >= 2}
+            finished, hung = _watch_worker(progress_path, frozenset(skip))
+            events = _read_events(progress_path)
+            done = {e["rung"] for e in events if "rung" in e and "ms" in e}
+            if finished:
+                break
+            if hung:
+                hang_counts[hung] = hang_counts.get(hung, 0) + 1
+                sys.stderr.write(f"[bench] rung {hung} hung; re-probing\n")
+            # Mid-run re-probe (VERDICT r3 task 1): don't relaunch into
+            # a dead relay — wait for it to answer again first.
+            if attempt + 1 < _WORKER_ATTEMPTS and not _probe_tpu():
+                sys.stderr.write("[bench] relay down mid-run; stopping\n")
+                break
+        events = _read_events(progress_path)
+        if not any("rung" in e and "ms" in e for e in events):
+            on_tpu = False  # fall back to the CPU ladder below
+
+    if not on_tpu:
+        cpu_path = progress_path + ".cpu"
+        with open(cpu_path, "w") as fh:
+            run_ladder(fh, on_tpu=False, skip=frozenset())
+        events = _read_events(cpu_path)
+
+    ladder = {
+        e["rung"]: e["ms"] for e in events if "rung" in e and "ms" in e
+    }
+    errors = {
+        e["rung"]: e["error"] for e in events
+        if "rung" in e and "error" in e and e["rung"] not in ladder
+    }
+    if on_tpu:
+        # Rungs abandoned after repeated watchdog kills never emit an
+        # event — record them so they don't silently vanish from the
+        # machine-readable output.
+        for rung, count in hang_counts.items():
+            if rung not in ladder and rung not in errors:
+                errors[rung] = f"hung (killed by watchdog) x{count}"
+    init = next((e["init"] for e in events if "init" in e), None)
+    cross = next(
+        (e for e in events if e.get("cross_check") == "mega_multi"), None
+    )
+
+    if not ladder or init is None:
         print(json.dumps({
             "metric": "qwen3_decode_ms_per_step",
             "value": None,
             "unit": "ms",
             "vs_baseline": None,
-            "platform": jax.default_backend(),
-            "errors": errors,
+            "platform": "tpu" if on_tpu else "cpu",
+            "errors": errors or {"init": "no rung completed"},
         }))
-        raise SystemExit(1)
+        return 1
 
     best_name = min(ladder, key=ladder.get)
     ms = ladder[best_name]
-
     # Bandwidth roofline: weights read once per step + KV context read.
-    param_bytes = sum(
-        x.size * x.dtype.itemsize for x in jax.tree.leaves(model.params)
-    )
-    kv_bytes = (
-        2 * cfg.num_layers * cfg.num_kv_heads * PROMPT * cfg.head_dim
-        * jnp.dtype(cfg.dtype).itemsize
-    )
-    gbs = (param_bytes + kv_bytes) / (ms * 1e-3) / 1e9
+    gbs = (init["param_bytes"] + init["kv_bytes"]) / (ms * 1e-3) / 1e9
     out = {
         "metric": f"qwen3_{'0.6b' if on_tpu else 'tiny'}_decode_ms_per_step",
         "value": round(ms, 3),
         "unit": "ms",
-        "vs_baseline": round(gbs / chip_peak_gbs(jax), 4),
-        "platform": jax.default_backend(),
+        "vs_baseline": round(gbs / init["peak_gbs"], 4),
+        "platform": init["platform"],
         "best_rung": best_name,
         "ladder": {k: round(v, 3) for k, v in ladder.items()},
     }
+    if cross is not None:
+        out["mega_multi_cross_check"] = bool(cross.get("ok"))
     if errors:
         out["errors"] = errors
     print(json.dumps(out))
+    return 0
 
 
 if __name__ == "__main__":

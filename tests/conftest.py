@@ -59,3 +59,24 @@ def ctx2x4():
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavyweight interpret-mode runs; excluded from the default "
+        "suite (VERDICT r2 weak #7 — keep a fast path on one core). "
+        "Run with `-m slow` or TDT_RUN_SLOW=1 (an empty -m '' is "
+        "indistinguishable from no -m and still skips).",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.option.markexpr or os.environ.get("TDT_RUN_SLOW") == "1":
+        return
+    skip = pytest.mark.skip(
+        reason="slow (opt in: -m slow or TDT_RUN_SLOW=1)"
+    )
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)

@@ -48,6 +48,7 @@ def _golden_attn(x, wq, wk, wv, wo, hq, hkv, hd, theta=1e6, qn=None, kn=None):
     return o.swapaxes(0, 1).reshape(s, hq * hd) @ wo
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("mode", ["xla", "pallas"])
 def test_tp_attn_prefill(ctx4, rng, mode):
     from triton_distributed_tpu.layers.tp_attn import TPAttn

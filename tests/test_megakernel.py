@@ -86,6 +86,7 @@ class TestMegaQwen3:
     @pytest.mark.parametrize(
         "policy", [SchedulePolicy.ROUND_ROBIN, SchedulePolicy.ZIG_ZAG]
     )
+    @pytest.mark.slow
     def test_decode_parity_tp4(self, ctx4, policy):
         model = AutoLLM.from_pretrained("tiny", ctx=ctx4)
         B = 2
@@ -135,6 +136,7 @@ class TestMegaQwen3:
 class TestMegaPaged:
     @pytest.mark.parametrize("s_max", [64, 128])  # 128: pick_tile's 128
     # floor must not widen s_blk past the 16-wide page
+    @pytest.mark.slow
     def test_decode_parity_paged(self, ctx4, s_max):
         """Megakernel over a paged pool (table-indexed block DMAs) vs
         the dense XLA golden (parity: reference megakernel paged decode,
@@ -185,6 +187,7 @@ class TestMegaPaged:
             np.asarray(paged_out.kv_len), np.asarray(cache_gold.kv_len)
         )
 
+    @pytest.mark.slow
     def test_paged_decode_fn_qwen(self, ctx4):
         """Model-level paged decode (paged_flash_decode path) matches
         the dense decode step."""
@@ -241,6 +244,7 @@ class TestMegaPrefill:
             np.asarray(cache_m.kv_len), np.asarray(cache_g.kv_len)
         )
 
+    @pytest.mark.slow
     def test_prefill_then_mega_decode(self, ctx4):
         """Greedy continuation after a mega prefill matches the XLA
         path end-to-end."""
@@ -265,6 +269,7 @@ class TestMegaPrefill:
             np.testing.assert_array_equal(np.asarray(tok_g), np.asarray(tok_m))
 
 
+@pytest.mark.slow
 def test_lm_head_remainder_tile(ctx4):
     """Wide LM tiles on an unround vocab: tn_lm = tile_n with a final
     remainder tile (per-shard vocab 384, tile 256 → rem 128) must match the
@@ -306,6 +311,7 @@ class TestMultiStepDecode:
         yield ctx
         mesh_mod.finalize_distributed()
 
+    @pytest.mark.slow
     def test_multi_matches_chained_single(self, ctx1):
         model = AutoLLM.from_pretrained("tiny", ctx=ctx1)
         B, NS = 2, 4
@@ -346,6 +352,7 @@ class TestMultiStepDecode:
             np.asarray(mc.kv_len), np.asarray(c.kv_len)
         )
 
+    @pytest.mark.slow
     def test_multi_matches_chained_single_tp4(self, ctx4):
         """Under TP the LM head's local argmax is cross-rank exchanged;
         tokens must still match chained single-step decode exactly."""
@@ -378,6 +385,7 @@ class TestMultiStepDecode:
             np.asarray(mc.k), np.asarray(c.k), rtol=2e-3, atol=2e-3
         )
 
+    @pytest.mark.slow
     @pytest.mark.parametrize("nranks", [1, 4])
     def test_multi_sampled_gumbel(self, request, nranks):
         """Sampled multi-step (argmax over logits + host-drawn noise)
@@ -432,6 +440,7 @@ class TestMultiStepDecode:
         finally:
             mesh_mod.finalize_distributed()
 
+    @pytest.mark.slow
     def test_multi_paged_matches_chained_single(self, ctx4):
         """Paged multi-step: pool reads via the page table, all NS new
         rows landed by one scatter (append_n) — tokens and pool match

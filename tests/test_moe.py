@@ -176,6 +176,7 @@ def test_ep_moe_fp8_payload(ctx4, rng, moe_weights, method):
     np.testing.assert_allclose(np.asarray(out), gold, atol=5e-2, rtol=5e-2)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("payload", [None, "fp8"])
 def test_ep_transport_parity(ctx4, rng, moe_weights, payload):
     """The device-push transport must be BIT-IDENTICAL to the XLA

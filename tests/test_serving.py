@@ -6,6 +6,7 @@ in-process and the golden is ``Engine.serve`` on the same weights.
 """
 
 import numpy as np
+import pytest
 
 from triton_distributed_tpu.models import AutoLLM
 from triton_distributed_tpu.models.engine import Engine
@@ -137,6 +138,7 @@ def test_continuous_batching_oversubscribed_pool(ctx4):
         small.run([(np.zeros(48, np.int32), 16)])
 
 
+@pytest.mark.slow
 def test_continuous_batching_mega_multi(ctx4):
     """mode="mega" continuous serving decodes in NS-token chunks
     (paged multi-step launches) with host admission at chunk
@@ -165,6 +167,7 @@ def test_continuous_batching_mega_multi(ctx4):
     assert len(eng.pool.free) == free0  # all pages released
 
 
+@pytest.mark.slow
 def test_continuous_batching_mega_eos(ctx4):
     """eos mid-chunk: overshoot tokens are discarded, the slot frees at
     the chunk boundary, and the queued request still serves right."""
@@ -207,3 +210,23 @@ def test_continuous_batching_first_token_finishes(ctx4):
     )
     outs2 = eng2.run([(p, 6), (p, 2)])
     assert len(outs2[0]) == 1 and int(outs2[0][0]) == first
+
+
+def test_engine_serve_profile_hook(ctx4, tmp_path):
+    """Engine.serve(profile=...) must capture a decode-loop trace
+    (parity: the reference Engine's built-in profiled decode,
+    ``models/engine.py:151-177``) — files on disk, output unchanged."""
+    model = AutoLLM.from_pretrained("tiny", ctx=ctx4)
+    prompt = np.arange(8, dtype=np.int32)[None]
+    eng = Engine(model, temperature=0.0, mode="xla")
+    gold = eng.serve(prompt, gen_len=4)
+    prof_dir = str(tmp_path / "decode_trace")
+    out = eng.serve(prompt, gen_len=4, profile=prof_dir)
+    np.testing.assert_array_equal(out, gold)
+    import os as _os
+
+    captured = [
+        _os.path.join(r, f)
+        for r, _d, fs in _os.walk(prof_dir) for f in fs
+    ]
+    assert captured, f"no trace files under {prof_dir}"

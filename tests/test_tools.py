@@ -171,6 +171,7 @@ def test_autotune_persistent_cache(tmp_path, monkeypatch):
     assert len(calls) > 2
 
 
+@pytest.mark.slow
 def test_ag_gemm_tuned_end_to_end(ctx4, rng, tmp_path, monkeypatch):
     """The tuned overlap entry points sweep the tile grid once per shape
     and replay the argmin (in-memory + disk cache)."""
@@ -197,6 +198,7 @@ def test_ag_gemm_tuned_end_to_end(ctx4, rng, tmp_path, monkeypatch):
     np.testing.assert_allclose(np.asarray(out2), np.asarray(out), rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_gemm_rs_tuned_end_to_end(ctx4, rng, tmp_path, monkeypatch):
     monkeypatch.setenv("TDT_AUTOTUNE_CACHE_DIR", str(tmp_path))
     from triton_distributed_tpu.ops.overlap import gemm_rs_tuned
