@@ -425,10 +425,20 @@ def main() -> int:
             on_tpu = False  # fall back to the CPU ladder below
 
     if not on_tpu:
+        # Keep any TPU rung errors from the attempts above — a fully
+        # broken TPU path must stay visible in the machine-readable
+        # output, not be laundered into a clean CPU run.
+        tpu_errors = {
+            e["rung"]: e["error"]
+            for e in _read_events(progress_path)
+            if "rung" in e and "error" in e
+        }
         cpu_path = progress_path + ".cpu"
         with open(cpu_path, "w") as fh:
             run_ladder(fh, on_tpu=False, skip=frozenset())
         events = _read_events(cpu_path)
+    else:
+        tpu_errors = {}
 
     ladder = {
         e["rung"]: e["ms"] for e in events if "rung" in e and "ms" in e
@@ -477,6 +487,8 @@ def main() -> int:
         out["mega_multi_cross_check"] = bool(cross.get("ok"))
     if errors:
         out["errors"] = errors
+    if tpu_errors:
+        out["tpu_errors"] = tpu_errors
     print(json.dumps(out))
     return 0
 

@@ -219,3 +219,42 @@ def test_gemm_rs_tuned_end_to_end(ctx4, rng, tmp_path, monkeypatch):
     )
     tuner = _rs_tuner(M, N, K // 4, "tp", 4, "float32", False)
     assert len(tuner.cache) == 1  # swept once, argmin cached
+
+
+def test_anchored_spec_and_straggler_model():
+    """anchored_spec derives effective rates from recorded measurements
+    (hbm verbatim, MXU solved from the gemm anchor, ICI derated by the
+    HBM fraction); the straggler-stall model shows the adaptive
+    schedule's tolerance."""
+    from triton_distributed_tpu.tools.perf_model import (
+        anchored_spec,
+        chip_spec,
+        estimate_straggler_stall_ms,
+    )
+
+    base = chip_spec("v5e")
+    anchors = {
+        "chip": "v5e",
+        "hbm_gbs": 667.0,
+        "gemm_anchor": {"m": 8192, "n": 12288, "k": 4096, "ms": 12.65},
+        "error_bars_frac": 0.3,
+    }
+    spec, meta = anchored_spec(anchors)
+    assert meta["anchored"] is True
+    assert spec.hbm_gbs == 667.0
+    ideal = 2.0 * 8192 * 12288 * 4096 / (12.65e-3) / 1e12
+    assert abs(spec.bf16_tflops - ideal) < 0.1
+    assert abs(spec.ici_gbs_per_link - base.ici_gbs_per_link * 667 / 819) < 0.1
+    # No anchors: datasheet fallback, flagged.
+    spec2, meta2 = anchored_spec({})
+    assert meta2 == {"anchored": False}
+    assert spec2.bf16_tflops == base.bf16_tflops
+
+    # Straggler model: lag of 3 steps at tp=8 — static exposes some,
+    # adaptive exposes none (laggard met last, 7 steps of cover).
+    static = estimate_straggler_stall_ms(3.0, 1.0, 8, adaptive=False)
+    adapt = estimate_straggler_stall_ms(3.0, 1.0, 8, adaptive=True)
+    assert adapt == 0.0
+    assert static == pytest.approx(3 / 7)  # [2,1,0,...]/7
+    # Lag beyond full cover exposes the remainder either way.
+    assert estimate_straggler_stall_ms(10.0, 1.0, 8, True) == 3.0

@@ -169,6 +169,51 @@ class TestOverlapLower:
             _sds(tpu_ctx, (128, 8 * 128), (None, "tp")),
         )
 
+    def test_ag_gemm_adaptive(self, tpu_ctx):
+        """Arrival-adaptive schedule (semaphore_read probe + SMEM order
+        output) must trace and lower for TPU — it has no interpret
+        path, so this is its only off-chip gate."""
+        from triton_distributed_tpu.ops.overlap import AGGemmConfig, ag_gemm
+
+        f = tpu_ctx.shard_map(
+            functools.partial(
+                ag_gemm, axis="tp",
+                config=AGGemmConfig(tile_n=128, adaptive=True),
+                ctx=tpu_ctx,
+            ),
+            in_specs=(P("tp", None), P(None, "tp")),
+            out_specs=P(None, "tp"),
+        )
+        _lower(
+            tpu_ctx, f,
+            _sds(tpu_ctx, (8 * 16, 128), ("tp", None)),
+            _sds(tpu_ctx, (128, 8 * 128), (None, "tp")),
+        )
+
+    def test_gemm_rs_bidir_fp8(self, tpu_ctx):
+        """Dual-ring + fp8 wire hop lowering."""
+        import jax.numpy as jnp
+
+        from triton_distributed_tpu.ops.overlap import GemmRSConfig, gemm_rs
+
+        f = tpu_ctx.shard_map(
+            functools.partial(
+                gemm_rs, axis="tp",
+                config=GemmRSConfig(
+                    tile_n=128, tile_m=8, bidir=True,
+                    wire_dtype=jnp.float8_e4m3fn,
+                ),
+                ctx=tpu_ctx,
+            ),
+            in_specs=(P(None, "tp"), P("tp", None)),
+            out_specs=P("tp", None),
+        )
+        _lower(
+            tpu_ctx, f,
+            _sds(tpu_ctx, (8 * 16, 8 * 128), (None, "tp")),
+            _sds(tpu_ctx, (8 * 128, 128), ("tp", None)),
+        )
+
     def test_gemm_rs(self, tpu_ctx):
         from triton_distributed_tpu.ops.overlap import GemmRSConfig, gemm_rs
 

@@ -73,6 +73,25 @@ class AGGemmConfig:
     tile_n: int = 512
     tile_m: int | None = None  # None → whole m_per (small shapes)
     acc_dtype: jnp.dtype = jnp.float32
+    # Arrival-adaptive chunk scheduling (parity: the reference's
+    # rank-aware tile-order swizzles, ``threadblock_swizzle_ag_moe.py``
+    # / ``ag_gemm_threadblock_swizzle.py`` — compute lands on
+    # already-arrived data). At each step boundary the kernel probes
+    # every unprocessed chunk's arrival semaphore (non-blocking
+    # ``semaphore_read``) and computes the first one that has fully
+    # landed, falling back to ring order when none has. In the overlap
+    # regime (per-chunk compute ≥ chunk wire time — the regime these
+    # kernels are tuned for) every non-laggard chunk has landed by the
+    # first boundary, so a straggler is deferred to the END of the
+    # schedule and (n-2) other chunks' compute covers most of the lag.
+    # Outside that regime the probe can be inconclusive and the
+    # schedule degrades toward ring order (the fallback blocks on the
+    # ring-next chunk, laggard or not). The realized order is emitted
+    # so callers/benchmarks can observe the schedule. TPU-only: ``semaphore_read`` has no
+    # interpret-mode lowering, so off-TPU the kernel keeps the static
+    # ring order (same split as the LL all-gather's barrier-free mode).
+    # None = auto (on real TPU), True/False = forced.
+    adaptive: bool | None = None
     # Race-provocation fixtures (parity: ``for_correctness`` producer
     # sleeps, ``allgather_gemm.py:507-508``, and ``straggler_option``,
     # :534). Static: production traces carry zero overhead.
@@ -113,13 +132,16 @@ def _ag_gemm_kernel(
     ws,         # [n, m_per, K] ANY/HBM output — gathered A chunks
                 # (a workspace; Mosaic only allows VMEM/SMEM/semaphore
                 # scratch, so HBM workspaces are extra outputs)
+    order_ref,  # [n] SMEM int32 output — chunk processed at each step
     a_vmem,     # [2, tile_m, K] VMEM — double-buffered compute M-tile
     load_sems,  # DMA (2,) — HBM→VMEM stage
     send_sems,  # DMA (n-1,)
     recv_sems,  # DMA (n,) — slot r signaled when chunk r lands
+    done_smem,  # [n] SMEM int32 scratch — processed bitmask
     *,
     axis: str,
     acc_dtype,
+    adaptive: bool = False,
     for_correctness: bool = False,
     straggler_rank: int | None = None,
     straggler_nanos: int = 0,
@@ -132,6 +154,7 @@ def _ag_gemm_kernel(
     num_i = pl.num_programs(1)
     num_j = pl.num_programs(2)
     tile_m = a_vmem.shape[1]
+    chunk_bytes = ws.shape[1] * ws.shape[2] * jnp.dtype(ws.dtype).itemsize
 
     def rows(ti):
         return pl.ds(ti * tile_m, tile_m)
@@ -152,6 +175,14 @@ def _ag_gemm_kernel(
     def _start():
         # Stage own first tile for immediate compute (overlaps barrier).
         stage(0, 0).start()
+        # Schedule state: own chunk is step 0 (zero-latency start — the
+        # same reason as the reference's rank-swizzled tile order).
+        def init(c, carry):
+            done_smem[c] = jnp.where(c == me, 1, 0)
+            return carry
+
+        jax.lax.fori_loop(0, n, init, None)
+        order_ref[0] = me
         # Entry barrier: peers' ws outputs must be allocated before any
         # remote write lands.
         dl.barrier_all(axis)
@@ -192,7 +223,7 @@ def _ag_gemm_kernel(
 
         @pl.when(s > 0)
         def _():
-            stage(s, i + 1, chunk=jax.lax.rem(me + s, n)).start()
+            stage(s, i + 1, chunk=order_ref[s]).start()
 
     @pl.when(
         jnp.logical_and(
@@ -204,7 +235,37 @@ def _ag_gemm_kernel(
         # after this step's last tile is issued so the blocking wait sits
         # at the end of the step's compute, not ahead of it (keeps the
         # MXU busy while the ICI push is in flight).
-        nxt = jax.lax.rem(me + s + 1, n)
+        if adaptive:
+            # Arrival-adaptive pick: first unprocessed chunk whose
+            # arrival semaphore already counts a full chunk; ring order
+            # (first unprocessed) when none has landed yet. The probe
+            # is non-consuming — the blocking wait below still drains
+            # the chosen chunk's semaphore.
+            def scan(off, carry):
+                ready_pick, any_pick = carry
+                c = jax.lax.rem(me + off, n)
+                unproc = done_smem[c] == 0
+                ready = dl.read(recv_sems.at[c]) >= chunk_bytes
+                any_pick = jnp.where(
+                    jnp.logical_and(any_pick < 0, unproc), c, any_pick
+                )
+                ready_pick = jnp.where(
+                    jnp.logical_and(
+                        ready_pick < 0, jnp.logical_and(unproc, ready)
+                    ),
+                    c,
+                    ready_pick,
+                )
+                return ready_pick, any_pick
+
+            ready_pick, any_pick = jax.lax.fori_loop(
+                1, n, scan, (jnp.int32(-1), jnp.int32(-1))
+            )
+            nxt = jnp.where(ready_pick >= 0, ready_pick, any_pick)
+        else:
+            nxt = jax.lax.rem(me + s + 1, n)
+        done_smem[nxt] = 1
+        order_ref[s + 1] = nxt
         dl.wait_recv(recv_sems.at[nxt], ws.at[nxt])
         stage(s + 1, 0, chunk=nxt).start()
 
@@ -232,7 +293,6 @@ def ag_gemm(
     contract as reference ``ag_gemm`` (``allgather_gemm.py:534``).
     """
     n = jax.lax.axis_size(axis)
-    me = jax.lax.axis_index(axis)
     m_per, k = a.shape
     k2, n_loc = b.shape
     if k != k2:
@@ -247,10 +307,19 @@ def ag_gemm(
         raise ValueError(f"m_per={m_per} not divisible by tile_m={tile_m}")
     num_i = m_per // tile_m
 
+    adaptive = config.adaptive
+    if adaptive is None:
+        from triton_distributed_tpu.ops.common import _on_tpu
+
+        # semaphore_read (the non-blocking arrival probe) has no
+        # interpret-mode lowering; off-TPU the kernel keeps ring order.
+        adaptive = _on_tpu(ctx)
+
     grid = (n, num_i, num_j)
-    out, _ws = comm_pallas_call(
+    out, _ws, order = comm_pallas_call(
         functools.partial(
             _ag_gemm_kernel, axis=axis, acc_dtype=config.acc_dtype,
+            adaptive=adaptive,
             for_correctness=config.for_correctness,
             straggler_rank=config.straggler_rank,
             straggler_nanos=config.straggler_nanos,
@@ -258,6 +327,7 @@ def ag_gemm(
         (
             jax.ShapeDtypeStruct((n, m_per, n_loc), a.dtype),
             jax.ShapeDtypeStruct((n, m_per, k), a.dtype),
+            jax.ShapeDtypeStruct((n,), jnp.int32),
         ),
         grid=grid,
         in_specs=[
@@ -273,12 +343,14 @@ def ag_gemm(
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ),
         scratch_shapes=[
             pltpu.VMEM((2, tile_m, k), a.dtype),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
             pltpu.SemaphoreType.DMA((n,)),
+            pltpu.SMEM((max(n, 1),), jnp.int32),
         ],
         collective_id=_AG_GEMM_COLLECTIVE_ID,
         # Mosaic double-buffers the BlockSpec-pipelined operands; at
@@ -299,10 +371,11 @@ def ag_gemm(
         ctx=ctx,
     )(a, b)
 
-    # Step s computed chunk (me+s) mod n → global row-chunk r sits at
-    # step (r-me) mod n. One gather puts rows in global order.
-    steps = jnp.remainder(jnp.arange(n) - me, n)
-    return out[steps].reshape(n * m_per, n_loc)
+    # The kernel emits the realized schedule (order[s] = chunk computed
+    # at step s — ring order, or arrival order when adaptive). Global
+    # row-chunk r sits at the step where order[step] == r; argsort of a
+    # permutation inverts it. One gather puts rows in global order.
+    return out[jnp.argsort(order)].reshape(n * m_per, n_loc)
 
 
 def ag_gemm_op(

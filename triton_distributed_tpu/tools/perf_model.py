@@ -218,6 +218,35 @@ def estimate_all_reduce_time_ms(
     )
 
 
+def estimate_straggler_stall_ms(
+    lag_ms: float, step_ms: float, n: int, adaptive: bool
+) -> float:
+    """Expected exposed stall in AG+GEMM when one uniformly-random rank's
+    chunk arrives ``lag_ms`` late (the tolerance the reference's
+    arrival-adaptive tile swizzles buy, ``threadblock_swizzle_ag_moe.py``).
+
+    Static ring order meets the laggard's chunk at position
+    ``p = (r - me) mod n`` and stalls ``max(0, lag - p*step)`` — for a
+    next-door laggard almost the whole lag is exposed. The adaptive
+    schedule (``AGGemmConfig(adaptive=True)``) defers any not-yet-landed
+    chunk behind every landed one, so the laggard is met at position
+    ``n-1``: exposure is only what (n-2) other chunks' compute could
+    not cover.
+
+    PRECONDITION of the adaptive formula: the overlap regime —
+    ``step_ms`` at least the per-chunk wire time, so every non-laggard
+    chunk has landed by the first step boundary. When compute is faster
+    than the wire, the kernel's probe can be inconclusive and its
+    fallback blocks in ring order (see the config docstring); this
+    model then OVERSTATES the adaptive tolerance — don't capacity-plan
+    from it outside the compute-bound regime.
+    """
+    if adaptive:
+        return max(0.0, lag_ms - (n - 1) * step_ms)
+    stalls = [max(0.0, lag_ms - p * step_ms) for p in range(1, n)]
+    return sum(stalls) / len(stalls) if stalls else 0.0
+
+
 def prune_configs_by_model(configs, est_fn, top_k: int = 8):
     """Keep the ``top_k`` configs by estimated time.
 
