@@ -218,3 +218,22 @@ def test_broadcast(ctx4, rng, method, root):
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(x)[root], rtol=1e-6
     )
+
+
+def test_all_gather_torus_2d(ctx2x4, rng):
+    """Fused 2D-torus gather (one kernel, both axes' links): rank-major
+    result must equal a plain two-axis gather."""
+    from jax.sharding import PartitionSpec as P
+    from triton_distributed_tpu.ops.collectives.all_gather import (
+        all_gather_torus_2d,
+    )
+
+    x = jnp.asarray(rng.standard_normal((8 * 8, 128), dtype=np.float32))
+
+    def body(xi):
+        return all_gather_torus_2d(xi, axes=("dp", "tp"), ctx=ctx2x4)
+
+    f = ctx2x4.shard_map(
+        body, in_specs=P(("dp", "tp"), None), out_specs=P(None, None)
+    )
+    np.testing.assert_allclose(np.asarray(f(x)), np.asarray(x), rtol=1e-6)

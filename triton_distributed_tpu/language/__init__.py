@@ -7,6 +7,7 @@ with the reference's ``import triton_dist.language as dl``
 
 from triton_distributed_tpu.language.primitives import (  # noqa: F401
     barrier_all,
+    barrier_cross,
     barrier_neighbors,
     local_copy,
     maybe_delay,

@@ -272,6 +272,35 @@ def barrier_all(axis: str):
     pltpu.semaphore_wait(bsem, n - 1)
 
 
+def barrier_cross(*axes: str):
+    """Barrier with the UNION of per-axis peers (this device's row and
+    column on a 2D torus), as ONE signal/wait round.
+
+    Needed instead of sequential ``barrier_all(ax); barrier_all(ay)``:
+    both would share the kernel's single barrier semaphore
+    (``get_barrier_semaphore`` is per-kernel), so a fast peer's
+    second-barrier signal could satisfy a neighbor's still-pending
+    first-barrier wait and let it pass before all first-axis peers have
+    entered — anonymous increments cannot be attributed to a phase. One
+    combined round has no second phase to alias: after the wait, every
+    device this rank exchanges data with (its row + column) has
+    provably entered the kernel.
+    """
+    bsem = pltpu.get_barrier_semaphore()
+    expected = 0
+    for axis in axes:
+        n = jax.lax.axis_size(axis)
+        me = jax.lax.axis_index(axis)
+        for i in range(1, n):
+            peer = jax.lax.rem(me + i, n)
+            pltpu.semaphore_signal(
+                bsem, inc=1, device_id={axis: peer},
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+        expected += n - 1
+    pltpu.semaphore_wait(bsem, expected)
+
+
 def barrier_neighbors(axis: str):
     """Barrier with ring neighbors only (cheaper; parity: ring protocols)."""
     n = jax.lax.axis_size(axis)

@@ -14,6 +14,7 @@ Every op takes per-shard arrays and axis names and must be called inside
 """
 
 from triton_distributed_tpu.ops.collectives.all_gather import (  # noqa: F401
+    all_gather_torus_2d,
     AllGatherMethod,
     all_gather,
     all_gather_op,

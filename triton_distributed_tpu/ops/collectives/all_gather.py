@@ -322,6 +322,124 @@ def all_gather(
     )(x)
 
 
+_AG_2D_COLLECTIVE_ID = next_collective_id()
+
+
+def _torus_2d_kernel(
+    x_ref,       # [m_per, L] ANY — own shard
+    o_ref,       # [nx*ny*m_per, L] ANY — gathered, rank-major slots
+    copy_sem,    # DMA ()
+    send_y_sems,  # DMA (ny-1,)
+    send_x_sems,  # DMA (nx-1, ny)
+    recv_y_sems,  # DMA (ny,) — slot j' for column chunk (me_x, j')
+    recv_x_sem,   # DMA () — byte counter for all row arrivals
+    *,
+    ax: str,
+    ay: str,
+):
+    """Fused 2D-torus all-gather (equivalent role: the reference's
+    NUMA-aware 2D producers, ``allgather.py:196`` ``ring_push_numa_2d``
+    — use BOTH torus axes' links concurrently).
+
+    Phase y: own chunk full-mesh along the column (``ay``). Phase x:
+    every column chunk — own immediately, peers' AS EACH ARRIVES — is
+    forwarded full-mesh along the row (``ax``), so row links carry
+    traffic while column pushes are still in flight; no phase barrier.
+    All transfers are row-or-column, so ONE combined row+column entry
+    barrier (``dl.barrier_cross`` — NOT two sequential per-axis
+    barriers, whose anonymous signals would alias on the kernel's
+    single barrier semaphore) gives peer-buffer liveness without a
+    diagonal handshake.
+    """
+    mx = dl.rank(ax)
+    my = dl.rank(ay)
+    nx = dl.num_ranks(ax)
+    ny = dl.num_ranks(ay)
+    m_per = x_ref.shape[0]
+
+    def slot(gx, gy):
+        return pl.ds((gx * ny + gy) * m_per, m_per)
+
+    own = slot(mx, my)
+    cp = pltpu.make_async_copy(x_ref, o_ref.at[own], copy_sem)
+    cp.start()
+    dl.barrier_cross(ax, ay)
+    cp.wait()
+
+    dmas = []
+    # Column broadcast of the own chunk (y links busy first).
+    for q in range(1, ny):
+        peer = jax.lax.rem(my + q, ny)
+        dmas.append(
+            dl.put_signal(
+                o_ref.at[own], o_ref.at[own], peer,
+                send_y_sems.at[q - 1], recv_y_sems.at[my], axis=ay,
+            )
+        )
+    # Row broadcast of the own chunk — x links busy concurrently.
+    for p in range(1, nx):
+        peer = jax.lax.rem(mx + p, nx)
+        dmas.append(
+            dl.put_signal(
+                o_ref.at[own], o_ref.at[own], peer,
+                send_x_sems.at[p - 1, my], recv_x_sem, axis=ax,
+            )
+        )
+    # Forward each column chunk along the row as it arrives.
+    for q in range(1, ny):
+        src_y = jax.lax.rem(my + q, ny)
+        sl = slot(mx, src_y)
+        dl.wait_recv(recv_y_sems.at[src_y], o_ref.at[sl])
+        for p in range(1, nx):
+            peer = jax.lax.rem(mx + p, nx)
+            dmas.append(
+                dl.put_signal(
+                    o_ref.at[sl], o_ref.at[sl], peer,
+                    send_x_sems.at[p - 1, src_y], recv_x_sem, axis=ax,
+                )
+            )
+    # Row arrivals: (nx-1) stripes of ny chunks, all chunk-sized, on one
+    # byte-counting semaphore.
+    for _ in range((nx - 1) * ny):
+        dl.wait_recv(recv_x_sem, o_ref.at[own])
+    dl.quiet(*dmas)
+
+
+def all_gather_torus_2d(
+    x: jax.Array,
+    axes: tuple[str, str] = ("dp", "tp"),
+    ctx: DistContext | None = None,
+) -> jax.Array:
+    """Fused all-gather over a 2D torus mesh (distinct from the 2-LEVEL
+    ``hierarchical.all_gather_2d_op``, which splits ICI/DCN — here BOTH
+    axes are ICI and one kernel drives all four link directions): shards gathered across
+    BOTH axes in one kernel, rank-major ((ax, ay) row-major) row order.
+    Call inside ``shard_map``; ``x`` is ``[m_per, ...]``, result
+    ``[nx*ny*m_per, ...]``."""
+    ax, ay = axes
+    nx = jax.lax.axis_size(ax)
+    ny = jax.lax.axis_size(ay)
+    if x.ndim < 2:
+        raise ValueError("pallas all_gather_torus_2d needs >=2D input")
+    m_per = x.shape[0]
+    out_shape = jax.ShapeDtypeStruct((nx * ny * m_per, *x.shape[1:]), x.dtype)
+    return comm_pallas_call(
+        functools.partial(_torus_2d_kernel, ax=ax, ay=ay),
+        out_shape,
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((max(ny - 1, 1),)),
+            pltpu.SemaphoreType.DMA((max(nx - 1, 1), ny)),
+            pltpu.SemaphoreType.DMA((ny,)),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+        collective_id=_AG_2D_COLLECTIVE_ID,
+        ctx=ctx,
+    )(x)
+
+
 def all_gather_op(
     x: jax.Array,
     axis: str = "tp",
