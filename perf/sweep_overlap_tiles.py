@@ -103,7 +103,10 @@ def main(argv=None) -> int:
             cfg = AGGemmConfig(tile_n=tile_n, tile_m=tile_m)
             f = lambda a, b, cfg=cfg: ag_gemm_op(a, b, "tp", cfg, ctx)
         else:
-            cfg = GemmRSConfig(tile_n=tile_n, tile_m=tile_m)
+            # force_kernel: without it the tp=1 path short-circuits to a
+            # plain XLA dot and the sweep times XLA at every config.
+            cfg = GemmRSConfig(tile_n=tile_n, tile_m=tile_m,
+                               force_kernel=True)
             f = lambda a, b, cfg=cfg: gemm_rs_op(a, b, "tp", cfg, ctx)
         try:
             ms = timed(f)

@@ -161,3 +161,24 @@ def test_ag_gemm_bf16(ctx4, rng):
     out = ag_gemm_op(a, b, "tp", AGGemmConfig(tile_n=128), ctx4)
     gold = np.asarray(a, np.float32) @ np.asarray(b, np.float32)
     np.testing.assert_allclose(np.asarray(out, np.float32), gold, rtol=5e-2, atol=5e-1)
+
+
+def test_gemm_rs_force_kernel_n1(rng):
+    """force_kernel must run the real staging pipeline at n=1 (the
+    sweep's rung) and match the dot it normally short-circuits to."""
+    import jax
+
+    from triton_distributed_tpu.runtime import mesh as mesh_mod
+
+    ctx1 = mesh_mod.initialize_distributed(tp=1, devices=jax.devices()[:1])
+    try:
+        a = jnp.asarray(rng.standard_normal((32, 128)), jnp.float32)
+        b = jnp.asarray(rng.standard_normal((128, 256)), jnp.float32)
+        cfg = GemmRSConfig(tile_n=128, tile_m=8, force_kernel=True)
+        out = gemm_rs_op(a, b, "tp", cfg, ctx1)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(a) @ np.asarray(b),
+            rtol=1e-4, atol=1e-4,
+        )
+    finally:
+        mesh_mod.finalize_distributed()

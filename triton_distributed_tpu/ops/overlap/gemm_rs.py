@@ -78,6 +78,11 @@ class GemmRSConfig:
     acc_dtype: jnp.dtype = jnp.float32
     bidir: bool = True
     wire_dtype: jnp.dtype | None = None
+    # n=1 normally short-circuits to a plain XLA dot; the tile sweep
+    # (perf/sweep_overlap_tiles.py) needs the KERNEL's staging pipeline
+    # measured on one chip — without this flag its gemm_rs numbers
+    # would silently time XLA at every tile config.
+    force_kernel: bool = False
 
 
 # 8 MB (tile_m=1024 at K=4096 bf16) measured best on v5e — see
@@ -287,9 +292,14 @@ def _gemm_rs_kernel(
 
     @pl.when(s == n - 1)
     def _final_accumulate():
-        fbuf[p] = (
-            partial + inb_vmem[p].astype(acc_dtype)
-        ).astype(fbuf.dtype)
+        if n == 1:
+            # Degenerate ring (force_kernel at tp=1): no inbound partial
+            # exists — the tile is the full reduction.
+            fbuf[p] = partial.astype(fbuf.dtype)
+        else:
+            fbuf[p] = (
+                partial + inb_vmem[p].astype(acc_dtype)
+            ).astype(fbuf.dtype)
 
     @pl.when(s < n - 1)
     def _to_accbuf():
@@ -344,13 +354,14 @@ def _gemm_rs_kernel(
         @pl.when(s == n - 1)
         def _finish():
             # Steps 0..n-3 drained on accbuf reuse; only n-2 remains.
-            step = n - 2
-            for d in range(ndir):
-                pltpu.make_async_copy(
-                    accbuf.at[step % 2, dir_rows(d)],
-                    accbuf.at[step % 2, dir_rows(d)],
-                    send_sems.at[d, step],
-                ).wait()
+            if n > 1:
+                step = n - 2
+                for d in range(ndir):
+                    pltpu.make_async_copy(
+                        accbuf.at[step % 2, dir_rows(d)],
+                        accbuf.at[step % 2, dir_rows(d)],
+                        send_sems.at[d, step],
+                    ).wait()
 
 
 def gemm_rs(
@@ -384,7 +395,7 @@ def gemm_rs(
         raise ValueError(f"m_per={m_per} not divisible by tile_m={tile_m}")
     num_i = m_per // tile_m
 
-    if n == 1:
+    if n == 1 and not config.force_kernel:
         return jnp.dot(a, b, preferred_element_type=config.acc_dtype).astype(a.dtype)
 
     wire = jnp.dtype(config.wire_dtype or a.dtype)
@@ -417,16 +428,26 @@ def gemm_rs(
         pltpu.SemaphoreType.DMA((2,)),
         pltpu.SemaphoreType.DMA((2,)),
         pltpu.SemaphoreType.DMA((2,)),
-        pltpu.SemaphoreType.DMA((ndir, n - 1)),
-        pltpu.SemaphoreType.DMA((ndir, n - 1)),
+        pltpu.SemaphoreType.DMA((ndir, max(n - 1, 1))),
+        pltpu.SemaphoreType.DMA((ndir, max(n - 1, 1))),
     ]
 
     out, _ws, _acc = comm_pallas_call(
         kernel,
         (
             jax.ShapeDtypeStruct((m_per, n_out), a.dtype),
-            jax.ShapeDtypeStruct((n - 1, m_per, n_out), wire),
-            jax.ShapeDtypeStruct((2, m_per, n_out), wire),
+            # n=1 (force_kernel): every ws/accbuf access is RUNTIME-
+            # guarded (s>0 / s<n-1 / n>1) but still TRACED, so the dummy
+            # shapes must fit each static slice size (≤ m_per rows,
+            # ≤ tile_n cols) while dropping the n_out/tile_n-fold dead
+            # HBM the full workspaces would allocate.
+            jax.ShapeDtypeStruct(
+                (n - 1, m_per, n_out) if n > 1 else (1, m_per, tile_n),
+                wire,
+            ),
+            jax.ShapeDtypeStruct(
+                (2, m_per, n_out) if n > 1 else (2, m_per, tile_n), wire
+            ),
         ),
         grid=(n, num_i, num_j),
         in_specs=[
