@@ -149,3 +149,30 @@ def test_probe_topology_and_ici(ctx4):
 
     gbs = measure_ici_bandwidth_gbs("tp", nbytes=64 * 1024, iters=2, ctx=ctx4)
     assert gbs > 0
+
+
+def test_axis_ici_vs_dcn_classification(ctx2x4):
+    """DCN-spanning axes must be detected (AUTO dispatch falls back to
+    XLA there — device-initiated DMA is ICI-only). Classification is by
+    SLICE id, never process id: ICI spans hosts inside one slice (a
+    v4-32 has 4 processes and one all-ICI slice). The pure classifier
+    is exercised with synthetic slice-id grids."""
+    import numpy as np
+
+    from triton_distributed_tpu.runtime.mesh import DistContext
+
+    # 2 slices x 4 chips: slice id differs along dim 0 (DCN axis),
+    # constant along dim 1 (ICI axis).
+    ids = np.array([[0, 0, 0, 0], [1, 1, 1, 1]])
+    assert not DistContext._axis_within_group(ids, 0)  # dcn axis
+    assert DistContext._axis_within_group(ids, 1)      # tp axis
+
+    # 4 slices of 4 chips over a (4, 4) mesh.
+    ids = np.repeat(np.arange(4)[:, None], 4, axis=1)
+    assert not DistContext._axis_within_group(ids, 0)
+    assert DistContext._axis_within_group(ids, 1)
+
+    # Live sim-mesh context (CPU devices carry no slice_index → one
+    # slice): every axis is ICI even though a multi-host pod would have
+    # several processes.
+    assert ctx2x4.axis_is_ici("tp") and ctx2x4.axis_is_ici("dp")

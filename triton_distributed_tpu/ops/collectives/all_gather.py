@@ -25,9 +25,9 @@ from jax.sharding import PartitionSpec as P
 
 from triton_distributed_tpu import language as dl
 from triton_distributed_tpu.ops.common import (
+    device_initiable,
     comm_pallas_call,
     next_collective_id,
-    _on_tpu,
 )
 from triton_distributed_tpu.runtime.mesh import DistContext, current_context
 
@@ -255,7 +255,7 @@ def all_gather(
     """
     n = jax.lax.axis_size(axis)
     if method == AllGatherMethod.AUTO:
-        if not _on_tpu(ctx) or x.ndim < 2:
+        if not device_initiable(axis, ctx) or x.ndim < 2:
             # CPU-simulator meshes run Pallas in interpret mode, which is
             # for explicit kernel tests only; 1-D payloads (biases etc.)
             # also take the XLA path the Pallas kernels don't cover.

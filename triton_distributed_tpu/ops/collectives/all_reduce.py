@@ -25,10 +25,10 @@ from jax.sharding import PartitionSpec as P
 
 from triton_distributed_tpu import language as dl
 from triton_distributed_tpu.ops.common import (
+    device_initiable,
     VMEM_COMM_MAX_BYTES,
     comm_pallas_call,
     next_collective_id,
-    _on_tpu,
 )
 from triton_distributed_tpu.ops.collectives.all_gather import (
     AllGatherMethod,
@@ -168,7 +168,7 @@ def all_reduce(
     if method == AllReduceMethod.AUTO:
         method = (
             get_auto_allreduce_method(nbytes, n)
-            if _on_tpu(ctx) and x.ndim >= 2
+            if device_initiable(axis, ctx) and x.ndim >= 2
             else AllReduceMethod.XLA
         )
 

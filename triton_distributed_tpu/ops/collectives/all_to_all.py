@@ -22,10 +22,10 @@ from jax.sharding import PartitionSpec as P
 
 from triton_distributed_tpu import language as dl
 from triton_distributed_tpu.ops.common import (
+    device_initiable,
     VMEM_COMM_MAX_BYTES,
     comm_pallas_call,
     next_collective_id,
-    _on_tpu,
 )
 from triton_distributed_tpu.runtime.mesh import DistContext, current_context
 
@@ -75,7 +75,7 @@ def all_to_all(
     n = jax.lax.axis_size(axis)
     if method == "auto":
         on_chip = x.size * x.dtype.itemsize <= VMEM_COMM_MAX_BYTES
-        method = "pallas" if _on_tpu(ctx) and on_chip else "xla"
+        method = "pallas" if device_initiable(axis, ctx) and on_chip else "xla"
     if method == "xla":
         return jax.lax.all_to_all(
             x.reshape(n, x.shape[0] // n, *x.shape[1:]),

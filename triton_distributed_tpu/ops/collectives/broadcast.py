@@ -21,10 +21,10 @@ from jax.sharding import PartitionSpec as P
 
 from triton_distributed_tpu import language as dl
 from triton_distributed_tpu.ops.common import (
+    device_initiable,
     VMEM_COMM_MAX_BYTES,
     comm_pallas_call,
     next_collective_id,
-    _on_tpu,
 )
 from triton_distributed_tpu.runtime.mesh import DistContext, current_context
 
@@ -80,7 +80,7 @@ def broadcast(
     if method == BroadcastMethod.AUTO:
         method = (
             BroadcastMethod.ONE_SHOT
-            if _on_tpu(ctx) and x.ndim >= 2 and nbytes <= VMEM_COMM_MAX_BYTES
+            if device_initiable(axis, ctx) and x.ndim >= 2 and nbytes <= VMEM_COMM_MAX_BYTES
             else BroadcastMethod.XLA
         )
 

@@ -26,9 +26,9 @@ from jax.sharding import PartitionSpec as P
 
 from triton_distributed_tpu import language as dl
 from triton_distributed_tpu.ops.common import (
+    device_initiable,
     comm_pallas_call,
     next_collective_id,
-    _on_tpu,
 )
 from triton_distributed_tpu.runtime.mesh import DistContext, current_context
 
@@ -268,7 +268,7 @@ def reduce_scatter(
     from triton_distributed_tpu.ops.common import VMEM_COMM_MAX_BYTES
 
     if method == ReduceScatterMethod.AUTO:
-        if not _on_tpu(ctx) or x.ndim < 2:
+        if not device_initiable(axis, ctx) or x.ndim < 2:
             method = ReduceScatterMethod.XLA
         elif x.size * x.dtype.itemsize <= _RS_ONE_SHOT_MAX_BYTES:
             method = ReduceScatterMethod.ONE_SHOT

@@ -203,3 +203,19 @@ def _on_tpu(ctx: DistContext | None = None) -> bool:
         return current_context().on_tpu
     except RuntimeError:
         return jax.default_backend() == "tpu"
+
+
+def device_initiable(axis: str, ctx: DistContext | None = None) -> bool:
+    """True when a device-push Pallas kernel is legal on ``axis``: real
+    TPU AND the axis stays inside one slice (ICI). DCN-spanning axes
+    are host-driven — AUTO dispatchers must fall back to XLA there
+    (the 2-level ops in ``collectives/hierarchical.py`` exist for
+    exactly that split)."""
+    if not _on_tpu(ctx):
+        return False
+    if ctx is None:
+        try:
+            ctx = current_context()
+        except RuntimeError:
+            return True  # single-device scripts: no axis to cross
+    return ctx.axis_is_ici(axis)

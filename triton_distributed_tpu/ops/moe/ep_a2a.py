@@ -33,9 +33,11 @@ Transports (``method=``):
   ``put_signal`` block pushes, only the filled prefix crosses the wire
   — the reference's flagship ``low_latency_all_to_all.py`` shape).
 - ``"xla"`` — the whole max-padded segments ride ``lax.all_to_all``.
-- ``"auto"`` — pallas on real TPU, xla elsewhere. No size gate: the
-  segments live in ANY/HBM on both ends, so unlike the VMEM-resident
-  dense a2a there is no payload ceiling to dodge.
+- ``"auto"`` — pallas on real TPU when the EP axis is ICI-reachable
+  (``device_initiable`` — a DCN-spanning axis is host-driven and falls
+  back to xla), xla elsewhere. No size gate: the segments live in
+  ANY/HBM on both ends, so unlike the VMEM-resident dense a2a there is
+  no payload ceiling to dodge.
 """
 
 from __future__ import annotations
@@ -71,14 +73,17 @@ def _fp8_encode(x: jax.Array):
     return q, scale.astype(jnp.float32)
 
 
-def _resolve_method(method: str, ctx) -> str:
-    """``auto`` → the device-push kernel on real TPU, XLA elsewhere
-    (interpret-mode Pallas is a correctness tool, not a fast path)."""
+def _resolve_method(method: str, axis: str, ctx) -> str:
+    """``auto`` → the device-push kernel on real TPU when ``axis`` is
+    ICI-reachable, XLA elsewhere (interpret-mode Pallas is a
+    correctness tool, not a fast path; a DCN-spanning EP axis is
+    host-driven — the reference's cross-node analog is IBGDA RDMA,
+    which ICI has no device-initiated counterpart for)."""
     if method != "auto":
         return method
-    from triton_distributed_tpu.ops.common import _on_tpu
+    from triton_distributed_tpu.ops.common import device_initiable
 
-    return "pallas" if _on_tpu(ctx) else "xla"
+    return "pallas" if device_initiable(axis, ctx) else "xla"
 
 
 def ep_dispatch(
@@ -143,7 +148,7 @@ def ep_dispatch(
         splits_c[:, None, None], axis=axis, method="xla", ctx=ctx,
     )[:, 0, 0]  # [n]
 
-    method = _resolve_method(method, ctx)
+    method = _resolve_method(method, axis, ctx)
     recv_v = (
         jax.lax.broadcasted_iota(jnp.int32, (n, capacity), 1)
         < recv_counts[:, None]
@@ -225,7 +230,7 @@ def ep_combine(
     n = jax.lax.axis_size(axis)
     capacity = expert_out.shape[0] // n
     d = expert_out.shape[1]
-    method = _resolve_method(method, ctx)
+    method = _resolve_method(method, axis, ctx)
     if method == "pallas":
         # Return direction mirrors dispatch: this rank holds
         # recv_counts[s] result rows for source s and gets back its own

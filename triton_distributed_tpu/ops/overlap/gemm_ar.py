@@ -38,12 +38,12 @@ from jax.sharding import PartitionSpec as P
 
 from triton_distributed_tpu import language as dl
 from triton_distributed_tpu.ops.common import (
+    device_initiable,
     VMEM_COMM_MAX_BYTES,
     comm_cost,
     comm_pallas_call,
     next_collective_id,
     pick_tile,
-    _on_tpu,
 )
 from triton_distributed_tpu.ops.collectives.all_gather import (
     AllGatherMethod,
@@ -179,7 +179,7 @@ def gemm_ar(
 
     out_bytes = m * n_out * a.dtype.itemsize
     if method == GemmARMethod.AUTO:
-        if not _on_tpu(ctx):
+        if not device_initiable(axis, ctx):
             method = GemmARMethod.XLA
         elif out_bytes <= _ONE_SHOT_MAX_BYTES:
             method = GemmARMethod.ONE_SHOT

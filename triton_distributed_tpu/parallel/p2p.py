@@ -34,7 +34,7 @@ from triton_distributed_tpu import language as dl
 from triton_distributed_tpu.ops.common import (
     comm_pallas_call,
     next_collective_id,
-    _on_tpu,
+    device_initiable,
 )
 
 _P2P_COLLECTIVE_ID = next_collective_id()
@@ -81,7 +81,7 @@ def pp_shift(
     (or stage n-1's payload when ``wrap``)."""
     n = jax.lax.axis_size(axis)
     if method == "auto":
-        method = "pallas" if _on_tpu(ctx) and x.ndim >= 2 else "xla"
+        method = "pallas" if device_initiable(axis, ctx) and x.ndim >= 2 else "xla"
     if n == 1:
         return x if wrap else jnp.zeros_like(x)
     if method == "xla":

@@ -110,6 +110,35 @@ class DistContext:
     def on_tpu(self) -> bool:
         return self.topology.on_tpu
 
+    def axis_is_ici(self, axis: str) -> bool:
+        """True when neighbors along ``axis`` share a SLICE — i.e. the
+        axis is reachable by device-initiated remote DMA (ICI). A
+        DCN-spanning axis must use XLA collectives: DCN transfers are
+        host-driven (SURVEY.md §7 "inter-slice paths can't be
+        device-initiated"). AUTO method dispatchers consult this so a
+        device-push kernel is never selected across a slice boundary.
+
+        Slice identity comes from ``device.slice_index`` — ICI spans
+        HOSTS inside one slice (a v4-32 has 4 processes and one
+        all-ICI slice), so process boundaries must NOT be the signal.
+        Devices without a ``slice_index`` attribute (CPU sim, older
+        stacks) are treated as one slice."""
+        devs = np.asarray(self.mesh.devices)
+        ids = np.vectorize(
+            lambda d: getattr(d, "slice_index", None) or 0
+        )(devs)
+        if (ids == ids.flat[0]).all():
+            return True  # one slice: every axis is ICI
+        return self._axis_within_group(ids, self.axis_names.index(axis))
+
+    @staticmethod
+    def _axis_within_group(ids: "np.ndarray", ax_i: int) -> bool:
+        """Pure check: every move along mesh dim ``ax_i`` stays inside
+        one slice-id group (split out so the DCN/ICI classification is
+        unit-testable without a real multi-slice mesh)."""
+        moved = np.moveaxis(ids, ax_i, 0)
+        return bool((moved == moved[0]).all())
+
     # -- pallas helpers ---------------------------------------------------
     def pallas_interpret(self):
         """Interpret-mode params for Pallas on non-TPU backends.
