@@ -232,7 +232,12 @@ def run_ladder(progress_fh, on_tpu: bool, skip: frozenset[str]) -> None:
         try:
             from triton_distributed_tpu.megakernel import MegaQwen3
 
-            NS = 8
+            # Launch width: the ~2 ms dispatch tax amortizes as tax/NS,
+            # so NS=16 halves the per-step overhead vs 8 if capacity
+            # (max_length - prompt) allows; STEPS must stay divisible.
+            NS = _env_int("TDT_BENCH_NS", 8)
+            if NS <= 0 or STEPS % NS:
+                NS = 8
             if not mega_ok:
                 # The token cross-check below needs the single-step
                 # kernel even when its timing rung ran in an earlier
