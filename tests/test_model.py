@@ -464,3 +464,74 @@ def test_hf_transformers_parity(tmp_path):
         np.testing.assert_array_equal(out[0], hf_gen)
     finally:
         mesh_mod.finalize_distributed()
+
+
+@pytest.mark.parametrize("norm_topk", [True, False])
+def test_hf_transformers_moe_parity(tmp_path, norm_topk):
+    """MoE checkpoint path: a REAL ``transformers`` Qwen3MoeForCausalLM
+    saved with ``save_pretrained`` and loaded by our framework must
+    match upstream logits + greedy continuation (routes through
+    ``load_hf_moe_state_dict`` via the config's expert fields) — in
+    BOTH router-weight normalization modes (the HF default is False;
+    official checkpoints set True — the loader must follow the config,
+    not assume)."""
+    torch = pytest.importorskip("torch")
+    tfm = pytest.importorskip("transformers")
+
+    import jax as _jax
+
+    from triton_distributed_tpu.runtime import mesh as mesh_mod
+
+    hf_cfg = tfm.Qwen3MoeConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        moe_intermediate_size=32,
+        num_experts=8,
+        num_experts_per_tok=2,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=32,
+        rope_theta=1e6,
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=False,
+        max_position_embeddings=64,
+        norm_topk_prob=norm_topk,
+        decoder_sparse_step=1,
+        mlp_only_layers=[],
+    )
+    torch.manual_seed(0)
+    hf_model = tfm.Qwen3MoeForCausalLM(hf_cfg).eval()
+    hf_model.save_pretrained(tmp_path, safe_serialization=True)
+
+    prompt = np.array([5, 44, 3, 98, 17, 62, 29, 81], np.int32)
+    with torch.no_grad():
+        hf_logits = hf_model(
+            torch.tensor(prompt[None].astype(np.int64))
+        ).logits[0, -1].numpy()
+        hf_gen = hf_model.generate(
+            torch.tensor(prompt[None].astype(np.int64)),
+            max_new_tokens=6, do_sample=False,
+        )[0].numpy()
+
+    ctx = mesh_mod.initialize_distributed(tp=2, devices=_jax.devices()[:2])
+    try:
+        model = AutoLLM.from_pretrained(
+            str(tmp_path), ctx=ctx, dtype=jnp.float32, max_length=64,
+        )
+        from triton_distributed_tpu.models.qwen_moe import Qwen3MoE
+
+        assert isinstance(model, Qwen3MoE)
+        logits, _ = model.prefill(
+            jnp.asarray(prompt), model.new_cache(1), "xla"
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits), hf_logits, atol=2e-4, rtol=2e-4
+        )
+        out = Engine(model, temperature=0.0, mode="xla").serve(
+            prompt[None], gen_len=6, max_length=64
+        )
+        np.testing.assert_array_equal(out[0], hf_gen)
+    finally:
+        mesh_mod.finalize_distributed()

@@ -43,8 +43,17 @@ class AutoLLM:
         ctx = ctx or current_context()
         if os.path.isdir(name_or_path):
             cfg, state = _load_hf_checkpoint(name_or_path, **overrides)
-            model = Qwen3(cfg, axis=axis, ctx=ctx)
             n = ctx.axis_size(axis)
+            if cfg.num_experts:
+                from triton_distributed_tpu.models.qwen_moe import (
+                    Qwen3MoE,
+                    load_hf_moe_state_dict,
+                )
+
+                model = Qwen3MoE(cfg, axis=axis, ctx=ctx)
+                model.set_params(load_hf_moe_state_dict(cfg, state, n))
+                return model
+            model = Qwen3(cfg, axis=axis, ctx=ctx)
             model.set_params(load_hf_state_dict(cfg, state, n))
             return model
         cfg = get_config(name_or_path, **overrides)
@@ -76,8 +85,33 @@ def _load_hf_checkpoint(path: str, **overrides):
         rope_theta=hf.get("rope_theta", 1e6),
         rms_eps=hf.get("rms_norm_eps", 1e-6),
         tie_word_embeddings=hf.get("tie_word_embeddings", False),
+        # MoE checkpoints (Qwen3MoeForCausalLM): presence of experts in
+        # the config routes to the MoE model + state-dict mapper.
+        num_experts=hf.get("num_experts", 0),
+        num_experts_per_tok=hf.get("num_experts_per_tok", 0),
+        moe_intermediate_size=hf.get("moe_intermediate_size", 0),
+        # Follow the checkpoint (HF default is FALSE; official Qwen3-MoE
+        # releases set it true) — assuming true silently renormalizes
+        # router weights upstream leaves unnormalized.
+        norm_topk_prob=hf.get("norm_topk_prob", False),
         **overrides,
     )
+    if hf.get("num_experts", 0):
+        # Interleaved dense/sparse layers (decoder_sparse_step > 1 or
+        # mlp_only_layers) store real dense MLP weights for some layers;
+        # the uniform-sparse mapper would clobber them with placeholders
+        # and then fail on a cryptic missing-router KeyError. Refuse
+        # loudly instead. (The shipped Qwen3-MoE checkpoints are
+        # uniformly sparse: step=1, mlp_only_layers=[].)
+        step = hf.get("decoder_sparse_step", 1)
+        dense_layers = hf.get("mlp_only_layers") or []
+        if step != 1 or dense_layers:
+            raise NotImplementedError(
+                "interleaved dense/sparse MoE checkpoints are not "
+                f"supported (decoder_sparse_step={step}, "
+                f"mlp_only_layers={dense_layers}); only uniformly "
+                "sparse Qwen3-MoE layouts load"
+            )
     from safetensors import safe_open
 
     state = {}

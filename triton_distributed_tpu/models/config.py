@@ -31,6 +31,11 @@ class ModelConfig:
     num_experts: int = 0
     num_experts_per_tok: int = 0
     moe_intermediate_size: int = 0
+    # Renormalize top-k router weights to sum to 1 (HF Qwen3MoeConfig
+    # field; the official Qwen3-MoE checkpoints set it true, but the HF
+    # DEFAULT is false — checkpoint loading must follow the config, not
+    # assume).
+    norm_topk_prob: bool = True
     # runtime
     max_length: int = 4096
     dtype: jnp.dtype = jnp.bfloat16

@@ -34,6 +34,7 @@ class Qwen3MoE(Qwen3):
         return tp_moe_fwd(
             mlp_params, h, self.cfg.num_experts_per_tok,
             axis=self.axis, mode=mode, ctx=self.ctx,
+            norm_topk_prob=self.cfg.norm_topk_prob,
         )
 
     @property
@@ -112,14 +113,17 @@ def load_hf_moe_state_dict(
         return jnp.asarray(state[name]).astype(cfg.dtype)
 
     # Reuse the dense loader for everything but the MLP by synthesizing
-    # dense-shaped placeholders, then overwrite the MLP leaves.
+    # dense-shaped placeholders, then overwrite the MLP leaves. The
+    # gate/up placeholders must be n columns wide — the dense loader
+    # fuses them by shard (``_fuse_by_shard`` reshapes columns into n
+    # groups), and a 1-column dummy is not divisible.
     dense_state = dict(state)
-    zero = jnp.zeros((1, d), cfg.dtype)
+    zero_cols = jnp.zeros((n, d), cfg.dtype)  # torch layout [out, in]
     for i in range(L):
         p = f"model.layers.{i}.mlp."
-        dense_state[p + "gate_proj.weight"] = zero
-        dense_state[p + "up_proj.weight"] = zero
-        dense_state[p + "down_proj.weight"] = zero.T
+        dense_state[p + "gate_proj.weight"] = zero_cols
+        dense_state[p + "up_proj.weight"] = zero_cols
+        dense_state[p + "down_proj.weight"] = zero_cols.T
     params = load_hf_state_dict(cfg, dense_state, n)
 
     routers, w1s, w2s = [], [], []
