@@ -55,6 +55,7 @@ def test_all_gather_pull_windows(ctx4, rng, window):
         ReduceScatterMethod.XLA,
         ReduceScatterMethod.ONE_SHOT,
         ReduceScatterMethod.PALLAS_RING,
+        ReduceScatterMethod.PALLAS_BIDIR_RING,
         ReduceScatterMethod.PALLAS_RING_HBM,
     ],
 )
@@ -237,3 +238,16 @@ def test_all_gather_torus_2d(ctx2x4, rng):
         body, in_specs=P(("dp", "tp"), None), out_specs=P(None, None)
     )
     np.testing.assert_allclose(np.asarray(f(x)), np.asarray(x), rtol=1e-6)
+
+
+def test_reduce_scatter_bidir_8dev(ctx8, rng):
+    """Dual counter-rotating RS rings at n=8 (both directions' slot and
+    neighbor algebra exercised over more than one hop)."""
+    n = 8
+    x = jnp.asarray(rng.standard_normal((n, n * 4, 128), dtype=np.float32))
+    out = reduce_scatter_op(
+        x, "tp", ReduceScatterMethod.PALLAS_BIDIR_RING, ctx8
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(x).sum(0), rtol=1e-5, atol=1e-5
+    )

@@ -482,3 +482,23 @@ class TestLowLatencyLower:
             )
         exp = export.export(f, platforms=["tpu"])(*args)
         assert len(exp.mlir_module_serialized) > 0
+
+
+class TestBidirRSLower:
+    def test_reduce_scatter_bidir(self, tpu_ctx):
+        import functools
+
+        from triton_distributed_tpu.ops.collectives.reduce_scatter import (
+            ReduceScatterMethod,
+            reduce_scatter,
+        )
+
+        f = tpu_ctx.shard_map(
+            functools.partial(
+                reduce_scatter, axis="tp",
+                method=ReduceScatterMethod.PALLAS_BIDIR_RING, ctx=tpu_ctx,
+            ),
+            in_specs=P(None, None),
+            out_specs=P("tp", None),
+        )
+        _lower(tpu_ctx, f, _sds(tpu_ctx, (8 * 8, 128), (None, None)))

@@ -38,6 +38,7 @@ class ReduceScatterMethod(enum.Enum):
     XLA = "xla"
     ONE_SHOT = "one_shot"                # single-hop scatter + local add
     PALLAS_RING = "pallas_ring"          # VMEM-resident (small payloads)
+    PALLAS_BIDIR_RING = "pallas_bidir_ring"  # counter-rotating half-chunks
     PALLAS_RING_HBM = "pallas_ring_hbm"  # HBM slots + tiled VMEM adds
 
 
@@ -81,6 +82,67 @@ def _ring_rs_kernel(x_ref, o_ref, bufs, send_sems, recv_sems, *, axis: str):
     dl.quiet(*dmas)
     if n > 1:
         o_ref[:] = bufs[n - 2]
+    else:
+        o_ref[:] = x_ref[:]
+
+
+def _bidir_ring_rs_kernel(
+    x_ref, o_ref, bufs, send_sems, recv_sems, *, axis: str
+):
+    """Counter-rotating dual rings: each chunk's top half reduces
+    clockwise, bottom half counter-clockwise — both ICI directions
+    carry payload, half the wire time of the single ring (the same
+    lever as the bidir all-gather and the dual-ring ``gemm_rs``; the
+    anchored perf model's default RS estimate assumes exactly this).
+
+    Per direction the algebra mirrors :func:`_ring_rs_kernel`: cw at
+    step s sends the accumulated top of chunk ``me-1-s`` right and
+    receives ``me-2-s`` from the left; ccw sends the bottom of
+    ``me+1+s`` left and receives ``me+2+s`` from the right; both land
+    on the own chunk after n-1 steps.
+    """
+    me = dl.rank(axis)
+    n = dl.num_ranks(axis)
+    m_per = o_ref.shape[0]
+    half = m_per // 2
+    right = jax.lax.rem(me + 1, n)
+    left = jax.lax.rem(me - 1 + n, n)
+
+    def top(idx):
+        return pl.ds(idx * m_per, half)
+
+    def bot(idx):
+        return pl.ds(idx * m_per + half, m_per - half)
+
+    dl.barrier_all(axis)  # peers' bufs must exist before any put
+    dmas = []
+    for s in range(n - 1):
+        cw_send = jax.lax.rem(me - 1 - s + 2 * n, n)
+        ccw_send = jax.lax.rem(me + 1 + s, n)
+        src_cw = x_ref.at[top(cw_send)] if s == 0 else bufs.at[0, s - 1]
+        src_ccw = x_ref.at[bot(ccw_send)] if s == 0 else bufs.at[1, s - 1]
+        dmas.append(
+            dl.put_signal(
+                src_cw, bufs.at[0, s], right,
+                send_sems.at[0, s], recv_sems.at[0, s], axis=axis,
+            )
+        )
+        dmas.append(
+            dl.put_signal(
+                src_ccw, bufs.at[1, s], left,
+                send_sems.at[1, s], recv_sems.at[1, s], axis=axis,
+            )
+        )
+        dl.wait_recv(recv_sems.at[0, s], bufs.at[0, s])
+        cw_recv = jax.lax.rem(me - 2 - s + 2 * n, n)
+        bufs[0, s] = bufs[0, s] + x_ref[top(cw_recv)]
+        dl.wait_recv(recv_sems.at[1, s], bufs.at[1, s])
+        ccw_recv = jax.lax.rem(me + 2 + s, n)
+        bufs[1, s] = bufs[1, s] + x_ref[bot(ccw_recv)]
+    dl.quiet(*dmas)
+    if n > 1:
+        o_ref[pl.ds(0, half)] = bufs[0, n - 2]
+        o_ref[pl.ds(half, m_per - half)] = bufs[1, n - 2]
     else:
         o_ref[:] = x_ref[:]
 
@@ -273,7 +335,9 @@ def reduce_scatter(
         elif x.size * x.dtype.itemsize <= _RS_ONE_SHOT_MAX_BYTES:
             method = ReduceScatterMethod.ONE_SHOT
         elif x.size * x.dtype.itemsize <= VMEM_COMM_MAX_BYTES:
-            method = ReduceScatterMethod.PALLAS_RING
+            # Both ICI directions; the demotion guard below handles the
+            # degenerate/odd-chunk cases (single source of truth).
+            method = ReduceScatterMethod.PALLAS_BIDIR_RING
         else:
             method = ReduceScatterMethod.PALLAS_RING_HBM
 
@@ -341,6 +405,30 @@ def reduce_scatter(
             ctx=ctx,
         )(x)
         return out
+
+    if method == ReduceScatterMethod.PALLAS_BIDIR_RING and (
+        m_per < 2 or m_per % 2 or n <= 2
+    ):
+        # Halves degenerate (or odd chunks would mismatch the fixed
+        # half-chunk DMA slot shapes) — single ring covers it.
+        method = ReduceScatterMethod.PALLAS_RING
+
+    if method == ReduceScatterMethod.PALLAS_BIDIR_RING:
+        half = m_per // 2
+        return comm_pallas_call(
+            functools.partial(_bidir_ring_rs_kernel, axis=axis),
+            out_shape,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=[
+                # [direction, step] half-chunk slots.
+                pltpu.VMEM((2, max(n - 1, 1), half, *x.shape[1:]), x.dtype),
+                pltpu.SemaphoreType.DMA((2, max(n - 1, 1))),
+                pltpu.SemaphoreType.DMA((2, max(n - 1, 1))),
+            ],
+            collective_id=_RS_COLLECTIVE_ID,
+            ctx=ctx,
+        )(x)
 
     return comm_pallas_call(
         functools.partial(_ring_rs_kernel, axis=axis),
