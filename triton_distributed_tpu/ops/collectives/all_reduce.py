@@ -148,6 +148,26 @@ def _doubling_kernel(
     o_ref[:] = acc.astype(o_ref.dtype)
 
 
+def _straggle_entry(x, axis, straggler_rank, straggler_nanos, ctx):
+    """Identity op that lags one rank (race fixture for composed paths
+    whose leg kernels carry no injection params). Static no-op when no
+    straggler is configured — production traces are untouched."""
+    if straggler_rank is None or not straggler_nanos:
+        return x
+
+    def kern(x_ref, o_ref):
+        dl.straggle_if_rank(straggler_rank, axis, straggler_nanos)
+        o_ref[:] = x_ref[:]
+
+    return comm_pallas_call(
+        kern,
+        jax.ShapeDtypeStruct(x.shape, x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        ctx=ctx,
+    )(x)
+
+
 def all_reduce(
     x: jax.Array,
     axis: str = "tp",
@@ -227,10 +247,22 @@ def all_reduce(
             # ONE_SHOT gathers n copies into VMEM — only sane when small;
             # large indivisible payloads go to XLA.
             if nbytes <= _ONE_SHOT_MAX_BYTES:
-                return all_reduce(x, axis, AllReduceMethod.ONE_SHOT, ctx)
+                return all_reduce(
+                    x, axis, AllReduceMethod.ONE_SHOT, ctx,
+                    straggler_rank=straggler_rank,
+                    straggler_nanos=straggler_nanos,
+                )
             return jax.lax.psum(x, axis)
+        # Straggler fixture on a COMPOSED path: the legs' kernels carry
+        # no injection params, so the lag is applied as a delay-only
+        # kernel that skews this rank's ENTRY into the RS leg — the
+        # same late-producer class the monolithic kernels provoke
+        # in-kernel.
+        x = _straggle_entry(x, axis, straggler_rank, straggler_nanos, ctx)
         rs_method = (
-            ReduceScatterMethod.PALLAS_RING
+            # Both ICI directions on the RS leg too (demotes itself on
+            # degenerate shapes) — the AG leg is already bidirectional.
+            ReduceScatterMethod.PALLAS_BIDIR_RING
             if nbytes <= VMEM_COMM_MAX_BYTES
             else ReduceScatterMethod.PALLAS_RING_HBM  # no VMEM ceiling
         )
