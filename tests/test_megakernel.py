@@ -299,17 +299,18 @@ def test_lm_head_remainder_tile(ctx4):
     )
 
 
+@pytest.fixture
+def ctx1():
+    from triton_distributed_tpu.runtime import mesh as mesh_mod
+
+    ctx = mesh_mod.initialize_distributed(tp=1, devices=jax.devices()[:1])
+    yield ctx
+    mesh_mod.finalize_distributed()
+
+
 class TestMultiStepDecode:
     """Multi-step greedy decode: nsteps whole steps in one kernel launch
     (in-kernel argmax + SMEM token feedback + knew/vnew band)."""
-
-    @pytest.fixture
-    def ctx1(self):
-        from triton_distributed_tpu.runtime import mesh as mesh_mod
-
-        ctx = mesh_mod.initialize_distributed(tp=1, devices=jax.devices()[:1])
-        yield ctx
-        mesh_mod.finalize_distributed()
 
     @pytest.mark.slow
     def test_multi_matches_chained_single(self, ctx1):
@@ -508,3 +509,35 @@ class TestMultiStepDecode:
         np.testing.assert_array_equal(
             np.asarray(p_out.kv_len), np.asarray(p_ref.kv_len)
         )
+
+
+class TestMultiStepWide:
+    """NS=16 launch width (the ladder's TDT_BENCH_NS=16 rung): the SMEM
+    token table, in-launch KV band, and feedback chain must hold at 2x
+    the default width."""
+
+    @pytest.mark.slow
+    def test_multi_ns16_matches_chained_single(self, ctx1):
+        model = AutoLLM.from_pretrained("tiny", ctx=ctx1)
+        B, NS = 1, 16
+        cache = model.new_cache(B, max_length=64)
+        step_gold = model.decode_fn("xla")
+        _, cache = step_gold(model.params, jnp.asarray([3], jnp.int32), cache)
+
+        mega = MegaQwen3(model)
+        s_max = int(cache.k.shape[3])
+        tok0 = jnp.asarray([19], jnp.int32)
+
+        step = mega.decode_fn(B, s_max)
+        t, c = tok0, jax.tree.map(jnp.copy, cache)
+        ref_toks = []
+        for _ in range(NS):
+            lg, c = step(model.params, t, c)
+            t = jnp.argmax(lg, -1).astype(jnp.int32)
+            ref_toks.append(np.asarray(t))
+
+        multi = mega.decode_multi_fn(B, s_max, NS)
+        mtoks, _ml, _mc = multi(
+            model.params, tok0, jax.tree.map(jnp.copy, cache)
+        )
+        np.testing.assert_array_equal(np.asarray(mtoks), np.stack(ref_toks))

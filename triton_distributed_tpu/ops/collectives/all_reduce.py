@@ -155,15 +155,20 @@ def _straggle_entry(x, axis, straggler_rank, straggler_nanos, ctx):
     if straggler_rank is None or not straggler_nanos:
         return x
 
-    def kern(x_ref, o_ref):
+    def kern(x_ref, o_ref, sem):
         dl.straggle_if_rank(straggler_rank, axis, straggler_nanos)
-        o_ref[:] = x_ref[:]
+        # HBM->HBM DMA identity: no VMEM residency, so the fixture also
+        # works on the >VMEM-ceiling band the HBM-staged RS leg serves.
+        cp = pltpu.make_async_copy(x_ref, o_ref, sem)
+        cp.start()
+        cp.wait()
 
     return comm_pallas_call(
         kern,
         jax.ShapeDtypeStruct(x.shape, x.dtype),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA(())],
         ctx=ctx,
     )(x)
 
