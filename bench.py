@@ -58,7 +58,11 @@ _PROBE_ATTEMPTS = _env_int("TDT_BENCH_PROBE_ATTEMPTS", 3)
 _PROBE_TIMEOUT_S = _env_int("TDT_BENCH_PROBE_TIMEOUT_S", 270)
 _PROBE_SLEEP_S = 25
 _INIT_TIMEOUT_S = 900      # worker import + model build + prefill compile
-_RUNG_TIMEOUT_S = 600      # per-rung compile + timing
+_RUNG_TIMEOUT_S = _env_int("TDT_BENCH_RUNG_TIMEOUT_S", 600)
+# mega_multi's start→first-progress window holds ~4 fresh jit compiles
+# plus two full chained decode executions (the token cross-check) — a
+# healthy rung needs far more headroom than the others.
+_MULTI_RUNG_TIMEOUT_S = _env_int("TDT_BENCH_MULTI_RUNG_TIMEOUT_S", 1800)
 _WORKER_ATTEMPTS = 3
 _GLOBAL_DEADLINE_S = 2700  # stop relaunching workers past this
 
@@ -340,12 +344,20 @@ def _watch_worker(progress_path: str, skip: frozenset[str]) -> tuple[bool, str |
 
     def _reap(kill: bool) -> None:
         # A worker stalling in jax/relay TEARDOWN (after its work is on
-        # disk) must not crash the bench — the results are safe.
+        # disk) must not crash the bench — the results are safe — but it
+        # must not be LEFT RUNNING either (an orphan holds the TPU
+        # client and wedges the next run): escalate to kill on timeout.
         try:
             if kill:
                 proc.kill()
             proc.wait(timeout=30)
-        except (subprocess.TimeoutExpired, OSError):
+        except subprocess.TimeoutExpired:
+            try:
+                proc.kill()
+                proc.wait(timeout=30)
+            except (subprocess.TimeoutExpired, OSError):
+                pass
+        except OSError:
             pass
 
     last_size = os.path.getsize(progress_path)
@@ -366,7 +378,12 @@ def _watch_worker(progress_path: str, skip: frozenset[str]) -> tuple[bool, str |
             continue
         started = [e["start"] for e in events if "start" in e]
         current = started[-1] if started else None
-        limit = _INIT_TIMEOUT_S if current in (None, "init") else _RUNG_TIMEOUT_S
+        if current in (None, "init"):
+            limit = _INIT_TIMEOUT_S
+        elif current == "mega_multi":
+            limit = _MULTI_RUNG_TIMEOUT_S
+        else:
+            limit = _RUNG_TIMEOUT_S
         if time.time() - last_change > limit:
             _reap(kill=True)
             return False, None if current in (None, "init") else current
@@ -402,7 +419,10 @@ def main() -> int:
 
     t_start = time.time()
     on_tpu = _probe_tpu()
-    progress_path = tempfile.mktemp(prefix="bench_progress_", suffix=".jsonl")
+    fd, progress_path = tempfile.mkstemp(
+        prefix="bench_progress_", suffix=".jsonl"
+    )
+    os.close(fd)
 
     if on_tpu:
         done: set[str] = set()
@@ -495,6 +515,14 @@ def main() -> int:
     if tpu_errors:
         out["tpu_errors"] = tpu_errors
     print(json.dumps(out))
+    if not errors and not tpu_errors:
+        # Clean run: drop the progress files (kept on failures so the
+        # per-rung event log stays inspectable).
+        for path in (progress_path, progress_path + ".cpu"):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
     return 0
 
 

@@ -79,7 +79,10 @@ STEPS = [
                      "--iters", "4"], 600),
     ("ep_overhead", [sys.executable, "perf/ep_a2a_overhead.py"], 600),
     ("adaptive_ag", [sys.executable, "-c", _ADAPTIVE_AG], 400),
-    ("ladder", [sys.executable, "bench.py"], 3000),
+    # bench.py's own worst case: ~860 s probe retries + 2700 s global
+    # worker deadline + CPU fallback ladder + teardown — the step
+    # timeout must sit ABOVE it or the always-emit JSON contract breaks.
+    ("ladder", [sys.executable, "bench.py"], 4800),
     ("e2e", [sys.executable, "perf/real_weights_e2e.py",
              "--mode", "mega_multi", "--gen-len", "64"], 1500),
     ("sweep_full", [sys.executable, "perf/sweep_overlap_tiles.py",

@@ -502,3 +502,55 @@ class TestBidirRSLower:
             out_specs=P("tp", None),
         )
         _lower(tpu_ctx, f, _sds(tpu_ctx, (8 * 8, 128), (None, None)))
+
+
+class TestEPExchangeLower:
+    def test_ep_exchange(self, tpu_ctx):
+        """The device-initiated EP transport is the AUTO default on real
+        TPU — its Mosaic lowering (dynamic-trip fori_loop waits,
+        put_signal under pl.when, SMEM scalar bounds) needs an off-chip
+        gate like every other TPU-only kernel."""
+        import functools
+
+        import jax.numpy as jnp
+
+        from triton_distributed_tpu.ops.moe.ep_exchange import ep_exchange
+
+        n = 8
+
+        def body(rows, splits, counts):
+            return ep_exchange(rows, splits, counts, axis="tp", ctx=tpu_ctx)
+
+        f = tpu_ctx.shard_map(
+            functools.partial(body),
+            in_specs=(P(None, None, None), P(None), P(None)),
+            out_specs=P(None, None, None),
+        )
+        _lower(
+            tpu_ctx, f,
+            _sds(tpu_ctx, (n, 64, 256), (None, None, None), jnp.uint8),
+            _sds(tpu_ctx, (n,), (None,), jnp.int32),
+            _sds(tpu_ctx, (n,), (None,), jnp.int32),
+        )
+
+    def test_ep_moe_ffn_pallas(self, tpu_ctx):
+        """Whole EP MoE layer with the device transport lowers."""
+        import functools
+
+        from triton_distributed_tpu.ops.moe import ep_moe_ffn
+
+        f = tpu_ctx.shard_map(
+            functools.partial(
+                ep_moe_ffn, k=2, axis="tp", method="pallas", ctx=tpu_ctx
+            ),
+            in_specs=(P("tp", None), P(), P("tp", None, None),
+                      P("tp", None, None)),
+            out_specs=P("tp", None),
+        )
+        _lower(
+            tpu_ctx, f,
+            _sds(tpu_ctx, (8 * 8, 128), ("tp", None)),
+            _sds(tpu_ctx, (128, 16), (None, None)),
+            _sds(tpu_ctx, (16, 128, 2 * 128), ("tp", None, None)),
+            _sds(tpu_ctx, (16, 128, 128), ("tp", None, None)),
+        )
