@@ -72,7 +72,9 @@ print("adaptive ag_gemm compiled+ran on chip (semaphore_read + SMEM order)")
 """
 
 STEPS = [
-    ("probe", [sys.executable, "-c", _PROBE], 120),
+    # A recovering relay's first contact can spend 20-40 s compiling
+    # plus connection wobble — don't write off a live chip at 120 s.
+    ("probe", [sys.executable, "-c", _PROBE], 240),
     ("kernel_smoke", [sys.executable, "-c", _KERNEL_SMOKE], 300),
     ("sweep_small", [sys.executable, "perf/sweep_overlap_tiles.py",
                      "--m", "2048", "--k", "1024", "--n", "2048",
