@@ -179,7 +179,7 @@ def run_ladder(progress_fh, on_tpu: bool, skip: frozenset[str]) -> None:
     for name, mode in (("jit", "xla"), ("pallas", "pallas")):
         if name in skip:
             continue
-        _emit(progress_fh, {"start": name})
+        _emit(progress_fh, {"start": name, "budget_s": _RUNG_TIMEOUT_S})
         try:
             run = make_runner(mode)
 
@@ -199,7 +199,7 @@ def run_ladder(progress_fh, on_tpu: bool, skip: frozenset[str]) -> None:
     # off-TPU (interpret mode is semantics-only, not a timing rung).
     mega_ok = False
     if on_tpu and "mega" not in skip:
-        _emit(progress_fh, {"start": "mega"})
+        _emit(progress_fh, {"start": "mega", "budget_s": _RUNG_TIMEOUT_S})
         try:
             from triton_distributed_tpu.megakernel import MegaQwen3
 
@@ -232,7 +232,12 @@ def run_ladder(progress_fh, on_tpu: bool, skip: frozenset[str]) -> None:
         # (in-kernel argmax + SMEM token feedback) — amortizes the
         # platform's per-launch/per-op dispatch tax, the dominant cost
         # of single-step decode on this chip.
-        _emit(progress_fh, {"start": "mega_multi"})
+        # Budget covers ~4 fresh jit compiles plus two full chained
+        # decode executions (the token cross-check) before the first
+        # progress write.
+        _emit(progress_fh, {
+            "start": "mega_multi", "budget_s": _MULTI_RUNG_TIMEOUT_S,
+        })
         try:
             from triton_distributed_tpu.megakernel import MegaQwen3
 
@@ -376,14 +381,15 @@ def _watch_worker(progress_path: str, skip: frozenset[str]) -> tuple[bool, str |
         if size != last_size:
             last_size, last_change = size, time.time()
             continue
-        started = [e["start"] for e in events if "start" in e]
-        current = started[-1] if started else None
+        starts = [e for e in events if "start" in e]
+        current = starts[-1]["start"] if starts else None
         if current in (None, "init"):
             limit = _INIT_TIMEOUT_S
-        elif current == "mega_multi":
-            limit = _MULTI_RUNG_TIMEOUT_S
         else:
-            limit = _RUNG_TIMEOUT_S
+            # Each rung declares its own watchdog budget in its start
+            # event (the worker knows which rungs are compile-heavy) —
+            # no rung-name special cases here.
+            limit = starts[-1].get("budget_s", _RUNG_TIMEOUT_S)
         if time.time() - last_change > limit:
             _reap(kill=True)
             return False, None if current in (None, "init") else current

@@ -58,10 +58,6 @@ _EP_EXCHANGE_COLLECTIVE_ID = next_collective_id()
 EP_BLOCK_ROWS = 32
 
 
-def _cdiv(x, d: int):
-    return (x + (d - 1)) // d
-
-
 def _ep_exchange_kernel(
     splits_ref,   # [n] SMEM int32 — rows this rank sends to each dest
     expect_ref,   # [n] SMEM int32 — rows each source sends this rank
@@ -90,7 +86,7 @@ def _ep_exchange_kernel(
     dl.straggle_if_rank(straggler_rank, axis, straggle_nanos)
 
     # Own segment never crosses the wire: local DMA of filled blocks.
-    own_nb = _cdiv(splits_ref[me], block)
+    own_nb = pl.cdiv(splits_ref[me], block)
 
     def own_start(j, carry):
         @pl.when(j < own_nb)
@@ -107,7 +103,7 @@ def _ep_exchange_kernel(
     # slot convention), so receivers never contend for a slot.
     for i in range(1, n):
         peer = jax.lax.rem(me + i, n)
-        nb = _cdiv(splits_ref[peer], block)
+        nb = pl.cdiv(splits_ref[peer], block)
 
         def push(j, carry, peer=peer, nb=nb, i=i):
             @pl.when(j < nb)
@@ -129,7 +125,7 @@ def _ep_exchange_kernel(
     total_in = jnp.int32(0)
     for i in range(1, n):
         src = jax.lax.rem(me + i, n)
-        total_in = total_in + _cdiv(expect_ref[src], block)
+        total_in = total_in + pl.cdiv(expect_ref[src], block)
 
     def arrival(t, carry):
         dl.wait_recv(recv_sem, seg_block(o_ref, 0, 0))
@@ -151,7 +147,7 @@ def _ep_exchange_kernel(
     # Quiet: drain sends so x_ref is reusable after the call returns.
     for i in range(1, n):
         peer = jax.lax.rem(me + i, n)
-        nb = _cdiv(splits_ref[peer], block)
+        nb = pl.cdiv(splits_ref[peer], block)
 
         def drain(j, carry, peer=peer, nb=nb, i=i):
             @pl.when(j < nb)
