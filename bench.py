@@ -69,8 +69,16 @@ _GLOBAL_DEADLINE_S = 2700  # stop relaunching workers past this
 
 def _probe_tpu() -> bool:
     """Check (in a subprocess, with timeout + retry) that the TPU backend
-    actually comes up. Keeps a hung plugin from wedging the bench."""
-    code = "import jax; d = jax.devices(); assert d[0].platform != 'cpu'"
+    actually comes up AND EXECUTES. Keeps a hung plugin from wedging the
+    bench — and catches the observed half-up relay state where device
+    enumeration answers but any compute hangs (a doomed worker would
+    otherwise burn the init-timeout budget per attempt)."""
+    code = (
+        "import jax, numpy as np; d = jax.devices(); "
+        "assert d[0].platform != 'cpu'; "
+        "import jax.numpy as jnp; x = jnp.ones((8, 128)) + 1; "
+        "assert float(np.asarray(x).sum()) == 2048.0"
+    )
     for attempt in range(_PROBE_ATTEMPTS):
         try:
             r = subprocess.run(
