@@ -34,6 +34,8 @@ def main(argv=None) -> int:
     p.add_argument("--batch", type=int, default=1)
     p.add_argument("--ctx", type=int, default=512)
     p.add_argument("--steps", type=int, default=32)
+    p.add_argument("--ns", type=int, default=8,
+                   help="multi-step launch width (steps per kernel launch)")
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--cpu", action="store_true", help="simulated CPU mesh")
     p.add_argument("--rungs", default="eager,jit,pallas,mega,mega_multi")
@@ -105,7 +107,7 @@ def main(argv=None) -> int:
         # NS greedy steps per launch (in-kernel argmax) — the rung that
         # amortizes the per-launch dispatch tax.
         mega = MegaQwen3(model)
-        NS = min(8, args.steps)
+        NS = min(args.ns, args.steps)
         c0 = fresh_cache()
         fn = mega.decode_multi_fn(B, int(c0.k.shape[3]), NS)
 
