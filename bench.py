@@ -524,6 +524,13 @@ def main() -> int:
     }
     if cross is not None:
         out["mega_multi_cross_check"] = bool(cross.get("ok"))
+    spl = next(
+        (e.get("steps_per_launch") for e in events
+         if e.get("rung") == "mega_multi" and "steps_per_launch" in e),
+        None,
+    )
+    if spl is not None:
+        out["mega_multi_steps_per_launch"] = int(spl)
     if errors:
         out["errors"] = errors
     if tpu_errors:
