@@ -57,7 +57,10 @@ def _env_int(name: str, default: int) -> int:
 _PROBE_ATTEMPTS = _env_int("TDT_BENCH_PROBE_ATTEMPTS", 3)
 _PROBE_TIMEOUT_S = _env_int("TDT_BENCH_PROBE_TIMEOUT_S", 270)
 _PROBE_SLEEP_S = 25
-_INIT_TIMEOUT_S = 900      # worker import + model build + prefill compile
+# Worker import + model build + prefill compile. The watchdog timer
+# resets on every progress line, so this bounds each init PHASE (ctx /
+# params / prefill — the worker emits between them), not their sum.
+_INIT_TIMEOUT_S = _env_int("TDT_BENCH_INIT_TIMEOUT_S", 900)
 _RUNG_TIMEOUT_S = _env_int("TDT_BENCH_RUNG_TIMEOUT_S", 600)
 # mega_multi's start→first-progress window holds ~4 fresh jit compiles
 # plus two full chained decode executions (the token cross-check) — a
@@ -137,8 +140,13 @@ def run_ladder(progress_fh, on_tpu: bool, skip: frozenset[str]) -> None:
 
     _emit(progress_fh, {"start": "init"})
     ctx = initialize_distributed(tp=1, devices=jax.devices()[:1])
+    _emit(progress_fh, {"init_phase": "ctx"})
     model_name = "Qwen/Qwen3-0.6B" if on_tpu else "tiny"
+    # init is one jitted device-side program (no bulk weight transfer
+    # over the relay — see Qwen3._set_params_jit).
     model = AutoLLM.from_pretrained(model_name, ctx=ctx, max_length=1024)
+    jax.block_until_ready(model.params)
+    _emit(progress_fh, {"init_phase": "params"})
     cfg = model.cfg
 
     PROMPT = 512
@@ -146,6 +154,7 @@ def run_ladder(progress_fh, on_tpu: bool, skip: frozenset[str]) -> None:
     cache0 = model.new_cache(1)
     tokens = jnp.asarray(np.arange(PROMPT) % cfg.vocab_size, jnp.int32)
     logits, cache0 = model.prefill(tokens, cache0, "xla")
+    _emit(progress_fh, {"init_phase": "prefill"})
     tok0 = jnp.argmax(logits)[None].astype(jnp.int32)
 
     param_bytes = sum(

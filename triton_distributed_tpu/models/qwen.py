@@ -122,59 +122,87 @@ class Qwen3:
         n = self.ctx.axis_size(self.axis)
         hd, d = cfg.head_dim, cfg.hidden_size
         L = cfg.num_layers
-        ks = iter(jax.random.split(key, 9))
         dt = cfg.dtype
 
-        def rnd(k, *shape, scale=None):
-            scale = scale if scale is not None else shape[-2] ** -0.5
-            return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dt)
+        def build(key):
+            ks = iter(jax.random.split(key, 9))
 
-        # Fused qkv, laid out per shard [q_loc | k_loc | v_loc].
-        wq = rnd(next(ks), L, d, cfg.num_q_heads * hd)
-        wk = rnd(next(ks), L, d, cfg.num_kv_heads * hd)
-        wv = rnd(next(ks), L, d, cfg.num_kv_heads * hd)
-        wqkv = _fuse_by_shard([wq, wk, wv], n)
-        gate = rnd(next(ks), L, d, cfg.intermediate_size)
-        up = rnd(next(ks), L, d, cfg.intermediate_size)
-        w1 = _fuse_by_shard([gate, up], n)
-        params = Qwen3Params(
-            embed=rnd(next(ks), cfg.vocab_size, d, scale=0.02),
-            layers=Qwen3LayerParams(
-                ln1=jnp.ones((L, d), dt),
-                attn=TPAttnParams(
-                    wqkv=wqkv,
-                    wo=rnd(next(ks), L, cfg.num_q_heads * hd, d),
-                    q_norm=jnp.ones((L, hd), dt),
-                    k_norm=jnp.ones((L, hd), dt),
+            def rnd(k, *shape, scale=None):
+                scale = scale if scale is not None else shape[-2] ** -0.5
+                return (
+                    jax.random.normal(k, shape, jnp.float32) * scale
+                ).astype(dt)
+
+            # Fused qkv, laid out per shard [q_loc | k_loc | v_loc].
+            wq = rnd(next(ks), L, d, cfg.num_q_heads * hd)
+            wk = rnd(next(ks), L, d, cfg.num_kv_heads * hd)
+            wv = rnd(next(ks), L, d, cfg.num_kv_heads * hd)
+            wqkv = _fuse_by_shard([wq, wk, wv], n)
+            gate = rnd(next(ks), L, d, cfg.intermediate_size)
+            up = rnd(next(ks), L, d, cfg.intermediate_size)
+            w1 = _fuse_by_shard([gate, up], n)
+            return Qwen3Params(
+                embed=rnd(next(ks), cfg.vocab_size, d, scale=0.02),
+                layers=Qwen3LayerParams(
+                    ln1=jnp.ones((L, d), dt),
+                    attn=TPAttnParams(
+                        wqkv=wqkv,
+                        wo=rnd(next(ks), L, cfg.num_q_heads * hd, d),
+                        q_norm=jnp.ones((L, hd), dt),
+                        k_norm=jnp.ones((L, hd), dt),
+                    ),
+                    ln2=jnp.ones((L, d), dt),
+                    mlp=TPMLPParams(
+                        w1=w1, w2=rnd(next(ks), L, cfg.intermediate_size, d)
+                    ),
                 ),
-                ln2=jnp.ones((L, d), dt),
-                mlp=TPMLPParams(w1=w1, w2=rnd(next(ks), L, cfg.intermediate_size, d)),
-            ),
-            norm=jnp.ones((d,), dt),
-            lm_head=rnd(next(ks), d, cfg.vocab_size),
-        )
-        return self.set_params(params)
+                norm=jnp.ones((d,), dt),
+                lm_head=rnd(next(ks), d, cfg.vocab_size),
+            )
 
-    def set_params(self, params: Qwen3Params) -> Qwen3Params:
+        return self._set_params_jit(build, key)
+
+    def _pad_lm_head(self, params: Qwen3Params) -> Qwen3Params:
         # Pad the LM head's vocab axis to a multiple of 128·tp: each
         # shard's column count becomes a 128-multiple, so tiled kernels
         # (the megakernel's wide lm stream) stay lane-aligned under TP
         # (Qwen3's 151936 = 2^7·1187 leaves a 64/96/48 residue at
         # tp=2/4/8). ``_logits`` slices the pads back off — zero-weight
         # columns would otherwise score 0 and could beat real logits.
-        n = self.ctx.axis_size(self.axis)
+        align = 128 * self.ctx.axis_size(self.axis)
         v = params.lm_head.shape[1]
-        align = 128 * n
         vp = -(-v // align) * align
         if vp != v:
             params = dataclasses.replace(
                 params, lm_head=jnp.pad(params.lm_head, ((0, 0), (0, vp - v)))
             )
-        self.params = jax.tree.map(
-            lambda x, s: jax.device_put(x, self.ctx.sharding(*s)),
-            params,
+        return params
+
+    @property
+    def param_shardings(self):
+        return jax.tree.map(
+            lambda s: self.ctx.sharding(*s),
             self.param_specs,
             is_leaf=lambda x: isinstance(x, P),
+        )
+
+    def _set_params_jit(self, build, key: jax.Array) -> Qwen3Params:
+        """Generate + pad + shard the whole param pytree in ONE compiled
+        program, executed device-side. Random init was previously ~50
+        eager ops; on the axon relay each eager dispatch is a remote
+        compile round trip, which blew the bench's init budget (and
+        correlates with relay wedges). One jit = one compile, and the
+        weights never transit the host."""
+        self.params = jax.jit(
+            lambda k: self._pad_lm_head(build(k)),
+            out_shardings=self.param_shardings,
+        )(key)
+        return self.params
+
+    def set_params(self, params: Qwen3Params) -> Qwen3Params:
+        params = self._pad_lm_head(params)
+        self.params = jax.tree.map(
+            jax.device_put, params, self.param_shardings
         )
         return self.params
 

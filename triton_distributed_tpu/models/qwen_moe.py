@@ -54,47 +54,52 @@ class Qwen3MoE(Qwen3):
         n = self.ctx.axis_size(self.axis)
         L, d = cfg.num_layers, cfg.hidden_size
         e, f = cfg.num_experts, cfg.moe_intermediate_size
-        ks = iter(jax.random.split(key, 12))
         dt = cfg.dtype
-
-        def rnd(kk, *shape, scale):
-            return (jax.random.normal(kk, shape, jnp.float32) * scale).astype(dt)
-
         hd = cfg.head_dim
-        wq = rnd(next(ks), L, d, cfg.num_q_heads * hd, scale=d**-0.5)
-        wk = rnd(next(ks), L, d, cfg.num_kv_heads * hd, scale=d**-0.5)
-        wv = rnd(next(ks), L, d, cfg.num_kv_heads * hd, scale=d**-0.5)
-        gate = rnd(next(ks), L, e, d, f, scale=d**-0.5)
-        up = rnd(next(ks), L, e, d, f, scale=d**-0.5)
-        w1 = jnp.concatenate(
-            [
-                gate.reshape(L, e, d, n, f // n),
-                up.reshape(L, e, d, n, f // n),
-            ],
-            axis=4,
-        ).reshape(L, e, d, 2 * f)
-        params = Qwen3Params(
-            embed=rnd(next(ks), cfg.vocab_size, d, scale=0.02),
-            layers=Qwen3LayerParams(
-                ln1=jnp.ones((L, d), dt),
-                attn=TPAttnParams(
-                    wqkv=_fuse_by_shard([wq, wk, wv], n),
-                    wo=rnd(next(ks), L, cfg.num_q_heads * hd, d,
-                           scale=(cfg.num_q_heads * hd) ** -0.5),
-                    q_norm=jnp.ones((L, hd), dt),
-                    k_norm=jnp.ones((L, hd), dt),
+
+        def build(key):
+            ks = iter(jax.random.split(key, 12))
+
+            def rnd(kk, *shape, scale):
+                return (
+                    jax.random.normal(kk, shape, jnp.float32) * scale
+                ).astype(dt)
+
+            wq = rnd(next(ks), L, d, cfg.num_q_heads * hd, scale=d**-0.5)
+            wk = rnd(next(ks), L, d, cfg.num_kv_heads * hd, scale=d**-0.5)
+            wv = rnd(next(ks), L, d, cfg.num_kv_heads * hd, scale=d**-0.5)
+            gate = rnd(next(ks), L, e, d, f, scale=d**-0.5)
+            up = rnd(next(ks), L, e, d, f, scale=d**-0.5)
+            w1 = jnp.concatenate(
+                [
+                    gate.reshape(L, e, d, n, f // n),
+                    up.reshape(L, e, d, n, f // n),
+                ],
+                axis=4,
+            ).reshape(L, e, d, 2 * f)
+            return Qwen3Params(
+                embed=rnd(next(ks), cfg.vocab_size, d, scale=0.02),
+                layers=Qwen3LayerParams(
+                    ln1=jnp.ones((L, d), dt),
+                    attn=TPAttnParams(
+                        wqkv=_fuse_by_shard([wq, wk, wv], n),
+                        wo=rnd(next(ks), L, cfg.num_q_heads * hd, d,
+                               scale=(cfg.num_q_heads * hd) ** -0.5),
+                        q_norm=jnp.ones((L, hd), dt),
+                        k_norm=jnp.ones((L, hd), dt),
+                    ),
+                    ln2=jnp.ones((L, d), dt),
+                    mlp=TPMoEParams(
+                        w_router=rnd(next(ks), L, d, e, scale=d**-0.5),
+                        w1=w1,
+                        w2=rnd(next(ks), L, e, f, d, scale=f**-0.5),
+                    ),
                 ),
-                ln2=jnp.ones((L, d), dt),
-                mlp=TPMoEParams(
-                    w_router=rnd(next(ks), L, d, e, scale=d**-0.5),
-                    w1=w1,
-                    w2=rnd(next(ks), L, e, f, d, scale=f**-0.5),
-                ),
-            ),
-            norm=jnp.ones((d,), dt),
-            lm_head=rnd(next(ks), d, cfg.vocab_size, scale=d**-0.5),
-        )
-        return self.set_params(params)
+                norm=jnp.ones((d,), dt),
+                lm_head=rnd(next(ks), d, cfg.vocab_size, scale=d**-0.5),
+            )
+
+        return self._set_params_jit(build, key)
 
 
 def load_hf_moe_state_dict(

@@ -225,12 +225,18 @@ def _ag_gemm_kernel(
         def _():
             stage(s, i + 1, chunk=order_ref[s]).start()
 
+    # n == 1: the next-chunk block is unreachable (s+1 < n never holds),
+    # but Mosaic still compiles the body — where the arrival scan
+    # constant-folds to a -1 semaphore index and trips a lowering check
+    # (`d >> 32 == 0` seen on-chip). Don't emit it at all.
     @pl.when(
         jnp.logical_and(
             i == num_i - 1, jnp.logical_and(s + 1 < n, j == num_j - 1)
         )
     )
     def _prefetch_next_chunk():
+        if n == 1:
+            return
         # Arrival fence + first-tile stage for the next chunk, placed
         # after this step's last tile is issued so the blocking wait sits
         # at the end of the step's compute, not ahead of it (keeps the
