@@ -119,9 +119,19 @@ def _emit(fh, obj) -> None:
     os.fsync(fh.fileno())
 
 
-def run_ladder(progress_fh, on_tpu: bool, skip: frozenset[str]) -> None:
+def run_ladder(
+    progress_fh,
+    on_tpu: bool,
+    skip: frozenset[str],
+    model_name: str | None = None,
+) -> None:
     """Run the decode ladder, emitting one JSON line per event (worker
-    body; also called in-process for the CPU fallback)."""
+    body; also called in-process for the CPU fallback).
+
+    ``model_name`` may carry a ``+lite`` suffix: same geometry but
+    num_layers=8 / vocab 32768 — the relay-gentle fallback used when
+    full-model init wedged the relay (a reduced-model TPU ladder beats
+    a CPU fallback as round evidence)."""
     if not on_tpu:
         os.environ["XLA_FLAGS"] = (
             os.environ.get("XLA_FLAGS", "")
@@ -141,10 +151,16 @@ def run_ladder(progress_fh, on_tpu: bool, skip: frozenset[str]) -> None:
     _emit(progress_fh, {"start": "init"})
     ctx = initialize_distributed(tp=1, devices=jax.devices()[:1])
     _emit(progress_fh, {"init_phase": "ctx"})
-    model_name = "Qwen/Qwen3-0.6B" if on_tpu else "tiny"
+    model_name = model_name or ("Qwen/Qwen3-0.6B" if on_tpu else "tiny")
+    overrides = {}
+    if model_name.endswith("+lite"):
+        overrides = {"num_layers": 8, "vocab_size": 32768}
     # init is one jitted device-side program (no bulk weight transfer
     # over the relay — see Qwen3._set_params_jit).
-    model = AutoLLM.from_pretrained(model_name, ctx=ctx, max_length=1024)
+    model = AutoLLM.from_pretrained(
+        model_name.removesuffix("+lite"), ctx=ctx, max_length=1024,
+        **overrides,
+    )
     jax.block_until_ready(model.params)
     _emit(progress_fh, {"init_phase": "params"})
     cfg = model.cfg
@@ -347,10 +363,13 @@ def run_ladder(progress_fh, on_tpu: bool, skip: frozenset[str]) -> None:
     _emit(progress_fh, {"done": True})
 
 
-def _watch_worker(progress_path: str, skip: frozenset[str]) -> tuple[bool, str | None]:
+def _watch_worker(
+    progress_path: str, skip: frozenset[str], model: str
+) -> tuple[bool, str | None]:
     """Launch a TPU worker and watchdog its progress file. Returns
     ``(finished, hung_rung)`` — ``hung_rung`` names the rung being run
-    when progress stalled (None if the stall was during init)."""
+    when progress stalled (``"__init__"`` for an init-phase stall, None
+    when the worker died on its own)."""
     with open(progress_path, "a") as fh:
         fh.write("")  # ensure exists
     # Hang attribution must only look at THIS attempt's events — a
@@ -359,7 +378,8 @@ def _watch_worker(progress_path: str, skip: frozenset[str]) -> tuple[bool, str |
     # rung skipped, wrong timeout applied).
     n_before = len(_read_events(progress_path))
     argv = [sys.executable, os.path.abspath(__file__), "--worker",
-            progress_path, "--skip", ",".join(sorted(skip))]
+            progress_path, "--skip", ",".join(sorted(skip)),
+            "--model", model]
     proc = subprocess.Popen(
         argv, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL
     )
@@ -409,7 +429,7 @@ def _watch_worker(progress_path: str, skip: frozenset[str]) -> tuple[bool, str |
             limit = starts[-1].get("budget_s", _RUNG_TIMEOUT_S)
         if time.time() - last_change > limit:
             _reap(kill=True)
-            return False, None if current in (None, "init") else current
+            return False, "__init__" if current in (None, "init") else current
 
 
 def _read_events(progress_path: str) -> list[dict]:
@@ -431,11 +451,12 @@ def _read_events(progress_path: str) -> list[dict]:
 def main() -> int:
     if len(sys.argv) > 1 and sys.argv[1] == "--worker":
         progress_path = sys.argv[2]
-        skip = frozenset(
-            s for s in sys.argv[4].split(",") if s
-        ) if len(sys.argv) > 4 else frozenset()
+        flags = dict(zip(sys.argv[3::2], sys.argv[4::2]))
+        skip = frozenset(s for s in flags.get("--skip", "").split(",") if s)
         with open(progress_path, "a") as fh:
-            run_ladder(fh, on_tpu=True, skip=skip)
+            run_ladder(
+                fh, on_tpu=True, skip=skip, model_name=flags.get("--model")
+            )
         return 0
 
     import tempfile
@@ -450,17 +471,31 @@ def main() -> int:
     if on_tpu:
         done: set[str] = set()
         hang_counts: dict[str, int] = {}
+        model = os.environ.get("TDT_BENCH_MODEL", "Qwen/Qwen3-0.6B")
         for attempt in range(_WORKER_ATTEMPTS):
             if time.time() - t_start > _GLOBAL_DEADLINE_S:
                 sys.stderr.write("[bench] global deadline reached\n")
                 break
             skip = done | {r for r, c in hang_counts.items() if c >= 2}
-            finished, hung = _watch_worker(progress_path, frozenset(skip))
+            finished, hung = _watch_worker(
+                progress_path, frozenset(skip), model
+            )
             events = _read_events(progress_path)
             done = {e["rung"] for e in events if "rung" in e and "ms" in e}
             if finished:
                 break
-            if hung:
+            if hung == "__init__":
+                sys.stderr.write("[bench] init stalled; re-probing\n")
+                if not done and not model.endswith("+lite"):
+                    # Full-model first contact wedged before any rung
+                    # landed — drop to the relay-gentle lite config so
+                    # the round still gets a platform:tpu ladder. Hangs
+                    # observed under the full model say nothing about
+                    # the lite one; let it try every rung afresh.
+                    model += "+lite"
+                    hang_counts.clear()
+                    sys.stderr.write(f"[bench] falling back to {model}\n")
+            elif hung:
                 hang_counts[hung] = hang_counts.get(hung, 0) + 1
                 sys.stderr.write(f"[bench] rung {hung} hung; re-probing\n")
             # Mid-run re-probe (VERDICT r3 task 1): don't relaunch into
@@ -502,7 +537,9 @@ def main() -> int:
         for rung, count in hang_counts.items():
             if rung not in ladder and rung not in errors:
                 errors[rung] = f"hung (killed by watchdog) x{count}"
-    init = next((e["init"] for e in events if "init" in e), None)
+    # LAST init event: after a +lite fallback the surviving worker's
+    # init (model name, param bytes) is the one the summary describes.
+    init = next((e["init"] for e in reversed(events) if "init" in e), None)
     cross = next(
         (e for e in events if e.get("cross_check") == "mega_multi"), None
     )
@@ -522,8 +559,13 @@ def main() -> int:
     ms = ladder[best_name]
     # Bandwidth roofline: weights read once per step + KV context read.
     gbs = (init["param_bytes"] + init["kv_bytes"]) / (ms * 1e-3) / 1e9
+    # Name the metric after the model the surviving worker actually ran
+    # (may be the +lite fallback): Qwen/Qwen3-0.6B -> qwen3_0.6b,
+    # Qwen/Qwen3-0.6B+lite -> qwen3_0.6b_lite, tiny -> qwen3_tiny.
+    mname = init["model"].split("/")[-1].lower()
+    mname = mname.removeprefix("qwen3-").replace("+", "_").replace("-", "_")
     out = {
-        "metric": f"qwen3_{'0.6b' if on_tpu else 'tiny'}_decode_ms_per_step",
+        "metric": f"qwen3_{mname}_decode_ms_per_step",
         "value": round(ms, 3),
         "unit": "ms",
         "vs_baseline": round(gbs / init["peak_gbs"], 4),

@@ -111,3 +111,80 @@ def test_translate_rank(ctx2x4):
         assert out[w, 0] == dp * 4 + 2      # tp-peer 2's world rank
         assert out[w, 1] == 2               # round-trip back to tp team
         assert out[w, 2] == w               # own tp rank → own world rank
+
+
+def test_team_rank_tuple(ctx2x4):
+    """Axis-tuple team identity (parity: nvshmem_team_my_pe / n_pes,
+    ``libnvshmem_device.py:130,1199``): rank over ("dp","tp") is the
+    row-major world rank; num_ranks is the team size."""
+    def body():
+        me = dl.team_my_pe(("dp", "tp"))
+        n = jnp.int32(dl.team_n_pes(("dp", "tp")))
+        return jnp.stack([me, n])[None]
+
+    out = np.asarray(ctx2x4.shard_map(body, in_specs=(), out_specs=P(("dp", "tp")))())
+    out = out.reshape(8, 2)
+    np.testing.assert_array_equal(out[:, 0], np.arange(8))
+    np.testing.assert_array_equal(out[:, 1], 8)
+
+
+def test_signal_set_wait_until(ctx4):
+    """SET-mode value-carrying signal + cmp wait (parity:
+    ``nvshmemx_signal_op(..., SIGNAL_SET)`` + ``signal_wait_until``,
+    ``libnvshmem_device.py:756-804``).
+
+    Two single-set phases per device, left-neighbor publisher: phase 1
+    publishes ``10 + me`` (wait eq), phase 2 publishes ``20 + me``
+    (wait ge). Each phase owns its flag slot + semaphore — same-path
+    puts may land out of order, so a shared slot would let phase 2's
+    set satisfy phase 1's wait and deadlock phase 2 (the reason the
+    reference double-buffers LL flags by call count; see the
+    ``wait_until`` docstring).
+    """
+
+    def kernel(o_ref, flag1, flag2, stage_ref, send_sem, recv1, recv2):
+        me = dl.rank("tp")
+        n = dl.num_ranks("tp")
+        right = jax.lax.rem(me + 1, n)
+        left = jax.lax.rem(me - 1 + n, n)
+        dl.barrier_all("tp")  # peers' flag buffers allocated
+        # Phase 1: set right's flag to 10 + me, so each rank's own flag
+        # arrives as 10 + left.
+        dma1 = dl.signal_set(
+            10 + me, stage_ref, flag1, right, send_sem, recv1, "tp"
+        )
+        got1 = dl.wait_until(flag1, recv1, 10 + left, cmp="eq")
+        dma1.wait_send()
+        # Phase 2: fresh slot; wait is a ge.
+        dma2 = dl.signal_set(
+            20 + me, stage_ref, flag2, right, send_sem, recv2, "tp"
+        )
+        got2 = dl.wait_until(flag2, recv2, 20, cmp="ge")
+        dma2.wait_send()
+        o_ref[0, 0] = got1
+        o_ref[0, 1] = got2
+
+    def body():
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((1, 2), jnp.int32),
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=[
+                pltpu.VMEM((1, 1), jnp.int32),
+                pltpu.VMEM((1, 1), jnp.int32),
+                pltpu.VMEM((1, 1), jnp.int32),
+                pltpu.SemaphoreType.DMA,
+                pltpu.SemaphoreType.DMA,
+                pltpu.SemaphoreType.DMA,
+            ],
+            compiler_params=pltpu.CompilerParams(
+                has_side_effects=True, collective_id=0
+            ),
+            interpret=ctx4.pallas_interpret(),
+        )()
+
+    f = jax.jit(ctx4.shard_map(body, in_specs=(), out_specs=P("tp", None)))
+    out = np.asarray(f())
+    left = (np.arange(4) - 1) % 4
+    np.testing.assert_array_equal(out[:, 0], 10 + left)
+    np.testing.assert_array_equal(out[:, 1], 20 + left)

@@ -20,8 +20,12 @@ from triton_distributed_tpu.language.primitives import (  # noqa: F401
     request,
     serve_get,
     signal,
+    signal_set,
     straggle_if_rank,
+    team_my_pe,
+    team_n_pes,
     translate_rank,
     wait,
     wait_recv,
+    wait_until,
 )

@@ -12,7 +12,9 @@ Mapping (see SURVEY.md §2.4):
 | reference (NVSHMEM/Triton)       | here (Pallas/Mosaic over ICI)           |
 |----------------------------------|-----------------------------------------|
 | ``dl.rank()`` / ``num_ranks``    | ``rank(axis)`` / ``num_ranks(axis)``    |
-| ``dl.notify(rank, sem, SET/ADD)``| ``signal(sem, inc, dst=...)``           |
+| ``dl.notify(rank, sem, ADD)``    | ``signal(sem, inc, dst=...)``           |
+| ``dl.notify(rank, sig, SET)``    | ``signal_set(value, ...)`` (value-      |
+| + ``signal_wait_until(cmp, v)``  | carrying put) + ``wait_until(cmp, v)``  |
 | ``dl.wait(sem, n)`` + token      | ``wait(sem, n)`` (ordering is by       |
 |                                  | semaphore dataflow, no token needed —   |
 |                                  | Mosaic orders the dependent DMA/loads)  |
@@ -49,7 +51,9 @@ from jax.experimental.pallas import tpu as pltpu
 # -- identity ---------------------------------------------------------------
 
 def rank(axis: str | Sequence[str]) -> jax.Array:
-    """This device's index along ``axis`` (parity: ``dl.rank``)."""
+    """This device's index along ``axis`` (parity: ``dl.rank``; with an
+    axis tuple this is the row-major team rank —
+    ``nvshmem_team_my_pe`` for teams-as-axis-tuples)."""
     return jax.lax.axis_index(axis)
 
 
@@ -61,6 +65,13 @@ def num_ranks(axis: str | Sequence[str]) -> int:
     for a in axis:
         out *= jax.lax.axis_size(a)
     return out
+
+
+# Teams are mesh axes (or axis tuples); the NVSHMEM team API maps to
+# the same three calls the reference exposes on devices
+# (``libnvshmem_device.py:130,1199-1343``):
+team_my_pe = rank
+team_n_pes = num_ranks
 
 
 def translate_rank(
@@ -141,6 +152,86 @@ def wait(sem, value: int | jax.Array = 1):
 def read(sem) -> jax.Array:
     """Non-blocking semaphore read (parity: spin-poll fast paths)."""
     return pltpu.semaphore_read(sem)
+
+
+def signal_set(
+    value: jax.Array,
+    stage_ref,
+    flag_ref,
+    dst: jax.Array | int,
+    send_sem,
+    recv_sem,
+    axis: str,
+):
+    """Publish a VALUE to a peer's flag — SET-mode signaling (parity:
+    ``nvshmemx_signal_op(..., NVSHMEM_SIGNAL_SET, pe)``,
+    ``libnvshmem_device.py:756``).
+
+    Mosaic semaphores are pure counters, so a value-carrying signal is a
+    tiny put: ``value`` is staged into the local ``stage_ref`` and
+    DMA'd into the peer's symmetric ``flag_ref``; the DMA's recv
+    semaphore is the arrival notification (data lands before the
+    signal, same ordering NVSHMEM guarantees). Both refs are ``(1, 1)``
+    int32 buffers. Single writer per flag, as with NVSHMEM SET — two
+    racing setters leave the last writer's value.
+
+    Returns the started DMA (``.wait_send()`` to reuse ``stage_ref``).
+    """
+    stage_ref[0, 0] = value
+    return put_signal(
+        stage_ref, flag_ref, dst, send_sem, recv_sem, axis=axis
+    )
+
+
+def wait_until(flag_ref, recv_sem, value: jax.Array | int, cmp: str = "ge"):
+    """Block until this rank's flag, published via :func:`signal_set`,
+    satisfies ``flag <cmp> value``; returns the flag's final value.
+
+    Parity: ``nvshmem_signal_wait_until(sig, NVSHMEM_CMP_{GE,EQ,GT,NE},
+    value)`` (``libnvshmem_device.py:782``). NVSHMEM spin-reads the
+    flag; here each check is gated on one DMA arrival (a spin would
+    burn the issue stream), CONSUME-FIRST: the wait always drains at
+    least one set, then keeps draining until the comparison holds.
+    Checking the flag before the first arrival instead would race — a
+    set landing just before the check would satisfy it without being
+    consumed, leaking its arrival count nondeterministically.
+
+    Consequences for protocol design (the epoch-publication pattern,
+    e.g. the LL a2a's per-call-count phase flags,
+    ``low_latency_all_to_all.py:36-125``):
+    - each ``wait_until`` phase must pair with a set whose value makes
+      the condition true — an already-satisfying stale flag does NOT
+      exit the wait;
+    - leak-free exactly when the satisfying set is the phase's last
+      (single-set phases trivially; monotone multi-set runs when
+      same-path DMA completion is in order);
+    - do NOT reuse one flag+semaphore pair across phases: same-path
+      puts may land out of order (observed in the interpreter), so a
+      later phase's set can satisfy an earlier wait, strand the earlier
+      value, and deadlock the later wait. Give each phase its own flag
+      slot — the reference double-buffers its LL flags by call count
+      for the same reason (``low_latency_all_to_all.py:95-125``).
+    """
+    cmps = {
+        "ge": lambda v: v >= value,
+        "gt": lambda v: v > value,
+        "eq": lambda v: v == value,
+        "ne": lambda v: v != value,
+    }
+    try:
+        ok = cmps[cmp]
+    except KeyError:
+        raise ValueError(f"cmp must be one of {sorted(cmps)}, got {cmp!r}")
+
+    def cond(satisfied):
+        return jnp.logical_not(satisfied)
+
+    def body(_):
+        wait_recv(recv_sem, flag_ref)  # one more set has landed
+        return ok(flag_ref[0, 0])
+
+    jax.lax.while_loop(cond, body, jnp.bool_(False))
+    return flag_ref[0, 0]
 
 
 # -- remote DMA -------------------------------------------------------------
