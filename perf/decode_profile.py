@@ -1,0 +1,162 @@
+"""Per-component decode-step profile — locate the ms/step gap on chip.
+
+The round-3 ladder measured (v5e, chained 32-step jit):
+jit 5.10 / pallas 5.43 / mega 4.31 / mega_multi 4.27 ms/step, vs the
+~1.8 ms HBM floor (1.19 GB bf16 weights at the probe-measured
+667 GB/s). This harness times each weight-streaming component of the
+Qwen3-0.6B decode step IN ISOLATION (chained in one fori_loop with a
+data dependency so per-launch relay tax amortizes and XLA cannot CSE
+the iterations), yielding achieved GB/s per matvec shape. The sum of
+component floors vs the measured full-step rungs splits the gap into
+"shape-level inefficiency" (XLA/Mosaic matvec quality per weight
+matrix) vs "step-level overhead" (everything between the matmuls:
+norms, rope, attention, collectives, scheduling).
+
+Decode analog of the reference's per-op perf models
+(``kernels/nvidia/gemm_perf_model.py:247`` — analytic floors used to
+explain measured ladders, ``docs/mega_triton_kernel.md:27-37``).
+
+Usage: python perf/decode_profile.py [--steps 64] [--out -]
+"""
+
+import argparse
+import functools
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Qwen3-0.6B geometry (models/config.py PRESETS) at tp=1.
+D, F, HQ, HKV, HD, L = 1024, 3072, 16, 8, 128, 28
+V_PAD = 152064  # vocab 151936 padded to 128·tp by set_params
+
+COMPONENTS = {
+    # name: (d_in, d_out, per-layer count)
+    "qkv": (D, HQ * HD + 2 * HKV * HD, L),
+    "o_proj": (HQ * HD, D, L),
+    "mlp_in": (D, 2 * F, L),   # fused gate+up
+    "mlp_down": (F, D, L),
+    "lm_head": (D, V_PAD, 1),
+}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--steps", type=int, default=64,
+                   help="chained iterations per component")
+    p.add_argument("--batch", type=int, default=1)
+    p.add_argument("--out", default="-")
+    p.add_argument("--cpu", action="store_true",
+                   help="force the CPU backend (smoke only; the env's "
+                        "sitecustomize pins the TPU plugin, which HANGS "
+                        "during a relay outage — env vars are ignored, "
+                        "only jax.config reaches it in time)")
+    args = p.parse_args(argv)
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from triton_distributed_tpu.runtime.utils import median_time
+
+    out = sys.stdout if args.out == "-" else open(args.out, "a")
+
+    def emit(rec):
+        out.write(json.dumps(rec) + "\n")
+        out.flush()
+
+    platform = jax.devices()[0].platform
+    emit({"profile": "decode_components",
+          "device": jax.devices()[0].device_kind, "platform": platform,
+          "steps": args.steps, "batch": args.batch})
+
+    # Fixed per-execution cost (relay round-trip + dispatch + fetch):
+    # timed on a trivial program. Every "total/steps" number in the
+    # round carries RT/steps of this on top of true device time; the
+    # slope timings below subtract it out instead.
+    triv = jax.jit(lambda x: x + 1)
+    x8 = jnp.zeros((8, 128))
+    np.asarray(triv(x8))
+    rt = median_time(lambda: np.asarray(triv(x8)))
+    emit({"component": "fixed_dispatch_roundtrip", "ms": round(rt * 1e3, 3)})
+
+    # Device-side init: bulk host->device transfers over the axon relay
+    # are slow and have wedged it (round-3 session notes; same reason
+    # Qwen3._set_params_jit exists).
+    key = jax.random.PRNGKey(0)
+
+    def timed_matvec(d_in, d_out):
+        w = jax.jit(
+            lambda k: jax.random.normal(k, (d_in, d_out), jnp.bfloat16) * 0.02
+        )(key)
+        x0 = jax.jit(
+            lambda k: jax.random.normal(k, (args.batch, d_in), jnp.bfloat16)
+        )(key)
+        jax.block_until_ready((w, x0))
+
+        @functools.partial(jax.jit, static_argnums=2)
+        def chain(x, w, steps):
+            def body(_, x):
+                y = jnp.dot(x, w, preferred_element_type=jnp.float32)
+                # Data dependency: next x depends on the FULL product
+                # (sum fences every output column) but stays d_in-wide.
+                return x + (jnp.sum(y) * jnp.bfloat16(1e-8)).astype(x.dtype)
+
+            return jax.lax.fori_loop(0, steps, body, x)
+
+        # Slope timing: (T(2s) - T(s)) / s cancels the fixed dispatch
+        # round-trip that total/steps folds in.
+        t1 = median_time(lambda: np.asarray(chain(x0, w, args.steps)))
+        t2 = median_time(lambda: np.asarray(chain(x0, w, 2 * args.steps)))
+        sec = (t2 - t1) / args.steps
+        # Relay noise can push the slope to ~0 or negative; flag it
+        # rather than report absurd bandwidth.
+        return sec, int(w.size * 2), sec * args.steps < 0.2 * t1
+
+    total_floor_ms = 0.0
+    for name, (d_in, d_out, count) in COMPONENTS.items():
+        sec, wbytes, noisy = timed_matvec(d_in, d_out)
+        ms_step = sec * 1e3 * count
+        total_floor_ms += ms_step
+        rec = {"component": name, "shape": [d_in, d_out], "count": count,
+               "ms_per_call": round(sec * 1e3, 4),
+               "achieved_gbs": round(wbytes / max(sec, 1e-9) / 1e9, 1),
+               "ms_per_step_total": round(ms_step, 4)}
+        if noisy:
+            rec["unreliable"] = "slope < 20% of base time — relay noise"
+        emit(rec)
+
+    # HBM stream anchor: one big reduction (pure read bandwidth, no MXU).
+    big = jax.jit(
+        lambda k: jax.random.normal(k, (64, 1024, 4096), jnp.bfloat16)
+    )(key)
+    jax.block_until_ready(big)
+
+    @jax.jit
+    def stream(x):
+        return jnp.sum(x, dtype=jnp.float32)
+
+    sec = median_time(lambda: np.asarray(stream(big)))
+    emit({"component": "hbm_stream", "bytes": int(big.size * 2),
+          "achieved_gbs": round(big.size * 2 / sec / 1e9, 1)})
+
+    # KV-attention bytes are small at ctx=512 (~30 MB) but the gather +
+    # softmax pipeline has fixed cost; time one flash-decode call class.
+    emit({
+        "summary": {
+            "matvec_floor_ms_per_step": round(total_floor_ms, 3),
+            "note": ("floor = sum of isolated matvec times; the full-"
+                     "step rungs add norms/rope/attention/feedback — "
+                     "compare with bench.py ladder"),
+        }
+    })
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

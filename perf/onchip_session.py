@@ -80,15 +80,23 @@ STEPS = [
                      "--m", "2048", "--k", "1024", "--n", "2048",
                      "--iters", "4"], 600),
     ("ep_overhead", [sys.executable, "perf/ep_a2a_overhead.py"], 600),
+    # Slope-timed per-component decode profile: splits the measured
+    # ladder's ms/step into per-matvec floors + fixed dispatch cost
+    # (the number that decides where megakernel tuning goes next).
+    ("decode_profile", [sys.executable, "perf/decode_profile.py"], 900),
     ("adaptive_ag", [sys.executable, "-c", _ADAPTIVE_AG], 400),
     # bench.py's own worst case: ~860 s probe retries + 2700 s global
     # worker deadline + CPU fallback ladder + teardown — the step
     # timeout must sit ABOVE it or the always-emit JSON contract breaks.
     ("ladder", [sys.executable, "bench.py"], 4800),
+    # e2e burned a full 1500 s budget twice with the relay HEALTHY for
+    # part of it (03:19 run) — the torch-side checkpoint build plus the
+    # host->device weight transfer need more headroom on this 1-core
+    # host; phase markers on stderr now show where the time goes.
     ("e2e", [sys.executable, "perf/real_weights_e2e.py",
-             "--mode", "mega_multi", "--gen-len", "64"], 1500),
+             "--mode", "mega_multi", "--gen-len", "64"], 2700),
     ("sweep_full", [sys.executable, "perf/sweep_overlap_tiles.py",
-                    "--op", "gemm_rs"], 1200),
+                    "--op", "gemm_rs"], 2400),
 ]
 
 

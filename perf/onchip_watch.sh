@@ -1,0 +1,60 @@
+#!/bin/bash
+# Relay watcher: probe every ~20 min; when the relay answers, run the
+# still-pending on-chip measurement steps (perf/onchip_session.py) —
+# steps that already passed in an earlier window are dropped from the
+# queue, so a half-successful window only costs the remainder. Appends
+# to perf/onchip_loop.log (gitignored scratch; results land in
+# perf/ONCHIP_r3.jsonl via onchip_session).
+#
+# Usage: nohup bash perf/onchip_watch.sh STEPS... >/dev/null 2>&1 &
+cd "$(dirname "$0")/.." || exit 1
+LOG=perf/onchip_loop.log
+# Steps may be given as separate args or comma-joined; normalize to the
+# comma form pending()/onchip_session expect.
+QUEUE=$(IFS=,; echo "${*:-decode_profile,ep_overhead,e2e,sweep_full}")
+SINCE=$(date +%s)
+
+pending() {
+  python - "$QUEUE" "$SINCE" <<'EOF'
+import json, sys
+queue, since = sys.argv[1].split(","), float(sys.argv[2])
+done = set()
+try:
+    for line in open("perf/ONCHIP_r3.jsonl"):
+        try:
+            r = json.loads(line)
+        except ValueError:
+            continue  # partial line from a killed writer — not "done"
+        if r.get("rc") == 0 and r.get("t_start", 0) >= since:
+            done.add(r["step"])
+except FileNotFoundError:
+    pass
+print(",".join(s for s in queue if s not in done))
+EOF
+}
+
+probe() {
+  timeout 150 python -c "
+import jax, numpy as np, jax.numpy as jnp
+assert jax.devices()[0].platform != 'cpu'
+x = jnp.ones((256, 256), jnp.bfloat16)
+assert float(np.asarray(x @ x)[0, 0]) == 256.0
+" >/dev/null 2>&1
+}
+
+echo "[watch $(date -u +%H:%M)] start, queue: $QUEUE" >>"$LOG"
+while true; do
+  REMAIN=$(pending)
+  if [ -z "$REMAIN" ]; then
+    echo "[watch $(date -u +%H:%M)] all steps green — done" >>"$LOG"
+    exit 0
+  fi
+  if probe; then
+    echo "[watch $(date -u +%H:%M)] relay UP — running: $REMAIN" >>"$LOG"
+    python perf/onchip_session.py --only "probe,$REMAIN" >>"$LOG" 2>&1
+    echo "[watch $(date -u +%H:%M)] window done (rc=$?)" >>"$LOG"
+  else
+    echo "[watch $(date -u +%H:%M)] relay down" >>"$LOG"
+  fi
+  sleep 1140
+done

@@ -28,6 +28,8 @@ import sys
 import tempfile
 import time
 
+_T0 = time.perf_counter()  # process start — anchors the first phase marker
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
@@ -80,11 +82,23 @@ def main(argv=None) -> int:
     from triton_distributed_tpu.models import AutoLLM, Engine
     from triton_distributed_tpu.runtime.mesh import initialize_distributed
 
+    # Phase progress on stderr (flushed): a step-timeout kill then still
+    # shows WHERE the time went — the 03:19 on-chip session burned its
+    # whole 1500 s budget with zero output.
+    def phase(name, t0=[_T0]):
+        now = time.perf_counter()
+        print(f"[e2e +{now - t0[0]:.0f}s] {name}", file=sys.stderr, flush=True)
+        t0[0] = now
+
+    phase("imports done; building HF checkpoint (torch, 1 core)")
     ckpt = build_checkpoint(args.full)
+    phase("checkpoint saved; initializing device context")
     ctx = initialize_distributed(tp=1, devices=jax.devices()[:1])
+    phase("ctx up; AutoLLM.from_pretrained (safetensors -> device)")
     t0 = time.perf_counter()
     model = AutoLLM.from_pretrained(ckpt, ctx=ctx, max_length=1024)
     load_s = time.perf_counter() - t0
+    phase("params loaded; building Engine")
 
     mode = args.mode
     if mode == "mega_multi":
@@ -99,6 +113,7 @@ def main(argv=None) -> int:
     t0 = time.perf_counter()
     out = eng.serve(prompt, gen_len=args.gen_len)
     cold_wall = time.perf_counter() - t0
+    phase(f"cold serve done ({cold_wall:.0f}s incl. compiles); timed serve")
     t0 = time.perf_counter()
     out2 = eng.serve(prompt, gen_len=args.gen_len)
     wall = time.perf_counter() - t0
