@@ -31,7 +31,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # Qwen3-0.6B geometry (models/config.py PRESETS) at tp=1.
 D, F, HQ, HKV, HD, L = 1024, 3072, 16, 8, 128, 28
-V_PAD = 152064  # vocab 151936 padded to 128·tp by set_params
+# lm_head width at tp=1: 151936 is already 128-aligned, so _pad_lm_head
+# (models/qwen.py) adds nothing. (tp>1 pads to a 128·tp multiple —
+# recompute, don't reuse this constant, for a tp>1 profile.)
+V_PAD = 151936
 
 COMPONENTS = {
     # name: (d_in, d_out, per-layer count)
@@ -119,15 +122,18 @@ def main(argv=None) -> int:
         return sec, int(w.size * 2), sec * args.steps < 0.2 * t1
 
     total_floor_ms = 0.0
+    any_noisy = False
     for name, (d_in, d_out, count) in COMPONENTS.items():
         sec, wbytes, noisy = timed_matvec(d_in, d_out)
-        ms_step = sec * 1e3 * count
+        ms_step = max(sec, 0.0) * 1e3 * count
         total_floor_ms += ms_step
         rec = {"component": name, "shape": [d_in, d_out], "count": count,
                "ms_per_call": round(sec * 1e3, 4),
-               "achieved_gbs": round(wbytes / max(sec, 1e-9) / 1e9, 1),
+               "achieved_gbs": (None if noisy or sec <= 0
+                                else round(wbytes / sec / 1e9, 1)),
                "ms_per_step_total": round(ms_step, 4)}
         if noisy:
+            any_noisy = True
             rec["unreliable"] = "slope < 20% of base time — relay noise"
         emit(rec)
 
@@ -147,14 +153,18 @@ def main(argv=None) -> int:
 
     # KV-attention bytes are small at ctx=512 (~30 MB) but the gather +
     # softmax pipeline has fixed cost; time one flash-decode call class.
-    emit({
-        "summary": {
-            "matvec_floor_ms_per_step": round(total_floor_ms, 3),
-            "note": ("floor = sum of isolated matvec times; the full-"
-                     "step rungs add norms/rope/attention/feedback — "
-                     "compare with bench.py ladder"),
-        }
-    })
+    summary = {
+        "matvec_floor_ms_per_step": round(total_floor_ms, 3),
+        "note": ("floor = sum of isolated matvec times; the full-"
+                 "step rungs add norms/rope/attention/feedback — "
+                 "compare with bench.py ladder"),
+    }
+    if any_noisy:
+        summary["unreliable"] = (
+            "one or more component slopes were noise-dominated; "
+            "the floor understates — re-run in a quieter window"
+        )
+    emit({"summary": summary})
     return 0
 
 

@@ -84,6 +84,9 @@ STEPS = [
     # ladder's ms/step into per-matvec floors + fixed dispatch cost
     # (the number that decides where megakernel tuning goes next).
     ("decode_profile", [sys.executable, "perf/decode_profile.py"], 900),
+    # Launch-width sweep: fits per-launch vs per-step megakernel cost
+    # (decides whether wider NS or kernel-body tuning moves the ladder).
+    ("mega_ns", [sys.executable, "perf/mega_ns_sweep.py"], 2400),
     ("adaptive_ag", [sys.executable, "-c", _ADAPTIVE_AG], 400),
     # bench.py's own worst case: ~860 s probe retries + 2700 s global
     # worker deadline + CPU fallback ladder + teardown — the step

@@ -42,6 +42,18 @@ assert float(np.asarray(x @ x)[0, 0]) == 256.0
 " >/dev/null 2>&1
 }
 
+# Fail fast on unknown step names: onchip_session --only silently drops
+# them, so a typo would loop the watcher forever without ever draining.
+python - "$QUEUE" <<'EOF' || exit 1
+import sys
+sys.path.insert(0, "perf")
+from onchip_session import STEPS
+known = {name for name, _, _ in STEPS}
+bad = [s for s in sys.argv[1].split(",") if s not in known]
+if bad:
+    sys.exit(f"unknown step(s) {bad}; known: {sorted(known)}")
+EOF
+
 echo "[watch $(date -u +%H:%M)] start, queue: $QUEUE" >>"$LOG"
 while true; do
   REMAIN=$(pending)
