@@ -1,0 +1,55 @@
+"""Shared greedy-chain runners for the megakernel sweep harnesses.
+
+One implementation of the token-chain timing loop, used by both
+``mega_ns_sweep.py`` and ``mega_tile_sweep.py`` so their fits and
+cross-checks always time the SAME computation shape as each other (and
+as ``bench.py``'s mega/mega_multi rungs, which this mirrors — bench.py
+keeps its own copy because its worker emits progress lines between
+rungs and must stay runnable when ``perf/`` is absent from the
+deployment).
+
+Import only after the jax platform is configured (both sweeps select
+CPU/TPU inside ``main`` before importing this module).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def single_step_chain(mstep, params, tok0, cache0, steps):
+    """``steps`` greedy single-step decodes chained in one jit; returns
+    ``once()`` yielding the np token chain [steps]."""
+
+    def run_n(params, tok, cache, n):
+        def body(i, carry):
+            tok, cache, seq = carry
+            logits, cache = mstep(params, tok, cache)
+            tok = jnp.argmax(logits, -1).astype(jnp.int32)
+            return tok, cache, seq.at[i].set(tok[0])
+
+        seq0 = jnp.zeros((n,), jnp.int32)
+        return jax.lax.fori_loop(0, n, body, (tok, cache, seq0))[2]
+
+    jrun = jax.jit(run_n, static_argnums=3)
+    return lambda: np.asarray(jrun(params, tok0, cache0, steps))
+
+
+def multi_step_chain(mmulti, ns, params, tok0, cache0, steps):
+    """``steps // ns`` launches of an NS-wide multi-step kernel chained
+    in one jit; returns ``once()`` yielding the np token chain [steps]."""
+    if steps % ns:
+        raise ValueError(f"ns={ns} must divide steps={steps}")
+
+    def run_n(params, tok, cache, nl):
+        def body(i, carry):
+            tok, cache, seq = carry
+            toks, _lg, cache = mmulti(params, tok, cache)
+            seq = jax.lax.dynamic_update_slice(seq, toks[:, 0], (i * ns,))
+            return toks[ns - 1], cache, seq
+
+        seq0 = jnp.zeros((nl * ns,), jnp.int32)
+        return jax.lax.fori_loop(0, nl, body, (tok, cache, seq0))[2]
+
+    jrun = jax.jit(run_n, static_argnums=3)
+    return lambda: np.asarray(jrun(params, tok0, cache0, steps // ns))

@@ -66,47 +66,20 @@ def main(argv=None) -> int:
     mega = MegaQwen3(model)
     s_max = int(cache0.k.shape[3])
 
+    from perf._chain import multi_step_chain, single_step_chain
+
     results = []
     chains = {}
     for ns in widths:
         if ns == 1:
-            mstep = mega.decode_fn(1, s_max)
-
-            def run_n(params, tok, cache, n):
-                def body(i, carry):
-                    tok, cache, seq = carry
-                    logits, cache = mstep(params, tok, cache)
-                    tok = jnp.argmax(logits, -1).astype(jnp.int32)
-                    return tok, cache, seq.at[i].set(tok[0])
-
-                seq0 = jnp.zeros((n,), jnp.int32)
-                return jax.lax.fori_loop(
-                    0, n, body, (tok, cache, seq0)
-                )[2]
-
-            jrun = jax.jit(run_n, static_argnums=3)
-
-            def once(jrun=jrun):
-                return np.asarray(jrun(model.params, tok0, cache0, steps))
+            once = single_step_chain(
+                mega.decode_fn(1, s_max), model.params, tok0, cache0, steps
+            )
         else:
-            mmulti = mega.decode_multi_fn(1, s_max, ns)
-
-            def run_n(params, tok, cache, nl, ns=ns, mmulti=mmulti):
-                def body(i, carry):
-                    tok, cache, seq = carry
-                    toks, _lg, cache = mmulti(params, tok, cache)
-                    seq = jax.lax.dynamic_update_slice(seq, toks[:, 0], (i * ns,))
-                    return toks[ns - 1], cache, seq
-
-                seq0 = jnp.zeros((nl * ns,), jnp.int32)
-                return jax.lax.fori_loop(
-                    0, nl, body, (tok, cache, seq0)
-                )[2]
-
-            jrun = jax.jit(run_n, static_argnums=3)
-
-            def once(jrun=jrun, ns=ns):
-                return np.asarray(jrun(model.params, tok0, cache0, steps // ns))
+            once = multi_step_chain(
+                mega.decode_multi_fn(1, s_max, ns), ns,
+                model.params, tok0, cache0, steps,
+            )
 
         chains[ns] = once()  # warm + token chain
         sec = median_time(lambda: once())

@@ -1,0 +1,102 @@
+"""Megakernel weight-stream sweep: (tile_n/tile_k, nbuf) on the chip.
+
+The decode ladder's floor is the per-step weight stream (~1.2 GB at
+0.6B); the r3 ladder ran it at ~280 GB/s effective vs the 667 GB/s
+probe-measured HBM rate. Two levers target the gap (see
+``MegaConfig``): wider tiles (fewer per-tile control gaps) and a
+deeper staging pipeline (``nbuf`` > 2 keeps DMAs in flight through
+those gaps). This sweep times the SAME 32-step greedy chain (NS=8
+launches, the ladder's mega_multi configuration) across configs and
+cross-checks token equality against the baseline config.
+
+Usage: python perf/mega_tile_sweep.py [--configs 1024:1024:2,1024:1024:4,...]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT = "1024:1024:2,1024:1024:4,2048:1024:2,2048:2048:4"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--configs", default=DEFAULT,
+                   help="comma list of tile_n:tile_k:nbuf")
+    p.add_argument("--steps", type=int, default=32)
+    p.add_argument("--ns", type=int, default=8)
+    p.add_argument("--model", default="Qwen/Qwen3-0.6B")
+    p.add_argument("--cpu", action="store_true")
+    args = p.parse_args(argv)
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from triton_distributed_tpu.megakernel import MegaQwen3
+    from triton_distributed_tpu.megakernel.code_generator import MegaConfig
+    from triton_distributed_tpu.models import AutoLLM
+    from triton_distributed_tpu.runtime.mesh import initialize_distributed
+    from triton_distributed_tpu.runtime.utils import median_time
+
+    ctx = initialize_distributed(tp=1, devices=jax.devices()[:1])
+    model = AutoLLM.from_pretrained(args.model, ctx=ctx, max_length=1024)
+    jax.block_until_ready(model.params)
+
+    PROMPT = 512
+    steps, ns = args.steps, args.ns
+    if steps % ns:
+        raise SystemExit(f"--ns {ns} must divide --steps {steps}")
+    cache0 = model.new_cache(1)
+    tokens = jnp.asarray(np.arange(PROMPT) % model.cfg.vocab_size, jnp.int32)
+    logits, cache0 = model.prefill(tokens, cache0, "xla")
+    tok0 = jnp.argmax(logits)[None].astype(jnp.int32)
+    s_max = int(cache0.k.shape[3])
+
+    from perf._chain import multi_step_chain
+
+    ref_chain = None
+    all_match = True
+    any_ok = False
+    for spec in args.configs.split(","):
+        tn, tk, nb = (int(v) for v in spec.split(":"))
+        label = f"tn{tn}_tk{tk}_nb{nb}"
+        try:
+            mega = MegaQwen3(
+                model, cfg=MegaConfig(tile_n=tn, tile_k=tk, nbuf=nb)
+            )
+            once = multi_step_chain(
+                mega.decode_multi_fn(1, s_max, ns), ns,
+                model.params, tok0, cache0, steps,
+            )
+            chain = once()  # compile + warm
+            if ref_chain is None:
+                ref_chain = chain
+            match = bool((chain == ref_chain).all())
+            all_match = all_match and match
+            any_ok = True
+            sec = median_time(lambda: once())
+            print(json.dumps({
+                "config": label,
+                "ms_per_step": round(sec / steps * 1e3, 3),
+                "tokens_match_baseline": match,
+            }), flush=True)
+        except Exception as e:  # keep sweeping past a failed compile
+            print(json.dumps({
+                "config": label,
+                "error": f"{type(e).__name__}: {e}"[:220],
+            }), flush=True)
+    # A mismatching config computed wrong logits — its timing must not
+    # be promotable from a green-looking run (mega_ns_sweep contract).
+    return 0 if (any_ok and all_match) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

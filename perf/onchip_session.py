@@ -87,6 +87,10 @@ STEPS = [
     # Launch-width sweep: fits per-launch vs per-step megakernel cost
     # (decides whether wider NS or kernel-body tuning moves the ladder).
     ("mega_ns", [sys.executable, "perf/mega_ns_sweep.py"], 2400),
+    # Weight-stream sweep: (tile_n/tile_k, nbuf) — the HBM-floor levers
+    # (wide tiles + deep staging) A/B'd at the ladder's mega_multi
+    # configuration; winners become MegaConfig defaults.
+    ("mega_tiles", [sys.executable, "perf/mega_tile_sweep.py"], 2400),
     ("adaptive_ag", [sys.executable, "-c", _ADAPTIVE_AG], 400),
     # bench.py's own worst case: ~860 s probe retries + 2700 s global
     # worker deadline + CPU fallback ladder + teardown — the step

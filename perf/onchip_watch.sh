@@ -44,15 +44,20 @@ assert float(np.asarray(x @ x)[0, 0]) == 256.0
 
 # Fail fast on unknown step names: onchip_session --only silently drops
 # them, so a typo would loop the watcher forever without ever draining.
-python - "$QUEUE" <<'EOF' || exit 1
+# The complaint must land in $LOG — the documented invocation discards
+# stderr, and a silent death is the very failure mode this prevents.
+if ! python - "$QUEUE" >>"$LOG" 2>&1 <<'EOF'
 import sys
 sys.path.insert(0, "perf")
 from onchip_session import STEPS
 known = {name for name, _, _ in STEPS}
 bad = [s for s in sys.argv[1].split(",") if s not in known]
 if bad:
-    sys.exit(f"unknown step(s) {bad}; known: {sorted(known)}")
+    sys.exit(f"[watch] unknown step(s) {bad}; known: {sorted(known)}")
 EOF
+then
+  exit 1
+fi
 
 echo "[watch $(date -u +%H:%M)] start, queue: $QUEUE" >>"$LOG"
 while true; do

@@ -299,6 +299,34 @@ def test_lm_head_remainder_tile(ctx4):
     )
 
 
+@pytest.mark.parametrize("nbuf", [3, 4])
+def test_deep_weight_stream_pipeline(ctx4, nbuf):
+    """nbuf > 2 staging (depth-nbuf weight-stream pipeline, the HBM
+    floor lever on chip) must be logits-exact vs the golden step —
+    covers the prologue fill, the depth-1-ahead prefetch, and the tail
+    tile joining a deeper rotation."""
+    from triton_distributed_tpu.megakernel.code_generator import MegaConfig
+
+    model = AutoLLM.from_pretrained("tiny", ctx=ctx4, vocab_size=1536)
+    cache = model.new_cache(1, max_length=64)
+    step_gold = model.decode_fn("xla")
+    for t in (3, 5):
+        _, cache = step_gold(model.params, jnp.asarray([t], jnp.int32), cache)
+    tok = jnp.asarray([7], jnp.int32)
+    logits_gold, _ = step_gold(model.params, tok, jax.tree.map(jnp.copy, cache))
+
+    # tile 256 on the 384-wide per-shard vocab → one main tile + a
+    # 128-wide TAIL tile, with the stream shorter than the pipeline at
+    # nbuf=4 — exercises the prologue covering the whole stream AND the
+    # tail joining a deeper slot rotation (the trickiest new paths).
+    mega = MegaQwen3(model, cfg=MegaConfig(tile_n=256, nbuf=nbuf))
+    logits_mega, _ = mega.decode_step(tok, cache)
+    np.testing.assert_allclose(
+        np.asarray(logits_mega), np.asarray(logits_gold),
+        rtol=2e-3, atol=2e-3,
+    )
+
+
 @pytest.fixture
 def ctx1():
     from triton_distributed_tpu.runtime import mesh as mesh_mod

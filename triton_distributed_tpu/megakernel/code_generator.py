@@ -99,14 +99,24 @@ class MegaConfig:
 
     # Defaults from a v5e sweep on Qwen3-0.6B decode (1024/1024/256 ran
     # 3.0 ms/step vs 4.1 at 512/512): wide tiles amortize the per-tile
-    # DMA turnaround in the weight streams; 2048-wide tiles fail to
-    # compile and s_blk=512 regresses the KV pipeline.
+    # DMA turnaround in the weight streams; s_blk=512 regresses the KV
+    # pipeline. (2048-wide tiles used to fail to compile — that was the
+    # 16 MB default scoped-VMEM limit, which build_mega_call now
+    # raises; they are sweepable again via perf/mega_tile_sweep.py.)
     tile_n: int = 1024
     tile_k: int = 1024
     s_blk: int = 256
+    # Weight-stream staging depth: nbuf-1 DMAs stay in flight ahead of
+    # the consuming matmul (2 = classic double buffer). The decode-step
+    # weight stream is the whole ladder's floor (~1.2 GB/step at 0.6B);
+    # with per-tile control overhead comparable to a 2 MB tile's wire
+    # time, a deeper pipeline keeps the HBM controller busy through the
+    # scalar-core gaps between tiles.
+    nbuf: int = 2
 
     def resolve(self, dims: MegaDims) -> "ResolvedConfig":
         return ResolvedConfig(
+            nbuf=max(2, self.nbuf),
             tn_qkv=pick_tile(dims.qkv_loc, self.tile_n),
             tn_fc1=pick_tile(dims.f_loc, self.tile_n),
             # The vocab axis rarely divides by a wide tile (Qwen3:
@@ -134,6 +144,7 @@ class MegaConfig:
 
 @dataclasses.dataclass(frozen=True)
 class ResolvedConfig:
+    nbuf: int
     tn_qkv: int
     tn_fc1: int
     tn_lm: int
@@ -324,8 +335,8 @@ def build_mega_call(
             pltpu.VMEM(
                 (1, 8, d) if dims.prefill else (B, 8, d), wdtype
             ),                                                 # estage
-            pltpu.VMEM((2, d, cfg.tn_max), wdtype),            # colstage
-            pltpu.VMEM((2, cfg.tk_max, d), wdtype),            # rowstage
+            pltpu.VMEM((cfg.nbuf, d, cfg.tn_max), wdtype),     # colstage
+            pltpu.VMEM((cfg.nbuf, cfg.tk_max, d), wdtype),     # rowstage
             pltpu.VMEM(
                 (1,) * 5 if dims.prefill
                 else (2, B, hkv, cfg.s_blk, hd), cdtype
@@ -341,7 +352,7 @@ def build_mega_call(
             # next step's EMBED can scalar-read it as a DMA index.
             pltpu.VMEM((1, max(B, 1)), jnp.int32),             # tokrow
             pltpu.SMEM((1, max(B, 1)), jnp.int32),             # tok_smem
-            pltpu.SemaphoreType.DMA((2,)),                     # wsem
+            pltpu.SemaphoreType.DMA((cfg.nbuf,)),              # wsem
             pltpu.SemaphoreType.DMA,                           # esem
             pltpu.SemaphoreType.DMA,                           # osem
             pltpu.SemaphoreType.DMA((2,)),                     # ksem
@@ -403,6 +414,11 @@ def build_mega_call(
             dimension_semantics=("arbitrary", "arbitrary"),
             collective_id=collective_id,
             allow_collective_id_without_custom_barrier=True,
+            # The default 16 MB scoped-VMEM limit is what made wide
+            # tiles (tn=2048) fail to compile: staging alone is
+            # nbuf·d·tn·2B per stream direction. v5e/v5p carry 128 MB
+            # physical; leave Mosaic headroom.
+            vmem_limit_bytes=100 * 1024 * 1024,
         ),
         interpret=interpret_mode(ctx),
     )

@@ -78,10 +78,13 @@ def _stream_cols(kctx, x_f32, w_hbm, n: int, tn: int, consume,
     tile-by-tile, plus an optional ``tail``-wide final tile when ``tn``
     doesn't divide the column count (the LM head's vocab axis).
 
-    Double-buffered: tile ``j+1``'s DMA runs under tile ``j``'s matmul
-    (parity role: the reference linear task's tile pipeline,
+    Depth-``nbuf`` pipelined: up to ``nbuf - 1`` tile DMAs stay in
+    flight ahead of the consuming matmul (parity role: the reference
+    linear task's tile pipeline,
     ``mega_triton_kernel/kernels/linear.py``); the tail tile joins the
-    same pipeline (prefetched under the last main tile's matmul).
+    same pipeline. The weight stream is the decode step's HBM floor —
+    per-tile control overhead is comparable to a 2 MB tile's wire time,
+    so one-deep prefetch leaves the HBM controller idle between tiles.
     ``consume(j, val)`` sinks each f32 product — ``val.shape[1]`` is
     ``tn`` for main tiles and ``tail`` for the final one. With
     ``carry`` set, ``consume(j, val, carry) -> carry`` threads loop
@@ -89,9 +92,11 @@ def _stream_cols(kctx, x_f32, w_hbm, n: int, tn: int, consume,
     final carry is returned.
     """
     stage, sem = kctx.colstage, kctx.wsem
+    depth = stage.shape[0]
     k = x_f32.shape[1]
     xa = x_f32.astype(kctx.wdtype)
     stateful = carry is not None
+    total = n + (1 if tail else 0)  # tile index n = the tail tile
 
     def copy(j, slot, w=None):
         w = tn if w is None else w
@@ -101,19 +106,26 @@ def _stream_cols(kctx, x_f32, w_hbm, n: int, tn: int, consume,
             sem.at[slot],
         )
 
-    copy(0, 0, tail if n == 0 else None).start()
+    def start(j):
+        return copy(j, j % depth, tail if j == n else None)
+
+    # Prologue: fill the pipeline (static — n, tail, depth are Python
+    # ints here).
+    for j in range(min(depth - 1, total)):
+        start(j).start()
 
     def body(j, c):
-        slot = jax.lax.rem(j, 2)
+        slot = jax.lax.rem(j, depth)
+        p = j + depth - 1  # tile to prefetch, keeping depth-1 in flight
 
-        @pl.when(j + 1 < n)
+        @pl.when(p < n)
         def _prefetch():
-            copy(j + 1, 1 - slot).start()
+            copy(p, jax.lax.rem(p, depth)).start()
 
         if tail:
-            @pl.when(j + 1 == n)
+            @pl.when(p == n)
             def _prefetch_tail():
-                copy(n, 1 - slot, tail).start()
+                copy(n, jax.lax.rem(p, depth), tail).start()
 
         copy(j, slot).wait()
         val = jnp.dot(
@@ -129,7 +141,7 @@ def _stream_cols(kctx, x_f32, w_hbm, n: int, tn: int, consume,
     )
 
     if tail:
-        slot = n % 2
+        slot = n % depth
         copy(n, slot, tail).wait()
         val = jnp.dot(
             xa, stage[slot, :k, :tail], preferred_element_type=jnp.float32
@@ -150,6 +162,7 @@ def _stream_rows(kctx, x_ref, w_hbm, out_ref, n: int, tk: int):
     ``dynamic_slice`` on register values, only for ref loads.
     """
     stage, sem = kctx.rowstage, kctx.wsem
+    depth = stage.shape[0]
     d = out_ref.shape[-1]
 
     def copy(j, slot):
@@ -159,15 +172,17 @@ def _stream_rows(kctx, x_ref, w_hbm, out_ref, n: int, tk: int):
             sem.at[slot],
         )
 
-    copy(0, 0).start()
+    for j in range(min(depth - 1, n)):  # fill the pipeline (static)
+        copy(j, j % depth).start()
     out_ref[...] = jnp.zeros_like(out_ref)
 
     def body(j, carry):
-        slot = jax.lax.rem(j, 2)
+        slot = jax.lax.rem(j, depth)
+        p = j + depth - 1  # keep depth-1 tiles in flight
 
-        @pl.when(j + 1 < n)
+        @pl.when(p < n)
         def _prefetch():
-            copy(j + 1, 1 - slot).start()
+            copy(p, jax.lax.rem(p, depth)).start()
 
         copy(j, slot).wait()
         val = jnp.dot(
