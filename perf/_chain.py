@@ -17,6 +17,22 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def prepare_decode_state(model, prompt_len: int = 512):
+    """The sweeps' common starting state: a prefilled cache and the
+    first greedy token — ONE definition so both harnesses (and their
+    fits) start every chain from the same computation.
+
+    Returns ``(tok0 [1] i32, cache, s_max)``.
+    """
+    cache = model.new_cache(1)
+    tokens = jnp.asarray(
+        np.arange(prompt_len) % model.cfg.vocab_size, jnp.int32
+    )
+    logits, cache = model.prefill(tokens, cache, "xla")
+    tok0 = jnp.argmax(logits)[None].astype(jnp.int32)
+    return tok0, cache, int(cache.k.shape[3])
+
+
 def single_step_chain(mstep, params, tok0, cache0, steps):
     """``steps`` greedy single-step decodes chained in one jit; returns
     ``once()`` yielding the np token chain [steps]."""

@@ -60,25 +60,50 @@ def main(argv=None) -> int:
     rows = jnp.zeros((n, cap, row), jnp.uint8)
     splits = jnp.full((n,), cap, jnp.int32)
 
-    def chained(x, iters):
+    def chained(x, iters, op=None):
+        op = op or (lambda xi: ep_exchange(xi, splits, splits, axis="tp",
+                                           ctx=ctx))
+
         def body(_, carry):
             # Non-foldable carry: XOR the previous call's first byte in.
             xi = carry.at[0, 0, 0].set(carry[0, 0, 0] ^ jnp.uint8(1))
-            out = ep_exchange(xi, splits, splits, axis="tp", ctx=ctx)
-            return out
+            return op(xi)
 
         out = jax.lax.fori_loop(0, iters, body, x)
         return jnp.sum(out.astype(jnp.int32))
 
-    def make_run(iters):
+    def make_run(iters, op=None):
         run = ctx.shard_map(
-            lambda x: chained(x, iters)[None],
+            lambda x: chained(x, iters, op)[None],
             in_specs=jax.sharding.PartitionSpec(None, None, None),
             out_specs=jax.sharding.PartitionSpec(None),
         )
         run = jax.jit(run)
         np.asarray(run(rows))  # compile + warm
         return run
+
+    # Cost-attribution probes, same operand shapes and chaining: a
+    # one-DMA whole-buffer copy kernel (per-call floor + one descriptor)
+    # vs the real exchange (barrier + 2·ceil(cap/block) descriptors).
+    # The difference isolates what the per-BLOCK machinery costs on this
+    # platform vs what a pallas call of this shape costs at all.
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    from triton_distributed_tpu.ops.common import comm_pallas_call
+
+    def _copy_kernel(x_ref, o_ref, sem):
+        pltpu.make_async_copy(x_ref, o_ref, sem).start()
+        pltpu.make_async_copy(x_ref, o_ref, sem).wait()
+
+    def one_dma_copy(xi):
+        return comm_pallas_call(
+            _copy_kernel,
+            jax.ShapeDtypeStruct(xi.shape, xi.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.SemaphoreType.DMA],
+            ctx=ctx,
+        )(xi)
 
     from triton_distributed_tpu.runtime.utils import median_time
 
@@ -94,6 +119,10 @@ def main(argv=None) -> int:
     t3 = timed(make_run(3 * args.iters))
     overhead_us = max((t3 - t1) / (2 * args.iters) * 1e6, 0.0)
     dispatch_us = max(t1 * 1e6 - overhead_us * args.iters, 0.0)
+
+    c1 = timed(make_run(args.iters, one_dma_copy))
+    c3 = timed(make_run(3 * args.iters, one_dma_copy))
+    copy_us = max((c3 - c1) / (2 * args.iters) * 1e6, 0.0)
 
     # Wire projection at the headline 8-rank intra-slice config.
     from perf.ep_a2a_projection import main as proj_main  # noqa: F401
@@ -115,6 +144,10 @@ def main(argv=None) -> int:
         "platform": jax.devices()[0].platform,
         "kernel_overhead_us_n1_lower_bound": round(overhead_us, 1),
         "fixed_dispatch_us_per_execution": round(dispatch_us, 1),
+        # Same shapes/chaining, ONE whole-buffer DMA: the platform's
+        # per-pallas-call floor. exchange - copy ≈ the per-block
+        # machinery (barrier + 2·ceil(cap/block) descriptors).
+        "one_dma_copy_us": round(copy_us, 1),
         "wire_projection_us": wire["projection_us"],
         # Lower bound: the n=1 kernel cannot execute the per-peer
         # push/arrival/drain loops (empty at n=1) — see module docstring.

@@ -39,7 +39,6 @@ def main(argv=None) -> int:
 
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
-    import jax.numpy as jnp
 
     from triton_distributed_tpu.models import AutoLLM
     from triton_distributed_tpu.runtime.mesh import initialize_distributed
@@ -55,18 +54,17 @@ def main(argv=None) -> int:
     model = AutoLLM.from_pretrained(args.model, ctx=ctx, max_length=1024)
     jax.block_until_ready(model.params)
 
-    PROMPT = 512
-    cache0 = model.new_cache(1)
-    tokens = jnp.asarray(np.arange(PROMPT) % model.cfg.vocab_size, jnp.int32)
-    logits, cache0 = model.prefill(tokens, cache0, "xla")
-    tok0 = jnp.argmax(logits)[None].astype(jnp.int32)
+    from perf._chain import (
+        multi_step_chain,
+        prepare_decode_state,
+        single_step_chain,
+    )
+
+    tok0, cache0, s_max = prepare_decode_state(model)
 
     from triton_distributed_tpu.megakernel import MegaQwen3
 
     mega = MegaQwen3(model)
-    s_max = int(cache0.k.shape[3])
-
-    from perf._chain import multi_step_chain, single_step_chain
 
     results = []
     chains = {}

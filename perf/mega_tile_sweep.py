@@ -17,7 +17,6 @@ import json
 import os
 import sys
 
-import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -38,7 +37,6 @@ def main(argv=None) -> int:
 
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
-    import jax.numpy as jnp
 
     from triton_distributed_tpu.megakernel import MegaQwen3
     from triton_distributed_tpu.megakernel.code_generator import MegaConfig
@@ -50,22 +48,18 @@ def main(argv=None) -> int:
     model = AutoLLM.from_pretrained(args.model, ctx=ctx, max_length=1024)
     jax.block_until_ready(model.params)
 
-    PROMPT = 512
     steps, ns = args.steps, args.ns
     if steps % ns:
         raise SystemExit(f"--ns {ns} must divide --steps {steps}")
-    cache0 = model.new_cache(1)
-    tokens = jnp.asarray(np.arange(PROMPT) % model.cfg.vocab_size, jnp.int32)
-    logits, cache0 = model.prefill(tokens, cache0, "xla")
-    tok0 = jnp.argmax(logits)[None].astype(jnp.int32)
-    s_max = int(cache0.k.shape[3])
 
-    from perf._chain import multi_step_chain
+    from perf._chain import multi_step_chain, prepare_decode_state
+
+    tok0, cache0, s_max = prepare_decode_state(model)
 
     ref_chain = None
     all_match = True
     any_ok = False
-    for spec in args.configs.split(","):
+    for i, spec in enumerate(args.configs.split(",")):
         tn, tk, nb = (int(v) for v in spec.split(":"))
         label = f"tn{tn}_tk{tk}_nb{nb}"
         try:
@@ -93,6 +87,12 @@ def main(argv=None) -> int:
                 "config": label,
                 "error": f"{type(e).__name__}: {e}"[:220],
             }), flush=True)
+            if i == 0:
+                # The FIRST config is the trusted baseline every other
+                # chain is checked against; without it, "matches" would
+                # mean "matches an unverified candidate". Keep timing
+                # the rest (data is still useful) but fail the run.
+                all_match = False
     # A mismatching config computed wrong logits — its timing must not
     # be promotable from a green-looking run (mega_ns_sweep contract).
     return 0 if (any_ok and all_match) else 1

@@ -115,8 +115,12 @@ class MegaConfig:
     nbuf: int = 2
 
     def resolve(self, dims: MegaDims) -> "ResolvedConfig":
+        if self.nbuf < 1:
+            raise ValueError(f"nbuf must be >= 1, got {self.nbuf}")
         return ResolvedConfig(
-            nbuf=max(2, self.nbuf),
+            # nbuf=1 is a valid (serial, no-prefetch) degenerate the
+            # sweep uses to isolate the prefetch benefit.
+            nbuf=self.nbuf,
             tn_qkv=pick_tile(dims.qkv_loc, self.tile_n),
             tn_fc1=pick_tile(dims.f_loc, self.tile_n),
             # The vocab axis rarely divides by a wide tile (Qwen3:

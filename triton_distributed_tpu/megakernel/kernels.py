@@ -142,6 +142,10 @@ def _stream_cols(kctx, x_f32, w_hbm, n: int, tn: int, consume,
 
     if tail:
         slot = n % depth
+        if depth == 1:
+            # Serial mode starts each tile at its own iteration; the
+            # tail has no iteration of its own — start it here.
+            copy(n, slot, tail).start()
         copy(n, slot, tail).wait()
         val = jnp.dot(
             xa, stage[slot, :k, :tail], preferred_element_type=jnp.float32
