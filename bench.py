@@ -119,6 +119,52 @@ def _emit(fh, obj) -> None:
     os.fsync(fh.fileno())
 
 
+def _tuned_mega_config(device_kind: str, model_name: str):
+    """Megakernel config for the mega rungs: ``TDT_BENCH_MEGA_CFG``
+    ("tile_n:tile_k:nbuf") wins, else ``perf/MEGA_TUNED.json`` (written
+    by ``perf/mega_tile_sweep.py`` for the best token-exact config on
+    this chip+model, validated against both before use), else None
+    (library defaults). Lets an on-chip sweep improve the driver's
+    end-of-round ladder without a code edit.
+
+    Returns ``(config_or_None, note_str)`` — the note goes in the
+    progress file so a dropped override/tuning is visible. A malformed
+    EXPLICIT env override raises: silently timing defaults would
+    invalidate the operator's A/B without a trace.
+    """
+    from triton_distributed_tpu.megakernel.code_generator import MegaConfig
+
+    def parse(spec):
+        tn, tk, nb = (int(v) for v in spec.split(":"))
+        return MegaConfig(tile_n=tn, tile_k=tk, nbuf=nb)
+
+    env = os.environ.get("TDT_BENCH_MEGA_CFG")
+    if env:
+        try:
+            return parse(env), f"env TDT_BENCH_MEGA_CFG={env}"
+        except Exception as e:
+            raise ValueError(
+                f"malformed TDT_BENCH_MEGA_CFG={env!r} (want tn:tk:nbuf)"
+            ) from e
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "perf", "MEGA_TUNED.json")
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (OSError, ValueError):
+        return None, "defaults (no tuning file)"
+    # Tuning is per chip and per model — ignore a file from another.
+    if rec.get("device") != device_kind or rec.get("model") != model_name:
+        return None, (
+            f"defaults (tuning file is for {rec.get('device')}/"
+            f"{rec.get('model')}, this run is {device_kind}/{model_name})"
+        )
+    try:
+        return parse(rec["config"]), f"perf/MEGA_TUNED.json {rec['config']}"
+    except Exception:
+        return None, "defaults (malformed tuning file ignored)"
+
+
 def run_ladder(
     progress_fh,
     on_tpu: bool,
@@ -231,12 +277,21 @@ def run_ladder(
     # the steps data-dependent; one jit dispatch for all STEPS). Skipped
     # off-TPU (interpret mode is semantics-only, not a timing rung).
     mega_ok = False
+    mega_cfg = None
+    if on_tpu:
+        # Resolve ONCE: both rungs and the cross-check's single-step
+        # kernel must build with the same config even if the file
+        # changes mid-run.
+        mega_cfg, cfg_note = _tuned_mega_config(
+            jax.devices()[0].device_kind, model_name
+        )
+        _emit(progress_fh, {"mega_config": cfg_note})
     if on_tpu and "mega" not in skip:
         _emit(progress_fh, {"start": "mega", "budget_s": _RUNG_TIMEOUT_S})
         try:
             from triton_distributed_tpu.megakernel import MegaQwen3
 
-            mega = MegaQwen3(model)
+            mega = MegaQwen3(model, cfg=mega_cfg)
             mstep = mega.decode_fn(1, int(cache0.k.shape[3]))
 
             def mega_decode_n(params, tok, cache, n):
@@ -284,8 +339,10 @@ def run_ladder(
                 # The token cross-check below needs the single-step
                 # kernel even when its timing rung ran in an earlier
                 # worker attempt (or failed).
-                mstep = MegaQwen3(model).decode_fn(1, int(cache0.k.shape[3]))
-            mmulti = MegaQwen3(model).decode_multi_fn(
+                mstep = MegaQwen3(
+                    model, cfg=mega_cfg
+                ).decode_fn(1, int(cache0.k.shape[3]))
+            mmulti = MegaQwen3(model, cfg=mega_cfg).decode_multi_fn(
                 1, int(cache0.k.shape[3]), NS
             )
 

@@ -59,6 +59,7 @@ def main(argv=None) -> int:
     ref_chain = None
     all_match = True
     any_ok = False
+    rows = []
     for i, spec in enumerate(args.configs.split(",")):
         tn, tk, nb = (int(v) for v in spec.split(":"))
         label = f"tn{tn}_tk{tk}_nb{nb}"
@@ -77,6 +78,7 @@ def main(argv=None) -> int:
             all_match = all_match and match
             any_ok = True
             sec = median_time(lambda: once())
+            rows.append((f"{tn}:{tk}:{nb}", sec / steps * 1e3, match, i == 0))
             print(json.dumps({
                 "config": label,
                 "ms_per_step": round(sec / steps * 1e3, 3),
@@ -93,6 +95,31 @@ def main(argv=None) -> int:
                 # mean "matches an unverified candidate". Keep timing
                 # the rest (data is still useful) but fail the run.
                 all_match = False
+    # Persist the winner for bench.py's mega rungs (TPU timings only —
+    # a CPU smoke must never touch chip tuning). The file is
+    # write-OR-REMOVE on every run with a valid baseline: a stale
+    # winner that stopped qualifying (mismatch after a kernel change,
+    # or no longer faster) must not keep steering the ladder.
+    if jax.devices()[0].platform != "cpu" and rows and rows[0][3]:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "MEGA_TUNED.json")
+        base_ms = rows[0][1]
+        best = min((r for r in rows if r[2]), key=lambda r: r[1])
+        if best[1] < base_ms * 0.98:  # >2% win, not noise
+            with open(path, "w") as f:
+                json.dump({
+                    "config": best[0],
+                    "ms_per_step": round(best[1], 3),
+                    "baseline_ms_per_step": round(base_ms, 3),
+                    "written_by": "perf/mega_tile_sweep.py",
+                    "device": jax.devices()[0].device_kind,
+                    "model": args.model,
+                }, f)
+            print(json.dumps({"tuned": best[0], "written": path}), flush=True)
+        elif os.path.exists(path):
+            os.remove(path)
+            print(json.dumps({"tuned": None, "removed": path}), flush=True)
+
     # A mismatching config computed wrong logits — its timing must not
     # be promotable from a green-looking run (mega_ns_sweep contract).
     return 0 if (any_ok and all_match) else 1
