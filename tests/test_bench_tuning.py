@@ -14,35 +14,37 @@ import pytest
 
 @pytest.fixture
 def bench(tmp_path, monkeypatch):
-    spec = importlib.util.spec_from_file_location(
-        "bench_under_test",
-        os.path.join(os.path.dirname(os.path.dirname(__file__)), "bench.py"),
-    )
+    """Load a COPY of bench.py from tmp_path so the tests' tuning file
+    lives under tmp_path/perf/ — never the repo's real
+    perf/MEGA_TUNED.json, which a live on-chip sweep may have written
+    for the next bench round (and which pre-existing state would also
+    break these tests)."""
+    import shutil
+
+    src = os.path.join(os.path.dirname(os.path.dirname(__file__)), "bench.py")
+    dst = tmp_path / "bench.py"
+    shutil.copy(src, dst)
+    (tmp_path / "perf").mkdir()
+    spec = importlib.util.spec_from_file_location("bench_under_test", dst)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     monkeypatch.delenv("TDT_BENCH_MEGA_CFG", raising=False)
     return mod
 
 
-def _write(bench, rec):
-    path = os.path.join(
-        os.path.dirname(os.path.abspath(bench.__file__)),
-        "perf", "MEGA_TUNED.json",
-    )
-    with open(path, "w") as f:
-        json.dump(rec, f)
-    return path
-
-
 @pytest.fixture
 def tuned_file(bench):
-    yield lambda rec: _write(bench, rec)
     path = os.path.join(
         os.path.dirname(os.path.abspath(bench.__file__)),
         "perf", "MEGA_TUNED.json",
     )
-    if os.path.exists(path):
-        os.remove(path)
+
+    def write(rec):
+        with open(path, "w") as f:
+            json.dump(rec, f)
+        return path
+
+    return write
 
 
 def test_no_file_means_defaults(bench, tuned_file):
