@@ -137,6 +137,42 @@ def main(argv=None) -> int:
             rec["unreliable"] = "slope < 20% of base time — relay noise"
         emit(rec)
 
+    # Per-grid-iteration overhead of a Pallas kernel: the megakernel
+    # dispatches ~200 task iterations per decode step, so N µs/iter is
+    # N*0.2 ms/step of pure scheduling. Slope over two grid sizes on a
+    # near-empty arbitrary-semantics kernel (same dispatch machinery as
+    # the megakernel's task loop, none of its work).
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def _tick_kernel(o_ref):
+        o_ref[0, 0] = (pl.program_id(0) + 1).astype(jnp.float32)
+
+    def grid_run(t):
+        call = pl.pallas_call(
+            _tick_kernel,
+            grid=(t,),
+            out_shape=jax.ShapeDtypeStruct((1, 128), jnp.float32),
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("arbitrary",),
+            ),
+            interpret=platform == "cpu",
+        )
+        f = jax.jit(call)
+        return lambda: np.asarray(f())  # median_time warms up itself
+
+    g1, g2 = (64, 192) if platform == "cpu" else (512, 1536)
+    tg1 = median_time(grid_run(g1))
+    tg2 = median_time(grid_run(g2))
+    rec = {"component": "pallas_grid_iter_overhead",
+           "us_per_iter": round((tg2 - tg1) / (g2 - g1) * 1e6, 2),
+           "grid_sizes": [g1, g2]}
+    if tg2 - tg1 < 0.2 * tg1:  # same guard as the matvec slopes
+        any_noisy = True
+        rec["unreliable"] = "slope < 20% of base time — relay noise"
+    emit(rec)
+
     # HBM stream anchor: one big reduction (pure read bandwidth, no MXU).
     big = jax.jit(
         lambda k: jax.random.normal(k, (64, 1024, 4096), jnp.bfloat16)
