@@ -135,8 +135,12 @@ def _tuned_mega_config(device_kind: str, model_name: str):
     from triton_distributed_tpu.megakernel.code_generator import MegaConfig
 
     def parse(spec):
-        tn, tk, nb = (int(v) for v in spec.split(":"))
-        return MegaConfig(tile_n=tn, tile_k=tk, nbuf=nb)
+        fields = [int(v) for v in spec.split(":")]
+        if len(fields) not in (3, 4):
+            raise ValueError(f"want tn:tk:nbuf[:fuse_norms], got {spec!r}")
+        tn, tk, nb = fields[:3]
+        fn = bool(fields[3]) if len(fields) > 3 else False
+        return MegaConfig(tile_n=tn, tile_k=tk, nbuf=nb, fuse_norms=fn)
 
     env = os.environ.get("TDT_BENCH_MEGA_CFG")
     if env:
@@ -144,7 +148,8 @@ def _tuned_mega_config(device_kind: str, model_name: str):
             return parse(env), f"env TDT_BENCH_MEGA_CFG={env}"
         except Exception as e:
             raise ValueError(
-                f"malformed TDT_BENCH_MEGA_CFG={env!r} (want tn:tk:nbuf)"
+                f"malformed TDT_BENCH_MEGA_CFG={env!r} "
+                "(want tn:tk:nbuf[:fuse_norms])"
             ) from e
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "perf", "MEGA_TUNED.json")

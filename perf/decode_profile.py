@@ -168,9 +168,13 @@ def main(argv=None) -> int:
     rec = {"component": "pallas_grid_iter_overhead",
            "us_per_iter": round((tg2 - tg1) / (g2 - g1) * 1e6, 2),
            "grid_sizes": [g1, g2]}
-    if tg2 - tg1 < 0.2 * tg1:  # same guard as the matvec slopes
-        any_noisy = True
-        rec["unreliable"] = "slope < 20% of base time — relay noise"
+    # The matvec guard (slope vs base) doesn't transfer here: tg1 is
+    # dominated by the fixed dispatch round-trip, not the measured
+    # work, so a small TRUE per-iter overhead would trip it every run.
+    # Only a non-positive slope is definitely noise; it does not taint
+    # the (independent) matvec floor.
+    if tg2 <= tg1:
+        rec["unreliable"] = "non-positive slope — relay noise"
     emit(rec)
 
     # HBM stream anchor: one big reduction (pure read bandwidth, no MXU).

@@ -20,13 +20,15 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-DEFAULT = "1024:1024:2,1024:1024:4,2048:1024:2,2048:2048:4"
+# tn:tk:nbuf[:fuse_norms] — baseline first (the library defaults).
+DEFAULT = ("1024:1024:2,1024:1024:4,2048:1024:2,2048:2048:4,"
+           "1024:1024:4:1,2048:1024:4:1")
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--configs", default=DEFAULT,
-                   help="comma list of tile_n:tile_k:nbuf")
+                   help="comma list of tile_n:tile_k:nbuf[:fuse_norms]")
     p.add_argument("--steps", type=int, default=32)
     p.add_argument("--ns", type=int, default=8)
     p.add_argument("--model", default="Qwen/Qwen3-0.6B")
@@ -61,11 +63,19 @@ def main(argv=None) -> int:
     any_ok = False
     rows = []
     for i, spec in enumerate(args.configs.split(",")):
-        tn, tk, nb = (int(v) for v in spec.split(":"))
-        label = f"tn{tn}_tk{tk}_nb{nb}"
+        label = spec
         try:
+            fields = [int(v) for v in spec.split(":")]
+            if len(fields) not in (3, 4):
+                raise ValueError(
+                    f"want tn:tk:nbuf[:fuse_norms], got {spec!r}"
+                )
+            tn, tk, nb = fields[:3]
+            fn = bool(fields[3]) if len(fields) > 3 else False
+            label = f"tn{tn}_tk{tk}_nb{nb}" + ("_fn" if fn else "")
             mega = MegaQwen3(
-                model, cfg=MegaConfig(tile_n=tn, tile_k=tk, nbuf=nb)
+                model,
+                cfg=MegaConfig(tile_n=tn, tile_k=tk, nbuf=nb, fuse_norms=fn),
             )
             once = multi_step_chain(
                 mega.decode_multi_fn(1, s_max, ns), ns,
@@ -78,7 +88,9 @@ def main(argv=None) -> int:
             all_match = all_match and match
             any_ok = True
             sec = median_time(lambda: once())
-            rows.append((f"{tn}:{tk}:{nb}", sec / steps * 1e3, match, i == 0))
+            rows.append((
+                f"{tn}:{tk}:{nb}:{int(fn)}", sec / steps * 1e3, match, i == 0,
+            ))
             print(json.dumps({
                 "config": label,
                 "ms_per_step": round(sec / steps * 1e3, 3),

@@ -299,6 +299,45 @@ def test_lm_head_remainder_tile(ctx4):
     )
 
 
+def test_fused_norms_parity(ctx4):
+    """fuse_norms folds the RMS norms into qkv/fc1/lm_head (dropping
+    2 tasks/layer + the final norm from the grid) — must be
+    logits-exact vs the golden step, with the task count shrunk by
+    exactly the removed norms."""
+    from triton_distributed_tpu.megakernel.code_generator import MegaConfig
+
+    model = AutoLLM.from_pretrained("tiny", ctx=ctx4)
+    cache = model.new_cache(1, max_length=64)
+    step_gold = model.decode_fn("xla")
+    for t in (3, 5):
+        _, cache = step_gold(model.params, jnp.asarray([t], jnp.int32), cache)
+    tok = jnp.asarray([7], jnp.int32)
+    logits_gold, _ = step_gold(model.params, tok, jax.tree.map(jnp.copy, cache))
+
+    base = MegaQwen3(model)
+    fused = MegaQwen3(model, cfg=MegaConfig(fuse_norms=True))
+    n_base = len(base._built(1, 64)[0].order)
+    n_fused = len(fused._built(1, 64)[0].order)
+    L = model.cfg.num_layers
+    assert n_base - n_fused == 2 * L + 1  # per-layer ln1+ln2, final norm
+
+    logits_mega, _ = fused.decode_step(tok, cache)
+    np.testing.assert_allclose(
+        np.asarray(logits_mega), np.asarray(logits_gold),
+        rtol=2e-3, atol=2e-3,
+    )
+
+    # Fused PREFILL graph too (inline final norm feeds the lm_head's
+    # onehot row select — a distinct composition from decode).
+    prompt = jnp.asarray([3, 5, 7, 2], jnp.int32)
+    gold_pre, _ = model.prefill(prompt, model.new_cache(1, max_length=64),
+                                "xla")
+    mega_pre, _ = fused.prefill(prompt, model.new_cache(1, max_length=64))
+    np.testing.assert_allclose(
+        np.asarray(mega_pre), np.asarray(gold_pre), rtol=2e-3, atol=2e-3,
+    )
+
+
 @pytest.mark.parametrize(
     "nbuf",
     [

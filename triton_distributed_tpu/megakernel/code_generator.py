@@ -113,6 +113,14 @@ class MegaConfig:
     # time, a deeper pipeline keeps the HBM controller busy through the
     # scalar-core gaps between tiles.
     nbuf: int = 2
+    # Fold the RMS norms into their consumers (qkv / fc1 / lm_head
+    # compute the norm inline from x instead of reading a NORM task's h)
+    # — drops 2 tasks per layer + the final norm from the grid, i.e.
+    # ~28% of the megakernel's task iterations at 0.6B. The norm math
+    # is identical; only the task boundary (grid-iteration dispatch +
+    # the consumer's first-DMA latency exposure) goes away. A/B'd by
+    # perf/mega_tile_sweep.py before becoming default.
+    fuse_norms: bool = False
 
     def resolve(self, dims: MegaDims) -> "ResolvedConfig":
         if self.nbuf < 1:
@@ -121,6 +129,7 @@ class MegaConfig:
             # nbuf=1 is a valid (serial, no-prefetch) degenerate the
             # sweep uses to isolate the prefetch benefit.
             nbuf=self.nbuf,
+            fuse_norms=self.fuse_norms,
             tn_qkv=pick_tile(dims.qkv_loc, self.tile_n),
             tn_fc1=pick_tile(dims.f_loc, self.tile_n),
             # The vocab axis rarely divides by a wide tile (Qwen3:
@@ -149,6 +158,7 @@ class MegaConfig:
 @dataclasses.dataclass(frozen=True)
 class ResolvedConfig:
     nbuf: int
+    fuse_norms: bool
     tn_qkv: int
     tn_fc1: int
     tn_lm: int

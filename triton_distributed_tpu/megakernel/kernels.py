@@ -285,6 +285,23 @@ def embed_body(kctx):
     return body
 
 
+def _normed_input(kctx, which: int):
+    """The consumer's [B, d] f32 input: the NORM task's output (``h``)
+    normally, or — with ``fuse_norms`` — the norm computed inline from
+    the residual ``x`` (which: 0 = ln1/qkv, 1 = ln2/fc1, 2 = final/lm).
+    The inline norm is a [B, d] vector op — negligible next to the
+    task boundary it replaces."""
+    if not kctx.cfg.fuse_norms:
+        return kctx.h[...]
+    eps = kctx.dims.rms_eps
+    xv = kctx.x[...]
+    if which == 0:
+        return _rms(xv, kctx.ln1[kctx.layer], eps)
+    if which == 1:
+        return _rms(xv, kctx.ln2[kctx.layer], eps)
+    return _rms(xv, kctx.normf[...], eps)
+
+
 @register_task(TaskType.NORM)
 def norm_body(kctx):
     def body():
@@ -321,7 +338,10 @@ def qkv_body(kctx):
         def sink(j, val):
             kctx.qkv[:, pl.ds(j * tn, tn)] = val
 
-        _stream_cols(kctx, kctx.h[...], kctx.wqkv.at[kctx.layer], n, tn, sink)
+        _stream_cols(
+            kctx, _normed_input(kctx, 0), kctx.wqkv.at[kctx.layer],
+            n, tn, sink,
+        )
 
     return body
 
@@ -686,7 +706,7 @@ def fc1_body(kctx):
         dims = kctx.dims
         tn = kctx.cfg.tn_fc1
         n = dims.f_loc // tn
-        h = kctx.h[...]
+        h = _normed_input(kctx, 1)
         w1 = kctx.w1.at[kctx.layer]
 
         def sink_gate(j, val):
@@ -757,10 +777,11 @@ def lm_head_body(kctx):
             sel = jax.lax.broadcasted_iota(jnp.int32, (1, S), 1)
             onehot = (sel == kctx.kv_len[0] - 1).astype(jnp.float32)
             x_in = jnp.dot(
-                onehot, kctx.h[...], preferred_element_type=jnp.float32
+                onehot, _normed_input(kctx, 2),
+                preferred_element_type=jnp.float32,
             )  # [1, d]
         else:
-            x_in = kctx.h[...]
+            x_in = _normed_input(kctx, 2)
 
         # Tail tile when tn doesn't divide v_loc (wide lm tiles on an
         # unround vocab axis); must stay a 128-multiple for lane

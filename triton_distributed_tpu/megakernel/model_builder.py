@@ -136,17 +136,21 @@ class ModelBuilder:
             # all subsequent allreduces within the launch.
             self.make_barrier()
         self.make_embed()
+        fused = self.cfg.fuse_norms  # norms run inline in their consumers
         for l in range(self.dims.num_layers):
-            self.make_norm(l, 0)
+            if not fused:
+                self.make_norm(l, 0)
             self.make_qkv_proj(l)
             self.make_attn(l)
             self.make_o_proj(l)
             self.make_allreduce(l)
-            self.make_norm(l, 1)
+            if not fused:
+                self.make_norm(l, 1)
             self.make_fc1(l)
             self.make_fc2(l)
             self.make_allreduce(l)
-        self.make_norm(0, 2)
+        if not fused:
+            self.make_norm(0, 2)
         self.make_lm_head()
 
     def build_prefill_graph(self) -> None:
@@ -158,17 +162,21 @@ class ModelBuilder:
         if self.dims.n_ranks > 1:
             self.make_barrier()  # same entry-skew reasoning as decode
         self.make_load_x()
+        fused = self.cfg.fuse_norms  # norms run inline in their consumers
         for l in range(self.dims.num_layers):
-            self.make_norm(l, 0)
+            if not fused:
+                self.make_norm(l, 0)
             self.make_qkv_proj(l)
             self.make_attn_prefill(l)
             self.make_o_proj(l)
             self.make_allreduce(l)
-            self.make_norm(l, 1)
+            if not fused:
+                self.make_norm(l, 1)
             self.make_fc1(l)
             self.make_fc2(l)
             self.make_allreduce(l)
-        self.make_norm(0, 2)
+        if not fused:
+            self.make_norm(0, 2)
         # The LM head projects only the last real row in prefill graphs
         # (driven by dims.prefill inside lm_head_body, not a task arg).
         self.make_lm_head()
