@@ -134,13 +134,7 @@ def _tuned_mega_config(device_kind: str, model_name: str):
     """
     from triton_distributed_tpu.megakernel.code_generator import MegaConfig
 
-    def parse(spec):
-        fields = [int(v) for v in spec.split(":")]
-        if len(fields) not in (3, 4):
-            raise ValueError(f"want tn:tk:nbuf[:fuse_norms], got {spec!r}")
-        tn, tk, nb = fields[:3]
-        fn = bool(fields[3]) if len(fields) > 3 else False
-        return MegaConfig(tile_n=tn, tile_k=tk, nbuf=nb, fuse_norms=fn)
+    parse = MegaConfig.from_spec
 
     env = os.environ.get("TDT_BENCH_MEGA_CFG")
     if env:

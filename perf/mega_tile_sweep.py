@@ -65,18 +65,18 @@ def main(argv=None) -> int:
     for i, spec in enumerate(args.configs.split(",")):
         label = spec
         try:
-            fields = [int(v) for v in spec.split(":")]
-            if len(fields) not in (3, 4):
-                raise ValueError(
-                    f"want tn:tk:nbuf[:fuse_norms], got {spec!r}"
-                )
-            tn, tk, nb = fields[:3]
-            fn = bool(fields[3]) if len(fields) > 3 else False
-            label = f"tn{tn}_tk{tk}_nb{nb}" + ("_fn" if fn else "")
-            mega = MegaQwen3(
-                model,
-                cfg=MegaConfig(tile_n=tn, tile_k=tk, nbuf=nb, fuse_norms=fn),
-            )
+            cfg = MegaConfig.from_spec(spec)
+        except ValueError as e:
+            # A malformed spec is an OPERATOR error, not a chip
+            # failure: record it AND fail the run (bench.py's explicit-
+            # override philosophy — a silently thinner A/B is invalid).
+            print(json.dumps({"config": spec, "error": str(e)}), flush=True)
+            all_match = False
+            continue
+        label = (f"tn{cfg.tile_n}_tk{cfg.tile_k}_nb{cfg.nbuf}"
+                 + ("_fn" if cfg.fuse_norms else ""))
+        try:
+            mega = MegaQwen3(model, cfg=cfg)
             once = multi_step_chain(
                 mega.decode_multi_fn(1, s_max, ns), ns,
                 model.params, tok0, cache0, steps,
@@ -89,7 +89,8 @@ def main(argv=None) -> int:
             any_ok = True
             sec = median_time(lambda: once())
             rows.append((
-                f"{tn}:{tk}:{nb}:{int(fn)}", sec / steps * 1e3, match, i == 0,
+                f"{cfg.tile_n}:{cfg.tile_k}:{cfg.nbuf}:{int(cfg.fuse_norms)}",
+                sec / steps * 1e3, match, i == 0,
             ))
             print(json.dumps({
                 "config": label,

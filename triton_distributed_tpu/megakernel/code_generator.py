@@ -122,6 +122,24 @@ class MegaConfig:
     # perf/mega_tile_sweep.py before becoming default.
     fuse_norms: bool = False
 
+    @classmethod
+    def from_spec(cls, spec: str) -> "MegaConfig":
+        """Parse the sweep/bench config-string format
+        ``tile_n:tile_k:nbuf[:fuse_norms]`` — the ONE parser for both
+        ``perf/mega_tile_sweep.py`` (which writes these strings into
+        ``perf/MEGA_TUNED.json``) and ``bench.py`` (which reads them
+        back); a shared definition keeps the handoff format-compatible.
+        """
+        fields = [int(v) for v in spec.split(":")]
+        if len(fields) not in (3, 4):
+            raise ValueError(
+                f"want tile_n:tile_k:nbuf[:fuse_norms], got {spec!r}"
+            )
+        return cls(
+            tile_n=fields[0], tile_k=fields[1], nbuf=fields[2],
+            fuse_norms=bool(fields[3]) if len(fields) > 3 else False,
+        )
+
     def resolve(self, dims: MegaDims) -> "ResolvedConfig":
         if self.nbuf < 1:
             raise ValueError(f"nbuf must be >= 1, got {self.nbuf}")

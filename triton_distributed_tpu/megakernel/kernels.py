@@ -697,9 +697,11 @@ def o_proj_body(kctx):
 
 @register_task(TaskType.FC1)
 def fc1_body(kctx):
-    """Gate pass then up pass over the fused ``[d, gate_loc | up_loc]``
-    shard layout (``models.qwen._fuse_by_shard``); silu·mul fused into
-    the sinks — the reference's separate activation/elementwise tasks
+    """One continuous column stream over the fused ``[d, gate | up]``
+    shard layout (``models.qwen._fuse_by_shard``): tiles ``j < n`` are
+    gate columns (silu into ``mlp``), tiles ``j >= n`` the matching up
+    columns (multiply in place) — silu·mul fused into the sinks, the
+    reference's separate activation/elementwise tasks
     (``tasks/activation.py``) fold into this body on TPU."""
 
     def body():
@@ -709,16 +711,22 @@ def fc1_body(kctx):
         h = _normed_input(kctx, 1)
         w1 = kctx.w1.at[kctx.layer]
 
-        def sink_gate(j, val):
-            kctx.mlp[:, pl.ds(j * tn, tn)] = val * jax.lax.logistic(val)
+        # ONE continuous stream over the fused [d, gate|up] plane —
+        # tiles j < n are gate columns, j >= n the matching up columns
+        # (the shard layout guarantees the offset is exactly f_loc).
+        # One pipeline fill instead of two per layer, and the depth-nbuf
+        # rotation never drains between the passes.
+        def sink(j, val):
+            @pl.when(j < n)
+            def _gate():
+                kctx.mlp[:, pl.ds(j * tn, tn)] = val * jax.lax.logistic(val)
 
-        _stream_cols(kctx, h, w1, n, tn, sink_gate, col0=0)
+            @pl.when(j >= n)
+            def _up():
+                sl = pl.ds((j - n) * tn, tn)
+                kctx.mlp[:, sl] = kctx.mlp[:, sl] * val
 
-        def sink_up(j, val):
-            sl = pl.ds(j * tn, tn)
-            kctx.mlp[:, sl] = kctx.mlp[:, sl] * val
-
-        _stream_cols(kctx, h, w1, n, tn, sink_up, col0=dims.f_loc)
+        _stream_cols(kctx, h, w1, 2 * n, tn, sink, col0=0)
 
     return body
 

@@ -89,8 +89,15 @@ class ModelBuilder:
     def make_embed(self, **kw) -> int:
         return self._add(TaskType.EMBED, **kw)
 
-    def make_norm(self, layer: int, which: int, **kw) -> int:
-        """which: 0 = input layernorm, 1 = post-attn, 2 = final."""
+    def make_norm(self, layer: int, which: int, **kw) -> int | None:
+        """which: 0 = input layernorm, 1 = post-attn, 2 = final.
+
+        Under ``cfg.fuse_norms`` this is a no-op (returns None): the
+        consumers (qkv/fc1/lm_head) compute the norm inline, and a NORM
+        task slipping back into ANY graph would double-normalize — the
+        guard lives here so no builder can forget it."""
+        if self.cfg.fuse_norms:
+            return None
         return self._add(TaskType.NORM, layer, arg0=which, **kw)
 
     def make_qkv_proj(self, layer: int, **kw) -> int:
@@ -136,21 +143,17 @@ class ModelBuilder:
             # all subsequent allreduces within the launch.
             self.make_barrier()
         self.make_embed()
-        fused = self.cfg.fuse_norms  # norms run inline in their consumers
         for l in range(self.dims.num_layers):
-            if not fused:
-                self.make_norm(l, 0)
+            self.make_norm(l, 0)  # no-op under cfg.fuse_norms
             self.make_qkv_proj(l)
             self.make_attn(l)
             self.make_o_proj(l)
             self.make_allreduce(l)
-            if not fused:
-                self.make_norm(l, 1)
+            self.make_norm(l, 1)
             self.make_fc1(l)
             self.make_fc2(l)
             self.make_allreduce(l)
-        if not fused:
-            self.make_norm(0, 2)
+        self.make_norm(0, 2)
         self.make_lm_head()
 
     def build_prefill_graph(self) -> None:
@@ -162,21 +165,17 @@ class ModelBuilder:
         if self.dims.n_ranks > 1:
             self.make_barrier()  # same entry-skew reasoning as decode
         self.make_load_x()
-        fused = self.cfg.fuse_norms  # norms run inline in their consumers
         for l in range(self.dims.num_layers):
-            if not fused:
-                self.make_norm(l, 0)
+            self.make_norm(l, 0)  # no-op under cfg.fuse_norms
             self.make_qkv_proj(l)
             self.make_attn_prefill(l)
             self.make_o_proj(l)
             self.make_allreduce(l)
-            if not fused:
-                self.make_norm(l, 1)
+            self.make_norm(l, 1)
             self.make_fc1(l)
             self.make_fc2(l)
             self.make_allreduce(l)
-        if not fused:
-            self.make_norm(0, 2)
+        self.make_norm(0, 2)
         # The LM head projects only the last real row in prefill graphs
         # (driven by dims.prefill inside lm_head_body, not a task arg).
         self.make_lm_head()
