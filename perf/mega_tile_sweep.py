@@ -20,9 +20,12 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# tn:tk:nbuf[:fuse_norms] — baseline first (the library defaults).
-DEFAULT = ("1024:1024:2,1024:1024:4,2048:1024:2,2048:2048:4,"
-           "1024:1024:4:1,2048:1024:4:1")
+# tn:tk:nbuf[:fuse_norms[:cross_prefetch]] — baseline (the library
+# defaults) first; then each lever added cumulatively so the deltas
+# attribute: staging depth, tile width, norm fusion, cross-task
+# prefetch.
+DEFAULT = ("1024:1024:2,1024:1024:4,2048:1024:4,"
+           "1024:1024:4:1,1024:1024:4:1:1,2048:1024:4:1:1")
 
 
 def main(argv=None) -> int:
@@ -74,7 +77,8 @@ def main(argv=None) -> int:
             all_match = False
             continue
         label = (f"tn{cfg.tile_n}_tk{cfg.tile_k}_nb{cfg.nbuf}"
-                 + ("_fn" if cfg.fuse_norms else ""))
+                 + ("_fn" if cfg.fuse_norms else "")
+                 + ("_xp" if cfg.cross_prefetch else ""))
         try:
             mega = MegaQwen3(model, cfg=cfg)
             once = multi_step_chain(
@@ -88,10 +92,7 @@ def main(argv=None) -> int:
             all_match = all_match and match
             any_ok = True
             sec = median_time(lambda: once())
-            rows.append((
-                f"{cfg.tile_n}:{cfg.tile_k}:{cfg.nbuf}:{int(cfg.fuse_norms)}",
-                sec / steps * 1e3, match, i == 0,
-            ))
+            rows.append((cfg.spec(), sec / steps * 1e3, match, i == 0))
             print(json.dumps({
                 "config": label,
                 "ms_per_step": round(sec / steps * 1e3, 3),

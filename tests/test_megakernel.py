@@ -299,6 +299,61 @@ def test_lm_head_remainder_tile(ctx4):
     )
 
 
+@pytest.mark.parametrize("fused", [True, pytest.param(False,
+                                                      marks=pytest.mark.slow)])
+def test_cross_prefetch_parity(ctx4, fused):
+    """cross_prefetch (the previous task starts the next task's first
+    weight-tile DMA; the stream consumes the SMEM flag and skips its
+    duplicate start) must be token-exact INCLUDING multi-step launches
+    — the flag handoff must also stop cleanly at each step's last task
+    (the next grid iteration is the next step's EMBED). The unfused
+    variant covers NORM-preceded stream boundaries."""
+    from triton_distributed_tpu.megakernel.code_generator import MegaConfig
+
+    model = AutoLLM.from_pretrained("tiny", ctx=ctx4)
+    cache = model.new_cache(1, max_length=64)
+    step_gold = model.decode_fn("xla")
+    for t in (3, 5):
+        _, cache = step_gold(model.params, jnp.asarray([t], jnp.int32), cache)
+    tok = jnp.asarray([7], jnp.int32)
+    logits_gold, _ = step_gold(model.params, tok, jax.tree.map(jnp.copy, cache))
+
+    # Golden 3-token greedy chain from the xla step.
+    gtok, gc, gold_chain = tok, jax.tree.map(jnp.copy, cache), []
+    for _ in range(3):
+        lg, gc = step_gold(model.params, gtok, gc)
+        gtok = jnp.argmax(lg, -1).astype(jnp.int32)
+        gold_chain.append(int(gtok[0]))
+
+    mega = MegaQwen3(
+        model, cfg=MegaConfig(fuse_norms=fused, cross_prefetch=True)
+    )
+    logits_mega, _ = mega.decode_step(tok, jax.tree.map(jnp.copy, cache))
+    np.testing.assert_allclose(
+        np.asarray(logits_mega), np.asarray(logits_gold),
+        rtol=2e-3, atol=2e-3,
+    )
+    # Multi-step launch: 3 steps in one kernel, prefetch flags crossing
+    # the step boundary.
+    mm = mega.decode_multi_fn(1, 64, 3)
+    toks3, _, _ = mm(model.params, tok, cache)
+    assert [int(x) for x in np.asarray(toks3)[:, 0]] == gold_chain
+
+
+def test_cross_prefetch_needs_depth(ctx4):
+    from triton_distributed_tpu.megakernel.code_generator import (
+        MegaConfig,
+        MegaDims,
+    )
+
+    with pytest.raises(ValueError, match="nbuf >= 2"):
+        MegaConfig(nbuf=1, cross_prefetch=True).resolve(
+            MegaDims(batch=1, d=128, hq_loc=1, hkv_loc=1, head_dim=128,
+                     f_loc=128, v_loc=128, num_layers=1, s_max=64,
+                     n_ranks=1, rms_eps=1e-6, rope_theta=1e6)
+        )
+
+
 def test_fused_norms_parity(ctx4):
     """fuse_norms folds the RMS norms into qkv/fc1/lm_head (dropping
     2 tasks/layer + the final norm from the grid) — must be

@@ -113,6 +113,15 @@ class MegaConfig:
     # time, a deeper pipeline keeps the HBM controller busy through the
     # scalar-core gaps between tiles.
     nbuf: int = 2
+    # Cross-task weight prefetch: after each task body, the kernel
+    # reads the NEXT task's header and — when it is a weight-streaming
+    # task — starts its FIRST tile's DMA into the staging rotation,
+    # with an SMEM "preloaded" flag telling that stream to skip its own
+    # tile-0 start. Removes the first-tile DMA exposure at every
+    # qkv/o/fc1/fc2/lm_head boundary (~5 per layer); the scalar core
+    # issues the prefetch while the MXU still runs the current task's
+    # trailing matmuls. Requires nbuf >= 2.
+    cross_prefetch: bool = False
     # Fold the RMS norms into their consumers (qkv / fc1 / lm_head
     # compute the norm inline from x instead of reading a NORM task's h)
     # — drops 2 tasks per layer + the final norm from the grid, i.e.
@@ -125,28 +134,40 @@ class MegaConfig:
     @classmethod
     def from_spec(cls, spec: str) -> "MegaConfig":
         """Parse the sweep/bench config-string format
-        ``tile_n:tile_k:nbuf[:fuse_norms]`` — the ONE parser for both
-        ``perf/mega_tile_sweep.py`` (which writes these strings into
-        ``perf/MEGA_TUNED.json``) and ``bench.py`` (which reads them
-        back); a shared definition keeps the handoff format-compatible.
-        """
+        ``tile_n:tile_k:nbuf[:fuse_norms[:cross_prefetch]]`` — the ONE
+        parser for both ``perf/mega_tile_sweep.py`` (which writes these
+        strings into ``perf/MEGA_TUNED.json``) and ``bench.py`` (which
+        reads them back); a shared definition keeps the handoff
+        format-compatible."""
         fields = [int(v) for v in spec.split(":")]
-        if len(fields) not in (3, 4):
+        if len(fields) not in (3, 4, 5):
             raise ValueError(
-                f"want tile_n:tile_k:nbuf[:fuse_norms], got {spec!r}"
+                "want tile_n:tile_k:nbuf[:fuse_norms[:cross_prefetch]], "
+                f"got {spec!r}"
             )
         return cls(
             tile_n=fields[0], tile_k=fields[1], nbuf=fields[2],
             fuse_norms=bool(fields[3]) if len(fields) > 3 else False,
+            cross_prefetch=bool(fields[4]) if len(fields) > 4 else False,
         )
+
+    def spec(self) -> str:
+        """Inverse of :meth:`from_spec` (what the sweep persists)."""
+        return (f"{self.tile_n}:{self.tile_k}:{self.nbuf}:"
+                f"{int(self.fuse_norms)}:{int(self.cross_prefetch)}")
 
     def resolve(self, dims: MegaDims) -> "ResolvedConfig":
         if self.nbuf < 1:
             raise ValueError(f"nbuf must be >= 1, got {self.nbuf}")
+        if self.cross_prefetch and self.nbuf < 2:
+            # Serial mode starts each tile at its own iteration; there
+            # is no rotation slot a prefetched tile could wait in.
+            raise ValueError("cross_prefetch requires nbuf >= 2")
         return ResolvedConfig(
             # nbuf=1 is a valid (serial, no-prefetch) degenerate the
             # sweep uses to isolate the prefetch benefit.
             nbuf=self.nbuf,
+            cross_prefetch=self.cross_prefetch,
             fuse_norms=self.fuse_norms,
             tn_qkv=pick_tile(dims.qkv_loc, self.tile_n),
             tn_fc1=pick_tile(dims.f_loc, self.tile_n),
@@ -176,6 +197,7 @@ class MegaConfig:
 @dataclasses.dataclass(frozen=True)
 class ResolvedConfig:
     nbuf: int
+    cross_prefetch: bool
     fuse_norms: bool
     tn_qkv: int
     tn_fc1: int
@@ -216,6 +238,11 @@ class KernelCtx:
         self.tok_smem: Any = None   # [B] i32 — next-token feedback
         self.toks_out: Any = None   # [nsteps, 1, B] i32 — greedy tokens
         self.noise: Any = None  # [1, B, v_loc] VMEM — this step's noise
+        # cross_prefetch SMEM flags: slot 0 of col/rowstage already
+        # holds the current task's tile 0 (started by the previous
+        # task's prefetch block; the stream skips its own start).
+        self.pre_col: Any = None
+        self.pre_row: Any = None
 
 
 def make_mega_kernel(
@@ -263,6 +290,7 @@ def make_mega_kernel(
             colstage, rowstage, kstage, vstage,            # weight/KV staging
             arsrc, cbuf,                                   # AR staging
             tokrow, tok_smem,                              # token feedback
+            pre_col, pre_row,                              # prefetch flags
             wsem, esem, osem, ksem, vsem, arsend, arrecv,  # DMA semaphores
             tsem,
         ) = rest
@@ -285,6 +313,7 @@ def make_mega_kernel(
         kctx.kstage, kctx.vstage = kstage, vstage
         kctx.arsrc, kctx.cbuf = arsrc, cbuf
         kctx.tokrow, kctx.tok_smem = tokrow, tok_smem
+        kctx.pre_col, kctx.pre_row = pre_col, pre_row
         kctx.wsem, kctx.esem, kctx.osem = wsem, esem, osem
         kctx.ksem, kctx.vsem = ksem, vsem
         kctx.arsend, kctx.arrecv = arsend, arrecv
@@ -295,8 +324,59 @@ def make_mega_kernel(
         kctx.arg0 = task_tab[t, 2]
         kctx.arg1 = task_tab[t, 3]
 
+        if cfg.cross_prefetch:
+            @pl.when(jnp.logical_and(kctx.step == 0, t == 0))
+            def _init_flags():
+                pre_col[0] = 0
+                pre_row[0] = 0
+
         for value, body in bodies:
             pl.when(ttype == value)(body)
+
+        if cfg.cross_prefetch:
+            # Start the NEXT task's first weight-tile DMA now: the
+            # scalar core runs ahead of the MXU, so the copy overlaps
+            # this task's trailing matmuls and the next stream skips
+            # its own tile-0 start (flag consumed there). Copies must
+            # BYTE-MATCH the stream's own copy(0) — same refs, widths,
+            # and semaphore — or the wait accounting breaks. The last
+            # task of a step prefetches nothing (the next grid
+            # iteration is the next step's EMBED).
+            T = pl.num_programs(1)
+            d = dims.d
+
+            from triton_distributed_tpu.megakernel.kernels import (
+                col_tile_copy,
+                row_tile_copy,
+            )
+
+            @pl.when(t + 1 < T)
+            def _prefetch_next():
+                nt = task_tab[t + 1, 0]
+                nl = task_tab[t + 1, 1]
+
+                def col(w_hbm, tn):
+                    col_tile_copy(
+                        colstage, wsem, w_hbm, d, 0, tn, 0
+                    ).start()
+                    pre_col[0] = 1
+
+                def row(w_hbm, tk):
+                    row_tile_copy(
+                        rowstage, wsem, w_hbm, 0, tk, d, 0
+                    ).start()
+                    pre_row[0] = 1
+
+                pl.when(nt == int(TaskType.QKV_PROJ))(
+                    lambda: col(wqkv.at[nl], cfg.tn_qkv))
+                pl.when(nt == int(TaskType.FC1))(
+                    lambda: col(w1.at[nl], cfg.tn_fc1))
+                pl.when(nt == int(TaskType.LM_HEAD))(
+                    lambda: col(lm_head, cfg.tn_lm))
+                pl.when(nt == int(TaskType.O_PROJ))(
+                    lambda: row(wo.at[nl], cfg.tk_o))
+                pl.when(nt == int(TaskType.FC2))(
+                    lambda: row(w2.at[nl], cfg.tk_fc2))
 
     return kernel
 
@@ -384,6 +464,8 @@ def build_mega_call(
             # next step's EMBED can scalar-read it as a DMA index.
             pltpu.VMEM((1, max(B, 1)), jnp.int32),             # tokrow
             pltpu.SMEM((1, max(B, 1)), jnp.int32),             # tok_smem
+            pltpu.SMEM((1,), jnp.int32),                       # pre_col
+            pltpu.SMEM((1,), jnp.int32),                       # pre_row
             pltpu.SemaphoreType.DMA((cfg.nbuf,)),              # wsem
             pltpu.SemaphoreType.DMA,                           # esem
             pltpu.SemaphoreType.DMA,                           # osem

@@ -72,6 +72,25 @@ def _make_rope(hd: int, theta: float):
     return angle, rope
 
 
+def col_tile_copy(stage, sem, w_hbm, k, col0, w, slot):
+    """The column-stream's tile DMA descriptor — ONE definition shared
+    by ``_stream_cols`` and the cross_prefetch block in
+    ``code_generator.py``: a prefetched tile-0 must BYTE-MATCH the
+    stream's own ``copy(0)`` (same refs/slices/semaphore) or the wait
+    accounting breaks, so both build it here."""
+    return pltpu.make_async_copy(
+        w_hbm.at[:, pl.ds(col0, w)], stage.at[slot, :k, :w], sem.at[slot]
+    )
+
+
+def row_tile_copy(stage, sem, w_hbm, row0, tk, d, slot):
+    """Row-stream analog of :func:`col_tile_copy` (same sharing
+    contract)."""
+    return pltpu.make_async_copy(
+        w_hbm.at[pl.ds(row0, tk), :], stage.at[slot, :tk, :d], sem.at[slot]
+    )
+
+
 def _stream_cols(kctx, x_f32, w_hbm, n: int, tn: int, consume,
                  col0: int = 0, tail: int = 0, carry=None):
     """Column-streamed GEMM: ``x [B, K] @ w_hbm [K, col0:col0+n*tn]``
@@ -100,19 +119,23 @@ def _stream_cols(kctx, x_f32, w_hbm, n: int, tn: int, consume,
 
     def copy(j, slot, w=None):
         w = tn if w is None else w
-        return pltpu.make_async_copy(
-            w_hbm.at[:, pl.ds(col0 + j * tn, w)],
-            stage.at[slot, :k, :w],
-            sem.at[slot],
-        )
+        return col_tile_copy(stage, sem, w_hbm, k, col0 + j * tn, w, slot)
 
     def start(j):
         return copy(j, j % depth, tail if j == n else None)
 
     # Prologue: fill the pipeline (static — n, tail, depth are Python
-    # ints here).
+    # ints here). Under cross_prefetch, tile 0 may already be in flight
+    # (started by the previous task's prefetch block with an identical
+    # descriptor) — consume the flag and skip the duplicate start.
+    if kctx.cfg.cross_prefetch:
+        pre = kctx.pre_col[0]
+        kctx.pre_col[0] = 0
     for j in range(min(depth - 1, total)):
-        start(j).start()
+        if j == 0 and kctx.cfg.cross_prefetch:
+            pl.when(pre == 0)(lambda: start(0).start())
+        else:
+            start(j).start()
 
     def body(j, c):
         slot = jax.lax.rem(j, depth)
@@ -170,14 +193,18 @@ def _stream_rows(kctx, x_ref, w_hbm, out_ref, n: int, tk: int):
     d = out_ref.shape[-1]
 
     def copy(j, slot):
-        return pltpu.make_async_copy(
-            w_hbm.at[pl.ds(j * tk, tk), :],
-            stage.at[slot, :tk, :d],
-            sem.at[slot],
-        )
+        return row_tile_copy(stage, sem, w_hbm, j * tk, tk, d, slot)
 
-    for j in range(min(depth - 1, n)):  # fill the pipeline (static)
-        copy(j, j % depth).start()
+    # Pipeline fill; under cross_prefetch tile 0 may already be in
+    # flight from the previous task's prefetch block (same descriptor).
+    if kctx.cfg.cross_prefetch:
+        pre = kctx.pre_row[0]
+        kctx.pre_row[0] = 0
+    for j in range(min(depth - 1, n)):
+        if j == 0 and kctx.cfg.cross_prefetch:
+            pl.when(pre == 0)(lambda: copy(0, 0).start())
+        else:
+            copy(j, j % depth).start()
     out_ref[...] = jnp.zeros_like(out_ref)
 
     def body(j, carry):
