@@ -34,6 +34,18 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def build_checkpoint(full: bool) -> str:
+    # Reuse an already-built checkpoint: save_pretrained costs minutes
+    # on this 1-core host, and every watcher retry pays it again. The
+    # build is deterministic (manual_seed(0)), so an existing dir with
+    # weights is byte-equivalent to a rebuild.
+    path = os.path.join(
+        tempfile.gettempdir(), f"qwen3_hf_{'full' if full else 'small'}"
+    )
+    if os.path.exists(os.path.join(path, "config.json")) and any(
+        f.endswith(".safetensors") for f in os.listdir(path)
+    ):
+        return path
+
     import torch
     import transformers
 
@@ -52,8 +64,17 @@ def build_checkpoint(full: bool) -> str:
     )
     torch.manual_seed(0)
     model = transformers.Qwen3ForCausalLM(cfg).eval()
-    path = os.path.join(tempfile.gettempdir(), f"qwen3_hf_{'full' if full else 'small'}")
-    model.save_pretrained(path, safe_serialization=True)
+    # Build into a scratch dir and rename into place: save_pretrained
+    # is non-atomic and takes minutes here — a watcher kill mid-save
+    # would otherwise leave a partial dir that passes the reuse check
+    # forever.
+    tmp = path + ".building"
+    if os.path.exists(tmp):
+        import shutil
+
+        shutil.rmtree(tmp)
+    model.save_pretrained(tmp, safe_serialization=True)
+    os.rename(tmp, path)
     return path
 
 

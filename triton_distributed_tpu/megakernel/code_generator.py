@@ -343,40 +343,29 @@ def make_mega_kernel(
             # task of a step prefetches nothing (the next grid
             # iteration is the next step's EMBED).
             T = pl.num_programs(1)
-            d = dims.d
 
             from triton_distributed_tpu.megakernel.kernels import (
-                col_tile_copy,
-                row_tile_copy,
+                stream_tile0_table,
             )
 
             @pl.when(t + 1 < T)
             def _prefetch_next():
                 nt = task_tab[t + 1, 0]
                 nl = task_tab[t + 1, 1]
+                col_tab, row_tab = stream_tile0_table(kctx)
 
-                def col(w_hbm, tn):
-                    col_tile_copy(
-                        colstage, wsem, w_hbm, d, 0, tn, 0
-                    ).start()
-                    pre_col[0] = 1
+                for tt, make in col_tab:
+                    def fire(make=make):
+                        make(nl).start()
+                        pre_col[0] = 1
 
-                def row(w_hbm, tk):
-                    row_tile_copy(
-                        rowstage, wsem, w_hbm, 0, tk, d, 0
-                    ).start()
-                    pre_row[0] = 1
+                    pl.when(nt == int(tt))(fire)
+                for tt, make in row_tab:
+                    def fire(make=make):
+                        make(nl).start()
+                        pre_row[0] = 1
 
-                pl.when(nt == int(TaskType.QKV_PROJ))(
-                    lambda: col(wqkv.at[nl], cfg.tn_qkv))
-                pl.when(nt == int(TaskType.FC1))(
-                    lambda: col(w1.at[nl], cfg.tn_fc1))
-                pl.when(nt == int(TaskType.LM_HEAD))(
-                    lambda: col(lm_head, cfg.tn_lm))
-                pl.when(nt == int(TaskType.O_PROJ))(
-                    lambda: row(wo.at[nl], cfg.tk_o))
-                pl.when(nt == int(TaskType.FC2))(
-                    lambda: row(w2.at[nl], cfg.tk_fc2))
+                    pl.when(nt == int(tt))(fire)
 
     return kernel
 

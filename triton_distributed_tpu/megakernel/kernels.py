@@ -91,6 +91,29 @@ def row_tile_copy(stage, sem, w_hbm, row0, tk, d, slot):
     )
 
 
+# The ONE per-task-type table of tile-0 prefetch descriptors, kept next
+# to the task bodies whose streams must match them (each entry mirrors
+# its body's ``_stream_cols``/``_stream_rows`` call: same weight ref,
+# same tile width, k == dims.d, col0/row0 == 0 — the streams assert
+# those invariants when consuming the prefetch flag). The cross_prefetch
+# block in ``code_generator.py`` builds its dispatch from this table.
+def stream_tile0_table(kctx):
+    d = kctx.dims.d
+    cfg = kctx.cfg
+    col, row = [], []
+    col.append((TaskType.QKV_PROJ, lambda nl: col_tile_copy(
+        kctx.colstage, kctx.wsem, kctx.wqkv.at[nl], d, 0, cfg.tn_qkv, 0)))
+    col.append((TaskType.FC1, lambda nl: col_tile_copy(
+        kctx.colstage, kctx.wsem, kctx.w1.at[nl], d, 0, cfg.tn_fc1, 0)))
+    col.append((TaskType.LM_HEAD, lambda nl: col_tile_copy(
+        kctx.colstage, kctx.wsem, kctx.lm_head, d, 0, cfg.tn_lm, 0)))
+    row.append((TaskType.O_PROJ, lambda nl: row_tile_copy(
+        kctx.rowstage, kctx.wsem, kctx.wo.at[nl], 0, cfg.tk_o, d, 0)))
+    row.append((TaskType.FC2, lambda nl: row_tile_copy(
+        kctx.rowstage, kctx.wsem, kctx.w2.at[nl], 0, cfg.tk_fc2, d, 0)))
+    return col, row
+
+
 def _stream_cols(kctx, x_f32, w_hbm, n: int, tn: int, consume,
                  col0: int = 0, tail: int = 0, carry=None):
     """Column-streamed GEMM: ``x [B, K] @ w_hbm [K, col0:col0+n*tn]``
@@ -129,6 +152,11 @@ def _stream_cols(kctx, x_f32, w_hbm, n: int, tn: int, consume,
     # (started by the previous task's prefetch block with an identical
     # descriptor) — consume the flag and skip the duplicate start.
     if kctx.cfg.cross_prefetch:
+        # Prefetched tile-0 descriptors (stream_tile0_table) assume
+        # k == d, col0 == 0, and a full-width first tile (n >= 1 — a
+        # tail-only stream's copy(0) would be tail-width and break the
+        # byte match); fail at trace time instead of corrupting.
+        assert col0 == 0 and k == kctx.dims.d and n >= 1, (col0, k, n)
         pre = kctx.pre_col[0]
         kctx.pre_col[0] = 0
     for j in range(min(depth - 1, total)):
@@ -198,6 +226,7 @@ def _stream_rows(kctx, x_ref, w_hbm, out_ref, n: int, tk: int):
     # Pipeline fill; under cross_prefetch tile 0 may already be in
     # flight from the previous task's prefetch block (same descriptor).
     if kctx.cfg.cross_prefetch:
+        assert d == kctx.dims.d, d  # stream_tile0_table's assumption
         pre = kctx.pre_row[0]
         kctx.pre_row[0] = 0
     for j in range(min(depth - 1, n)):
