@@ -71,9 +71,11 @@ class ContinuousEngine(MegaDispatch):
         temperature: float = 0.0,
         eos_id: int | None = None,
         seed: int = 0,
+        mega_cfg=None,
     ):
         self.model = model
         self.mode = mode
+        self.mega_cfg = mega_cfg
         self.temperature = temperature
         self.eos_id = eos_id
         self.key = jax.random.key(seed)

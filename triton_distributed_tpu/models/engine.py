@@ -35,9 +35,13 @@ EngineMode = Literal["xla", "pallas", "mega"]
 class MegaDispatch:
     """Shared megakernel-mode dispatch (Engine + ContinuousEngine):
     lazy MegaQwen3 construction, xla prefill fallback, and mega-vs-model
-    decode routing. Expects ``self.model`` and ``self.mode``."""
+    decode routing. Expects ``self.model`` and ``self.mode``;
+    ``self.mega_cfg`` (an optional ``MegaConfig``, e.g. a sweep-tuned
+    one — ``MegaConfig.from_spec(...)`` parses the
+    ``perf/MEGA_TUNED.json`` config strings) customizes the kernel."""
 
     _mega = None
+    mega_cfg = None
 
     @property
     def _prefill_mode(self) -> Mode:
@@ -49,7 +53,7 @@ class MegaDispatch:
         if self._mega is None:
             from triton_distributed_tpu.megakernel import MegaQwen3
 
-            self._mega = MegaQwen3(self.model)
+            self._mega = MegaQwen3(self.model, cfg=self.mega_cfg)
         return self._mega
 
     def _decode_step(self, tok, cache):
@@ -72,11 +76,13 @@ class Engine(MegaDispatch):
         seed: int = 0,
         paged: bool = False,
         page_size: int = 128,
+        mega_cfg=None,
     ):
         self.model = model
         self.temperature = temperature
         self.top_p = top_p
         self.mode = mode
+        self.mega_cfg = mega_cfg
         self.verbose = verbose
         self.key = jax.random.key(seed)
         self.last_stats: dict = {}
