@@ -84,13 +84,15 @@ STEPS = [
     # ladder's ms/step into per-matvec floors + fixed dispatch cost
     # (the number that decides where megakernel tuning goes next).
     ("decode_profile", [sys.executable, "perf/decode_profile.py"], 900),
+    # Weight-stream sweep: (tiles, nbuf, fuse_norms, cross_prefetch) —
+    # the kernel-body levers A/B'd at the ladder's mega_multi config;
+    # the winner lands in MEGA_TUNED.json for the driver's bench.
+    # Ahead of mega_ns: in a short window this is the step that moves
+    # the headline.
+    ("mega_tiles", [sys.executable, "perf/mega_tile_sweep.py"], 2400),
     # Launch-width sweep: fits per-launch vs per-step megakernel cost
     # (decides whether wider NS or kernel-body tuning moves the ladder).
     ("mega_ns", [sys.executable, "perf/mega_ns_sweep.py"], 2400),
-    # Weight-stream sweep: (tile_n/tile_k, nbuf) — the HBM-floor levers
-    # (wide tiles + deep staging) A/B'd at the ladder's mega_multi
-    # configuration; winners become MegaConfig defaults.
-    ("mega_tiles", [sys.executable, "perf/mega_tile_sweep.py"], 2400),
     ("adaptive_ag", [sys.executable, "-c", _ADAPTIVE_AG], 400),
     # bench.py's own worst case: ~860 s probe retries + 2700 s global
     # worker deadline + CPU fallback ladder + teardown — the step
