@@ -368,6 +368,32 @@ class TestMegakernelLower:
         exp = export.export(step, platforms=["tpu"])(params, tok, cache)
         assert len(exp.mlir_module_serialized) > 0
 
+    def test_mega_tuned_config_lowers(self, tpu_ctx4):
+        """The sweep-promotable config (deep staging + fused norms +
+        cross-task prefetch) must lower for TPU — the trace-level gate
+        for the MEGA_TUNED.json path (Mosaic itself only runs on chip;
+        see module docstring)."""
+        from triton_distributed_tpu.megakernel import MegaQwen3
+        from triton_distributed_tpu.megakernel.code_generator import (
+            MegaConfig,
+        )
+        from triton_distributed_tpu.models import AutoLLM
+
+        model = AutoLLM.from_pretrained("tiny", ctx=tpu_ctx4)
+        mega = MegaQwen3(
+            model,
+            cfg=MegaConfig(nbuf=4, fuse_norms=True, cross_prefetch=True),
+        )
+        f = jax.jit(mega.build_multi(1, 64, 2))
+        cache = jax.eval_shape(lambda: model.new_cache(1, 64))
+        tok = jax.ShapeDtypeStruct((1,), jnp.int32)
+        params = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+            model.params,
+        )
+        exp = export.export(f, platforms=["tpu"])(params, tok, cache)
+        assert len(exp.mlir_module_serialized) > 0
+
 
 class TestBaselineShapesLower:
     """The survey north-star shapes (M=8192, K=4096, N=12288, tp=8,
