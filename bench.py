@@ -236,6 +236,57 @@ def run_ladder(
         }
     })
 
+    # Shared greedy-chain builders for the mega rungs (one definition
+    # serves the bf16 and q8 cross-checks/timers).
+    def _single_chain(step_fn):
+        def single_seq(params, tok, cache, n):
+            def body(i, carry):
+                tok, cache, seq = carry
+                logits, cache = step_fn(params, tok, cache)
+                tok = jnp.argmax(logits, -1).astype(jnp.int32)
+                return tok, cache, seq.at[i].set(tok[0])
+
+            seq0 = jnp.zeros((n,), jnp.int32)
+            return jax.lax.fori_loop(0, n, body, (tok, cache, seq0))[2]
+
+        return single_seq
+
+    def _multi_chain(multi_fn, ns, record):
+        def multi_seq(params, tok, cache, nl):
+            def body(i, carry):
+                tok, cache, seq = carry
+                toks, _lg, cache = multi_fn(params, tok, cache)
+                if record:
+                    seq = jax.lax.dynamic_update_slice(
+                        seq, toks[:, 0], (i * ns,)
+                    )
+                return toks[ns - 1], cache, seq
+
+            seq0 = jnp.zeros((nl * ns if record else 1,), jnp.int32)
+            out = jax.lax.fori_loop(0, nl, body, (tok, cache, seq0))
+            return out[2] if record else out[0]
+
+        return multi_seq
+
+    def _mega_cross_check(name, step_fn, multi_fn, ns, params):
+        """Single- vs multi-step greedy chains over the same kernel
+        math must agree token-for-token before the timing counts."""
+        s_seq = np.asarray(
+            jax.jit(_single_chain(step_fn), static_argnums=3)(
+                params, tok0, cache0, STEPS
+            )
+        )
+        m_seq = np.asarray(
+            jax.jit(_multi_chain(multi_fn, ns, record=True),
+                    static_argnums=3)(params, tok0, cache0, STEPS // ns)
+        )
+        if (s_seq != m_seq).any():
+            raise RuntimeError(
+                f"{name}: multi-step tokens diverge from single-step: "
+                f"{s_seq.tolist()} vs {m_seq.tolist()}"
+            )
+        _emit(progress_fh, {"cross_check": name, "ok": True})
+
     def make_runner(mode):
         step = model.decode_fn(mode)
 
@@ -345,63 +396,16 @@ def run_ladder(
                 1, int(cache0.k.shape[3]), NS
             )
 
-            def mega_multi_n(params, tok, cache, nl):
-                def body(_, carry):
-                    tok, cache = carry
-                    toks, _lg, cache = mmulti(params, tok, cache)
-                    return toks[NS - 1], cache
-
-                return jax.lax.fori_loop(0, nl, body, (tok, cache))
-
-            mmrun = jax.jit(mega_multi_n, static_argnums=3)
+            mmrun = jax.jit(
+                _multi_chain(mmulti, NS, record=False), static_argnums=3
+            )
 
             def mega_multi_once():
-                out_tok, _ = mmrun(model.params, tok0, cache0, STEPS // NS)
-                np.asarray(out_tok)
+                np.asarray(mmrun(model.params, tok0, cache0, STEPS // NS))
 
-            # Cross-check before timing: the single- and multi-step
-            # kernels run identical math, so their greedy chains must
-            # agree token-for-token — a mismatch means the multi kernel
-            # mis-executes on this chip, and its timing would be
-            # meaningless.
-            def single_seq(params, tok, cache, n):
-                def body(i, carry):
-                    tok, cache, seq = carry
-                    logits, cache = mstep(params, tok, cache)
-                    tok = jnp.argmax(logits, -1).astype(jnp.int32)
-                    return tok, cache, seq.at[i].set(tok[0])
-
-                seq0 = jnp.zeros((n,), jnp.int32)
-                return jax.lax.fori_loop(0, n, body, (tok, cache, seq0))[2]
-
-            def multi_seq(params, tok, cache, nl):
-                def body(i, carry):
-                    tok, cache, seq = carry
-                    toks, _lg, cache = mmulti(params, tok, cache)
-                    seq = jax.lax.dynamic_update_slice(
-                        seq, toks[:, 0], (i * NS,)
-                    )
-                    return toks[NS - 1], cache, seq
-
-                seq0 = jnp.zeros((nl * NS,), jnp.int32)
-                return jax.lax.fori_loop(0, nl, body, (tok, cache, seq0))[2]
-
-            s_seq = np.asarray(
-                jax.jit(single_seq, static_argnums=3)(
-                    model.params, tok0, cache0, STEPS
-                )
+            _mega_cross_check(
+                "mega_multi", mstep, mmulti, NS, model.params
             )
-            m_seq = np.asarray(
-                jax.jit(multi_seq, static_argnums=3)(
-                    model.params, tok0, cache0, STEPS // NS
-                )
-            )
-            if (s_seq != m_seq).any():
-                raise RuntimeError(
-                    "multi-step tokens diverge from single-step: "
-                    f"{s_seq.tolist()} vs {m_seq.tolist()}"
-                )
-            _emit(progress_fh, {"cross_check": "mega_multi", "ok": True})
 
             _emit(progress_fh, {
                 "rung": "mega_multi", "ms": time_rung(mega_multi_once),
@@ -413,6 +417,54 @@ def run_ladder(
         except Exception as e:
             _emit(progress_fh, {
                 "rung": "mega_multi",
+                "error": f"{type(e).__name__}: {e}"[:300],
+            })
+
+    if on_tpu and "mega_q8" not in skip:
+        # Weight-only int8 decode: HALF the HBM bytes of the bf16 step
+        # (decode is bandwidth-bound, so ~half the floor). Reported as
+        # its own rung — the headline value stays bf16 for
+        # apples-to-apples with the reference's bf16 ladder
+        # (docs/mega_triton_kernel.md:27-37); a serving user opts in
+        # via MegaConfig(wq8=True).
+        _emit(progress_fh, {
+            "start": "mega_q8", "budget_s": _MULTI_RUNG_TIMEOUT_S,
+        })
+        try:
+            import dataclasses as _dc
+
+            from triton_distributed_tpu.megakernel import MegaQwen3
+            from triton_distributed_tpu.megakernel.code_generator import (
+                MegaConfig,
+            )
+
+            NS8 = _env_int("TDT_BENCH_NS", 8)
+            if NS8 <= 0 or STEPS % NS8:
+                NS8 = 8
+            qcfg = _dc.replace(mega_cfg or MegaConfig(), wq8=True)
+            mega8 = MegaQwen3(model, cfg=qcfg)
+            qp = mega8.quantized_params()
+            q_single = mega8.decode_fn(1, int(cache0.k.shape[3]))
+            q_multi = mega8.decode_multi_fn(1, int(cache0.k.shape[3]), NS8)
+
+            _mega_cross_check("mega_q8", q_single, q_multi, NS8, qp)
+
+            q8_run = jax.jit(
+                _multi_chain(q_multi, NS8, record=False), static_argnums=3
+            )
+
+            def q8_once():
+                np.asarray(q8_run(qp, tok0, cache0, STEPS // NS8))
+
+            _emit(progress_fh, {
+                "rung": "mega_q8", "ms": time_rung(q8_once),
+                "steps_per_launch": NS8,
+                "note": "weight-only int8 (separate regime; headline "
+                        "stays bf16)",
+            })
+        except Exception as e:
+            _emit(progress_fh, {
+                "rung": "mega_q8",
                 "error": f"{type(e).__name__}: {e}"[:300],
             })
 
@@ -611,7 +663,12 @@ def main() -> int:
         }))
         return 1
 
-    best_name = min(ladder, key=ladder.get)
+    # Headline = best BF16 rung: mega_q8 halves the weight bytes and
+    # would win trivially, but the reference ladder it is compared
+    # against (docs/mega_triton_kernel.md:27-37) is bf16 — the int8
+    # number rides along in the ladder dict instead.
+    bf16 = {k: v for k, v in ladder.items() if k != "mega_q8"} or ladder
+    best_name = min(bf16, key=bf16.get)
     ms = ladder[best_name]
     # Bandwidth roofline: weights read once per step + KV context read.
     gbs = (init["param_bytes"] + init["kv_bytes"]) / (ms * 1e-3) / 1e9
@@ -631,6 +688,11 @@ def main() -> int:
     }
     if cross is not None:
         out["mega_multi_cross_check"] = bool(cross.get("ok"))
+    cross8 = next(
+        (e for e in events if e.get("cross_check") == "mega_q8"), None
+    )
+    if cross8 is not None:
+        out["mega_q8_cross_check"] = bool(cross8.get("ok"))
     spl = next(
         (e.get("steps_per_launch") for e in events
          if e.get("rung") == "mega_multi" and "steps_per_launch" in e),

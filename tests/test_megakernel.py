@@ -340,6 +340,69 @@ def test_cross_prefetch_parity(ctx4, fused):
     assert [int(x) for x in np.asarray(toks3)[:, 0]] == gold_chain
 
 
+def test_wq8_parity_vs_dequant_gold(ctx4):
+    """Weight-only int8 decode (MegaConfig.wq8): the megakernel fed
+    Q8Params must match an XLA forward over the DEQUANTIZED weights
+    (same math up to bf16 rounding order — the golden rounds w8·scale
+    to bf16 before its dots, the kernel scales the f32 product; row
+    shards dequantize per rank before the allreduce in both), and the
+    multi-step greedy chain must be token-exact against that golden."""
+    import dataclasses as dc
+
+    from jax.sharding import PartitionSpec as P
+
+    from triton_distributed_tpu.megakernel.code_generator import MegaConfig
+
+    model = AutoLLM.from_pretrained("tiny", ctx=ctx4, max_length=64)
+    cache = model.new_cache(1)
+    toks = jnp.asarray(np.arange(16) % model.cfg.vocab_size, jnp.int32)
+    logits, cache = model.prefill(toks, cache, "xla")
+    tok0 = jnp.argmax(logits)[None].astype(jnp.int32)
+    clone = lambda c: jax.tree.map(jnp.copy, c)  # noqa: E731
+
+    mega = MegaQwen3(model, cfg=MegaConfig(wq8=True))
+    qp = mega.quantized_params()
+    assert qp.wqkv.dtype == jnp.int8 and qp.lm_head.dtype == jnp.int8
+
+    ctx = model.ctx
+    dt = model.cfg.dtype
+
+    def deq(spec):
+        return ctx.shard_map(
+            lambda w8, s: (w8.astype(jnp.float32) * s).astype(dt),
+            in_specs=(spec, spec), out_specs=spec,
+        )
+
+    col3, row3, col2 = P(None, None, "tp"), P(None, "tp", None), P(None, "tp")
+    lp = model.params.layers
+    gold_params = dc.replace(
+        model.params,
+        layers=dc.replace(
+            lp,
+            attn=dc.replace(lp.attn, wqkv=deq(col3)(qp.wqkv, qp.sc_qkv),
+                            wo=deq(row3)(qp.wo, qp.sc_o)),
+            mlp=dc.replace(lp.mlp, w1=deq(col3)(qp.w1, qp.sc_w1),
+                           w2=deq(row3)(qp.w2, qp.sc_w2)),
+        ),
+        lm_head=deq(col2)(qp.lm_head, qp.sc_lm),
+    )
+    gold_step = model.decode_fn("xla")
+    lg_gold, _ = jax.jit(gold_step)(gold_params, tok0, clone(cache))
+    lg_mega, _ = mega.decode_fn(1, 64)(qp, tok0, clone(cache))
+    np.testing.assert_allclose(
+        np.asarray(lg_mega), np.asarray(lg_gold), rtol=2e-3, atol=2e-3,
+    )
+
+    # Multi-step greedy: token-exact vs the dequant golden chain.
+    tok, c, ref = tok0, clone(cache), []
+    for _ in range(3):
+        lg, c = jax.jit(gold_step)(gold_params, tok, c)
+        tok = jnp.argmax(lg, -1).astype(jnp.int32)
+        ref.append(int(tok[0]))
+    t3, _, _ = mega.decode_multi_fn(1, 64, 3)(qp, tok0, cache)
+    assert [int(x) for x in np.asarray(t3)[:, 0]] == ref
+
+
 def test_cross_prefetch_needs_depth(ctx4):
     from triton_distributed_tpu.megakernel.code_generator import (
         MegaConfig,

@@ -394,6 +394,28 @@ class TestMegakernelLower:
         exp = export.export(f, platforms=["tpu"])(params, tok, cache)
         assert len(exp.mlir_module_serialized) > 0
 
+    def test_mega_wq8_lowers(self, tpu_ctx4):
+        """Weight-only int8 decode must lower for TPU (int8 staging
+        tiles, VMEM scale operands, upcast-at-MXU dots)."""
+        from triton_distributed_tpu.megakernel import MegaQwen3
+        from triton_distributed_tpu.megakernel.code_generator import (
+            MegaConfig,
+        )
+        from triton_distributed_tpu.models import AutoLLM
+
+        model = AutoLLM.from_pretrained("tiny", ctx=tpu_ctx4)
+        mega = MegaQwen3(model, cfg=MegaConfig(wq8=True))
+        qp = mega.quantized_params()
+        f = jax.jit(mega.build_multi(1, 64, 2))
+        cache = jax.eval_shape(lambda: model.new_cache(1, 64))
+        tok = jax.ShapeDtypeStruct((1,), jnp.int32)
+        qspec = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+            qp,
+        )
+        exp = export.export(f, platforms=["tpu"])(qspec, tok, cache)
+        assert len(exp.mlir_module_serialized) > 0
+
 
 class TestBaselineShapesLower:
     """The survey north-star shapes (M=8192, K=4096, N=12288, tp=8,

@@ -113,6 +113,16 @@ class MegaConfig:
     # time, a deeper pipeline keeps the HBM controller busy through the
     # scalar-core gaps between tiles.
     nbuf: int = 2
+    # int8 weight-only quantized decode: the five projection weights
+    # stream as int8 (HALF the HBM bytes of the bf16 step — decode is
+    # HBM-bound, so this halves the ladder's floor) with f32
+    # per-output-channel scales applied to each tile product before
+    # any nonlinearity. Per-channel scales compose exactly with TP:
+    # column-sharded weights scale their local columns; row-sharded
+    # (o/fc2 partial sums) dequantize per shard BEFORE the allreduce.
+    # Activations, norms, embed, KV stay bf16/f32 — weight-only.
+    # Callers pass `MegaQwen3.quantized_params()` in place of params.
+    wq8: bool = False
     # Cross-task weight prefetch: after each task body, the kernel
     # reads the NEXT task's header and — when it is a weight-streaming
     # task — starts its FIRST tile's DMA into the staging rotation,
@@ -169,6 +179,7 @@ class MegaConfig:
             nbuf=self.nbuf,
             cross_prefetch=self.cross_prefetch,
             fuse_norms=self.fuse_norms,
+            wq8=self.wq8,
             tn_qkv=pick_tile(dims.qkv_loc, self.tile_n),
             tn_fc1=pick_tile(dims.f_loc, self.tile_n),
             # The vocab axis rarely divides by a wide tile (Qwen3:
@@ -199,6 +210,7 @@ class ResolvedConfig:
     nbuf: int
     cross_prefetch: bool
     fuse_norms: bool
+    wq8: bool
     tn_qkv: int
     tn_fc1: int
     tn_lm: int
@@ -243,6 +255,12 @@ class KernelCtx:
         # task's prefetch block; the stream skips its own start).
         self.pre_col: Any = None
         self.pre_row: Any = None
+        # wq8 dequant scale refs (None unless cfg.wq8):
+        self.sc_qkv: Any = None
+        self.sc_o: Any = None
+        self.sc_w1: Any = None
+        self.sc_w2: Any = None
+        self.sc_lm: Any = None
 
 
 def make_mega_kernel(
@@ -275,6 +293,10 @@ def make_mega_kernel(
             ln1, ln2, normf, qn, kn,                       # VMEM (small)
             *rest,
         ) = rest
+        if cfg.wq8:  # per-output-channel dequant scales, after norms
+            sc_qkv, sc_o, sc_w1, sc_w2, sc_lm, *rest = rest
+        else:
+            sc_qkv = sc_o = sc_w1 = sc_w2 = sc_lm = None
         if dims.prefill:  # embedded prompt rows, after the weights
             x0, *rest = rest
         else:
@@ -304,6 +326,8 @@ def make_mega_kernel(
         kctx.toks_out = toks_out
         kctx.embed, kctx.wqkv, kctx.wo = embed, wqkv, wo
         kctx.w1, kctx.w2, kctx.lm_head = w1, w2, lm_head
+        kctx.sc_qkv, kctx.sc_o, kctx.sc_w1 = sc_qkv, sc_o, sc_w1
+        kctx.sc_w2, kctx.sc_lm = sc_w2, sc_lm
         kctx.ln1, kctx.ln2, kctx.normf = ln1, ln2, normf
         kctx.qn, kctx.kn = qn, kn
         kctx.logits, kctx.kc, kctx.vc = logits, kc, vc
@@ -406,6 +430,9 @@ def build_mega_call(
         grid=(dims.nsteps, len(tasks)),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 6
         + [pl.BlockSpec(memory_space=pltpu.VMEM)] * 5
+        # wq8 dequant scales (~2 MB total at 0.6B): VMEM-resident like
+        # the norm weights they sit next to.
+        + ([pl.BlockSpec(memory_space=pltpu.VMEM)] * 5 if cfg.wq8 else [])
         + ([pl.BlockSpec(memory_space=pltpu.VMEM)] if dims.prefill else [])
         + (
             # Per-step noise block: Mosaic pipelines the [B, v_loc]
@@ -436,8 +463,10 @@ def build_mega_call(
             pltpu.VMEM(
                 (1, 8, d) if dims.prefill else (B, 8, d), wdtype
             ),                                                 # estage
-            pltpu.VMEM((cfg.nbuf, d, cfg.tn_max), wdtype),     # colstage
-            pltpu.VMEM((cfg.nbuf, cfg.tk_max, d), wdtype),     # rowstage
+            pltpu.VMEM((cfg.nbuf, d, cfg.tn_max),
+                       jnp.int8 if cfg.wq8 else wdtype),       # colstage
+            pltpu.VMEM((cfg.nbuf, cfg.tk_max, d),
+                       jnp.int8 if cfg.wq8 else wdtype),       # rowstage
             pltpu.VMEM(
                 (1,) * 5 if dims.prefill
                 else (2, B, hkv, cfg.s_blk, hd), cdtype
@@ -530,33 +559,24 @@ def build_mega_call(
         raise NotImplementedError("paged prefill: prefill then scatter")
     if dims.sampled and (dims.page or dims.prefill):
         raise NotImplementedError("sampled multi-step: dense decode only")
+    # ``wargs`` = the kernel-args block (weights + norms [+ wq8
+    # scales]) followed by the two cache operands — variadic so the
+    # wq8 path's extra scale operands flow through without per-mode
+    # signature edits. x0/noise/page_table are re-sited into the
+    # kernel's canonical operand order here.
     if dims.sampled:
-        def run(kv_len, tokens, noise, embed, wqkv, wo, w1, w2, lm_head,
-                ln1, ln2, normf, qn, kn, kc, vc):
+        def run(kv_len, tokens, noise, *wargs):
             return call(
-                table, kv_len, tokens, embed, wqkv, wo, w1, w2, lm_head,
-                ln1, ln2, normf, qn, kn, noise, kc, vc,
+                table, kv_len, tokens, *wargs[:-2], noise, *wargs[-2:]
             )
     elif dims.prefill:
-        def run(kv_len, tokens, x0, embed, wqkv, wo, w1, w2,
-                lm_head, ln1, ln2, normf, qn, kn, kc, vc):
-            return call(
-                table, kv_len, tokens, embed, wqkv, wo, w1, w2,
-                lm_head, ln1, ln2, normf, qn, kn, x0, kc, vc,
-            )
+        def run(kv_len, tokens, x0, *wargs):
+            return call(table, kv_len, tokens, *wargs[:-2], x0, *wargs[-2:])
     elif dims.page:
-        def run(kv_len, tokens, page_table, embed, wqkv, wo, w1, w2,
-                lm_head, ln1, ln2, normf, qn, kn, kc, vc):
-            return call(
-                table, kv_len, tokens, page_table, embed, wqkv, wo, w1, w2,
-                lm_head, ln1, ln2, normf, qn, kn, kc, vc,
-            )
+        def run(kv_len, tokens, page_table, *wargs):
+            return call(table, kv_len, tokens, page_table, *wargs)
     else:
-        def run(kv_len, tokens, embed, wqkv, wo, w1, w2, lm_head,
-                ln1, ln2, normf, qn, kn, kc, vc):
-            return call(
-                table, kv_len, tokens, embed, wqkv, wo, w1, w2, lm_head,
-                ln1, ln2, normf, qn, kn, kc, vc,
-            )
+        def run(kv_len, tokens, *wargs):
+            return call(table, kv_len, tokens, *wargs)
 
     return run

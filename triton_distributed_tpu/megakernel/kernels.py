@@ -165,6 +165,13 @@ def _stream_cols(kctx, x_f32, w_hbm, n: int, tn: int, consume,
         else:
             start(j).start()
 
+    def wtile(slot, w):
+        wt = stage[slot, :k, :w]
+        # wq8: int8 staging tiles upcast at the MXU's doorstep (VPU op
+        # pipelined under the next tile's DMA); scales apply in the
+        # sinks, per output column.
+        return wt.astype(xa.dtype) if wt.dtype == jnp.int8 else wt
+
     def body(j, c):
         slot = jax.lax.rem(j, depth)
         p = j + depth - 1  # tile to prefetch, keeping depth-1 in flight
@@ -180,7 +187,7 @@ def _stream_cols(kctx, x_f32, w_hbm, n: int, tn: int, consume,
 
         copy(j, slot).wait()
         val = jnp.dot(
-            xa, stage[slot, :k, :tn], preferred_element_type=jnp.float32
+            xa, wtile(slot, tn), preferred_element_type=jnp.float32
         )
         if stateful:
             return consume(j, val, c)
@@ -199,7 +206,7 @@ def _stream_cols(kctx, x_f32, w_hbm, n: int, tn: int, consume,
             copy(n, slot, tail).start()
         copy(n, slot, tail).wait()
         val = jnp.dot(
-            xa, stage[slot, :k, :tail], preferred_element_type=jnp.float32
+            xa, wtile(slot, tail), preferred_element_type=jnp.float32
         )
         if stateful:
             carry = consume(n, val, carry)
@@ -208,13 +215,18 @@ def _stream_cols(kctx, x_f32, w_hbm, n: int, tn: int, consume,
     return carry
 
 
-def _stream_rows(kctx, x_ref, w_hbm, out_ref, n: int, tk: int):
+def _stream_rows(kctx, x_ref, w_hbm, out_ref, n: int, tk: int,
+                 scale_row=None):
     """Row-streamed GEMM with accumulation: ``out += x [B, K] @ w [K, d]``
     streaming K tiles (o-proj / fc2 shape class). Overwrites ``out_ref``.
 
     ``x_ref`` must be a (VMEM) ref: the K tile is sliced per step with a
     dynamic ``pl.ds`` on the ref — Mosaic has no lowering for
     ``dynamic_slice`` on register values, only for ref loads.
+
+    ``scale_row`` (wq8): a ``[1, d]`` f32 per-output-channel dequant
+    row applied to every tile product — per-column constants distribute
+    over the K-tile sum, so per-tile application is exact.
     """
     stage, sem = kctx.rowstage, kctx.wsem
     depth = stage.shape[0]
@@ -245,11 +257,16 @@ def _stream_rows(kctx, x_ref, w_hbm, out_ref, n: int, tk: int):
             copy(p, jax.lax.rem(p, depth)).start()
 
         copy(j, slot).wait()
+        wt = stage[slot, :tk, :d]
+        if wt.dtype == jnp.int8:
+            wt = wt.astype(kctx.wdtype)
         val = jnp.dot(
             x_ref[:, pl.ds(j * tk, tk)].astype(kctx.wdtype),
-            stage[slot, :tk, :d],
+            wt,
             preferred_element_type=jnp.float32,
         )
+        if scale_row is not None:
+            val = val * scale_row
         out_ref[...] = out_ref[...] + val
         return carry
 
@@ -341,6 +358,21 @@ def embed_body(kctx):
     return body
 
 
+def _q8_scale(kctx, sref, layer, col0, val):
+    """Apply the per-output-channel dequant scale slice to a tile
+    product (``wq8`` only; identity otherwise). ``col0`` is the tile's
+    first output column (traced ``j * tn`` is fine — tn is a
+    128-multiple, so the lane slice is provably aligned); ``layer`` is
+    the traced layer id for per-layer scale planes, None for the LM
+    head's single plane."""
+    if not kctx.cfg.wq8:
+        return val
+    w = val.shape[1]
+    sl = pl.ds(col0, w)
+    s = sref[:, sl] if layer is None else sref[layer, :, sl]
+    return val * s
+
+
 def _normed_input(kctx, which: int):
     """The consumer's [B, d] f32 input: the NORM task's output (``h``)
     normally, or — with ``fuse_norms`` — the norm computed inline from
@@ -392,6 +424,7 @@ def qkv_body(kctx):
         n = dims.qkv_loc // tn
 
         def sink(j, val):
+            val = _q8_scale(kctx, kctx.sc_qkv, kctx.layer, j * tn, val)
             kctx.qkv[:, pl.ds(j * tn, tn)] = val
 
         _stream_cols(
@@ -744,8 +777,10 @@ def o_proj_body(kctx):
         dims = kctx.dims
         tk = kctx.cfg.tk_o
         n = (dims.hq_loc * dims.head_dim) // tk
+        scale = kctx.sc_o[kctx.layer] if kctx.cfg.wq8 else None
         _stream_rows(
-            kctx, kctx.ao, kctx.wo.at[kctx.layer], kctx.h, n, tk
+            kctx, kctx.ao, kctx.wo.at[kctx.layer], kctx.h, n, tk,
+            scale_row=scale,
         )
 
     return body
@@ -773,6 +808,11 @@ def fc1_body(kctx):
         # One pipeline fill instead of two per layer, and the depth-nbuf
         # rotation never drains between the passes.
         def sink(j, val):
+            # wq8 dequant BEFORE the nonlinearity (val*s is the true
+            # product); sc_w1 shares w1's [1, gate|up] column layout so
+            # j*tn indexes both regions directly.
+            val = _q8_scale(kctx, kctx.sc_w1, kctx.layer, j * tn, val)
+
             @pl.when(j < n)
             def _gate():
                 kctx.mlp[:, pl.ds(j * tn, tn)] = val * jax.lax.logistic(val)
@@ -793,8 +833,10 @@ def fc2_body(kctx):
         dims = kctx.dims
         tk = kctx.cfg.tk_fc2
         n = dims.f_loc // tk
+        scale = kctx.sc_w2[kctx.layer] if kctx.cfg.wq8 else None
         _stream_rows(
-            kctx, kctx.mlp, kctx.w2.at[kctx.layer], kctx.h, n, tk
+            kctx, kctx.mlp, kctx.w2.at[kctx.layer], kctx.h, n, tk,
+            scale_row=scale,
         )
 
     return body
@@ -872,6 +914,7 @@ def lm_head_body(kctx):
                 v_real = min(v_total, dims.v_loc)
 
             def sink(j, val, carry):
+                val = _q8_scale(kctx, kctx.sc_lm, None, j * tn, val)
                 kctx.logits[:, pl.ds(j * tn, val.shape[1])] = val
                 bestv, besti = carry
                 if dims.sampled:
@@ -945,6 +988,7 @@ def lm_head_body(kctx):
             cp.wait()
         else:
             def sink(j, val):
+                val = _q8_scale(kctx, kctx.sc_lm, None, j * tn, val)
                 kctx.logits[:, pl.ds(j * tn, val.shape[1])] = val
 
             _stream_cols(kctx, x_in, kctx.lm_head, n, tn, sink, tail=rem)

@@ -28,6 +28,72 @@ from triton_distributed_tpu.models.paged_kv_cache import (
 )
 from triton_distributed_tpu.models import paged_kv_cache as _paged
 from triton_distributed_tpu.models.qwen import Qwen3, Qwen3Params
+from triton_distributed_tpu.runtime.pytree import register_param_dataclass
+
+
+@dataclasses.dataclass
+class Q8Params:
+    """Weight-only int8 megakernel parameters (``MegaConfig.wq8``).
+
+    The five projection weights are symmetric per-OUTPUT-channel int8
+    (scale = max|w| / 127 over the contraction axis, computed per TP
+    shard — column shards scale their local columns; row shards
+    (``wo``/``w2``, partial sums) carry a per-RANK scale plane stacked
+    on a tp-sharded axis and dequantize before the allreduce, which is
+    exact). Everything else (embed, norms) stays full precision —
+    including ``embed`` when the checkpoint ties it to ``lm_head``:
+    the tied tensor is stored twice, once bf16 for the gather and once
+    int8 for the head stream.
+    """
+
+    embed: jax.Array    # [V, d] full precision
+    wqkv: jax.Array     # [L, d, qkv_loc] int8
+    wo: jax.Array       # [L, o_k, d] int8
+    w1: jax.Array       # [L, d, 2*f_loc] int8
+    w2: jax.Array       # [L, f_loc, d] int8
+    lm_head: jax.Array  # [d, v_loc] int8
+    sc_qkv: jax.Array   # [L, 1, qkv_loc] f32
+    sc_o: jax.Array     # [L, tp, d] f32 globally; [L, 1, d] per shard
+    sc_w1: jax.Array    # [L, 1, 2*f_loc] f32
+    sc_w2: jax.Array    # [L, tp, d] f32 globally; [L, 1, d] per shard
+    sc_lm: jax.Array    # [1, v_loc] f32
+    ln1: jax.Array
+    ln2: jax.Array
+    norm: jax.Array
+    qn: jax.Array
+    kn: jax.Array
+
+
+register_param_dataclass(Q8Params, [
+    "embed", "wqkv", "wo", "w1", "w2", "lm_head",
+    "sc_qkv", "sc_o", "sc_w1", "sc_w2", "sc_lm",
+    "ln1", "ln2", "norm", "qn", "kn",
+])
+
+
+def _quantize_shard(params: Qwen3Params) -> Q8Params:
+    """Per-shard quantization (runs inside shard_map, jitted once)."""
+    lp = params.layers
+
+    def q(w, axis):
+        s = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axis, keepdims=True)
+        s = jnp.maximum(s / 127.0, 1e-12)
+        wi = jnp.clip(
+            jnp.round(w.astype(jnp.float32) / s), -127, 127
+        ).astype(jnp.int8)
+        return wi, s
+
+    wqkv8, sq = q(lp.attn.wqkv, 1)
+    wo8, so = q(lp.attn.wo, 1)
+    w18, s1 = q(lp.mlp.w1, 1)
+    w28, s2 = q(lp.mlp.w2, 1)
+    lm8, slm = q(params.lm_head, 0)
+    return Q8Params(
+        embed=params.embed, wqkv=wqkv8, wo=wo8, w1=w18, w2=w28,
+        lm_head=lm8, sc_qkv=sq, sc_o=so, sc_w1=s1, sc_w2=s2, sc_lm=slm,
+        ln1=lp.ln1, ln2=lp.ln2, norm=params.norm,
+        qn=lp.attn.q_norm, kn=lp.attn.k_norm,
+    )
 
 
 class MegaQwen3:
@@ -89,7 +155,9 @@ class MegaQwen3:
         per_shard = compiled.per_shard
         ax = m.axis
 
-        kernel_args = self._kernel_args
+        wq8 = self.cfg.wq8
+        kernel_args = self._kernel_args_q8 if wq8 else self._kernel_args
+        pspecs = self._q8_specs() if wq8 else m.param_specs
 
         if page:
             def shard_fn(params: Qwen3Params, tokens, cache: PagedKVCache):
@@ -134,7 +202,7 @@ class MegaQwen3:
 
         g = m.ctx.shard_map(
             shard_fn,
-            in_specs=(m.param_specs, P(), specs),
+            in_specs=(pspecs, P(), specs),
             out_specs=(P(None, ax), specs),
         )
         V = m.cfg.vocab_size
@@ -147,6 +215,49 @@ class MegaQwen3:
 
         step = jax.jit(f, donate_argnums=(2,))
         return compiled, step, f
+
+    def _q8_specs(self) -> Q8Params:
+        ax = self.model.axis
+        return Q8Params(
+            embed=P(), wqkv=P(None, None, ax), wo=P(None, ax, None),
+            w1=P(None, None, ax), w2=P(None, ax, None), lm_head=P(None, ax),
+            sc_qkv=P(None, None, ax),
+            # Row-sharded weights carry per-RANK scales: local [L, 1, d]
+            # planes stack on a tp-sharded middle axis.
+            sc_o=P(None, ax, None),
+            sc_w1=P(None, None, ax),
+            sc_w2=P(None, ax, None),
+            sc_lm=P(None, ax),
+            ln1=P(), ln2=P(), norm=P(), qn=P(), kn=P(),
+        )
+
+    def quantized_params(self) -> Q8Params:
+        """The int8 weight pytree ``wq8`` steps take IN PLACE of
+        ``model.params`` (quantized once, device-side, per shard;
+        cached on this instance)."""
+        if getattr(self, "_q8", None) is None:
+            m = self.model
+            f = m.ctx.shard_map(
+                _quantize_shard,
+                in_specs=(m.param_specs,),
+                out_specs=self._q8_specs(),
+            )
+            self._q8 = jax.jit(f)(m.params)
+            jax.block_until_ready(self._q8)
+        return self._q8
+
+    @staticmethod
+    def _kernel_args_q8(q: Q8Params):
+        V, d = q.embed.shape
+        if V % 8:
+            raise ValueError(f"megakernel needs vocab_size % 8 == 0, got {V}")
+        return (
+            q.embed.reshape(V // 8, 8, d),
+            q.wqkv, q.wo, q.w1, q.w2, q.lm_head,
+            q.ln1[:, None, :], q.ln2[:, None, :], q.norm[None, :],
+            q.qn[:, None, :], q.kn[:, None, :],
+            q.sc_qkv, q.sc_o, q.sc_w1, q.sc_w2, q.sc_lm,
+        )
 
     @staticmethod
     def _kernel_args(params: Qwen3Params):
@@ -187,7 +298,14 @@ class MegaQwen3:
             step = self._built(b, s_max, page)[1]
         else:
             step = self._built(b, int(cache.k.shape[3]))[1]
-        return step(self.model.params, tokens, cache)
+        return step(self._step_params(), tokens, cache)
+
+    def _step_params(self):
+        """What the built steps take as their first argument: the int8
+        pytree under ``wq8``, the model's params otherwise."""
+        if self.cfg.wq8:
+            return self.quantized_params()
+        return self.model.params
 
     def decode_fn(self, batch: int, s_max: int, page: int = 0):
         """The raw (unjitted) step ``f(params, tokens, cache) →
@@ -243,7 +361,9 @@ class MegaQwen3:
         mb.build_decoder_graph()
         per_shard = mb.compile(self.policy).per_shard
         ax = m.axis
-        kernel_args = self._kernel_args
+        wq8 = self.cfg.wq8
+        kernel_args = self._kernel_args_q8 if wq8 else self._kernel_args
+        pspecs = self._q8_specs() if wq8 else m.param_specs
 
         if page:
             def shard_fn(params: Qwen3Params, tokens,
@@ -293,7 +413,7 @@ class MegaQwen3:
         noise_specs = (P(None, None, ax),) if sampled else ()
         g = m.ctx.shard_map(
             shard_fn,
-            in_specs=(m.param_specs, P(), specs, *noise_specs),
+            in_specs=(pspecs, P(), specs, *noise_specs),
             out_specs=(P(), P(None, ax), specs),
         )
 
@@ -340,12 +460,15 @@ class MegaQwen3:
         mb.build_prefill_graph()
         per_shard = mb.compile(self.policy).per_shard
         ax = m.axis
+        wq8 = self.cfg.wq8
+        kernel_args = self._kernel_args_q8 if wq8 else self._kernel_args
+        pspecs = self._q8_specs() if wq8 else m.param_specs
 
-        def shard_fn(params: Qwen3Params, tokens, true_len, cache: KVCache):
+        def shard_fn(params, tokens, true_len, cache: KVCache):
             x0 = jnp.take(params.embed, tokens, axis=0)  # [S, d] XLA gather
             logits, k_rows, v_rows, _toks = per_shard(
                 true_len[None], jnp.zeros((1,), jnp.int32), x0,
-                *self._kernel_args(params),
+                *kernel_args(params),
                 # The prefill kernel never reads the cache; tiny
                 # placeholders keep the operand list uniform.
                 jnp.zeros((1, 1, 1, 8, 128), m.cfg.dtype),
@@ -363,7 +486,7 @@ class MegaQwen3:
 
         g = m.ctx.shard_map(
             shard_fn,
-            in_specs=(m.param_specs, P(), P(), cache_specs(ax)),
+            in_specs=(pspecs, P(), P(), cache_specs(ax)),
             out_specs=(P(ax), cache_specs(ax)),
         )
         V = m.cfg.vocab_size
@@ -387,5 +510,6 @@ class MegaQwen3:
         if true_len is None:
             true_len = s
         return self._jit[key](
-            self.model.params, tokens, jnp.asarray(true_len, jnp.int32), cache
+            self._step_params(), tokens, jnp.asarray(true_len, jnp.int32),
+            cache,
         )
