@@ -247,7 +247,9 @@ class ContinuousEngine(MegaDispatch):
                         page=self.page_size,
                     )
                 toks, _logits, self.cache = multi_fn(
-                    self.model.params, jnp.asarray(tok), self.cache
+                    # Q8Params under MegaConfig(wq8=True), else params.
+                    self._mega_model()._step_params(),
+                    jnp.asarray(tok), self.cache,
                 )
                 self._kv_len += NS * active
                 toks_np = np.asarray(toks)  # [NS, max_batch]

@@ -272,7 +272,10 @@ class Engine(MegaDispatch):
                     else:
                         extra = ()
                     toks, logits, cache = fn(
-                        self.model.params, tok, cache, *extra
+                        # _step_params: the Q8Params pytree under
+                        # MegaConfig(wq8=True), model.params otherwise.
+                        self._mega_model()._step_params(), tok, cache,
+                        *extra,
                     )
                     toks = np.asarray(toks)  # [NS, b]
                     out.append(toks.T)
