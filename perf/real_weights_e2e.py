@@ -84,6 +84,9 @@ def main(argv=None) -> int:
                    help="true Qwen3-0.6B dims (heavy relay first contact)")
     p.add_argument("--mode", default="mega_multi",
                    choices=["xla", "pallas", "mega", "mega_multi"])
+    p.add_argument("--q8", action="store_true",
+                   help="weight-only int8 megakernel decode "
+                        "(MegaConfig(wq8=True); mega modes only)")
     p.add_argument("--gen-len", type=int, default=64)
     p.add_argument("--cpu", action="store_true")
     args = p.parse_args(argv)
@@ -124,7 +127,14 @@ def main(argv=None) -> int:
     mode = args.mode
     if mode == "mega_multi":
         mode = "mega"  # Engine auto-selects multi-step in mega mode
-    eng = Engine(model, temperature=0.0, mode=mode)
+    mega_cfg = None
+    if args.q8:
+        from triton_distributed_tpu.megakernel.code_generator import (
+            MegaConfig,
+        )
+
+        mega_cfg = MegaConfig(wq8=True)
+    eng = Engine(model, temperature=0.0, mode=mode, mega_cfg=mega_cfg)
     prompt = np.arange(1, 33, dtype=np.int32)[None]
 
     # First serve is the WARM-UP (prefill + decode compiles, tens of
@@ -145,7 +155,7 @@ def main(argv=None) -> int:
         "checkpoint": ckpt,
         "config": "qwen3-0.6B" if args.full else "qwen3-0.6B-depth8",
         "platform": jax.devices()[0].platform,
-        "mode": args.mode,
+        "mode": args.mode + ("+q8" if args.q8 else ""),
         "load_s": round(load_s, 1),
         "gen_len": int(args.gen_len),
         "cold_wall_s": round(cold_wall, 2),
