@@ -35,6 +35,11 @@ def main(argv=None) -> int:
     p.add_argument("--steps", type=int, default=32)
     p.add_argument("--ns", type=int, default=8)
     p.add_argument("--model", default="Qwen/Qwen3-0.6B")
+    p.add_argument("--q8", action="store_true",
+                   help="sweep with weight-only int8 streams "
+                        "(wq8=True on every config; results are NOT "
+                        "written to MEGA_TUNED.json, which tunes the "
+                        "bf16 headline rungs)")
     p.add_argument("--cpu", action="store_true")
     args = p.parse_args(argv)
 
@@ -69,6 +74,10 @@ def main(argv=None) -> int:
         label = spec
         try:
             cfg = MegaConfig.from_spec(spec)
+            if args.q8:
+                import dataclasses as _dc
+
+                cfg = _dc.replace(cfg, wq8=True)
         except ValueError as e:
             # A malformed spec is an OPERATOR error, not a chip
             # failure: record it AND fail the run (bench.py's explicit-
@@ -78,12 +87,13 @@ def main(argv=None) -> int:
             continue
         label = (f"tn{cfg.tile_n}_tk{cfg.tile_k}_nb{cfg.nbuf}"
                  + ("_fn" if cfg.fuse_norms else "")
-                 + ("_xp" if cfg.cross_prefetch else ""))
+                 + ("_xp" if cfg.cross_prefetch else "")
+                 + ("_q8" if cfg.wq8 else ""))
         try:
             mega = MegaQwen3(model, cfg=cfg)
             once = multi_step_chain(
                 mega.decode_multi_fn(1, s_max, ns), ns,
-                model.params, tok0, cache0, steps,
+                mega._step_params(), tok0, cache0, steps,
             )
             chain = once()  # compile + warm
             if ref_chain is None:
@@ -114,7 +124,8 @@ def main(argv=None) -> int:
     # write-OR-REMOVE on every run with a valid baseline: a stale
     # winner that stopped qualifying (mismatch after a kernel change,
     # or no longer faster) must not keep steering the ladder.
-    if jax.devices()[0].platform != "cpu" and rows and rows[0][3]:
+    if (jax.devices()[0].platform != "cpu" and rows and rows[0][3]
+            and not args.q8):  # q8 timings must not tune the bf16 rungs
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "MEGA_TUNED.json")
         base_ms = rows[0][1]

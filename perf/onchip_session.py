@@ -90,6 +90,11 @@ STEPS = [
     # Ahead of mega_ns: in a short window this is the step that moves
     # the headline.
     ("mega_tiles", [sys.executable, "perf/mega_tile_sweep.py"], 2400),
+    # int8 weight-stream variant of the tile sweep (informational; the
+    # bf16 tuned file is never written by this step).
+    ("mega_tiles_q8", [sys.executable, "perf/mega_tile_sweep.py",
+                       "--q8", "--configs",
+                       "1024:1024:2,1024:1024:4:1,2048:1024:4:1:1"], 1800),
     # Launch-width sweep: fits per-launch vs per-step megakernel cost
     # (decides whether wider NS or kernel-body tuning moves the ladder).
     ("mega_ns", [sys.executable, "perf/mega_ns_sweep.py"], 2400),
