@@ -340,7 +340,16 @@ def test_cross_prefetch_parity(ctx4, fused):
     assert [int(x) for x in np.asarray(toks3)[:, 0]] == gold_chain
 
 
-def test_wq8_parity_vs_dequant_gold(ctx4):
+@pytest.mark.parametrize("extras", [
+    {},
+    # The full tuned q8 stack the on-chip sweep runs (deep staging +
+    # fused norms + cross-task prefetch over int8 streams).
+    pytest.param(
+        {"nbuf": 3, "fuse_norms": True, "cross_prefetch": True},
+        marks=pytest.mark.slow,
+    ),
+])
+def test_wq8_parity_vs_dequant_gold(ctx4, extras):
     """Weight-only int8 decode (MegaConfig.wq8): the megakernel fed
     Q8Params must match an XLA forward over the DEQUANTIZED weights
     (same math up to bf16 rounding order — the golden rounds w8·scale
@@ -360,7 +369,7 @@ def test_wq8_parity_vs_dequant_gold(ctx4):
     tok0 = jnp.argmax(logits)[None].astype(jnp.int32)
     clone = lambda c: jax.tree.map(jnp.copy, c)  # noqa: E731
 
-    mega = MegaQwen3(model, cfg=MegaConfig(wq8=True))
+    mega = MegaQwen3(model, cfg=MegaConfig(wq8=True, **extras))
     qp = mega.quantized_params()
     assert qp.wqkv.dtype == jnp.int8 and qp.lm_head.dtype == jnp.int8
 
