@@ -570,6 +570,28 @@ def main() -> int:
     import tempfile
 
     t_start = time.time()
+    # Serialize against a concurrently-running measurement queue
+    # (perf/onchip_session.py holds the same flock per step): one chip,
+    # one measurer. Proceed anyway after 10 min — the driver's bench
+    # must never deadlock behind a wedged queue step.
+    tpu_lock = None
+    _lock_release = None
+    try:
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "perf"
+        ))
+        from _tpulock import HELD_ENV as _HELD
+        from _tpulock import acquire as _lock_acquire
+        from _tpulock import release as _lock_release
+
+        tpu_lock = _lock_acquire(timeout_s=600)
+        if tpu_lock is None and not os.environ.get(_HELD):
+            sys.stderr.write(
+                "[bench] TPU lock contended; proceeding (numbers may "
+                "be noisy)\n"
+            )
+    except Exception:
+        pass  # lock helper missing/broken must never sink the bench
     on_tpu = _probe_tpu()
     fd, progress_path = tempfile.mkstemp(
         prefix="bench_progress_", suffix=".jsonl"
@@ -616,6 +638,11 @@ def main() -> int:
             on_tpu = False  # fall back to the CPU ladder below
 
     if not on_tpu:
+        # No more chip work — stop blocking the measurement queue
+        # while the (multi-minute) CPU ladder runs.
+        if tpu_lock is not None and _lock_release is not None:
+            _lock_release(tpu_lock)
+            tpu_lock = None
         # Keep any TPU rung errors from the attempts above — a fully
         # broken TPU path must stay visible in the machine-readable
         # output, not be laundered into a clean CPU run.

@@ -1,0 +1,55 @@
+"""Advisory single-holder lock for the (one) TPU chip.
+
+Two measurement drivers exist — the driver's end-of-round ``bench.py``
+and the relay watcher's ``perf/onchip_session.py`` queue. If both touch
+the chip at once, both measurements degrade (shared relay, shared HBM).
+This flock serializes them. ADVISORY with a proceed-anyway timeout:
+the driver's bench must never deadlock behind a wedged queue step, so
+after ``timeout_s`` the caller proceeds without the lock (logged).
+"""
+
+import fcntl
+import os
+import time
+
+LOCK_PATH = os.environ.get("TDT_TPU_LOCK", "/tmp/tdt_tpu.lock")
+# Set in the environment of child processes spawned UNDER a held lock
+# (the queue's ladder step runs bench.py, which also acquires): the
+# child is already covered by its parent's hold and must not poll
+# against it.
+HELD_ENV = "TDT_TPU_LOCK_HELD"
+
+
+def acquire(timeout_s: float, poll_s: float = 5.0):
+    """Try to hold the chip lock for up to ``timeout_s``. Returns the
+    open fd on success (keep it alive; close to release) or None on
+    timeout/any error — callers proceed either way, None just means
+    'contended/unavailable, numbers may be noisy'."""
+    if os.environ.get(HELD_ENV):
+        return None  # parent already holds it on our behalf
+    try:
+        fd = os.open(LOCK_PATH, os.O_CREAT | os.O_RDWR, 0o666)
+        try:
+            os.chmod(LOCK_PATH, 0o666)  # umask-proof for other users
+        except OSError:
+            pass
+    except OSError:
+        return None
+    deadline = time.time() + timeout_s
+    while True:
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            return fd
+        except OSError:
+            if time.time() >= deadline:
+                os.close(fd)
+                return None
+            time.sleep(poll_s)
+
+
+def release(fd) -> None:
+    if fd is not None:
+        try:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+        finally:
+            os.close(fd)

@@ -124,40 +124,61 @@ def main(argv=None) -> int:
     only = set(args.only.split(",")) if args.only else None
     skip = set(args.skip.split(",")) if args.skip else set()
     failures = 0
+    sys.path.insert(0, os.path.join(ROOT, "perf"))
+    from _tpulock import HELD_ENV, acquire, release
+
     with open(os.path.join(ROOT, args.log), "a") as log:
         for name, argvs, timeout in STEPS:
             if (only and name not in only) or name in skip:
                 continue
+            # Serialize against a concurrently-launched bench.py (the
+            # driver's end-of-round run): one chip, one measurer. Give
+            # up after 15 min and run anyway (a wedged holder must not
+            # stall the whole queue window). Children run with the
+            # held-marker set so a step that itself runs bench.py (the
+            # ladder) doesn't poll against its own parent's hold.
+            lock = acquire(timeout_s=900)
+            env = dict(os.environ)
+            if lock is not None:
+                env[HELD_ENV] = "1"
             t0 = time.time()
             rec = {"step": name, "t_start": round(t0, 1)}
+            if lock is None:
+                rec["lock"] = "contended (proceeded without)"
             try:
-                r = subprocess.run(
-                    argvs, cwd=ROOT, timeout=timeout,
-                    capture_output=True, text=True,
-                )
-                rec["rc"] = r.returncode
-                rec["stdout_tail"] = r.stdout[-2000:]
-                if r.returncode != 0:
-                    rec["stderr_tail"] = r.stderr[-1000:]
+                try:
+                    r = subprocess.run(
+                        argvs, cwd=ROOT, timeout=timeout,
+                        capture_output=True, text=True, env=env,
+                    )
+                    rec["rc"] = r.returncode
+                    rec["stdout_tail"] = r.stdout[-2000:]
+                    if r.returncode != 0:
+                        rec["stderr_tail"] = r.stderr[-1000:]
+                        failures += 1
+                except subprocess.TimeoutExpired as e:
+                    rec["rc"] = "timeout"
+
+                    # Keep the partial output — it names the rung/step
+                    # that wedged, which is the whole point of the log.
+                    # (On timeout the attached output can be bytes even
+                    # under text=True.)
+                    def _tail(raw, k):
+                        if isinstance(raw, bytes):
+                            raw = raw.decode(errors="replace")
+                        return (raw or "")[-k:]
+
+                    rec["stdout_tail"] = _tail(e.stdout, 2000)
+                    rec["stderr_tail"] = _tail(e.stderr, 1000)
                     failures += 1
-            except subprocess.TimeoutExpired as e:
-                rec["rc"] = "timeout"
-
-                # Keep the partial output — it names the rung/step that
-                # wedged, which is the whole point of the log. (On
-                # timeout the attached output can be bytes even under
-                # text=True.)
-                def _tail(raw, k):
-                    if isinstance(raw, bytes):
-                        raw = raw.decode(errors="replace")
-                    return (raw or "")[-k:]
-
-                rec["stdout_tail"] = _tail(e.stdout, 2000)
-                rec["stderr_tail"] = _tail(e.stderr, 1000)
-                failures += 1
-            rec["wall_s"] = round(time.time() - t0, 1)
-            log.write(json.dumps(rec) + "\n")
-            log.flush()
+                except Exception as e:  # spawn failure etc.
+                    rec["rc"] = f"spawn-error: {type(e).__name__}: {e}"[:200]
+                    failures += 1
+            finally:
+                release(lock)
+                rec["wall_s"] = round(time.time() - t0, 1)
+                log.write(json.dumps(rec) + "\n")
+                log.flush()
             print(json.dumps({k: rec[k] for k in ("step", "rc", "wall_s")}),
                   flush=True)
             if name == "probe" and rec["rc"] != 0:
