@@ -76,6 +76,14 @@ STEPS = [
     # plus connection wobble — don't write off a live chip at 120 s.
     ("probe", [sys.executable, "-c", _PROBE], 240),
     ("kernel_smoke", [sys.executable, "-c", _KERNEL_SMOKE], 300),
+    # Weight-stream sweep FIRST among the heavy steps: the winner lands
+    # in MEGA_TUNED.json for the (next) ladder/bench — in a short relay
+    # window these two are what move BENCH_r03.
+    ("mega_tiles", [sys.executable, "perf/mega_tile_sweep.py"], 2400),
+    # bench.py's own worst case: ~860 s probe retries + 2700 s global
+    # worker deadline + CPU fallback ladder + teardown — the step
+    # timeout must sit ABOVE it or the always-emit JSON contract breaks.
+    ("ladder", [sys.executable, "bench.py"], 4800),
     ("sweep_small", [sys.executable, "perf/sweep_overlap_tiles.py",
                      "--m", "2048", "--k", "1024", "--n", "2048",
                      "--iters", "4"], 600),
@@ -84,12 +92,6 @@ STEPS = [
     # ladder's ms/step into per-matvec floors + fixed dispatch cost
     # (the number that decides where megakernel tuning goes next).
     ("decode_profile", [sys.executable, "perf/decode_profile.py"], 900),
-    # Weight-stream sweep: (tiles, nbuf, fuse_norms, cross_prefetch) —
-    # the kernel-body levers A/B'd at the ladder's mega_multi config;
-    # the winner lands in MEGA_TUNED.json for the driver's bench.
-    # Ahead of mega_ns: in a short window this is the step that moves
-    # the headline.
-    ("mega_tiles", [sys.executable, "perf/mega_tile_sweep.py"], 2400),
     # int8 weight-stream variant of the tile sweep (informational; the
     # bf16 tuned file is never written by this step).
     ("mega_tiles_q8", [sys.executable, "perf/mega_tile_sweep.py",
@@ -99,10 +101,6 @@ STEPS = [
     # (decides whether wider NS or kernel-body tuning moves the ladder).
     ("mega_ns", [sys.executable, "perf/mega_ns_sweep.py"], 2400),
     ("adaptive_ag", [sys.executable, "-c", _ADAPTIVE_AG], 400),
-    # bench.py's own worst case: ~860 s probe retries + 2700 s global
-    # worker deadline + CPU fallback ladder + teardown — the step
-    # timeout must sit ABOVE it or the always-emit JSON contract breaks.
-    ("ladder", [sys.executable, "bench.py"], 4800),
     # e2e burned a full 1500 s budget twice with the relay HEALTHY for
     # part of it (03:19 run) — the torch-side checkpoint build plus the
     # host->device weight transfer need more headroom on this 1-core
