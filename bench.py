@@ -16,14 +16,19 @@ framing (README.md:190-209).
 
 Robustness (round-1 lesson; round-2 lesson: a relay OUTAGE mid-run
 hangs forever rather than raising, and one outage zeroed the round's
-perf evidence — VERDICT r2 #1). The backend is probed in a SUBPROCESS
-with timeouts and retries; the ladder itself then runs in a WORKER
-subprocess that appends one JSON line per completed rung to a progress
-file, while the parent watchdogs progress, kills a hung worker,
-re-probes the relay, and relaunches skipping completed rungs — so a
-mid-run outage costs the remaining rungs at worst, never the whole
-ladder. On total failure the bench falls back to the CPU platform so a
-parseable number is always emitted (marked ``"platform": "cpu"``).
+perf evidence — VERDICT r2 #1; round-3 lesson: giving up on the probe
+after ~14 min and then burning ~30 min on a CPU ladder loses to a relay
+whose windows are ~30 min every few hours — VERDICT r3 weak #1). The
+backend is probed in a SUBPROCESS, in a RETRY LOOP that runs for the
+whole global budget minus a small CPU reserve; the ladder itself then
+runs in a WORKER subprocess that appends one JSON line per completed
+rung to a progress file, while the parent watchdogs progress, kills a
+hung worker, re-probes the relay (again: until the budget ends, not a
+fixed count), and relaunches skipping completed rungs — so a mid-run
+outage costs the remaining rungs at worst, never the whole ladder. Only
+in the final reserved minutes does the bench fall back to a CPU STUB
+(jit rung, 4 steps) so a parseable number is always emitted (marked
+``"platform": "cpu"``).
 
 Timing notes (axon relay): ``block_until_ready`` resolves early and
 identical executions are memoized, so decode steps are chained inside
@@ -54,9 +59,8 @@ def _env_int(name: str, default: int) -> int:
         return default
 
 
-_PROBE_ATTEMPTS = _env_int("TDT_BENCH_PROBE_ATTEMPTS", 3)
-_PROBE_TIMEOUT_S = _env_int("TDT_BENCH_PROBE_TIMEOUT_S", 270)
-_PROBE_SLEEP_S = 25
+_PROBE_TIMEOUT_S = _env_int("TDT_BENCH_PROBE_TIMEOUT_S", 180)
+_PROBE_SLEEP_S = _env_int("TDT_BENCH_PROBE_SLEEP_S", 20)
 # Worker import + model build + prefill compile. The watchdog timer
 # resets on every progress line, so this bounds each init PHASE (ctx /
 # params / prefill — the worker emits between them), not their sum.
@@ -66,43 +70,68 @@ _RUNG_TIMEOUT_S = _env_int("TDT_BENCH_RUNG_TIMEOUT_S", 600)
 # plus two full chained decode executions (the token cross-check) — a
 # healthy rung needs far more headroom than the others.
 _MULTI_RUNG_TIMEOUT_S = _env_int("TDT_BENCH_MULTI_RUNG_TIMEOUT_S", 1800)
-_WORKER_ATTEMPTS = 3
-_GLOBAL_DEADLINE_S = 2700  # stop relaunching workers past this
+_WORKER_ATTEMPTS = 8
+_GLOBAL_DEADLINE_S = _env_int("TDT_BENCH_DEADLINE_S", 2700)
+# Wall-clock reserved at the tail for the CPU fallback stub (jit rung
+# only, 4 steps) so a parseable number is ALWAYS emitted. Everything
+# before this reserve belongs to TPU probing — relay windows are ~30 min
+# every few hours, so giving up early and burning the budget on a CPU
+# ladder is exactly backwards (VERDICT r3 weak #1).
+_CPU_RESERVE_S = _env_int("TDT_BENCH_CPU_RESERVE_S", 480)
 
 
-def _probe_tpu() -> bool:
-    """Check (in a subprocess, with timeout + retry) that the TPU backend
-    actually comes up AND EXECUTES. Keeps a hung plugin from wedging the
-    bench — and catches the observed half-up relay state where device
-    enumeration answers but any compute hangs (a doomed worker would
-    otherwise burn the init-timeout budget per attempt)."""
+def _probe_tpu_once() -> bool:
+    """One probe (in a subprocess, with timeout) that the TPU backend
+    comes up AND EXECUTES. Catches the observed half-up relay state
+    where device enumeration answers but any compute hangs (a doomed
+    worker would otherwise burn the init-timeout budget per attempt)."""
     code = (
         "import jax, numpy as np; d = jax.devices(); "
         "assert d[0].platform != 'cpu'; "
         "import jax.numpy as jnp; x = jnp.ones((8, 128)) + 1; "
         "assert float(np.asarray(x).sum()) == 2048.0"
     )
-    for attempt in range(_PROBE_ATTEMPTS):
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c", code],
-                timeout=_PROBE_TIMEOUT_S,
-                capture_output=True,
-            )
-            if r.returncode == 0:
-                return True
-            sys.stderr.write(
-                f"[bench] TPU probe attempt {attempt + 1} failed rc="
-                f"{r.returncode}: {r.stderr.decode()[-500:]}\n"
-            )
-        except subprocess.TimeoutExpired:
-            sys.stderr.write(
-                f"[bench] TPU probe attempt {attempt + 1} timed out after "
-                f"{_PROBE_TIMEOUT_S}s\n"
-            )
-        if attempt + 1 < _PROBE_ATTEMPTS:
-            time.sleep(_PROBE_SLEEP_S)
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=_PROBE_TIMEOUT_S,
+            capture_output=True,
+        )
+        if r.returncode == 0:
+            return True
+        sys.stderr.write(
+            f"[bench] TPU probe failed rc={r.returncode}: "
+            f"{r.stderr.decode()[-500:]}\n"
+        )
+    except subprocess.TimeoutExpired:
+        sys.stderr.write(
+            f"[bench] TPU probe timed out after {_PROBE_TIMEOUT_S}s\n"
+        )
     return False
+
+
+def _probe_tpu_until(deadline: float) -> bool:
+    """Probe-retry continuously until the relay answers or ``deadline``
+    (absolute ``time.time()``) passes. An outage hangs probes rather
+    than failing them, so each cycle costs ~_PROBE_TIMEOUT_S; the loop
+    keeps cycling because a window can open at ANY point in the budget
+    — the whole strategy is to still be probing when it does."""
+    attempt = 0
+    while True:
+        # Probe BEFORE checking the deadline: even a deadline already in
+        # the past (tiny TDT_BENCH_DEADLINE_S) gets one real attempt, so
+        # a healthy TPU is never silently skipped for the CPU stub.
+        attempt += 1
+        if _probe_tpu_once():
+            return True
+        remaining = deadline - time.time()
+        sys.stderr.write(
+            f"[bench] relay down (probe {attempt}); "
+            f"{max(remaining, 0) / 60:.0f} min of probe budget left\n"
+        )
+        if remaining <= _PROBE_SLEEP_S:
+            return False
+        time.sleep(_PROBE_SLEEP_S)
 
 
 def chip_peak_gbs(jax) -> float:
@@ -143,7 +172,7 @@ def _tuned_mega_config(device_kind: str, model_name: str):
         except Exception as e:
             raise ValueError(
                 f"malformed TDT_BENCH_MEGA_CFG={env!r} "
-                "(want tn:tk:nbuf[:fuse_norms])"
+                "(want tn:tk:nbuf[:fuse_norms[:cross_prefetch]])"
             ) from e
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "perf", "MEGA_TUNED.json")
@@ -211,7 +240,7 @@ def run_ladder(
     cfg = model.cfg
 
     PROMPT = 512
-    STEPS = 32 if on_tpu else 8
+    STEPS = 32 if on_tpu else 4
     cache0 = model.new_cache(1)
     tokens = jnp.asarray(np.arange(PROMPT) % cfg.vocab_size, jnp.int32)
     logits, cache0 = model.prefill(tokens, cache0, "xla")
@@ -592,7 +621,12 @@ def main() -> int:
             )
     except Exception:
         pass  # lock helper missing/broken must never sink the bench
-    on_tpu = _probe_tpu()
+    # Everything up to the CPU reserve is probe budget: keep retrying
+    # for the WHOLE window (relay windows are ~30 min every few hours;
+    # VERDICT r3: 14 min of probing then a 30-min CPU ladder was the
+    # failure mode — inverted here).
+    probe_deadline = t_start + _GLOBAL_DEADLINE_S - _CPU_RESERVE_S
+    on_tpu = _probe_tpu_until(probe_deadline)
     fd, progress_path = tempfile.mkstemp(
         prefix="bench_progress_", suffix=".jsonl"
     )
@@ -603,8 +637,8 @@ def main() -> int:
         hang_counts: dict[str, int] = {}
         model = os.environ.get("TDT_BENCH_MODEL", "Qwen/Qwen3-0.6B")
         for attempt in range(_WORKER_ATTEMPTS):
-            if time.time() - t_start > _GLOBAL_DEADLINE_S:
-                sys.stderr.write("[bench] global deadline reached\n")
+            if time.time() > probe_deadline:
+                sys.stderr.write("[bench] probe-budget deadline reached\n")
                 break
             skip = done | {r for r, c in hang_counts.items() if c >= 2}
             finished, hung = _watch_worker(
@@ -628,10 +662,15 @@ def main() -> int:
             elif hung:
                 hang_counts[hung] = hang_counts.get(hung, 0) + 1
                 sys.stderr.write(f"[bench] rung {hung} hung; re-probing\n")
-            # Mid-run re-probe (VERDICT r3 task 1): don't relaunch into
-            # a dead relay — wait for it to answer again first.
-            if attempt + 1 < _WORKER_ATTEMPTS and not _probe_tpu():
-                sys.stderr.write("[bench] relay down mid-run; stopping\n")
+            # Mid-run re-probe: don't relaunch into a dead relay — keep
+            # probing until it answers again or the probe budget runs
+            # out (a mid-run outage can end and the window reopen).
+            if attempt + 1 < _WORKER_ATTEMPTS and not _probe_tpu_until(
+                probe_deadline
+            ):
+                sys.stderr.write(
+                    "[bench] relay down through probe budget; stopping\n"
+                )
                 break
         events = _read_events(progress_path)
         if not any("rung" in e and "ms" in e for e in events):
@@ -651,9 +690,12 @@ def main() -> int:
             for e in _read_events(progress_path)
             if "rung" in e and "error" in e
         }
+        # Last-minutes STUB, not a ladder: jit rung only (interpret-mode
+        # Pallas timing on a 1-core host is meaningless and burned ~30
+        # min in round 3) — just enough for a parseable number.
         cpu_path = progress_path + ".cpu"
         with open(cpu_path, "w") as fh:
-            run_ladder(fh, on_tpu=False, skip=frozenset())
+            run_ladder(fh, on_tpu=False, skip=frozenset({"pallas"}))
         events = _read_events(cpu_path)
     else:
         tpu_errors = {}

@@ -1,26 +1,32 @@
 """Staged on-chip work queue — run EVERYTHING pending when the relay answers.
 
-The axon relay's outages (5+ hours observed; down for this entire
-round-3 session so far) make chip time precious and first-contact load
-risky (heavy pushes have twice correlated with wedging the relay —
-skill notes). This runner executes the round's pending on-chip items in
-ESCALATING order of load, each in its own subprocess with a timeout, so
-one wedge costs one step, and appends every result to a JSONL log:
+The axon relay's outages (5+ hours observed, ~30-min windows every few
+hours) make chip time precious and first-contact load risky (heavy
+pushes have twice correlated with wedging the relay — skill notes).
+This runner executes the round's pending on-chip items in ESCALATING
+order of load, each in its own subprocess with a timeout, so one wedge
+costs one step, and appends every result to a JSONL log.
 
-1. probe        — tiny: jax.devices() + 1 add (seconds)
-2. kernel_smoke — one small Pallas ring kernel through Mosaic
-3. sweep_small  — ag_gemm tile sweep at a reduced shape
-4. ep_overhead  — perf/ep_a2a_overhead.py (device-initiated EP kernel)
-5. adaptive_ag  — AG+GEMM adaptive-schedule order observation (n=1
-                  degenerate: validates compile + order output on chip)
-6. ladder       — bench.py full decode ladder (jit/pallas/mega/
-                  mega_multi + token cross-check) — THE deliverable
-7. e2e          — perf/real_weights_e2e.py (HF-format checkpoint,
-                  mega_multi serve, transcript + tok/s)
-8. sweep_full   — overlap tile sweeps at north-star shapes (bonus)
+Round-4 queue (VERDICT r3 "Next round" items in priority order):
 
-Usage: python perf/onchip_session.py [--log perf/ONCHIP_r3.jsonl]
-       [--only ladder,e2e] [--skip sweep_full]
+1. probe          — tiny: jax.devices() + 1 add (seconds)
+2. kernel_smoke   — one small Pallas ring kernel through Mosaic
+3. mega_tiles     — weight-stream sweep → perf/MEGA_TUNED.json (task 2)
+4. ladder         — bench.py 0.6B decode ladder, inherits the tuning
+                    (task 1: the driver-artifact evidence class)
+5. decode_profile — slope-timed per-matvec floors (task 3 split)
+6. gemm_mfu       — plain-GEMM MFU at ≥3 shapes × variants (tasks 3+6)
+7. ep_overhead    — EP dispatch-tax slope + block sweep (task 5)
+8. adaptive_order — straggler-reaction order observation (task 7)
+9. ladder_17      — bench.py at Qwen3-1.7B geometry (task 4:
+                    headline-class decode on the chip)
+10. e2e_17        — 1.7B HF-checkpoint serve, transcript + tok/s (task 4)
+11. stress        — randomized on-chip stress subset (task 8)
+12. mega_ns / mega_tiles_q8 / ladder_4b / e2e / sweep_full — depth,
+    int8 sweep, 4B-geometry ladder, 0.6B e2e, north-star tile sweeps
+
+Usage: python perf/onchip_session.py [--log perf/ONCHIP_r4.jsonl]
+       [--only ladder,e2e_17] [--skip sweep_full]
 """
 
 import argparse
@@ -54,58 +60,71 @@ assert np.asarray(out).shape == (16, 128)
 print("kernel smoke ok (Mosaic compile + run)")
 """
 
-_ADAPTIVE_AG = """
-import jax, numpy as np
-import jax.numpy as jnp
-from triton_distributed_tpu.runtime.mesh import initialize_distributed
-from triton_distributed_tpu.ops.overlap.ag_gemm import AGGemmConfig, ag_gemm_op
-ctx = initialize_distributed(tp=1, devices=jax.devices()[:1])
-rng = np.random.default_rng(0)
-a = jnp.asarray(rng.standard_normal((256, 512)), jnp.bfloat16)
-b = jnp.asarray(rng.standard_normal((512, 512)), jnp.bfloat16)
-cfg = AGGemmConfig(tile_n=128, adaptive=True)
-out = ag_gemm_op(a, b, "tp", cfg, ctx)
-gold = np.asarray(a, np.float32) @ np.asarray(b, np.float32)
-err = np.abs(np.asarray(out, np.float32) - gold)
-assert err.max() < 2.0, err.max()
-print("adaptive ag_gemm compiled+ran on chip (semaphore_read + SMEM order)")
-"""
-
+# Entries: (name, argv, timeout_s[, extra_env]). Ordered by priority
+# within escalating load — a ~30-min window drains the head; later
+# windows resume from pending().
 STEPS = [
     # A recovering relay's first contact can spend 20-40 s compiling
     # plus connection wobble — don't write off a live chip at 120 s.
     ("probe", [sys.executable, "-c", _PROBE], 240),
     ("kernel_smoke", [sys.executable, "-c", _KERNEL_SMOKE], 300),
     # Weight-stream sweep FIRST among the heavy steps: the winner lands
-    # in MEGA_TUNED.json for the (next) ladder/bench — in a short relay
-    # window these two are what move BENCH_r03.
+    # in MEGA_TUNED.json for the (next) ladder/bench — these two are
+    # what move BENCH_r04 (VERDICT task 2).
     ("mega_tiles", [sys.executable, "perf/mega_tile_sweep.py"], 2400),
-    # bench.py's own worst case: ~860 s probe retries + 2700 s global
-    # worker deadline + CPU fallback ladder + teardown — the step
-    # timeout must sit ABOVE it or the always-emit JSON contract breaks.
-    ("ladder", [sys.executable, "bench.py"], 4800),
-    ("sweep_small", [sys.executable, "perf/sweep_overlap_tiles.py",
-                     "--m", "2048", "--k", "1024", "--n", "2048",
-                     "--iters", "4"], 600),
-    ("ep_overhead", [sys.executable, "perf/ep_a2a_overhead.py"], 600),
+    # Relay is UP here (probe gated), so bench's probe succeeds at
+    # once; the reduced deadline stops a mid-ladder outage from
+    # burning the session's window on probe retries. Step timeout must
+    # sit ABOVE bench's worst case or the always-emit JSON contract
+    # breaks: a worker launched just before the probe deadline
+    # (D - 480 s) can stall through its longest per-rung watchdog
+    # (mega_multi, 1800 s) before the kill + one re-probe (180 s) +
+    # CPU stub (~480 s) — worst ~= D + 2100 s.
+    ("ladder", [sys.executable, "bench.py"], 4000,
+     {"TDT_BENCH_DEADLINE_S": "1800"}),
     # Slope-timed per-component decode profile: splits the measured
     # ladder's ms/step into per-matvec floors + fixed dispatch cost
     # (the number that decides where megakernel tuning goes next).
     ("decode_profile", [sys.executable, "perf/decode_profile.py"], 900),
+    # Plain-GEMM MFU at >=3 shapes x accumulation/precision/layout
+    # variants — explain-or-fix the 33% (VERDICT task 3), and the
+    # perf model's non-anchor validation points (task 6).
+    ("gemm_mfu", [sys.executable, "perf/gemm_mfu.py"], 1800),
+    ("ep_overhead", [sys.executable, "perf/ep_a2a_overhead.py"], 900),
+    # Straggler-reaction proof: realized adaptive order vs ring order
+    # under virtualized arrival skew (VERDICT task 7).
+    ("adaptive_order", [sys.executable,
+                        "perf/adaptive_order_probe.py"], 500),
+    # Headline-class decode ladder: Qwen3-1.7B geometry, device-side
+    # synthetic weights (no host transfer), all rungs incl. wq8
+    # (VERDICT task 4).
+    # Timeout: D + 2100 headroom (see the ladder note).
+    ("ladder_17", [sys.executable, "bench.py"], 4600,
+     {"TDT_BENCH_MODEL": "Qwen/Qwen3-1.7B",
+      "TDT_BENCH_DEADLINE_S": "2400"}),
+    # 1.7B HF-format checkpoint through Engine mega_multi: transcript
+    # + tok/s (VERDICT task 4's serving half).
+    ("e2e_17", [sys.executable, "perf/real_weights_e2e.py",
+                "--geom", "1.7b", "--mode", "mega_multi",
+                "--gen-len", "64"], 2700),
+    # Randomized on-chip stress subset (VERDICT task 8).
+    ("stress", [sys.executable, "perf/onchip_stress.py",
+                "--iters", "12"], 1500),
+    # Launch-width sweep: fits per-launch vs per-step megakernel cost
+    # (decides whether wider NS or kernel-body tuning moves the ladder).
+    ("mega_ns", [sys.executable, "perf/mega_ns_sweep.py"], 2400),
     # int8 weight-stream variant of the tile sweep (informational; the
     # bf16 tuned file is never written by this step).
     ("mega_tiles_q8", [sys.executable, "perf/mega_tile_sweep.py",
                        "--q8", "--configs",
                        "1024:1024:2,1024:1024:4:1,2048:1024:4:1:1"], 1800),
-    # Launch-width sweep: fits per-launch vs per-step megakernel cost
-    # (decides whether wider NS or kernel-body tuning moves the ladder).
-    ("mega_ns", [sys.executable, "perf/mega_ns_sweep.py"], 2400),
-    ("adaptive_ag", [sys.executable, "-c", _ADAPTIVE_AG], 400),
-    # e2e burned a full 1500 s budget twice with the relay HEALTHY for
-    # part of it (03:19 run) — the torch-side checkpoint build plus the
-    # host->device weight transfer need more headroom on this 1-core
-    # host; phase markers on stderr now show where the time goes.
-    ("e2e", [sys.executable, "perf/real_weights_e2e.py",
+    # 4B-geometry ladder (8 GB bf16 params — the biggest bf16 model a
+    # 16 GB v5e holds with headroom).
+    # Timeout: D + 2100 headroom (see the ladder note).
+    ("ladder_4b", [sys.executable, "bench.py"], 5200,
+     {"TDT_BENCH_MODEL": "Qwen/Qwen3-4B",
+      "TDT_BENCH_DEADLINE_S": "3000"}),
+    ("e2e", [sys.executable, "perf/real_weights_e2e.py", "--full",
              "--mode", "mega_multi", "--gen-len", "64"], 2700),
     ("sweep_full", [sys.executable, "perf/sweep_overlap_tiles.py",
                     "--op", "gemm_rs"], 2400),
@@ -114,7 +133,7 @@ STEPS = [
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--log", default="perf/ONCHIP_r3.jsonl")
+    p.add_argument("--log", default="perf/ONCHIP_r4.jsonl")
     p.add_argument("--only", default=None)
     p.add_argument("--skip", default="")
     args = p.parse_args(argv)
@@ -126,7 +145,9 @@ def main(argv=None) -> int:
     from _tpulock import HELD_ENV, acquire, release
 
     with open(os.path.join(ROOT, args.log), "a") as log:
-        for name, argvs, timeout in STEPS:
+        for entry in STEPS:
+            name, argvs, timeout = entry[:3]
+            extra_env = entry[3] if len(entry) > 3 else {}
             if (only and name not in only) or name in skip:
                 continue
             # Serialize against a concurrently-launched bench.py (the
@@ -137,6 +158,7 @@ def main(argv=None) -> int:
             # ladder) doesn't poll against its own parent's hold.
             lock = acquire(timeout_s=900)
             env = dict(os.environ)
+            env.update(extra_env)
             if lock is not None:
                 env[HELD_ENV] = "1"
             t0 = time.time()

@@ -4,14 +4,15 @@
 # steps that already passed in an earlier window are dropped from the
 # queue, so a half-successful window only costs the remainder. Appends
 # to perf/onchip_loop.log (gitignored scratch; results land in
-# perf/ONCHIP_r3.jsonl via onchip_session).
+# perf/ONCHIP_r4.jsonl via onchip_session).
 #
 # Usage: nohup bash perf/onchip_watch.sh STEPS... >/dev/null 2>&1 &
 cd "$(dirname "$0")/.." || exit 1
 LOG=perf/onchip_loop.log
 # Steps may be given as separate args or comma-joined; normalize to the
-# comma form pending()/onchip_session expect.
-QUEUE=$(IFS=,; echo "${*:-decode_profile,ep_overhead,e2e,sweep_full}")
+# comma form pending()/onchip_session expect. Default: the whole
+# round-4 queue in priority order.
+QUEUE=$(IFS=,; echo "${*:-kernel_smoke,mega_tiles,ladder,decode_profile,gemm_mfu,ep_overhead,adaptive_order,ladder_17,e2e_17,stress,mega_ns,mega_tiles_q8,ladder_4b,e2e,sweep_full}")
 SINCE=$(date +%s)
 
 pending() {
@@ -20,7 +21,7 @@ import json, sys
 queue, since = sys.argv[1].split(","), float(sys.argv[2])
 done = set()
 try:
-    for line in open("perf/ONCHIP_r3.jsonl"):
+    for line in open("perf/ONCHIP_r4.jsonl"):
         try:
             r = json.loads(line)
         except ValueError:
@@ -50,7 +51,7 @@ if ! python - "$QUEUE" >>"$LOG" 2>&1 <<'EOF'
 import sys
 sys.path.insert(0, "perf")
 from onchip_session import STEPS
-known = {name for name, _, _ in STEPS}
+known = {entry[0] for entry in STEPS}
 bad = [s for s in sys.argv[1].split(",") if s not in known]
 if bad:
     sys.exit(f"[watch] unknown step(s) {bad}; known: {sorted(known)}")

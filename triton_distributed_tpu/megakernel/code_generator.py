@@ -29,6 +29,38 @@ from triton_distributed_tpu.ops.common import interpret_mode, pick_tile
 from triton_distributed_tpu.runtime.mesh import DistContext
 
 
+def _vmem_limit_bytes(scratch: list, out_shapes: list) -> int:
+    """Scoped-VMEM limit derived from the resolved kernel footprint.
+
+    Sums the VMEM scratch buffers (the staging depth × tile-width
+    product that actually scales with :class:`MegaConfig`) plus the
+    VMEM-resident outputs, applies 1.5× headroom for Mosaic's own
+    temporaries and the VMEM-resident in_specs (norm weights, wq8
+    scales — small), and clamps to [32 MiB, 112 MiB]: the floor keeps
+    tiny configs from under-shooting Mosaic's working needs, the cap
+    stays under the 128 MiB physical VMEM of v5e/v5p. Replaces the old
+    flat 100 MiB constant that over-committed smaller-VMEM generations
+    and over-asked for default configs (ADVICE r3)."""
+    def _nbytes(x) -> int:
+        shape = getattr(x, "shape", None)
+        dtype = getattr(x, "dtype", None)
+        if shape is None or dtype is None:
+            return 0
+        try:
+            itemsize = jnp.dtype(dtype).itemsize
+        except TypeError:  # semaphore "dtypes" (dma_sem etc.)
+            return 0
+        n = 1
+        for s in shape:
+            n *= int(s)
+        return n * itemsize
+
+    footprint = sum(_nbytes(s) for s in scratch)
+    footprint += sum(_nbytes(o) for o in out_shapes)
+    mib = 1024 * 1024
+    return max(32 * mib, min(112 * mib, int(footprint * 1.5) + 8 * mib))
+
+
 @dataclasses.dataclass(frozen=True)
 class MegaDims:
     """Static per-shard geometry of the decode step."""
@@ -154,6 +186,17 @@ class MegaConfig:
             raise ValueError(
                 "want tile_n:tile_k:nbuf[:fuse_norms[:cross_prefetch]], "
                 f"got {spec!r}"
+            )
+        # Validate VALUES here, not just arity: a tuned-file/env spec
+        # like "0:1024:2" or a negative tile would otherwise surface as
+        # an obscure failure deep inside kernel build.
+        if min(fields[:3]) <= 0:
+            raise ValueError(
+                f"tile_n/tile_k/nbuf must be positive, got {spec!r}"
+            )
+        if any(f not in (0, 1) for f in fields[3:]):
+            raise ValueError(
+                f"fuse_norms/cross_prefetch flags must be 0 or 1: {spec!r}"
             )
         return cls(
             tile_n=fields[0], tile_k=fields[1], nbuf=fields[2],
@@ -451,7 +494,7 @@ def build_mega_call(
             pl.BlockSpec(memory_space=pltpu.VMEM),  # new V rows
             pl.BlockSpec(memory_space=pltpu.VMEM),  # greedy tokens
         ],
-        scratch_shapes=[
+        scratch_shapes=(scratch := [
             pltpu.VMEM((B, d), jnp.float32),                   # x
             pltpu.VMEM((B, d), jnp.float32),                   # h
             pltpu.VMEM((B, dims.qkv_loc), jnp.float32),        # qkv
@@ -492,7 +535,7 @@ def build_mega_call(
             pltpu.SemaphoreType.DMA,                           # arsend
             pltpu.SemaphoreType.DMA((n,)),                     # arrecv
             pltpu.SemaphoreType.DMA,                           # tsem
-        ],
+        ]),
     )
 
     # FLOPs/bytes annotation (parity: the reference's launch_metadata on
@@ -522,7 +565,7 @@ def build_mega_call(
         # come out as [L, B, hkv, hd] and the caller merges them with
         # one XLA dynamic_update_slice (which aliases in place when the
         # cache is donated).
-        out_shape=[
+        out_shape=(out_shapes := [
             jax.ShapeDtypeStruct(
                 (1 if dims.prefill else B, dims.v_loc), jnp.float32
             ),
@@ -540,7 +583,7 @@ def build_mega_call(
             # Greedy tokens per step (multi-step; garbage when the LM
             # head runs in single-step mode and the caller ignores it).
             jax.ShapeDtypeStruct((dims.nsteps, 1, max(B, 1)), jnp.int32),
-        ],
+        ]),
         compiler_params=pltpu.CompilerParams(
             has_side_effects=True,
             dimension_semantics=("arbitrary", "arbitrary"),
@@ -548,9 +591,14 @@ def build_mega_call(
             allow_collective_id_without_custom_barrier=True,
             # The default 16 MB scoped-VMEM limit is what made wide
             # tiles (tn=2048) fail to compile: staging alone is
-            # nbuf·d·tn·2B per stream direction. v5e/v5p carry 128 MB
-            # physical; leave Mosaic headroom.
-            vmem_limit_bytes=100 * 1024 * 1024,
+            # nbuf·d·tn·2B per stream direction. Derive the limit from
+            # the resolved footprint (scratch staging + VMEM-resident
+            # outs) with 1.5x headroom for Mosaic's own temporaries, so
+            # default configs keep the small default-ish limit and only
+            # wide-tile/deep-nbuf configs raise it — capped at 112 MiB
+            # to stay under the 128 MiB physical VMEM of the v5e/v5p
+            # generations this targets.
+            vmem_limit_bytes=_vmem_limit_bytes(scratch, out_shapes),
         ),
         interpret=interpret_mode(ctx),
     )

@@ -155,8 +155,16 @@ def _stream_cols(kctx, x_f32, w_hbm, n: int, tn: int, consume,
         # Prefetched tile-0 descriptors (stream_tile0_table) assume
         # k == d, col0 == 0, and a full-width first tile (n >= 1 — a
         # tail-only stream's copy(0) would be tail-width and break the
-        # byte match); fail at trace time instead of corrupting.
-        assert col0 == 0 and k == kctx.dims.d and n >= 1, (col0, k, n)
+        # byte match); fail at trace time instead of corrupting. A
+        # hard raise (not assert): under ``python -O`` an assert would
+        # vanish and the mismatch would become a silent DMA-descriptor
+        # mismatch at run time.
+        if not (col0 == 0 and k == kctx.dims.d and n >= 1):
+            raise ValueError(
+                "cross_prefetch byte-match invariant violated: need "
+                f"col0 == 0, k == d ({kctx.dims.d}), n >= 1; got "
+                f"col0={col0}, k={k}, n={n}"
+            )
         pre = kctx.pre_col[0]
         kctx.pre_col[0] = 0
     for j in range(min(depth - 1, total)):
@@ -238,7 +246,13 @@ def _stream_rows(kctx, x_ref, w_hbm, out_ref, n: int, tk: int,
     # Pipeline fill; under cross_prefetch tile 0 may already be in
     # flight from the previous task's prefetch block (same descriptor).
     if kctx.cfg.cross_prefetch:
-        assert d == kctx.dims.d, d  # stream_tile0_table's assumption
+        # stream_tile0_table's byte-match assumption; raise (not
+        # assert) so the guard survives ``python -O``.
+        if d != kctx.dims.d:
+            raise ValueError(
+                "cross_prefetch byte-match invariant violated: row "
+                f"stream width d={d} != model d={kctx.dims.d}"
+            )
         pre = kctx.pre_row[0]
         kctx.pre_row[0] = 0
     for j in range(min(depth - 1, n)):

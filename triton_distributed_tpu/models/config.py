@@ -49,6 +49,16 @@ _PRESETS: dict[str, dict] = {
         num_q_heads=16, num_kv_heads=8, head_dim=128,
         tie_word_embeddings=True,
     ),
+    "Qwen/Qwen3-1.7B": dict(
+        hidden_size=2048, intermediate_size=6144, num_layers=28,
+        num_q_heads=16, num_kv_heads=8, head_dim=128,
+        tie_word_embeddings=True,
+    ),
+    "Qwen/Qwen3-4B": dict(
+        hidden_size=2560, intermediate_size=9728, num_layers=36,
+        num_q_heads=32, num_kv_heads=8, head_dim=128,
+        tie_word_embeddings=True,
+    ),
     "Qwen/Qwen3-8B": dict(
         hidden_size=4096, intermediate_size=12288, num_layers=36,
         num_q_heads=32, num_kv_heads=8, head_dim=128,

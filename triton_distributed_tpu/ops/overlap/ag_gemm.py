@@ -125,6 +125,41 @@ def create_ag_gemm_context(
     )
 
 
+def adaptive_pick(done_smem, recv_sems, chunk_bytes, me, n):
+    """Arrival-adaptive chunk pick: first unprocessed chunk whose
+    arrival semaphore already counts a full chunk; ring order (first
+    unprocessed) when none has landed yet. The probe is non-consuming —
+    the caller's blocking wait still drains the chosen chunk's
+    semaphore.
+
+    Shared by the overlap kernel and ``perf/adaptive_order_probe.py``
+    (the single-chip straggler-reaction observation) so the probe
+    exercises EXACTLY the production scheduler logic. Parity: the
+    reference's rank-aware tile-order swizzles
+    (``threadblock_swizzle_ag_moe.py``)."""
+    def scan(off, carry):
+        ready_pick, any_pick = carry
+        c = jax.lax.rem(me + off, n)
+        unproc = done_smem[c] == 0
+        ready = dl.read(recv_sems.at[c]) >= chunk_bytes
+        any_pick = jnp.where(
+            jnp.logical_and(any_pick < 0, unproc), c, any_pick
+        )
+        ready_pick = jnp.where(
+            jnp.logical_and(
+                ready_pick < 0, jnp.logical_and(unproc, ready)
+            ),
+            c,
+            ready_pick,
+        )
+        return ready_pick, any_pick
+
+    ready_pick, any_pick = jax.lax.fori_loop(
+        1, n, scan, (jnp.int32(-1), jnp.int32(-1))
+    )
+    return jnp.where(ready_pick >= 0, ready_pick, any_pick)
+
+
 def _ag_gemm_kernel(
     a_ref,      # [m_per, K] ANY/HBM — this device's A shard
     b_ref,      # [K, tile_n] VMEM — B tile j (pipelined by BlockSpec)
@@ -242,32 +277,7 @@ def _ag_gemm_kernel(
         # at the end of the step's compute, not ahead of it (keeps the
         # MXU busy while the ICI push is in flight).
         if adaptive:
-            # Arrival-adaptive pick: first unprocessed chunk whose
-            # arrival semaphore already counts a full chunk; ring order
-            # (first unprocessed) when none has landed yet. The probe
-            # is non-consuming — the blocking wait below still drains
-            # the chosen chunk's semaphore.
-            def scan(off, carry):
-                ready_pick, any_pick = carry
-                c = jax.lax.rem(me + off, n)
-                unproc = done_smem[c] == 0
-                ready = dl.read(recv_sems.at[c]) >= chunk_bytes
-                any_pick = jnp.where(
-                    jnp.logical_and(any_pick < 0, unproc), c, any_pick
-                )
-                ready_pick = jnp.where(
-                    jnp.logical_and(
-                        ready_pick < 0, jnp.logical_and(unproc, ready)
-                    ),
-                    c,
-                    ready_pick,
-                )
-                return ready_pick, any_pick
-
-            ready_pick, any_pick = jax.lax.fori_loop(
-                1, n, scan, (jnp.int32(-1), jnp.int32(-1))
-            )
-            nxt = jnp.where(ready_pick >= 0, ready_pick, any_pick)
+            nxt = adaptive_pick(done_smem, recv_sems, chunk_bytes, me, n)
         else:
             nxt = jax.lax.rem(me + s + 1, n)
         done_smem[nxt] = 1
