@@ -58,11 +58,31 @@ _EP_EXCHANGE_COLLECTIVE_ID = next_collective_id()
 EP_BLOCK_ROWS = 32
 
 
+def _for_each_run(count_blocks, nbits: int, fn):
+    """Invoke ``fn(off_blocks, size_blocks)`` once per power-of-two run
+    of ``count_blocks``'s binary decomposition (``off`` traced, ``size``
+    static). Exactly ``popcount(count_blocks)`` <= ``nbits`` DMA-sized
+    runs cover the filled prefix — the descriptor-count lever that
+    replaced the old block-by-block loops (VERDICT r3 task 5: the n=1
+    floor was ~5 ms because the kernel issued O(capacity/block)
+    predicated DMAs; runs make it O(log))."""
+    off = jnp.int32(0)
+    for b in reversed(range(nbits)):
+        sz = 1 << b
+        bit = (count_blocks >> b) & 1
+
+        @pl.when(bit == 1)
+        def _(off=off, sz=sz):
+            fn(off, sz)
+
+        off = off + bit * sz
+
+
 def _ep_exchange_kernel(
     splits_ref,   # [n] SMEM int32 — rows this rank sends to each dest
     expect_ref,   # [n] SMEM int32 — rows each source sends this rank
-    x_ref,        # [n, C, R] ANY uint8 — per-destination send segments
-    o_ref,        # [n, C, R] ANY uint8 — per-source recv segments
+    x_ref,        # [n, NB, block, R] ANY uint8 — send segments, blocked
+    o_ref,        # [n, NB, block, R] ANY uint8 — recv segments, blocked
     send_sems,    # DMA (n-1,)
     recv_sem,     # DMA ()
     local_sem,    # DMA ()
@@ -74,95 +94,88 @@ def _ep_exchange_kernel(
 ):
     me = dl.rank(axis)
     n = dl.num_ranks(axis)
-    c = x_ref.shape[1]
-    nb_cap = c // block
+    nb_cap = x_ref.shape[1]
+    # Blocks are a LEADING (untiled) dim so power-of-two runs can slice
+    # at traced offsets with no sublane-alignment proof (the tiled dims
+    # are the static [block, R] tail).
+    nbits = max(nb_cap.bit_length(), 1)
 
-    def seg_block(ref, seg, j):
-        return ref.at[seg, pl.ds(j * block, block)]
+    def seg_run(ref, seg, off, sz):
+        return ref.at[seg, pl.ds(off, sz)]
 
     # Peers' o_ref must exist before any put (same contract as the dense
     # a2a); also fences reuse of THIS call's buffers across calls.
     dl.barrier_all(axis)
     dl.straggle_if_rank(straggler_rank, axis, straggle_nanos)
 
-    # Own segment never crosses the wire: local DMA of filled blocks.
+    # Own segment never crosses the wire: local DMA of the filled
+    # prefix, one descriptor per binary run.
     own_nb = pl.cdiv(splits_ref[me], block)
+    _for_each_run(own_nb, nbits, lambda off, sz: pltpu.make_async_copy(
+        seg_run(x_ref, me, off, sz), seg_run(o_ref, me, off, sz), local_sem
+    ).start())
 
-    def own_start(j, carry):
-        @pl.when(j < own_nb)
-        def _():
-            pltpu.make_async_copy(
-                seg_block(x_ref, me, j), seg_block(o_ref, me, j), local_sem
-            ).start()
-        return carry
-
-    jax.lax.fori_loop(0, nb_cap, own_start, None)
-
-    # Push the filled prefix of every peer segment, block by block. Data
+    # Push the filled prefix of every peer segment, run by run. Data
     # from rank ``me`` lands in the peer's segment ``me`` (the dense-a2a
     # slot convention), so receivers never contend for a slot.
     for i in range(1, n):
         peer = jax.lax.rem(me + i, n)
         nb = pl.cdiv(splits_ref[peer], block)
+        _for_each_run(nb, nbits, lambda off, sz, peer=peer, i=i:
+                      dl.put_signal(
+                          seg_run(x_ref, peer, off, sz),
+                          seg_run(o_ref, me, off, sz),
+                          peer,
+                          send_sems.at[i - 1],
+                          recv_sem,
+                          axis=axis,
+                      ))
 
-        def push(j, carry, peer=peer, nb=nb, i=i):
-            @pl.when(j < nb)
-            def _():
-                dl.put_signal(
-                    seg_block(x_ref, peer, j),
-                    seg_block(o_ref, me, j),
-                    peer,
-                    send_sems.at[i - 1],
-                    recv_sem,
-                    axis=axis,
-                )
+    # DMA semaphores only accept descriptor-expressed waits (Pallas
+    # rejects a raw semaphore_wait on a dma_sem), so waits mirror the
+    # senders' run structure: one descriptor per binary run. A count
+    # can exceed one segment's capacity (arrivals sum over sources), so
+    # full-segment descriptors cover the quotient — <= n-1 of them —
+    # and binary runs the remainder: O(n + log) waits total, vs the old
+    # O(n * capacity/block) wait loop.
+    def wait_runs(count_blocks, sem):
+        full = count_blocks // nb_cap
+
+        def one_full(_, carry):
+            dl.wait_recv(sem, o_ref.at[0])
             return carry
 
-        jax.lax.fori_loop(0, nb_cap, push, None)
+        jax.lax.fori_loop(0, full, one_full, None)
+        _for_each_run(count_blocks - full * nb_cap, nbits, lambda off, sz:
+                      dl.wait_recv(sem, seg_run(o_ref, 0, 0, sz)))
 
-    # Arrivals: every inbound block is ``block * R`` bytes on one shared
-    # byte-counting semaphore, so the wait is simply "that many blocks".
+    # Arrivals: the shared recv semaphore counts bytes, so WHICH sized
+    # descriptors express the wait doesn't matter — only their total.
     total_in = jnp.int32(0)
     for i in range(1, n):
         src = jax.lax.rem(me + i, n)
         total_in = total_in + pl.cdiv(expect_ref[src], block)
 
-    def arrival(t, carry):
-        dl.wait_recv(recv_sem, seg_block(o_ref, 0, 0))
-        return carry
-
-    jax.lax.fori_loop(0, total_in, arrival, None)
+    wait_runs(total_in, recv_sem)
 
     # Drain own-segment local copies.
-    def own_wait(j, carry):
-        @pl.when(j < own_nb)
-        def _():
-            pltpu.make_async_copy(
-                seg_block(x_ref, me, 0), seg_block(o_ref, me, 0), local_sem
-            ).wait()
-        return carry
-
-    jax.lax.fori_loop(0, nb_cap, own_wait, None)
+    wait_runs(own_nb, local_sem)
 
     # Quiet: drain sends so x_ref is reusable after the call returns.
+    # Send semaphores also count bytes — runs per peer cover every
+    # byte pushed to it.
     for i in range(1, n):
         peer = jax.lax.rem(me + i, n)
         nb = pl.cdiv(splits_ref[peer], block)
-
-        def drain(j, carry, peer=peer, nb=nb, i=i):
-            @pl.when(j < nb)
-            def _():
-                dl.remote_copy(
-                    seg_block(x_ref, peer, 0),
-                    seg_block(o_ref, me, 0),
-                    peer,
-                    send_sems.at[i - 1],
-                    recv_sem,
-                    axis=axis,
-                ).wait_send()
-            return carry
-
-        jax.lax.fori_loop(0, nb_cap, drain, None)
+        _for_each_run(nb, nbits, lambda off, sz, peer=peer, i=i:
+                      dl.remote_copy(
+                          seg_run(x_ref, peer, off, sz),
+                          seg_run(o_ref, me, off, sz),
+                          peer,
+                          send_sems.at[i - 1],
+                          recv_sem,
+                          axis=axis,
+                      ).wait_send())
 
 
 def ep_exchange(
@@ -192,6 +205,11 @@ def ep_exchange(
     if pad_c:
         rows = jnp.pad(rows, ((0, 0), (0, pad_c), (0, 0)))
     cp = c + pad_c
+    # Blocked layout [n, NB, block, R]: the block index becomes a
+    # LEADING (untiled) dim, so the kernel's power-of-two runs can DMA
+    # from traced block offsets (dynamic sublane slices of [C, R] would
+    # need an alignment proof Mosaic can't make on a run sum).
+    rows = rows.reshape(n, cp // block, block, r)
 
     out = comm_pallas_call(
         functools.partial(
@@ -201,7 +219,7 @@ def ep_exchange(
             straggler_rank=straggler_rank,
             straggle_nanos=straggle_nanos,
         ),
-        jax.ShapeDtypeStruct((n, cp, r), jnp.uint8),
+        jax.ShapeDtypeStruct((n, cp // block, block, r), jnp.uint8),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
@@ -217,6 +235,7 @@ def ep_exchange(
         ctx=ctx,
         cost_estimate=comm_cost(bytes_accessed=2 * n * cp * r),
     )(splits.astype(jnp.int32), recv_counts.astype(jnp.int32), rows)
+    out = out.reshape(n, cp, r)
     return out[:, :c] if pad_c else out
 
 

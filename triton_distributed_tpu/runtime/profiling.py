@@ -1,19 +1,91 @@
-"""Profiling: per-process trace capture with a merged timeline.
+"""Profiling: per-process trace capture with a merged one-file timeline.
 
 Reference parity: ``group_profile`` (``python/triton_dist/utils.py:505-589``)
-wraps ``torch.profiler``, exports one chrome trace per rank, gathers them to
-rank 0 and merges into a single timeline. The TPU-native analog wraps
-``jax.profiler`` (XPlane/Perfetto): each process traces into
-``<dir>/<name>/rank<i>``; on shared filesystems the result is already merged
-by directory layout and loads as one timeline in XProf/Perfetto.
+wraps ``torch.profiler``, exports one chrome trace per rank, gathers them
+to rank 0, remaps pids per rank and merges + gzips into a SINGLE
+timeline. The TPU-native analog wraps ``jax.profiler`` (XPlane +
+chrome-trace export): each process traces into ``<dir>/<name>/rank<i>``,
+then rank 0 merges every rank's chrome trace into
+``<dir>/<name>/merged.trace.json.gz`` — one file, one timeline, pids
+namespaced per rank exactly like the reference's ``merge_json_files``
+(``utils.py:370-502``).
 """
 
 from __future__ import annotations
 
 import contextlib
+import glob
+import gzip
+import json
 import os
 
 import jax
+
+# Rank pid namespace stride: chrome-trace pids from one process stay
+# below this, so ``rank * _PID_STRIDE + pid`` never collides across
+# ranks (the reference remaps pids the same way, ``utils.py:430-470``).
+_PID_STRIDE = 10_000_000
+
+
+def _load_chrome_trace(path: str) -> dict:
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rt") as f:
+        return json.load(f)
+
+
+def merge_group_profile(name: str, out_dir: str = "prof") -> str | None:
+    """Merge every rank's chrome trace under ``<out_dir>/<name>`` into
+    ONE gzipped timeline, ``<out_dir>/<name>/merged.trace.json.gz``.
+
+    Each rank's events keep their relative pid/tid structure but move
+    into a per-rank pid namespace, and every process-name metadata row
+    is prefixed ``rank<i>:`` so the merged view in Perfetto/chrome
+    reads like the reference's merged ``group_profile`` output. Returns
+    the merged path, or None when no rank traces exist (e.g. profiling
+    was off)."""
+    root = os.path.join(out_dir, name)
+    rank_dirs = sorted(
+        d for d in glob.glob(os.path.join(root, "rank*"))
+        if os.path.isdir(d)
+    )
+    merged: list = []
+    meta: dict = {}
+    found = False
+    for d in rank_dirs:
+        try:
+            rank = int(os.path.basename(d).removeprefix("rank"))
+        except ValueError:
+            continue
+        # jax.profiler lays out <dir>/plugins/profile/<session>/
+        # <host>.trace.json.gz; take the newest session per rank.
+        traces = sorted(glob.glob(
+            os.path.join(d, "plugins", "profile", "*", "*.trace.json.gz")
+        )) or sorted(glob.glob(os.path.join(d, "*.trace.json.gz")))
+        if not traces:
+            continue
+        found = True
+        data = _load_chrome_trace(traces[-1])
+        base = rank * _PID_STRIDE
+        for ev in data.get("traceEvents", []):
+            ev = dict(ev)
+            if isinstance(ev.get("pid"), int):
+                ev["pid"] = base + ev["pid"]
+            if (ev.get("ph") == "M" and ev.get("name") == "process_name"
+                    and isinstance(ev.get("args"), dict)):
+                ev["args"] = dict(ev["args"])
+                ev["args"]["name"] = (
+                    f"rank{rank}: {ev['args'].get('name', '')}"
+                )
+            merged.append(ev)
+        for k, v in data.items():
+            if k != "traceEvents":
+                meta.setdefault(k, v)
+    if not found:
+        return None
+    out_path = os.path.join(root, "merged.trace.json.gz")
+    with gzip.open(out_path, "wt") as f:
+        json.dump({**meta, "traceEvents": merged}, f)
+    return out_path
 
 
 @contextlib.contextmanager
@@ -21,14 +93,21 @@ def group_profile(
     name: str | None = None,
     do_prof: bool = True,
     out_dir: str = "prof",
+    merge: bool = True,
 ):
-    """Context manager capturing a jax.profiler trace for all processes.
+    """Context manager capturing a jax.profiler trace for all processes,
+    merged to one timeline on exit.
 
     Usage parity with the reference (``test_ag_gemm.py:109``):
 
         with group_profile("ag_gemm", do_prof=args.profile):
             run_the_kernel()
-    """
+
+    On exit, process 0 merges every rank's chrome trace it can see into
+    ``<out_dir>/<name>/merged.trace.json.gz`` (ranks write to a shared
+    filesystem in the torchrun-style launches this mirrors; without one,
+    gather the ``rank*`` dirs and call :func:`merge_group_profile`
+    post-hoc)."""
     if not do_prof or name is None:
         yield
         return
@@ -39,3 +118,22 @@ def group_profile(
         yield
     finally:
         jax.profiler.stop_trace()
+        if merge:
+            try:
+                if jax.process_count() > 1:
+                    # EVERY process joins the sync (it is a collective —
+                    # rank-0-only would deadlock); it fences the other
+                    # ranks' trace export before rank 0 reads their
+                    # files (the reference gathers over the process
+                    # group at the same point).
+                    from jax.experimental import multihost_utils
+
+                    multihost_utils.sync_global_devices(
+                        f"group_profile:{name}"
+                    )
+                if jax.process_index() == 0:
+                    merge_group_profile(name, out_dir)
+            except Exception:
+                # A failed merge must never sink the profiled run; the
+                # per-rank traces are still on disk.
+                pass
