@@ -124,6 +124,9 @@ STEPS = [
     ("ladder_4b", [sys.executable, "bench.py"], 5200,
      {"TDT_BENCH_MODEL": "Qwen/Qwen3-4B",
       "TDT_BENCH_DEADLINE_S": "3000"}),
+    # Beyond-HBM: 8B-geometry wq8 decode from synthetic int8 (the bf16
+    # tree would exceed the chip; labeled synthetic in its output).
+    ("ladder_8b_q8", [sys.executable, "perf/ladder_q8_synth.py"], 2400),
     ("e2e", [sys.executable, "perf/real_weights_e2e.py", "--full",
              "--mode", "mega_multi", "--gen-len", "64"], 2700),
     ("sweep_full", [sys.executable, "perf/sweep_overlap_tiles.py",

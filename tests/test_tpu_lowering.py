@@ -602,3 +602,63 @@ class TestEPExchangeLower:
             _sds(tpu_ctx, (16, 128, 2 * 128), ("tp", None, None)),
             _sds(tpu_ctx, (16, 128, 128), ("tp", None, None)),
         )
+
+
+class TestHeadlineGeometryLower:
+    """The round-4 headline-class ladders (VERDICT r3 task 4) run
+    Qwen3-1.7B / Qwen3-4B geometry on the chip; their per-layer dims
+    (d=2048/2560, o_k=4096, f=6144/9728) must lower BEFORE a relay
+    window is spent on them. Layers/vocab are reduced — they change
+    tile counts, not tile shapes (full-vocab lm streams are
+    chip-proven at 0.6B)."""
+
+    @pytest.mark.parametrize("preset", ["Qwen/Qwen3-1.7B", "Qwen/Qwen3-4B"])
+    def test_mega_multi_lowers(self, tpu_ctx1, preset):
+        from triton_distributed_tpu.megakernel import MegaQwen3
+        from triton_distributed_tpu.models import AutoLLM
+
+        model = AutoLLM.from_pretrained(
+            preset, ctx=tpu_ctx1, max_length=128,
+            num_layers=2, vocab_size=32768,
+        )
+        mega = MegaQwen3(model)
+        f = jax.jit(mega.build_multi(1, 128, 4))
+        cache = jax.eval_shape(lambda: model.new_cache(1, 128))
+        tok = jax.ShapeDtypeStruct((1,), jnp.int32)
+        params = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(
+                x.shape, x.dtype, sharding=x.sharding
+            ),
+            model.params,
+        )
+        exp = export.export(f, platforms=["tpu"])(params, tok, cache)
+        assert len(exp.mlir_module_serialized) > 0
+
+    def test_mega_q8_synth_8b_geometry_lowers(self, tpu_ctx1):
+        """The beyond-HBM path (perf/ladder_q8_synth.py): 8B-geometry
+        wq8 decode from synthesized Q8Params, no bf16 tree."""
+        from triton_distributed_tpu.megakernel import MegaQwen3
+        from triton_distributed_tpu.megakernel.code_generator import (
+            MegaConfig,
+        )
+        from triton_distributed_tpu.models.config import get_config
+        from triton_distributed_tpu.models.qwen import Qwen3
+
+        cfg = get_config(
+            "Qwen/Qwen3-8B", max_length=128,
+            num_layers=2, vocab_size=32768,
+        )
+        model = Qwen3(cfg, ctx=tpu_ctx1)  # params stay None
+        mega = MegaQwen3(model, cfg=MegaConfig(wq8=True))
+        qp = mega.quantized_init(jax.random.PRNGKey(0))
+        f = jax.jit(mega.build_multi(1, 128, 4))
+        cache = jax.eval_shape(lambda: model.new_cache(1, 128))
+        tok = jax.ShapeDtypeStruct((1,), jnp.int32)
+        qshapes = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(
+                x.shape, x.dtype, sharding=x.sharding
+            ),
+            qp,
+        )
+        exp = export.export(f, platforms=["tpu"])(qshapes, tok, cache)
+        assert len(exp.mlir_module_serialized) > 0

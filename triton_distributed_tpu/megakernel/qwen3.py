@@ -27,7 +27,7 @@ from triton_distributed_tpu.models.paged_kv_cache import (
     paged_cache_specs,
 )
 from triton_distributed_tpu.models import paged_kv_cache as _paged
-from triton_distributed_tpu.models.qwen import Qwen3, Qwen3Params
+from triton_distributed_tpu.models.qwen import Qwen3, Qwen3Params, pad_vocab
 from triton_distributed_tpu.runtime.pytree import register_param_dataclass
 
 
@@ -106,7 +106,11 @@ class MegaQwen3:
         cfg: MegaConfig | None = None,
         policy: SchedulePolicy = SchedulePolicy.ROUND_ROBIN,
     ):
-        if model.params is None:
+        if model.params is None and not (cfg and cfg.wq8):
+            # wq8 decode can run from Q8Params alone (see
+            # :meth:`quantized_init` — int8 synthesis that never
+            # materializes the bf16 tree); every other path needs the
+            # model loaded.
             raise ValueError("load or init Qwen3 params first")
         self.model = model
         self.cfg = cfg or MegaConfig()
@@ -119,8 +123,12 @@ class MegaQwen3:
         n = m.ctx.axis_size(m.axis)
         # The lm_head's vocab axis is padded to 128·tp by set_params;
         # v_loc follows the padded width (the step wrappers slice the
-        # pad logits back off).
-        v_pad = m.params.lm_head.shape[1]
+        # pad logits back off). Without loaded params (the wq8
+        # synthetic path) the same padding is computed from the config.
+        if m.params is not None:
+            v_pad = m.params.lm_head.shape[1]
+        else:
+            v_pad = pad_vocab(c.vocab_size, n)
         return MegaDims(
             batch=batch,
             d=c.hidden_size,
@@ -237,6 +245,12 @@ class MegaQwen3:
         cached on this instance)."""
         if getattr(self, "_q8", None) is None:
             m = self.model
+            if m.params is None:
+                raise ValueError(
+                    "no bf16 params to quantize — load/init the model "
+                    "first, or synthesize int8 directly with "
+                    "quantized_init()"
+                )
             f = m.ctx.shard_map(
                 _quantize_shard,
                 in_specs=(m.param_specs,),
@@ -244,6 +258,66 @@ class MegaQwen3:
             )
             self._q8 = jax.jit(f)(m.params)
             jax.block_until_ready(self._q8)
+        return self._q8
+
+    def quantized_init(self, key: jax.Array) -> Q8Params:
+        """SYNTHETIC per-channel-int8 parameters, generated device-side
+        WITHOUT ever materializing the bf16 tree — the path that puts
+        an 8B-geometry model on one 16 GB v5e (the bf16 tree alone,
+        ~16.4 GB, would exceed HBM; the reference serves 8B across
+        8×H800 = 640 GB, ``docs/mega_triton_kernel.md:27-31``).
+
+        Weights are uniform int8 with init-scale-magnitude uniform
+        scales, so every DMA/tile/dequant path is production-shaped but
+        the logits carry no knowledge — this exists for geometry/perf
+        evidence. The cross-checks still bind: single- and multi-step
+        chains must agree token-for-token over the same synthetic
+        weights. Requires ``MegaConfig(wq8=True)``; fills the same
+        cache :meth:`quantized_params` reads."""
+        if not self.cfg.wq8:
+            raise ValueError("quantized_init requires MegaConfig(wq8=True)")
+        m = self.model
+        c = m.cfg
+        n = m.ctx.axis_size(m.axis)
+        hd, d, L, f = c.head_dim, c.hidden_size, c.num_layers, \
+            c.intermediate_size
+        qkv = (c.num_q_heads + 2 * c.num_kv_heads) * hd
+        o_k = c.num_q_heads * hd
+        v_pad = pad_vocab(c.vocab_size, n)
+        dt = c.dtype
+
+        def build(k):
+            ks = iter(jax.random.split(k, 7))
+
+            def w8(*shape):
+                return jax.random.randint(
+                    next(ks), shape, -127, 128, jnp.int8
+                )
+
+            def sc(*shape):
+                return jnp.full(shape, 0.02 / 127.0, jnp.float32)
+
+            return Q8Params(
+                embed=(jax.random.normal(
+                    next(ks), (c.vocab_size, d), jnp.float32
+                ) * 0.02).astype(dt),
+                wqkv=w8(L, d, qkv), wo=w8(L, o_k, d),
+                w1=w8(L, d, 2 * f), w2=w8(L, f, d),
+                lm_head=w8(d, v_pad),
+                sc_qkv=sc(L, 1, qkv), sc_o=sc(L, n, d),
+                sc_w1=sc(L, 1, 2 * f), sc_w2=sc(L, n, d),
+                sc_lm=sc(1, v_pad),
+                ln1=jnp.ones((L, d), dt), ln2=jnp.ones((L, d), dt),
+                norm=jnp.ones((d,), dt),
+                qn=jnp.ones((L, hd), dt), kn=jnp.ones((L, hd), dt),
+            )
+
+        shardings = jax.tree.map(
+            lambda s: m.ctx.sharding(*s), self._q8_specs(),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        self._q8 = jax.jit(build, out_shardings=shardings)(key)
+        jax.block_until_ready(self._q8)
         return self._q8
 
     @staticmethod

@@ -67,6 +67,16 @@ def rms_norm(x: jax.Array, w: jax.Array, eps: float = 1e-6) -> jax.Array:
     return (xf * w.astype(jnp.float32)).astype(x.dtype)
 
 
+def pad_vocab(v: int, n: int) -> int:
+    """Vocab width padded to a multiple of 128·tp, so each shard's
+    column count is lane-aligned (Qwen3's 151936 = 2^7·1187 leaves a
+    64/96/48 residue at tp=2/4/8). The ONE definition behind
+    ``_pad_lm_head``, ``MegaQwen3._dims``'s unloaded fallback, and
+    ``quantized_init``."""
+    align = 128 * n
+    return -(-v // align) * align
+
+
 class Qwen3:
     """Host-level model wrapper (parity: reference ``Qwen3``,
     ``models/qwen.py``). Holds sharded params + jitted SPMD programs."""
@@ -169,9 +179,8 @@ class Qwen3:
         # (Qwen3's 151936 = 2^7·1187 leaves a 64/96/48 residue at
         # tp=2/4/8). ``_logits`` slices the pads back off — zero-weight
         # columns would otherwise score 0 and could beat real logits.
-        align = 128 * self.ctx.axis_size(self.axis)
         v = params.lm_head.shape[1]
-        vp = -(-v // align) * align
+        vp = pad_vocab(v, self.ctx.axis_size(self.axis))
         if vp != v:
             params = dataclasses.replace(
                 params, lm_head=jnp.pad(params.lm_head, ((0, 0), (0, vp - v)))
