@@ -16,6 +16,7 @@ import argparse
 import json
 import os
 import sys
+import time
 
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -23,9 +24,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # tn:tk:nbuf[:fuse_norms[:cross_prefetch]] — baseline (the library
 # defaults) first; then each lever added cumulatively so the deltas
 # attribute: staging depth, tile width, norm fusion, cross-task
-# prefetch.
+# prefetch. Tail candidates probe the edges of the space (deeper
+# staging, wider K tiles, max-width N tiles) on top of the full lever
+# stack — VMEM stays under the derived limit at 0.6B dims.
 DEFAULT = ("1024:1024:2,1024:1024:4,2048:1024:4,"
-           "1024:1024:4:1,1024:1024:4:1:1,2048:1024:4:1:1")
+           "1024:1024:4:1,1024:1024:4:1:1,2048:1024:4:1:1,"
+           "1024:1024:6:1:1,2048:2048:4:1:1,3072:1024:4:1:1")
 
 
 def main(argv=None) -> int:
@@ -40,8 +44,15 @@ def main(argv=None) -> int:
                         "(wq8=True on every config; results are NOT "
                         "written to MEGA_TUNED.json, which tunes the "
                         "bf16 headline rungs)")
+    p.add_argument("--deadline-s", type=float, default=1800,
+                   help="stop starting new configs past this wall "
+                        "budget and finalize with what's measured — a "
+                        "relay window must never end with ZERO tuning "
+                        "because the sweep was killed mid-flight "
+                        "(0 disables)")
     p.add_argument("--cpu", action="store_true")
     args = p.parse_args(argv)
+    t_start = time.time()
 
     import jax
 
@@ -70,7 +81,18 @@ def main(argv=None) -> int:
     all_match = True
     any_ok = False
     rows = []
+    truncated = False
     for i, spec in enumerate(args.configs.split(",")):
+        if (args.deadline_s and i > 0
+                and time.time() - t_start > args.deadline_s):
+            # Stop starting configs; rc stays 0 so the window queue
+            # moves on to the ladder rather than re-paying the sweep.
+            print(json.dumps({
+                "deadline_s": args.deadline_s,
+                "skipped_configs": args.configs.split(",")[i:],
+            }), flush=True)
+            truncated = True
+            break
         label = spec
         try:
             cfg = MegaConfig.from_spec(spec)
@@ -130,18 +152,32 @@ def main(argv=None) -> int:
                             "MEGA_TUNED.json")
         base_ms = rows[0][1]
         best = min((r for r in rows if r[2]), key=lambda r: r[1])
-        if best[1] < base_ms * 0.98:  # >2% win, not noise
+        # A deadline-TRUNCATED sweep saw only a subset of the space: it
+        # may improve an existing record but must never delete or
+        # downgrade one a FULL sweep wrote (the remove below exists to
+        # drop stale winners, and "stale" can only be judged by a full
+        # re-measure).
+        prior_ms = None
+        if truncated:
+            try:
+                with open(path) as f:
+                    prior_ms = float(json.load(f)["ms_per_step"])
+            except (OSError, ValueError, KeyError, TypeError):
+                prior_ms = None
+        if best[1] < base_ms * 0.98 and (  # >2% win, not noise
+                prior_ms is None or best[1] < prior_ms):
             with open(path, "w") as f:
                 json.dump({
                     "config": best[0],
                     "ms_per_step": round(best[1], 3),
                     "baseline_ms_per_step": round(base_ms, 3),
                     "written_by": "perf/mega_tile_sweep.py",
+                    "truncated": truncated,
                     "device": jax.devices()[0].device_kind,
                     "model": args.model,
                 }, f)
             print(json.dumps({"tuned": best[0], "written": path}), flush=True)
-        elif os.path.exists(path):
+        elif os.path.exists(path) and not truncated:
             os.remove(path)
             print(json.dumps({"tuned": None, "removed": path}), flush=True)
 

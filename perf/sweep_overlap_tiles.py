@@ -29,8 +29,14 @@ def main(argv=None) -> int:
     p.add_argument("--n", type=int, default=12288)
     p.add_argument("--op", default="ag_gemm", choices=["ag_gemm", "gemm_rs"])
     p.add_argument("--iters", type=int, default=8)
+    p.add_argument("--deadline-s", type=float, default=1800,
+                   help="stop starting new configs past this wall "
+                        "budget and report best-so-far (this step has "
+                        "twice burned a whole relay window compiling "
+                        "every config; 0 disables)")
     p.add_argument("--cpu", action="store_true")
     args = p.parse_args(argv)
+    t_start = time.time()
 
     if args.cpu:
         os.environ["XLA_FLAGS"] = (
@@ -93,6 +99,11 @@ def main(argv=None) -> int:
     tile_ms = [256, 512, 1024, 2048]
     tile_ns = [512, 1024, 1536]
     for tile_m, tile_n in itertools.product(tile_ms, tile_ns):
+        if args.deadline_s and time.time() - t_start > args.deadline_s:
+            print(json.dumps({"deadline_s": args.deadline_s,
+                              "stopped_at": f"tm{tile_m}_tn{tile_n}"}),
+                  flush=True)
+            break
         if m % tile_m or n % tile_n:
             continue
         itemsize = jnp.dtype(dt).itemsize
