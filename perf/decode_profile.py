@@ -137,6 +137,54 @@ def main(argv=None) -> int:
             rec["unreliable"] = "slope < 20% of base time — relay noise"
         emit(rec)
 
+    # Attention component (the one non-matvec weight-class cost in the
+    # step): flash-decode over the 0.6B ctx=512 cache, slope-timed the
+    # same way — completes the floor split (norms/rope are VPU-bound
+    # and fold into whatever they fuse with).
+    from triton_distributed_tpu.ops.attention.flash_decode import (
+        flash_decode,
+    )
+
+    S = 512
+    q0 = jax.jit(lambda k: jax.random.normal(
+        k, (args.batch, HQ, HD), jnp.bfloat16))(key)
+    kc = jax.jit(lambda k: jax.random.normal(
+        k, (args.batch, HKV, S, HD), jnp.bfloat16))(key)
+    vc = jax.jit(lambda k: jax.random.normal(
+        k, (args.batch, HKV, S, HD), jnp.bfloat16))(key)
+    klen = jnp.full((args.batch,), S, jnp.int32)
+    jax.block_until_ready((q0, kc, vc))
+
+    @functools.partial(jax.jit, static_argnums=4)
+    def attn_chain(q, kc, vc, kl, steps):
+        def body(_, q):
+            o = flash_decode(q, kc, vc, kl)
+            return q + (jnp.sum(o) * jnp.bfloat16(1e-8)).astype(q.dtype)
+
+        return jax.lax.fori_loop(0, steps, body, q)
+
+    ta1 = median_time(
+        lambda: np.asarray(attn_chain(q0, kc, vc, klen, args.steps)))
+    ta2 = median_time(
+        lambda: np.asarray(attn_chain(q0, kc, vc, klen, 2 * args.steps)))
+    a_sec = (ta2 - ta1) / args.steps
+    attn_bytes = int(kc.size + vc.size) * 2  # K+V read once per step
+    a_ms_step = max(a_sec, 0.0) * 1e3 * L
+    a_noisy = a_sec * args.steps < 0.2 * ta1
+    rec = {"component": "attention", "shape": [HQ, HKV, S, HD],
+           "count": L,
+           "ms_per_call": round(a_sec * 1e3, 4),
+           # Same convention as the matvec records: a noise-dominated
+           # slope must not report an absurd bandwidth.
+           "achieved_gbs": (None if a_noisy or a_sec <= 0
+                            else round(attn_bytes / a_sec / 1e9, 1)),
+           "ms_per_step_total": round(a_ms_step, 4)}
+    total_floor_ms += a_ms_step
+    if a_noisy:
+        any_noisy = True
+        rec["unreliable"] = "slope < 20% of base time — relay noise"
+    emit(rec)
+
     # Per-grid-iteration overhead of a Pallas kernel: the megakernel
     # dispatches ~200 task iterations per decode step, so N µs/iter is
     # N*0.2 ms/step of pure scheduling. Slope over two grid sizes on a
@@ -194,10 +242,10 @@ def main(argv=None) -> int:
     # KV-attention bytes are small at ctx=512 (~30 MB) but the gather +
     # softmax pipeline has fixed cost; time one flash-decode call class.
     summary = {
-        "matvec_floor_ms_per_step": round(total_floor_ms, 3),
-        "note": ("floor = sum of isolated matvec times; the full-"
-                 "step rungs add norms/rope/attention/feedback — "
-                 "compare with bench.py ladder"),
+        "component_floor_ms_per_step": round(total_floor_ms, 3),
+        "note": ("floor = sum of isolated matvec + attention times; "
+                 "the full-step rungs add norms/rope/feedback/"
+                 "scheduling — compare with bench.py ladder"),
     }
     if any_noisy:
         summary["unreliable"] = (

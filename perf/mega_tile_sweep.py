@@ -161,7 +161,13 @@ def main(argv=None) -> int:
         if truncated:
             try:
                 with open(path) as f:
-                    prior_ms = float(json.load(f)["ms_per_step"])
+                    prior = json.load(f)
+                # Only an APPLICABLE prior can block the write — a
+                # record for another chip/model is ignored by bench's
+                # reader anyway (same gate as _tuned_mega_config).
+                if (prior.get("device") == jax.devices()[0].device_kind
+                        and prior.get("model") == args.model):
+                    prior_ms = float(prior["ms_per_step"])
             except (OSError, ValueError, KeyError, TypeError):
                 prior_ms = None
         if best[1] < base_ms * 0.98 and (  # >2% win, not noise

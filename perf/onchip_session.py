@@ -70,8 +70,13 @@ STEPS = [
     ("kernel_smoke", [sys.executable, "-c", _KERNEL_SMOKE], 300),
     # Weight-stream sweep FIRST among the heavy steps: the winner lands
     # in MEGA_TUNED.json for the (next) ladder/bench — these two are
-    # what move BENCH_r04 (VERDICT task 2).
-    ("mega_tiles", [sys.executable, "perf/mega_tile_sweep.py"], 2400),
+    # what move BENCH_r04 (VERDICT task 2). Internal deadline sized so
+    # sweep + tuned ladder BOTH fit one ~30-min window; the step
+    # timeout leaves a full worst-case config (~400 s fresh compile)
+    # PLUS finalize headroom past the deadline, so the tuned-file
+    # write always runs before the SIGKILL.
+    ("mega_tiles", [sys.executable, "perf/mega_tile_sweep.py",
+                    "--deadline-s", "1200"], 1800),
     # Relay is UP here (probe gated), so bench's probe succeeds at
     # once; the reduced deadline stops a mid-ladder outage from
     # burning the session's window on probe retries. Step timeout must

@@ -535,3 +535,52 @@ def test_hf_transformers_moe_parity(tmp_path, norm_topk):
         np.testing.assert_array_equal(out[0], hf_gen)
     finally:
         mesh_mod.finalize_distributed()
+
+
+def test_hf_bf16_checkpoint_loads(tmp_path):
+    """A bf16-saved checkpoint (the dtype real Qwen3 releases — and the
+    round-4 1.7B e2e checkpoint — ship in) must load and serve. Pinned
+    against the SAME model's fp32 save: identical greedy tokens (tiny
+    dims, logit gaps far above bf16 noise is not guaranteed — so
+    compare prefill logits with a bf16-scale tolerance instead)."""
+    torch = pytest.importorskip("torch")
+    tfm = pytest.importorskip("transformers")
+
+    import jax as _jax
+
+    from triton_distributed_tpu.runtime import mesh as mesh_mod
+
+    hf_cfg = tfm.Qwen3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=32, rope_theta=1e6, rms_norm_eps=1e-6,
+        tie_word_embeddings=True, max_position_embeddings=64,
+    )
+    torch.manual_seed(0)
+    hf_model = tfm.Qwen3ForCausalLM(hf_cfg).eval()
+    hf_model.save_pretrained(tmp_path / "f32", safe_serialization=True)
+    hf_model.to(torch.bfloat16).save_pretrained(
+        tmp_path / "bf16", safe_serialization=True
+    )
+
+    prompt = np.array([3, 14, 15, 92, 65, 35, 89, 79], np.int32)
+    ctx = mesh_mod.initialize_distributed(tp=2, devices=_jax.devices()[:2])
+    try:
+        logits = {}
+        for name in ("f32", "bf16"):
+            model = AutoLLM.from_pretrained(
+                str(tmp_path / name), ctx=ctx, dtype=jnp.float32,
+                max_length=64,
+            )
+            lg, _ = model.prefill(
+                jnp.asarray(prompt), model.new_cache(1), "xla"
+            )
+            logits[name] = np.asarray(lg)
+        # bf16 weight rounding is ~2^-8 relative; tiny-dim logits are
+        # O(1), so 0.05 is generous headroom without masking a wrong
+        # tensor mapping (those diverge by O(1)).
+        np.testing.assert_allclose(
+            logits["bf16"], logits["f32"], atol=5e-2, rtol=5e-2
+        )
+    finally:
+        mesh_mod.finalize_distributed()
