@@ -36,9 +36,11 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # (M, K, N) — north-star GEMM+RS anchor, its AG+GEMM mirror, a square
-# anchor, and two Qwen3-TP decode/prefill shapes (non-anchor points for
-# the perf-model validation).
-DEFAULT_SHAPES = "4096,4096,4096;8192,4096,12288;8192,12288,4096;2048,2048,8192;512,1024,3072"
+# anchor, two Qwen3-TP decode/prefill shapes (non-anchor points for
+# the perf-model validation), and a tall-M shape (does feeding the MXU
+# a longer M dimension move the MFU?).
+DEFAULT_SHAPES = ("4096,4096,4096;8192,4096,12288;8192,12288,4096;"
+                  "2048,2048,8192;512,1024,3072;16384,4096,4096")
 
 _PEAK_TFS = {
     # bf16 dense peak per chip. v5e: 197 TF/s (public spec, also

@@ -112,6 +112,10 @@ STEPS = [
     ("e2e_17", [sys.executable, "perf/real_weights_e2e.py",
                 "--geom", "1.7b", "--mode", "mega_multi",
                 "--gen-len", "64"], 2700),
+    # Live socket-server demo: transcript + tok/s measured THROUGH the
+    # wire protocol (reference model_server.py:112-198 parity).
+    ("serve_demo", [sys.executable, "perf/serve_demo.py",
+                    "--mode", "mega", "--gen-len", "32"], 1200),
     # Randomized on-chip stress subset (VERDICT task 8).
     ("stress", [sys.executable, "perf/onchip_stress.py",
                 "--iters", "12"], 1500),

@@ -1,0 +1,74 @@
+"""Summarize an ONCHIP_r*.jsonl log into a per-step digest.
+
+Each queue window appends raw step records (rc, wall, stdout tail);
+this collapses them into the latest outcome per step plus the headline
+numbers the round log needs (ladder ms/step, tuned config, MFU table,
+EP floor, adaptive-order verdict, e2e tok/s).
+
+Usage: python perf/summarize_onchip.py [perf/ONCHIP_r4.jsonl]
+"""
+
+import json
+import sys
+
+
+def last_json_line(text: str) -> dict | None:
+    """Deepest parseable JSON object line in a stdout tail."""
+    for line in reversed((text or "").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    return None
+
+
+def main(argv=None) -> int:
+    path = (argv or sys.argv[1:] or ["perf/ONCHIP_r4.jsonl"])[0]
+    latest: dict[str, dict] = {}
+    order: list[str] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    r = json.loads(line)
+                except ValueError:
+                    continue
+                step = r.get("step")
+                if not step:
+                    continue
+                if step not in latest:
+                    order.append(step)
+                # Prefer the latest SUCCESS; else the latest attempt.
+                if r.get("rc") == 0 or latest.get(step, {}).get("rc") != 0:
+                    latest[step] = r
+    except FileNotFoundError:
+        print(f"no log at {path}")
+        return 1
+
+    for step in order:
+        r = latest[step]
+        line = {"step": step, "rc": r.get("rc"),
+                "wall_s": r.get("wall_s")}
+        payload = last_json_line(r.get("stdout_tail", ""))
+        if payload:
+            # Pull the fields that matter per step kind.
+            for key in ("ladder", "value", "metric", "vs_baseline",
+                        "platform", "tuned", "ms_per_step",
+                        "kernel_overhead_us_n1_lower_bound",
+                        "overhead_us_by_block", "one_dma_copy_us",
+                        "reacts", "adaptive_order", "ring_order",
+                        "tok_s", "deterministic", "summary",
+                        "cross_check_ok", "achieved_gbs",
+                        "mega_multi_cross_check", "best_rung"):
+                if key in payload:
+                    line[key] = payload[key]
+        if r.get("rc") not in (0, None):
+            line["stderr_tail"] = (r.get("stderr_tail") or "")[-200:]
+        print(json.dumps(line))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

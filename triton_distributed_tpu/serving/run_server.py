@@ -22,7 +22,8 @@ def main(argv=None) -> int:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--temperature", type=float, default=0.0)
-    p.add_argument("--mode", default="xla", choices=["xla", "pallas"])
+    p.add_argument("--mode", default="xla",
+                   choices=["xla", "pallas", "mega"])
     args = p.parse_args(argv)
 
     from triton_distributed_tpu.models import AutoLLM
