@@ -12,7 +12,9 @@ this one zooms into the wire and contrasts the two transports:
   destination gets 3 tokens.
 - ``method="pallas"`` (``ops/moe/ep_exchange.py``): ONE Pallas kernel
   pushes only ``ceil(splits[p]/32)`` 32-row blocks per destination —
-  wire bytes scale with the REAL splits. The [n]-int splits stay on the
+  wire bytes scale with the REAL splits — grouped into power-of-two
+  runs (popcount ≤ log2 DMA descriptors per peer, round 4: the
+  descriptor-count lever that cut the single-chip dispatch floor). The [n]-int splits stay on the
   XLA control plane (they compile into the same program); payload,
   fp8 scales, and expert ids pack into one lane-padded uint8 row (the
   reference's flag-in-data LL codec shape, with the byte-counting DMA
