@@ -74,5 +74,9 @@ while true; do
   else
     echo "[watch $(date -u +%H:%M)] relay down" >>"$LOG"
   fi
-  sleep 1140
+  # Short cycle: windows are ~30 min — a ~20-min probe cadence (the
+  # old 1140 s sleep + 150 s probe timeout) could burn 2/3 of one
+  # before noticing. ~7 min keeps discovery latency small against the
+  # window length at negligible probe cost.
+  sleep 420
 done
