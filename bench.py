@@ -1,4 +1,10 @@
-"""Headline benchmark — prints ONE JSON line.
+"""Headline benchmark — prints a JSON result line (the LAST line wins).
+
+On the happy path exactly one JSON line is printed. On the fallback
+path up to TWO are: a minimal ``value: null`` line the moment TPU work
+is abandoned, then a refined line if the CPU stub completes — each
+line supersedes the previous, so consumers must parse the LAST
+parseable JSON line of stdout (the driver's tail-parse does).
 
 Flagship number: Qwen3-0.6B bf16 single-chip decode ladder (bs=1,
 512-token context), the chip-local analog of the reference's TP8 decode
@@ -25,10 +31,22 @@ runs in a WORKER subprocess that appends one JSON line per completed
 rung to a progress file, while the parent watchdogs progress, kills a
 hung worker, re-probes the relay (again: until the budget ends, not a
 fixed count), and relaunches skipping completed rungs — so a mid-run
-outage costs the remaining rungs at worst, never the whole ladder. Only
-in the final reserved minutes does the bench fall back to a CPU STUB
-(jit rung, 4 steps) so a parseable number is always emitted (marked
-``"platform": "cpu"``).
+outage costs the remaining rungs at worst, never the whole ladder.
+
+Round-4 lesson (VERDICT r4 weak #1 — the all-down run emitted NOTHING
+because the probe loop overran its deadline and the in-process CPU stub
+then overran the driver's kill): the output contract is now
+EMIT-FIRST-REFINE-LATER. (a) a probe is only STARTED if it can finish
+before the reserve boundary (pre-probe deadline check); (b) the global
+deadline defaults BELOW the driver's 2700 s hard kill; (c) the moment
+the bench falls back from TPU, a minimal valid JSON line — ``platform:
+"cpu"``, ``value: null``, plus a ``last_known_tpu`` field carrying the
+newest on-chip ladder from ``perf/ONCHIP_*.jsonl``, labeled cached — is
+printed IMMEDIATELY, and only then does a subprocess-bounded CPU stub
+(jit rung, 4 steps) attempt to refine it with a fresh measurement; if
+the stub lands, a second (refined) line is printed and, being last,
+supersedes the minimal one. A wedged stub can no longer take the
+artifact down with it.
 
 Timing notes (axon relay): ``block_until_ready`` resolves early and
 identical executions are memoized, so decode steps are chained inside
@@ -71,13 +89,29 @@ _RUNG_TIMEOUT_S = _env_int("TDT_BENCH_RUNG_TIMEOUT_S", 600)
 # healthy rung needs far more headroom than the others.
 _MULTI_RUNG_TIMEOUT_S = _env_int("TDT_BENCH_MULTI_RUNG_TIMEOUT_S", 1800)
 _WORKER_ATTEMPTS = 8
-_GLOBAL_DEADLINE_S = _env_int("TDT_BENCH_DEADLINE_S", 2700)
+# Default BELOW the driver's 2700 s hard kill: the bench must always
+# finish (and print) first. r4's 2700-with-zero-margin died mid-stub.
+_GLOBAL_DEADLINE_S = _env_int("TDT_BENCH_DEADLINE_S", 2580)
 # Wall-clock reserved at the tail for the CPU fallback stub (jit rung
 # only, 4 steps) so a parseable number is ALWAYS emitted. Everything
 # before this reserve belongs to TPU probing — relay windows are ~30 min
 # every few hours, so giving up early and burning the budget on a CPU
 # ladder is exactly backwards (VERDICT r3 weak #1).
 _CPU_RESERVE_S = _env_int("TDT_BENCH_CPU_RESERVE_S", 480)
+
+
+def _compile_cache_env(env: dict) -> dict:
+    """Point a child process at the shared persistent compilation cache
+    (``perf/.jax_cache``) unless the caller already chose one. Shared
+    by the bench worker spawn and ``perf/onchip_session.py`` (one
+    policy, one place): retried/relaunched steps re-pay tracing only,
+    not XLA compilation — the piece that burned round-3 windows
+    (VERDICT r4 next #8). Harmless if the backend ignores it."""
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "perf", ".jax_cache"
+    ))
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+    return env
 
 
 def _probe_tpu_once() -> bool:
@@ -115,12 +149,18 @@ def _probe_tpu_until(deadline: float) -> bool:
     (absolute ``time.time()``) passes. An outage hangs probes rather
     than failing them, so each cycle costs ~_PROBE_TIMEOUT_S; the loop
     keeps cycling because a window can open at ANY point in the budget
-    — the whole strategy is to still be probing when it does."""
+    — the whole strategy is to still be probing when it does.
+
+    The deadline is checked BEFORE each probe: a probe that cannot
+    complete before the reserve boundary is never started, so the
+    reserve is a true reserve (r4's post-probe check overran it by up
+    to a full probe+sleep cycle and starved the CPU stub — VERDICT r4
+    weak #1a). With a deadline already in the past this returns False
+    without probing at all: the caller prints the minimal line first,
+    so nothing is lost, and the driver's clock is never gambled with.
+    """
     attempt = 0
-    while True:
-        # Probe BEFORE checking the deadline: even a deadline already in
-        # the past (tiny TDT_BENCH_DEADLINE_S) gets one real attempt, so
-        # a healthy TPU is never silently skipped for the CPU stub.
+    while deadline - time.time() > _PROBE_TIMEOUT_S:
         attempt += 1
         if _probe_tpu_once():
             return True
@@ -129,9 +169,10 @@ def _probe_tpu_until(deadline: float) -> bool:
             f"[bench] relay down (probe {attempt}); "
             f"{max(remaining, 0) / 60:.0f} min of probe budget left\n"
         )
-        if remaining <= _PROBE_SLEEP_S:
-            return False
+        if remaining <= _PROBE_SLEEP_S + _PROBE_TIMEOUT_S:
+            break
         time.sleep(_PROBE_SLEEP_S)
+    return False
 
 
 def chip_peak_gbs(jax) -> float:
@@ -501,12 +542,16 @@ def run_ladder(
 
 
 def _watch_worker(
-    progress_path: str, skip: frozenset[str], model: str
+    progress_path: str, skip: frozenset[str], model: str,
+    hard_kill_at: float,
 ) -> tuple[bool, str | None]:
     """Launch a TPU worker and watchdog its progress file. Returns
     ``(finished, hung_rung)`` — ``hung_rung`` names the rung being run
     when progress stalled (``"__init__"`` for an init-phase stall, None
-    when the worker died on its own)."""
+    when the worker died on its own). ``hard_kill_at`` is the absolute
+    time past which the worker is killed REGARDLESS of per-rung budgets
+    — the parent must still summarize whatever rungs are on disk and
+    print before the driver's hard kill (VERDICT r4 weak #1b)."""
     with open(progress_path, "a") as fh:
         fh.write("")  # ensure exists
     # Hang attribution must only look at THIS attempt's events — a
@@ -518,7 +563,8 @@ def _watch_worker(
             progress_path, "--skip", ",".join(sorted(skip)),
             "--model", model]
     proc = subprocess.Popen(
-        argv, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL
+        argv, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        env=_compile_cache_env(dict(os.environ)),
     )
 
     def _reap(kill: bool) -> None:
@@ -547,6 +593,16 @@ def _watch_worker(
         if any("done" in e for e in events):
             _reap(kill=False)
             return True, None
+        if time.time() > hard_kill_at:
+            # NOT a hang: the worker may be healthily mid-rung — a
+            # distinct marker keeps this out of hang attribution (a
+            # false 'hung xN' record would steer next-round tuning).
+            sys.stderr.write(
+                "[bench] global deadline: killing worker to summarize "
+                "completed rungs\n"
+            )
+            _reap(kill=True)
+            return False, "__deadline__"
         if proc.poll() is not None:
             # Worker died (crash, OOM): not a hang; its per-rung error
             # lines are already on disk.
@@ -567,6 +623,51 @@ def _watch_worker(
         if time.time() - last_change > limit:
             _reap(kill=True)
             return False, "__init__" if current in (None, "init") else current
+
+
+def _last_known_tpu() -> dict | None:
+    """Newest on-chip ladder cached in ``perf/ONCHIP_*.jsonl`` (the
+    measurement queue's log — each record's ``stdout_tail`` holds the
+    step's final JSON line). A relay that stays down for a whole round
+    must not erase the evidence that the chip HAS run this ladder: the
+    minimal fallback line carries the cached number, clearly labeled,
+    so the artifact is never silent about known TPU state (VERDICT r4
+    next #1)."""
+    import glob
+
+    best = None
+    pat = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "perf", "ONCHIP_*.jsonl"
+    )
+    for path in glob.glob(pat):
+        for rec in _read_events(path):
+            if not isinstance(rec, dict):
+                continue  # _read_events keeps any valid JSON line
+            tail = rec.get("stdout_tail", "")
+            if not isinstance(tail, str):
+                continue
+            for line in reversed(tail.splitlines()):
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    continue
+                if not (isinstance(obj, dict)
+                        and obj.get("platform") == "tpu"
+                        and "ladder" in obj):
+                    continue
+                if best is None or rec.get("t_start", 0) > best["t_start"]:
+                    src = f"{os.path.basename(path)}:{rec.get('step', '?')}"
+                    best = {
+                        "note": "CACHED prior on-chip result, not this run",
+                        "source": src,
+                        "t_start": rec.get("t_start", 0),
+                        "age_h": round(
+                            (time.time() - rec.get("t_start", 0)) / 3600, 1
+                        ),
+                        "result": obj,
+                    }
+                break  # only the record's final JSON line counts
+    return best
 
 
 def _read_events(progress_path: str) -> list[dict]:
@@ -594,6 +695,13 @@ def main() -> int:
             run_ladder(
                 fh, on_tpu=True, skip=skip, model_name=flags.get("--model")
             )
+        return 0
+    if len(sys.argv) > 1 and sys.argv[1] == "--worker-cpu":
+        # CPU stub as a SUBPROCESS so the parent can bound it with a
+        # timeout — r4's in-process stub overran the driver's kill with
+        # the final JSON never printed (VERDICT r4 weak #1b).
+        with open(sys.argv[2], "a") as fh:
+            run_ladder(fh, on_tpu=False, skip=frozenset({"pallas"}))
         return 0
 
     import tempfile
@@ -625,8 +733,14 @@ def main() -> int:
     # for the WHOLE window (relay windows are ~30 min every few hours;
     # VERDICT r3: 14 min of probing then a 30-min CPU ladder was the
     # failure mode — inverted here).
-    probe_deadline = t_start + _GLOBAL_DEADLINE_S - _CPU_RESERVE_S
+    hard_deadline = t_start + _GLOBAL_DEADLINE_S
+    probe_deadline = hard_deadline - _CPU_RESERVE_S
     on_tpu = _probe_tpu_until(probe_deadline)
+    # Whether the relay EVER answered this run — ``on_tpu`` is flipped
+    # off when the worker fails, and the fallback note must not claim
+    # "relay down" when the relay answered and the code failed.
+    relay_answered = on_tpu
+    hang_counts: dict[str, int] = {}
     fd, progress_path = tempfile.mkstemp(
         prefix="bench_progress_", suffix=".jsonl"
     )
@@ -634,20 +748,26 @@ def main() -> int:
 
     if on_tpu:
         done: set[str] = set()
-        hang_counts: dict[str, int] = {}
         model = os.environ.get("TDT_BENCH_MODEL", "Qwen/Qwen3-0.6B")
         for attempt in range(_WORKER_ATTEMPTS):
-            if time.time() > probe_deadline:
+            # Attempt 0 always runs: the probe just succeeded, and a
+            # success that lands at/after the probe deadline must still
+            # buy one worker launch — otherwise a healthy TPU falls
+            # through to the CPU stub (ADVICE r4 #2). The hard-kill
+            # line inside _watch_worker bounds it.
+            if attempt and time.time() > probe_deadline:
                 sys.stderr.write("[bench] probe-budget deadline reached\n")
                 break
             skip = done | {r for r, c in hang_counts.items() if c >= 2}
             finished, hung = _watch_worker(
-                progress_path, frozenset(skip), model
+                progress_path, frozenset(skip), model, hard_deadline - 90
             )
             events = _read_events(progress_path)
             done = {e["rung"] for e in events if "rung" in e and "ms" in e}
             if finished:
                 break
+            if hung == "__deadline__":
+                break  # out of budget — summarize what's on disk
             if hung == "__init__":
                 sys.stderr.write("[bench] init stalled; re-probing\n")
                 if not done and not model.endswith("+lite"):
@@ -676,6 +796,7 @@ def main() -> int:
         if not any("rung" in e and "ms" in e for e in events):
             on_tpu = False  # fall back to the CPU ladder below
 
+    cached_tpu = None
     if not on_tpu:
         # No more chip work — stop blocking the measurement queue
         # while the (multi-minute) CPU ladder runs.
@@ -690,13 +811,71 @@ def main() -> int:
             for e in _read_events(progress_path)
             if "rung" in e and "error" in e
         }
-        # Last-minutes STUB, not a ladder: jit rung only (interpret-mode
-        # Pallas timing on a 1-core host is meaningless and burned ~30
-        # min in round 3) — just enough for a parseable number.
-        cpu_path = progress_path + ".cpu"
-        with open(cpu_path, "w") as fh:
-            run_ladder(fh, on_tpu=False, skip=frozenset({"pallas"}))
-        events = _read_events(cpu_path)
+        # EMIT FIRST: a minimal-but-valid line lands NOW, carrying the
+        # newest cached on-chip ladder, so the artifact can never be
+        # empty again — then (budget permitting) the refined CPU stub
+        # prints a second line that supersedes this one. The cache read
+        # is best-effort: a malformed ONCHIP record must not take the
+        # emit down with it (that would be r4's failure all over).
+        try:
+            cached_tpu = _last_known_tpu()
+        except Exception as e:
+            cached_tpu = None
+            sys.stderr.write(f"[bench] last_known_tpu read failed: {e}\n")
+        minimal = {
+            "metric": "qwen3_decode_ms_per_step",
+            "value": None,
+            "unit": "ms",
+            "vs_baseline": None,
+            "platform": "cpu",
+            # relay_answered ⇒ the relay was UP and the worker failed —
+            # say so, or the driver misreads a code regression as an
+            # outage.
+            "note": (
+                "relay answered but no TPU rung completed (see "
+                "tpu_errors); CPU stub pending (a refined line follows "
+                "if it completes)" if relay_answered else
+                "relay down for the whole run; CPU stub pending (a "
+                "refined line follows if it completes)"
+            ),
+        }
+        # Watchdog-killed rungs never wrote an error event — surface
+        # them alongside the real errors.
+        for rung, count in hang_counts.items():
+            tpu_errors.setdefault(
+                rung, f"hung (killed by watchdog) x{count}"
+            )
+        if cached_tpu is not None:
+            minimal["last_known_tpu"] = cached_tpu
+        if tpu_errors:
+            minimal["tpu_errors"] = tpu_errors
+        print(json.dumps(minimal), flush=True)
+        # REFINE LATER: last-minutes STUB, not a ladder — jit rung only
+        # (interpret-mode Pallas timing on a 1-core host is meaningless
+        # and burned ~30 min in round 3) — in a subprocess bounded so
+        # the parent always returns before the driver's hard kill.
+        events = []
+        stub_budget = hard_deadline - time.time() - 60
+        if stub_budget >= 120:
+            cpu_path = progress_path + ".cpu"
+            try:
+                subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--worker-cpu", cpu_path],
+                    timeout=stub_budget,
+                    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                    env=_compile_cache_env(dict(os.environ)),
+                )
+            except (subprocess.TimeoutExpired, OSError):
+                sys.stderr.write(
+                    "[bench] CPU stub timed out/failed; minimal line "
+                    "stands\n"
+                )
+            events = _read_events(cpu_path)
+        else:
+            sys.stderr.write(
+                "[bench] no budget for CPU stub; minimal line stands\n"
+            )
     else:
         tpu_errors = {}
 
@@ -722,14 +901,30 @@ def main() -> int:
     )
 
     if not ladder or init is None:
-        print(json.dumps({
-            "metric": "qwen3_decode_ms_per_step",
-            "value": None,
-            "unit": "ms",
-            "vs_baseline": None,
-            "platform": "tpu" if on_tpu else "cpu",
-            "errors": errors or {"init": "no rung completed"},
-        }))
+        if on_tpu:
+            # Defensive only — on_tpu implies at least one timed rung.
+            print(json.dumps({
+                "metric": "qwen3_decode_ms_per_step",
+                "value": None,
+                "unit": "ms",
+                "vs_baseline": None,
+                "platform": "tpu",
+                "errors": errors or {"init": "no rung completed"},
+            }))
+        elif errors:
+            # The stub RAN and failed: supersede the minimal line (it
+            # promised "a refined line follows") with the stub's error
+            # detail — strictly more info, same null value.
+            update = dict(minimal)
+            update["note"] = "CPU stub failed (see errors); " + (
+                "relay answered but no TPU rung completed (see "
+                "tpu_errors)" if relay_answered
+                else "relay down for the whole run"
+            )
+            update["errors"] = errors
+            print(json.dumps(update))
+        # else: the minimal line printed at fallback time stands — a
+        # stub that never ran/landed nothing has nothing to add.
         return 1
 
     # Headline = best BF16 rung: mega_q8 halves the weight bytes and
@@ -755,6 +950,22 @@ def main() -> int:
         "best_rung": best_name,
         "ladder": {k: round(v, 3) for k, v in ladder.items()},
     }
+    if not on_tpu:
+        out["note"] = "CPU fallback stub (%s)%s" % (
+            "relay answered but no TPU rung completed — see tpu_errors"
+            if relay_answered else "relay down",
+            "; see last_known_tpu for the cached on-chip ladder"
+            if cached_tpu is not None else "",
+        )
+        if cached_tpu is not None:
+            out["last_known_tpu"] = cached_tpu
+    elif init["model"].endswith("+lite"):
+        # Loud in the summary, not just the metric name: the geometry
+        # is REDUCED (8 layers / 32k vocab) and not comparable to a
+        # full-model ladder (VERDICT r4 weak #7).
+        out["note"] = ("REDUCED +lite geometry (num_layers=8, vocab "
+                       "32768) — full-model init wedged the relay; not "
+                       "comparable to the full 0.6B ladder")
     if cross is not None:
         out["mega_multi_cross_check"] = bool(cross.get("ok"))
     cross8 = next(

@@ -154,7 +154,10 @@ def main(argv=None) -> int:
     skip = set(args.skip.split(",")) if args.skip else set()
     failures = 0
     sys.path.insert(0, os.path.join(ROOT, "perf"))
+    sys.path.insert(0, ROOT)
     from _tpulock import HELD_ENV, acquire, release
+
+    from bench import _compile_cache_env
 
     with open(os.path.join(ROOT, args.log), "a") as log:
         for entry in STEPS:
@@ -169,7 +172,9 @@ def main(argv=None) -> int:
             # held-marker set so a step that itself runs bench.py (the
             # ladder) doesn't poll against its own parent's hold.
             lock = acquire(timeout_s=900)
-            env = dict(os.environ)
+            # Persistent compilation cache for EVERY step (one policy,
+            # defined once in bench.py — VERDICT r4 next #8).
+            env = _compile_cache_env(dict(os.environ))
             env.update(extra_env)
             if lock is not None:
                 env[HELD_ENV] = "1"

@@ -93,18 +93,34 @@ def test_malformed_file_ignored(bench, tuned_file):
 
 
 class TestProbeBudget:
-    """Round-4 window strategy: probe-retry to the deadline, never zero
-    probes, stop only when the budget truly ends (VERDICT r3 weak #1)."""
+    """Round-5 window strategy: probe-retry to the deadline with a
+    PRE-probe deadline check — a probe that cannot finish before the
+    reserve boundary is never started, so the CPU reserve is a true
+    reserve (VERDICT r4 weak #1a overruled r4's probe-first rule; the
+    healthy-TPU-never-skipped property now lives in the emit-first
+    minimal line plus the worker loop's guaranteed attempt 0)."""
 
-    def test_past_deadline_still_probes_once(self, bench, monkeypatch):
+    def test_past_deadline_never_probes(self, bench, monkeypatch):
         calls = []
         monkeypatch.setattr(
             bench, "_probe_tpu_once", lambda: calls.append(1) or True
         )
         import time as _t
 
-        assert bench._probe_tpu_until(_t.time() - 100) is True
-        assert len(calls) == 1
+        assert bench._probe_tpu_until(_t.time() - 100) is False
+        assert not calls
+
+    def test_no_probe_started_that_cannot_finish(self, bench, monkeypatch):
+        calls = []
+        monkeypatch.setattr(
+            bench, "_probe_tpu_once", lambda: calls.append(1) or True
+        )
+        monkeypatch.setattr(bench, "_PROBE_TIMEOUT_S", 180)
+        import time as _t
+
+        # 100 s of budget < one 180 s probe: zero probes, no overrun.
+        assert bench._probe_tpu_until(_t.time() + 100) is False
+        assert not calls
 
     def test_retries_until_success(self, bench, monkeypatch):
         results = iter([False, False, True])
@@ -119,16 +135,87 @@ class TestProbeBudget:
         assert bench._probe_tpu_until(_t.time() + 3600) is True
         assert len(calls) == 3
 
-    def test_gives_up_at_deadline(self, bench, monkeypatch):
-        monkeypatch.setattr(bench, "_probe_tpu_once", lambda: False)
-        # Pin the sleep interval: an ambient TDT_BENCH_PROBE_SLEEP_S=0
-        # would otherwise turn the "deadline closer than one sleep"
-        # setup into a busy-spin to the deadline.
+    def test_gives_up_without_burning_reserve(self, bench, monkeypatch):
+        probes = []
+        monkeypatch.setattr(
+            bench, "_probe_tpu_once", lambda: probes.append(1) or False
+        )
         monkeypatch.setattr(bench, "_PROBE_SLEEP_S", 20)
+        monkeypatch.setattr(bench, "_PROBE_TIMEOUT_S", 180)
         slept = []
         monkeypatch.setattr(bench.time, "sleep", lambda s: slept.append(s))
         import time as _t
 
-        # Deadline closer than one sleep interval: one probe, no sleep.
-        assert bench._probe_tpu_until(_t.time() + 1) is False
+        # Budget fits exactly one probe (probe mocked instant): one
+        # attempt, then no sleep-and-retry that would overrun.
+        assert bench._probe_tpu_until(_t.time() + 200) is False
+        assert len(probes) == 1
         assert not slept
+
+
+class TestEmitFirst:
+    """VERDICT r4 next #1: the driver artifact must be unloseable. A
+    bench run whose deadline is already inside (or past) the CPU
+    reserve must STILL print a parseable JSON line — immediately, with
+    the newest cached on-chip ladder attached — before attempting any
+    refinement."""
+
+    def _run_bench(self, env_extra, timeout=120):
+        import subprocess
+        import sys
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env.update(env_extra)
+        return subprocess.run(
+            [sys.executable, os.path.join(root, "bench.py")],
+            capture_output=True, text=True, timeout=timeout, env=env,
+            cwd=root,
+        )
+
+    def test_all_down_past_deadline_still_emits(self, tmp_path):
+        # Deadline (70 s) − reserve (480 s) < 0: zero probes; stub
+        # budget < 120 s: stub skipped. The minimal line must parse.
+        # Private lock path: the live relay watcher may hold the real
+        # chip lock mid-window, and this test must not wait on it.
+        r = self._run_bench({
+            "TDT_BENCH_DEADLINE_S": "70",
+            "TDT_TPU_LOCK": str(tmp_path / "tpu.lock"),
+        })
+        lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+        assert lines, f"no stdout; stderr: {r.stderr[-500:]}"
+        out = json.loads(lines[-1])
+        assert out["metric"] == "qwen3_decode_ms_per_step"
+        assert out["value"] is None
+        assert out["platform"] == "cpu"
+        assert out["unit"] == "ms"
+        # The repo carries a real round-3 on-chip ladder in
+        # perf/ONCHIP_r3.jsonl — the minimal line must surface it,
+        # labeled as cached.
+        cached = out.get("last_known_tpu")
+        if cached is not None:
+            assert "CACHED" in cached["note"]
+            assert cached["result"]["platform"] == "tpu"
+            assert "ladder" in cached["result"]
+
+    def test_last_known_tpu_picks_newest(self, bench):
+        perf = os.path.join(
+            os.path.dirname(os.path.abspath(bench.__file__)), "perf"
+        )
+        older = {"step": "ladder", "t_start": 100.0, "rc": 0,
+                 "stdout_tail": json.dumps(
+                     {"platform": "tpu", "ladder": {"jit": 9.0}}) + "\n"}
+        cpu_rec = {"step": "ladder", "t_start": 300.0, "rc": 0,
+                   "stdout_tail": json.dumps(
+                       {"platform": "cpu", "ladder": {"jit": 240.0}}) + "\n"}
+        newer = {"step": "ladder", "t_start": 200.0, "rc": 0,
+                 "stdout_tail": "noise line\n" + json.dumps(
+                     {"platform": "tpu", "ladder": {"mega": 4.3}}) + "\n"}
+        with open(os.path.join(perf, "ONCHIP_r0.jsonl"), "w") as f:
+            for rec in (older, cpu_rec, newer):
+                f.write(json.dumps(rec) + "\n")
+        got = bench._last_known_tpu()
+        assert got is not None
+        assert got["result"]["ladder"] == {"mega": 4.3}
+        assert got["source"].endswith(":ladder")
+        assert "CACHED" in got["note"]

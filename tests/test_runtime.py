@@ -184,24 +184,30 @@ class TestGroupProfileMerge:
     ``utils.py:505-589``)."""
 
     @staticmethod
-    def _write_rank_trace(root, rank, pid, name):
+    def _write_rank_trace(root, rank, pid, name, session="session1",
+                          mtime=None, empty=False):
         import gzip
         import json
         import os
 
-        d = root / f"rank{rank}" / "plugins" / "profile" / "session1"
+        d = root / f"rank{rank}" / "plugins" / "profile" / session
         d.mkdir(parents=True)
-        trace = {
-            "displayTimeUnit": "ns",
-            "traceEvents": [
-                {"ph": "M", "name": "process_name", "pid": pid,
-                 "args": {"name": name}},
-                {"ph": "X", "name": f"op_r{rank}", "pid": pid, "tid": 1,
-                 "ts": 10 * rank, "dur": 5},
-            ],
-        }
-        with gzip.open(os.path.join(d, "host.trace.json.gz"), "wt") as f:
-            json.dump(trace, f)
+        if not empty:
+            trace = {
+                "displayTimeUnit": "ns",
+                "traceEvents": [
+                    {"ph": "M", "name": "process_name", "pid": pid,
+                     "args": {"name": name}},
+                    {"ph": "X", "name": f"op_r{rank}", "pid": pid,
+                     "tid": 1, "ts": 10 * rank, "dur": 5},
+                ],
+            }
+            with gzip.open(
+                os.path.join(d, "host.trace.json.gz"), "wt"
+            ) as f:
+                json.dump(trace, f)
+        if mtime is not None:
+            os.utime(d, (mtime, mtime))
 
     def test_merges_ranks_into_one_file(self, tmp_path):
         import gzip
@@ -232,6 +238,51 @@ class TestGroupProfileMerge:
         )
 
         assert merge_group_profile("nothing", str(tmp_path)) is None
+
+    def test_newest_session_by_mtime_not_name(self, tmp_path):
+        """A stale session whose NAME sorts last must lose to the
+        mtime-newest one, and a session whose export failed (no trace
+        file) must be skipped for the newest COMPLETE session
+        (ADVICE r4)."""
+        import gzip
+        import json
+
+        from triton_distributed_tpu.runtime.profiling import (
+            merge_group_profile,
+        )
+
+        root = tmp_path / "prof" / "run"
+        # "zzz_stale" sorts lexicographically after "fresh" but is old.
+        self._write_rank_trace(root, 0, 1, "stale", session="zzz_stale",
+                               mtime=1000.0)
+        self._write_rank_trace(root, 0, 1, "fresh", session="fresh",
+                               mtime=2000.0)
+        # Newest session of all has NO trace (failed export): skipped.
+        self._write_rank_trace(root, 0, 1, "broken", session="broken",
+                               mtime=3000.0, empty=True)
+        out = merge_group_profile("run", str(tmp_path / "prof"))
+        with gzip.open(out, "rt") as f:
+            merged = json.load(f)
+        names = {e["args"]["name"] for e in merged["traceEvents"]
+                 if e.get("ph") == "M"}
+        assert names == {"rank0: fresh"}
+
+    def test_warns_on_mixed_sessions_across_ranks(self, tmp_path):
+        import warnings as _w
+
+        from triton_distributed_tpu.runtime.profiling import (
+            merge_group_profile,
+        )
+
+        root = tmp_path / "prof" / "run"
+        self._write_rank_trace(root, 0, 1, "a", session="sessA")
+        self._write_rank_trace(root, 1, 1, "b", session="sessB")
+        with _w.catch_warnings(record=True) as caught:
+            _w.simplefilter("always")
+            out = merge_group_profile("run", str(tmp_path / "prof"))
+        assert out is not None  # merge proceeds anyway
+        assert any("different capture sessions" in str(w.message)
+                   for w in caught)
 
     def test_group_profile_end_to_end_merge(self, tmp_path):
         """A real single-process capture must leave ONE merged file next

@@ -29,18 +29,24 @@ from triton_distributed_tpu.ops.common import interpret_mode, pick_tile
 from triton_distributed_tpu.runtime.mesh import DistContext
 
 
-def _vmem_limit_bytes(scratch: list, out_shapes: list) -> int:
+def _vmem_limit_bytes(
+    scratch: list, out_shapes: list, in_vmem_bytes: int = 0
+) -> int:
     """Scoped-VMEM limit derived from the resolved kernel footprint.
 
     Sums the VMEM scratch buffers (the staging depth × tile-width
-    product that actually scales with :class:`MegaConfig`) plus the
-    VMEM-resident outputs, applies 1.5× headroom for Mosaic's own
-    temporaries and the VMEM-resident in_specs (norm weights, wq8
-    scales — small), and clamps to [32 MiB, 112 MiB]: the floor keeps
-    tiny configs from under-shooting Mosaic's working needs, the cap
-    stays under the 128 MiB physical VMEM of v5e/v5p. Replaces the old
-    flat 100 MiB constant that over-committed smaller-VMEM generations
-    and over-asked for default configs (ADVICE r3)."""
+    product that actually scales with :class:`MegaConfig`), the
+    VMEM-resident outputs, and ``in_vmem_bytes`` — the caller's
+    analytic total for VMEM-resident in_specs (norm weights, wq8
+    scales, prefill prompt block, and the Mosaic-pipelined sampled-
+    noise block counted TWICE for double buffering — ADVICE r4: the
+    old 1.5× headroom alone under-provisioned sampled/large-B
+    configs). Applies 1.5× headroom for Mosaic's own temporaries and
+    clamps to [32 MiB, 112 MiB]: the floor keeps tiny configs from
+    under-shooting Mosaic's working needs, the cap stays under the
+    128 MiB physical VMEM of v5e/v5p. Replaces the old flat 100 MiB
+    constant that over-committed smaller-VMEM generations and
+    over-asked for default configs (ADVICE r3)."""
     def _nbytes(x) -> int:
         shape = getattr(x, "shape", None)
         dtype = getattr(x, "dtype", None)
@@ -57,6 +63,7 @@ def _vmem_limit_bytes(scratch: list, out_shapes: list) -> int:
 
     footprint = sum(_nbytes(s) for s in scratch)
     footprint += sum(_nbytes(o) for o in out_shapes)
+    footprint += in_vmem_bytes
     mib = 1024 * 1024
     return max(32 * mib, min(112 * mib, int(footprint * 1.5) + 8 * mib))
 
@@ -538,6 +545,25 @@ def build_mega_call(
         ]),
     )
 
+    # VMEM-resident in_specs are footprint too (ADVICE r4 — the 1.5×
+    # headroom alone under-provisioned sampled/large-B configs): norm
+    # weights ln1/ln2 [L,1,d] + normf [1,d] + qn/kn [L,1,hd] in wdtype;
+    # wq8 dequant scales (f32: sc_qkv [L,1,qkv_loc], sc_o/sc_w2 local
+    # [L,1,d], sc_w1 [L,1,2·f_loc], sc_lm [1,v_loc]); the prefill
+    # prompt block [S,d]; and the pipelined sampled-noise block
+    # [1,B,v_loc] f32, counted twice for double buffering.
+    itw = jnp.dtype(wdtype).itemsize
+    in_vmem = itw * (2 * dims.num_layers * d + d
+                     + 2 * dims.num_layers * dims.head_dim)
+    if cfg.wq8:
+        in_vmem += 4 * (dims.num_layers
+                        * (dims.qkv_loc + 2 * d + 2 * dims.f_loc)
+                        + dims.v_loc)
+    if dims.prefill:
+        in_vmem += itw * B * d
+    if dims.sampled:
+        in_vmem += 2 * 4 * B * dims.v_loc
+
     # FLOPs/bytes annotation (parity: the reference's launch_metadata on
     # its megakernel): decode is one pass over every weight shard plus
     # the KV context; flops ≈ 2·B·(weight params) per matmul chain.
@@ -598,7 +624,9 @@ def build_mega_call(
             # wide-tile/deep-nbuf configs raise it — capped at 112 MiB
             # to stay under the 128 MiB physical VMEM of the v5e/v5p
             # generations this targets.
-            vmem_limit_bytes=_vmem_limit_bytes(scratch, out_shapes),
+            vmem_limit_bytes=_vmem_limit_bytes(
+                scratch, out_shapes, in_vmem
+            ),
         ),
         interpret=interpret_mode(ctx),
     )

@@ -33,6 +33,29 @@ def _load_chrome_trace(path: str) -> dict:
         return json.load(f)
 
 
+def _newest_session_trace(rank_dir: str) -> tuple[str, str] | None:
+    """The newest-by-MTIME profiler session under a rank dir →
+    ``(session_name, trace_path)``. jax.profiler lays out
+    ``<rank_dir>/plugins/profile/<session>/<host>.trace.json.gz``; a
+    lexicographic sort of session names picked whichever string
+    compared last, so a stale session surviving from a prior run under
+    the same profile name could silently win (ADVICE r4). Sessions
+    with no exported trace (a failed export) are skipped rather than
+    masking an older complete one."""
+    root = os.path.join(rank_dir, "plugins", "profile")
+    sessions = [s for s in glob.glob(os.path.join(root, "*"))
+                if os.path.isdir(s)]
+    for s in sorted(sessions, key=os.path.getmtime, reverse=True):
+        traces = sorted(glob.glob(os.path.join(s, "*.trace.json.gz")))
+        if traces:
+            return os.path.basename(s), traces[-1]
+    flat = sorted(glob.glob(os.path.join(rank_dir, "*.trace.json.gz")),
+                  key=os.path.getmtime)
+    if flat:
+        return "", flat[-1]
+    return None
+
+
 def merge_group_profile(name: str, out_dir: str = "prof") -> str | None:
     """Merge every rank's chrome trace under ``<out_dir>/<name>`` into
     ONE gzipped timeline, ``<out_dir>/<name>/merged.trace.json.gz``.
@@ -42,7 +65,14 @@ def merge_group_profile(name: str, out_dir: str = "prof") -> str | None:
     is prefixed ``rank<i>:`` so the merged view in Perfetto/chrome
     reads like the reference's merged ``group_profile`` output. Returns
     the merged path, or None when no rank traces exist (e.g. profiling
-    was off)."""
+    was off).
+
+    Each rank's newest session is picked by MTIME; when ranks resolve
+    to DIFFERENT session names (one rank's export failed and an older
+    session won, or stale dirs persist under a reused profile name) a
+    warning is emitted — the merge still proceeds (partial evidence
+    beats none) but the timeline may mix capture sessions (ADVICE r4).
+    """
     root = os.path.join(out_dir, name)
     rank_dirs = sorted(
         d for d in glob.glob(os.path.join(root, "rank*"))
@@ -51,20 +81,19 @@ def merge_group_profile(name: str, out_dir: str = "prof") -> str | None:
     merged: list = []
     meta: dict = {}
     found = False
+    sessions_used: dict[int, str] = {}
     for d in rank_dirs:
         try:
             rank = int(os.path.basename(d).removeprefix("rank"))
         except ValueError:
             continue
-        # jax.profiler lays out <dir>/plugins/profile/<session>/
-        # <host>.trace.json.gz; take the newest session per rank.
-        traces = sorted(glob.glob(
-            os.path.join(d, "plugins", "profile", "*", "*.trace.json.gz")
-        )) or sorted(glob.glob(os.path.join(d, "*.trace.json.gz")))
-        if not traces:
+        picked = _newest_session_trace(d)
+        if picked is None:
             continue
+        session, trace_path = picked
+        sessions_used[rank] = session
         found = True
-        data = _load_chrome_trace(traces[-1])
+        data = _load_chrome_trace(trace_path)
         base = rank * _PID_STRIDE
         for ev in data.get("traceEvents", []):
             ev = dict(ev)
@@ -82,6 +111,16 @@ def merge_group_profile(name: str, out_dir: str = "prof") -> str | None:
                 meta.setdefault(k, v)
     if not found:
         return None
+    if len({s for s in sessions_used.values() if s}) > 1:
+        import warnings
+
+        warnings.warn(
+            "merge_group_profile: ranks resolved different capture "
+            f"sessions {sessions_used} — the merged timeline may mix "
+            "sessions (a rank's export failed, or stale session dirs "
+            "persist under this profile name)",
+            stacklevel=2,
+        )
     out_path = os.path.join(root, "merged.trace.json.gz")
     with gzip.open(out_path, "wt") as f:
         json.dump({**meta, "traceEvents": merged}, f)
