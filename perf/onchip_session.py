@@ -11,19 +11,23 @@ Round-4 queue (VERDICT r3 "Next round" items in priority order):
 
 1. probe          — tiny: jax.devices() + 1 add (seconds)
 2. kernel_smoke   — one small Pallas ring kernel through Mosaic
-3. mega_tiles     — weight-stream sweep → perf/MEGA_TUNED.json (task 2)
-4. ladder         — bench.py 0.6B decode ladder, inherits the tuning
+3. ladder_first   — bank an UNTUNED ladder before anything heavy
+                    (r5: four rounds of zero TPU numbers; also warms
+                    the persistent compile cache for 4 and the driver)
+4. mega_tiles     — weight-stream sweep → perf/MEGA_TUNED.json (task 2)
+5. ladder         — bench.py 0.6B decode ladder, inherits the tuning
                     (task 1: the driver-artifact evidence class)
-5. decode_profile — slope-timed per-matvec floors (task 3 split)
-6. gemm_mfu       — plain-GEMM MFU at ≥3 shapes × variants (tasks 3+6)
-7. ep_overhead    — EP dispatch-tax slope + block sweep (task 5)
-8. adaptive_order — straggler-reaction order observation (task 7)
-9. ladder_17      — bench.py at Qwen3-1.7B geometry (task 4:
+6. decode_profile — slope-timed per-matvec floors (task 3 split)
+7. gemm_mfu       — plain-GEMM MFU at ≥3 shapes × variants (tasks 3+6)
+8. ep_overhead    — EP dispatch-tax slope + block sweep (task 5)
+9. adaptive_order — straggler-reaction order observation (task 7)
+10. ladder_17     — bench.py at Qwen3-1.7B geometry (task 4:
                     headline-class decode on the chip)
-10. e2e_17        — 1.7B HF-checkpoint serve, transcript + tok/s (task 4)
-11. stress        — randomized on-chip stress subset (task 8)
-12. mega_ns / mega_tiles_q8 / ladder_4b / e2e / sweep_full — depth,
-    int8 sweep, 4B-geometry ladder, 0.6B e2e, north-star tile sweeps
+11. e2e_17        — 1.7B HF-checkpoint serve, transcript + tok/s (task 4)
+12. stress        — randomized on-chip stress subset (task 8)
+13. mega_ns / mega_tiles_q8 / ladder_4b / ladder_8b_q8 / e2e /
+    sweep_full — depth, int8 sweep, 4B/8B-geometry ladders, 0.6B e2e,
+    north-star tile sweeps
 
 Usage: python perf/onchip_session.py [--log perf/ONCHIP_r4.jsonl]
        [--only ladder,e2e_17] [--skip sweep_full]
@@ -68,6 +72,15 @@ STEPS = [
     # plus connection wobble — don't write off a live chip at 120 s.
     ("probe", [sys.executable, "-c", _PROBE], 240),
     ("kernel_smoke", [sys.executable, "-c", _KERNEL_SMOKE], 300),
+    # BANK A LADDER FIRST (round 5): four rounds produced zero TPU
+    # numbers — before spending ~20 min of a possibly-short window on
+    # the tile sweep, land the untuned ladder (~2-5 min warm). It also
+    # warms the compile cache for the SHARED compiles (model init,
+    # jit/pallas rungs; the mega rungs recompile if the sweep picks a
+    # non-default config — budget the post-sweep ladder accordingly).
+    # Timeout: D + 2100 headroom (see the ladder note below).
+    ("ladder_first", [sys.executable, "bench.py"], 3100,
+     {"TDT_BENCH_DEADLINE_S": "900"}),
     # Weight-stream sweep FIRST among the heavy steps: the winner lands
     # in MEGA_TUNED.json for the (next) ladder/bench — these two are
     # what move BENCH_r04 (VERDICT task 2). Internal deadline sized so

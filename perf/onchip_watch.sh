@@ -12,7 +12,7 @@ LOG=perf/onchip_loop.log
 # Steps may be given as separate args or comma-joined; normalize to the
 # comma form pending()/onchip_session expect. Default: the whole
 # round-4 queue in priority order.
-QUEUE=$(IFS=,; echo "${*:-kernel_smoke,mega_tiles,ladder,decode_profile,gemm_mfu,ep_overhead,adaptive_order,ladder_17,e2e_17,serve_demo,stress,mega_ns,mega_tiles_q8,ladder_4b,ladder_8b_q8,e2e,sweep_full}")
+QUEUE=$(IFS=,; echo "${*:-kernel_smoke,ladder_first,mega_tiles,ladder,decode_profile,gemm_mfu,ep_overhead,adaptive_order,ladder_17,e2e_17,serve_demo,stress,mega_ns,mega_tiles_q8,ladder_4b,ladder_8b_q8,e2e,sweep_full}")
 SINCE=$(date +%s)
 
 pending() {

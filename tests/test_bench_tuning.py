@@ -198,6 +198,27 @@ class TestEmitFirst:
             assert cached["result"]["platform"] == "tpu"
             assert "ladder" in cached["result"]
 
+    @pytest.mark.slow
+    def test_all_down_stub_refines_minimal_line(self, tmp_path):
+        """With enough tail budget the CPU stub must land a SECOND
+        line with a real measurement that supersedes the minimal one
+        (the driver parses the last JSON line)."""
+        # Deadline 490 s: probe budget (10 s) < one probe, so no
+        # probes; stub budget ≈ 430 s fits the (cache-warmed) stub.
+        r = self._run_bench({
+            "TDT_BENCH_DEADLINE_S": "490",
+            "TDT_TPU_LOCK": str(tmp_path / "tpu.lock"),
+        }, timeout=480)
+        lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+        parsed = [json.loads(ln) for ln in lines]
+        assert len(parsed) >= 2, f"want minimal+refined; got {lines}"
+        assert parsed[0]["value"] is None  # minimal, emitted first
+        refined = parsed[-1]
+        assert refined["platform"] == "cpu"
+        assert isinstance(refined["value"], float)
+        assert refined["metric"] == "qwen3_tiny_decode_ms_per_step"
+        assert "CPU fallback stub" in refined["note"]
+
     def test_last_known_tpu_picks_newest(self, bench):
         perf = os.path.join(
             os.path.dirname(os.path.abspath(bench.__file__)), "perf"
