@@ -88,7 +88,7 @@ _RUNG_TIMEOUT_S = _env_int("TDT_BENCH_RUNG_TIMEOUT_S", 600)
 # plus two full chained decode executions (the token cross-check) — a
 # healthy rung needs far more headroom than the others.
 _MULTI_RUNG_TIMEOUT_S = _env_int("TDT_BENCH_MULTI_RUNG_TIMEOUT_S", 1800)
-_WORKER_ATTEMPTS = 8
+_WORKER_ATTEMPTS = _env_int("TDT_BENCH_WORKER_ATTEMPTS", 8)
 # Default BELOW the driver's 2700 s hard kill: the bench must always
 # finish (and print) first. r4's 2700-with-zero-margin died mid-stub.
 _GLOBAL_DEADLINE_S = _env_int("TDT_BENCH_DEADLINE_S", 2580)
@@ -118,7 +118,25 @@ def _probe_tpu_once() -> bool:
     """One probe (in a subprocess, with timeout) that the TPU backend
     comes up AND EXECUTES. Catches the observed half-up relay state
     where device enumeration answers but any compute hangs (a doomed
-    worker would otherwise burn the init-timeout budget per attempt)."""
+    worker would otherwise burn the init-timeout budget per attempt).
+
+    ``TDT_BENCH_FORCE_PROBE=ok|fail`` (tests only) short-circuits the
+    probe: ``ok`` on a TPU-less host drives the full worker
+    orchestration — watchdog kill, relaunch, +lite fallback,
+    relay-answered labeling — against a worker that hangs exactly like
+    a wedged relay (the machinery otherwise only ever runs against a
+    live chip, where it has failed in novel ways three rounds
+    straight)."""
+    force = os.environ.get("TDT_BENCH_FORCE_PROBE")
+    if force:
+        if force not in ("ok", "fail"):
+            # Fail closed: a leaked/typoed override must not silently
+            # launder a healthy chip into a "relay down" round.
+            raise ValueError(
+                f"TDT_BENCH_FORCE_PROBE={force!r} (want ok|fail)"
+            )
+        sys.stderr.write(f"[bench] TEST OVERRIDE: probe forced {force}\n")
+        return force == "ok"
     code = (
         "import jax, numpy as np; d = jax.devices(); "
         "assert d[0].platform != 'cpu'; "
@@ -247,6 +265,13 @@ def run_ladder(
     num_layers=8 / vocab 32768 — the relay-gentle fallback used when
     full-model init wedged the relay (a reduced-model TPU ladder beats
     a CPU fallback as round evidence)."""
+    if on_tpu and os.environ.get("TDT_BENCH_FORCE_WORKER_HANG"):
+        # Tests only: simulate a wedged relay DETERMINISTICALLY (the
+        # real axon hang depends on backend state — a live relay or a
+        # CPU fallback would otherwise make the orchestration test
+        # flaky in both directions).
+        _emit(progress_fh, {"start": "init"})
+        time.sleep(10_000)
     if not on_tpu:
         os.environ["XLA_FLAGS"] = (
             os.environ.get("XLA_FLAGS", "")
@@ -741,6 +766,7 @@ def main() -> int:
     # "relay down" when the relay answered and the code failed.
     relay_answered = on_tpu
     hang_counts: dict[str, int] = {}
+    init_stalls = 0
     fd, progress_path = tempfile.mkstemp(
         prefix="bench_progress_", suffix=".jsonl"
     )
@@ -769,6 +795,7 @@ def main() -> int:
             if hung == "__deadline__":
                 break  # out of budget — summarize what's on disk
             if hung == "__init__":
+                init_stalls += 1
                 sys.stderr.write("[bench] init stalled; re-probing\n")
                 if not done and not model.endswith("+lite"):
                     # Full-model first contact wedged before any rung
@@ -811,6 +838,16 @@ def main() -> int:
             for e in _read_events(progress_path)
             if "rung" in e and "error" in e
         }
+        # Watchdog-killed rungs and init stalls never wrote an error
+        # event — surface them alongside the real errors.
+        for rung, count in hang_counts.items():
+            tpu_errors.setdefault(
+                rung, f"hung (killed by watchdog) x{count}"
+            )
+        if init_stalls and "init" not in tpu_errors:
+            tpu_errors["init"] = (
+                f"init stalled x{init_stalls} (killed by watchdog)"
+            )
         # EMIT FIRST: a minimal-but-valid line lands NOW, carrying the
         # newest cached on-chip ladder, so the artifact can never be
         # empty again — then (budget permitting) the refined CPU stub
@@ -839,12 +876,6 @@ def main() -> int:
                 "refined line follows if it completes)"
             ),
         }
-        # Watchdog-killed rungs never wrote an error event — surface
-        # them alongside the real errors.
-        for rung, count in hang_counts.items():
-            tpu_errors.setdefault(
-                rung, f"hung (killed by watchdog) x{count}"
-            )
         if cached_tpu is not None:
             minimal["last_known_tpu"] = cached_tpu
         if tpu_errors:
@@ -889,10 +920,15 @@ def main() -> int:
     if on_tpu:
         # Rungs abandoned after repeated watchdog kills never emit an
         # event — record them so they don't silently vanish from the
-        # machine-readable output.
+        # machine-readable output. Same for init stalls on attempts
+        # that preceded a successful relaunch.
         for rung, count in hang_counts.items():
             if rung not in ladder and rung not in errors:
                 errors[rung] = f"hung (killed by watchdog) x{count}"
+        if init_stalls and "init" not in errors:
+            errors["init"] = (
+                f"init stalled x{init_stalls} (killed by watchdog)"
+            )
     # LAST init event: after a +lite fallback the surviving worker's
     # init (model name, param bytes) is the one the summary describes.
     init = next((e["init"] for e in reversed(events) if "init" in e), None)

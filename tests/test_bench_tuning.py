@@ -199,6 +199,46 @@ class TestEmitFirst:
             assert "ladder" in cached["result"]
 
     @pytest.mark.slow
+    def test_forced_probe_drives_worker_orchestration(self, tmp_path):
+        """TDT_BENCH_FORCE_PROBE=ok on a TPU-less host sends main()
+        down the REAL TPU-worker path: the worker hangs in init
+        exactly like a wedged relay, the watchdog kills it, the +lite
+        fallback fires, and the fallback output is labeled 'relay
+        answered' (not 'relay down') with the init stalls surfaced in
+        tpu_errors. This machinery otherwise only ever runs against a
+        live chip — where it failed in novel ways three rounds
+        straight — so it gets an offline e2e drive here."""
+        # Deterministic wedge: the worker parks at start:init (no jax,
+        # no chip contact) so the test is independent of relay state,
+        # host speed, and memory. Probe timeout 10 s keeps the forced
+        # probes inside the pre-probe deadline check; test timeout
+        # (600 s) exceeds the bench deadline (560 s) so bench always
+        # finishes (or is internally bounded) before the test kills it.
+        r = self._run_bench({
+            "TDT_BENCH_DEADLINE_S": "560",
+            "TDT_BENCH_PROBE_TIMEOUT_S": "10",
+            "TDT_BENCH_FORCE_PROBE": "ok",
+            "TDT_BENCH_FORCE_WORKER_HANG": "1",
+            "TDT_BENCH_INIT_TIMEOUT_S": "15",
+            "TDT_BENCH_WORKER_ATTEMPTS": "2",
+            "TDT_TPU_LOCK": str(tmp_path / "tpu.lock"),
+        }, timeout=600)
+        lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+        assert lines, f"no stdout; stderr: {r.stderr[-800:]}"
+        parsed = [json.loads(ln) for ln in lines]
+        first = parsed[0]
+        assert first["value"] is None
+        assert "relay answered" in first["note"]
+        assert "init stalled" in first["tpu_errors"]["init"]
+        # The full-model init wedge must have triggered the +lite drop.
+        assert "falling back to" in r.stderr
+        # The refined stub line (if the budget allowed it) must carry
+        # the same relay-answered labeling.
+        if len(parsed) > 1 and parsed[-1]["value"] is not None:
+            assert "relay answered" in parsed[-1]["note"]
+            assert "init stalled" in parsed[-1]["tpu_errors"]["init"]
+
+    @pytest.mark.slow
     def test_all_down_stub_refines_minimal_line(self, tmp_path):
         """With enough tail budget the CPU stub must land a SECOND
         line with a real measurement that supersedes the minimal one
