@@ -172,65 +172,91 @@ def main(argv=None) -> int:
 
     from bench import _compile_cache_env
 
+    def run_one(log, name, argvs, timeout, extra_env):
+        """One bounded step under the chip lock + shared cache env,
+        fully recorded (rc, output tails, wall) in the JSONL log.
+
+        Serializes against a concurrently-launched bench.py (the
+        driver's end-of-round run): one chip, one measurer. Gives up
+        on the lock after 15 min and runs anyway (a wedged holder must
+        not stall the whole queue window). Children run with the
+        held-marker set so a step that itself runs bench.py (the
+        ladder) doesn't poll against its own parent's hold."""
+        lock = acquire(timeout_s=900)
+        # Persistent compilation cache for EVERY step (one policy,
+        # defined once in bench.py — VERDICT r4 next #8).
+        env = _compile_cache_env(dict(os.environ))
+        env.update(extra_env)
+        if lock is not None:
+            env[HELD_ENV] = "1"
+        t0 = time.time()
+        rec = {"step": name, "t_start": round(t0, 1)}
+        if lock is None:
+            rec["lock"] = "contended (proceeded without)"
+        try:
+            try:
+                r = subprocess.run(
+                    argvs, cwd=ROOT, timeout=timeout,
+                    capture_output=True, text=True, env=env,
+                )
+                rec["rc"] = r.returncode
+                rec["stdout_tail"] = r.stdout[-2000:]
+                if r.returncode != 0:
+                    rec["stderr_tail"] = r.stderr[-1000:]
+            except subprocess.TimeoutExpired as e:
+                rec["rc"] = "timeout"
+
+                # Keep the partial output — it names the rung/step
+                # that wedged, which is the whole point of the log.
+                # (On timeout the attached output can be bytes even
+                # under text=True.)
+                def _tail(raw, k):
+                    if isinstance(raw, bytes):
+                        raw = raw.decode(errors="replace")
+                    return (raw or "")[-k:]
+
+                rec["stdout_tail"] = _tail(e.stdout, 2000)
+                rec["stderr_tail"] = _tail(e.stderr, 1000)
+            except Exception as e:  # spawn failure etc.
+                rec["rc"] = f"spawn-error: {type(e).__name__}: {e}"[:200]
+        finally:
+            release(lock)
+            rec["wall_s"] = round(time.time() - t0, 1)
+            log.write(json.dumps(rec) + "\n")
+            log.flush()
+        print(json.dumps({k: rec[k] for k in ("step", "rc", "wall_s")}),
+              flush=True)
+        return rec
+
+    prev_failed = False
     with open(os.path.join(ROOT, args.log), "a") as log:
         for entry in STEPS:
             name, argvs, timeout = entry[:3]
             extra_env = entry[3] if len(entry) > 3 else {}
             if (only and name not in only) or name in skip:
                 continue
-            # Serialize against a concurrently-launched bench.py (the
-            # driver's end-of-round run): one chip, one measurer. Give
-            # up after 15 min and run anyway (a wedged holder must not
-            # stall the whole queue window). Children run with the
-            # held-marker set so a step that itself runs bench.py (the
-            # ladder) doesn't poll against its own parent's hold.
-            lock = acquire(timeout_s=900)
-            # Persistent compilation cache for EVERY step (one policy,
-            # defined once in bench.py — VERDICT r4 next #8).
-            env = _compile_cache_env(dict(os.environ))
-            env.update(extra_env)
-            if lock is not None:
-                env[HELD_ENV] = "1"
-            t0 = time.time()
-            rec = {"step": name, "t_start": round(t0, 1)}
-            if lock is None:
-                rec["lock"] = "contended (proceeded without)"
-            try:
-                try:
-                    r = subprocess.run(
-                        argvs, cwd=ROOT, timeout=timeout,
-                        capture_output=True, text=True, env=env,
-                    )
-                    rec["rc"] = r.returncode
-                    rec["stdout_tail"] = r.stdout[-2000:]
-                    if r.returncode != 0:
-                        rec["stderr_tail"] = r.stderr[-1000:]
-                        failures += 1
-                except subprocess.TimeoutExpired as e:
-                    rec["rc"] = "timeout"
-
-                    # Keep the partial output — it names the rung/step
-                    # that wedged, which is the whole point of the log.
-                    # (On timeout the attached output can be bytes even
-                    # under text=True.)
-                    def _tail(raw, k):
-                        if isinstance(raw, bytes):
-                            raw = raw.decode(errors="replace")
-                        return (raw or "")[-k:]
-
-                    rec["stdout_tail"] = _tail(e.stdout, 2000)
-                    rec["stderr_tail"] = _tail(e.stderr, 1000)
-                    failures += 1
-                except Exception as e:  # spawn failure etc.
-                    rec["rc"] = f"spawn-error: {type(e).__name__}: {e}"[:200]
-                    failures += 1
-            finally:
-                release(lock)
-                rec["wall_s"] = round(time.time() - t0, 1)
-                log.write(json.dumps(rec) + "\n")
-                log.flush()
-            print(json.dumps({k: rec[k] for k in ("step", "rc", "wall_s")}),
-                  flush=True)
+            if prev_failed and name != "probe":
+                # The previous step failed — before burning this step's
+                # timeout, distinguish "relay died mid-window" from a
+                # step-local failure. Without this gate a mid-window
+                # outage grinds serially through EVERY remaining step's
+                # timeout (~10 h for a full queue) while the watcher —
+                # blocked on this very process — cannot see the next
+                # window open. Abort on a dead relay; the watcher
+                # resumes probing and relaunches the pending steps.
+                rep = run_one(
+                    log, "reprobe", [sys.executable, "-c", _PROBE],
+                    240, {},
+                )
+                if rep["rc"] != 0:
+                    print("[onchip] relay died mid-session; aborting "
+                          "(watcher will resume pending steps)")
+                    return 1
+                prev_failed = False
+            rec = run_one(log, name, argvs, timeout, extra_env)
+            if rec["rc"] != 0:
+                failures += 1
+            prev_failed = rec["rc"] != 0
             if name == "probe" and rec["rc"] != 0:
                 print("[onchip] relay not answering; aborting session")
                 return 1

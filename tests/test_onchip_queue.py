@@ -1,0 +1,78 @@
+"""Measurement-queue session runner: mid-window outage handling.
+
+The queue (``perf/onchip_session.py``) runs each on-chip step in a
+bounded subprocess. A relay that dies MID-window must abort the
+session at the next step failure (after one cheap reprobe) rather than
+grinding serially through every remaining step's timeout (~10 h for a
+full queue) while the watcher — blocked on the session process —
+cannot see the next window open."""
+
+import importlib
+import json
+import os
+import sys
+
+import pytest
+
+
+@pytest.fixture
+def session(monkeypatch, tmp_path):
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(root, "perf"))
+    sys.path.insert(0, root)
+    # Keep the chip lock private to the test BEFORE (re)loading
+    # _tpulock: it reads TDT_TPU_LOCK at import time, and flocking the
+    # real path could block behind a live watcher window for 15 min.
+    monkeypatch.setenv("TDT_TPU_LOCK", str(tmp_path / "tpu.lock"))
+    import _tpulock
+    import onchip_session
+
+    importlib.reload(_tpulock)
+    importlib.reload(onchip_session)
+    return onchip_session
+
+
+def _fake_steps(marker_path):
+    ok = f"open({marker_path!r}, 'a').write('x')"
+    return [
+        ("probe", [sys.executable, "-c", "pass"], 30),
+        ("fails", [sys.executable, "-c", "import sys; sys.exit(1)"], 30),
+        ("after", [sys.executable, "-c", ok], 30),
+    ]
+
+
+def test_dead_relay_aborts_instead_of_grinding(
+    session, monkeypatch, tmp_path
+):
+    marker = tmp_path / "after_ran"
+    monkeypatch.setattr(session, "STEPS", _fake_steps(str(marker)))
+    # Reprobe sees a dead relay.
+    monkeypatch.setattr(
+        session, "_PROBE", "import sys; sys.exit(3)"
+    )
+    log = tmp_path / "log.jsonl"
+    rc = session.main(["--log", str(log)])
+    assert rc == 1
+    assert not marker.exists(), "step after the outage must NOT run"
+    recs = [json.loads(ln) for ln in log.read_text().splitlines()]
+    steps = [r["step"] for r in recs]
+    assert steps == ["probe", "fails", "reprobe"]
+    assert recs[-1]["rc"] == 3
+
+
+def test_live_relay_continues_past_step_local_failure(
+    session, monkeypatch, tmp_path
+):
+    marker = tmp_path / "after_ran"
+    monkeypatch.setattr(session, "STEPS", _fake_steps(str(marker)))
+    # Reprobe answers: the failure was step-local, keep draining.
+    monkeypatch.setattr(session, "_PROBE", "pass")
+    log = tmp_path / "log.jsonl"
+    rc = session.main(["--log", str(log)])
+    assert rc == 2  # one step failed overall
+    assert marker.exists(), "queue must continue after a live reprobe"
+    recs = [json.loads(ln) for ln in log.read_text().splitlines()]
+    assert [r["step"] for r in recs] == [
+        "probe", "fails", "reprobe", "after"
+    ]
+    assert recs[2]["rc"] == 0
