@@ -190,6 +190,16 @@ def _probe_tpu_until(deadline: float) -> bool:
         if remaining <= _PROBE_SLEEP_S + _PROBE_TIMEOUT_S:
             break
         time.sleep(_PROBE_SLEEP_S)
+    if attempt == 0:
+        # The budget never fit even ONE probe — a healthy chip would be
+        # skipped with no trace. Say so, or a misconfigured
+        # TDT_BENCH_DEADLINE_S is indistinguishable from an outage
+        # (ADVICE r5).
+        sys.stderr.write(
+            f"[bench] probe budget exhausted before any probe ran "
+            f"({deadline - time.time():.0f}s left < one probe of "
+            f"{_PROBE_TIMEOUT_S}s); check TDT_BENCH_DEADLINE_S\n"
+        )
     return False
 
 
@@ -676,11 +686,16 @@ def _last_known_tpu() -> dict | None:
                     obj = json.loads(line)
                 except ValueError:
                     continue
-                if not (isinstance(obj, dict)
+                # Only the record's final PARSEABLE JSON line counts: a
+                # later line that parses but isn't a TPU ladder
+                # supersedes any earlier ladder in the same tail
+                # (ADVICE r5 — the old skip-and-continue could resurrect
+                # a superseded line).
+                if (isinstance(obj, dict)
                         and obj.get("platform") == "tpu"
-                        and "ladder" in obj):
-                    continue
-                if best is None or rec.get("t_start", 0) > best["t_start"]:
+                        and "ladder" in obj
+                        and (best is None
+                             or rec.get("t_start", 0) > best["t_start"])):
                     src = f"{os.path.basename(path)}:{rec.get('step', '?')}"
                     best = {
                         "note": "CACHED prior on-chip result, not this run",
@@ -691,7 +706,7 @@ def _last_known_tpu() -> dict | None:
                         ),
                         "result": obj,
                     }
-                break  # only the record's final JSON line counts
+                break
     return best
 
 
@@ -859,6 +874,16 @@ def main() -> int:
         except Exception as e:
             cached_tpu = None
             sys.stderr.write(f"[bench] last_known_tpu read failed: {e}\n")
+        # Compute the stub budget BEFORE printing the minimal line, so
+        # the note never promises a refinement that was never going to
+        # be attempted (ADVICE r5).
+        stub_budget = hard_deadline - time.time() - 60
+        stub_note = (
+            "CPU stub pending (a refined line follows if it completes)"
+            if stub_budget >= 120 else
+            f"no budget for CPU stub ({stub_budget:.0f}s left); this "
+            "minimal line is final"
+        )
         minimal = {
             "metric": "qwen3_decode_ms_per_step",
             "value": None,
@@ -870,10 +895,8 @@ def main() -> int:
             # outage.
             "note": (
                 "relay answered but no TPU rung completed (see "
-                "tpu_errors); CPU stub pending (a refined line follows "
-                "if it completes)" if relay_answered else
-                "relay down for the whole run; CPU stub pending (a "
-                "refined line follows if it completes)"
+                f"tpu_errors); {stub_note}" if relay_answered else
+                f"relay down for the whole run; {stub_note}"
             ),
         }
         if cached_tpu is not None:
@@ -886,7 +909,6 @@ def main() -> int:
         # and burned ~30 min in round 3) — in a subprocess bounded so
         # the parent always returns before the driver's hard kill.
         events = []
-        stub_budget = hard_deadline - time.time() - 60
         if stub_budget >= 120:
             cpu_path = progress_path + ".cpu"
             try:

@@ -284,6 +284,37 @@ class TestGroupProfileMerge:
         assert any("different capture sessions" in str(w.message)
                    for w in caught)
 
+    def test_warns_on_mixed_layouts_across_ranks(self, tmp_path):
+        """One rank resolved via a session dir, another via the flat
+        ``*.trace.json.gz`` fallback: the flat rank records the
+        ``<flat>`` sentinel session, so the layout mix trips the same
+        mixed-sessions warning (ADVICE r5)."""
+        import gzip
+        import json
+        import warnings as _w
+
+        from triton_distributed_tpu.runtime.profiling import (
+            merge_group_profile,
+        )
+
+        root = tmp_path / "prof" / "run"
+        self._write_rank_trace(root, 0, 1, "sessioned", session="sessA")
+        # rank1: flat layout, no plugins/profile dir.
+        flat_dir = root / "rank1"
+        flat_dir.mkdir(parents=True)
+        trace = {"traceEvents": [
+            {"ph": "M", "name": "process_name", "pid": 1,
+             "args": {"name": "flat"}},
+        ]}
+        with gzip.open(str(flat_dir / "host.trace.json.gz"), "wt") as f:
+            json.dump(trace, f)
+        with _w.catch_warnings(record=True) as caught:
+            _w.simplefilter("always")
+            out = merge_group_profile("run", str(tmp_path / "prof"))
+        assert out is not None
+        assert any("different capture sessions" in str(w.message)
+                   for w in caught)
+
     def test_group_profile_end_to_end_merge(self, tmp_path):
         """A real single-process capture must leave ONE merged file next
         to the per-rank dir."""

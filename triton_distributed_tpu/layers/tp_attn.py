@@ -128,6 +128,93 @@ def tp_attn_prefill(
     return out, k, v
 
 
+def tp_attn_prefill_paged_chunk(
+    params: TPAttnParams,
+    x: jax.Array,           # [C, d] replicated — one chunk of ONE sequence
+    k_pages: jax.Array,     # [P, hkv_loc, page, hd] — this layer's pool shard
+    v_pages: jax.Array,
+    table_row: jax.Array,   # [pages_per_seq] int32 — the sequence's pages
+    q_offset: jax.Array,    # scalar int32 — tokens already cached
+    dims: TPAttnDims,
+    *,
+    kv_pages: int | None = None,
+    axis: str = "tp",
+    mode: Mode = "xla_ar",
+    ctx: DistContext | None = None,
+):
+    """Per-shard chunked-prefill step over the paged pool (inside
+    ``shard_map``): QKV for ``C`` suffix tokens, rope at absolute
+    positions ``q_offset + i``, KV scattered through the page table, and
+    flash attention of the chunk's queries against the WHOLE cached
+    context (prefix pages + the chunk itself) via the dynamic
+    ``kv_offset``. This is the prefix-cache suffix prefill: matched
+    prefix pages are read, never recomputed.
+
+    Activations stay replicated (decode's AR layout, not prefill's
+    sequence-sharded one): chunks are short, so the ag/rs overlap machinery
+    would buy nothing, and replication keeps one compiled program valid for
+    every chunk offset. Returns ``(out [C, d], k_pages, v_pages)``.
+    """
+    c = x.shape[0]
+    page = k_pages.shape[2]
+    pps = table_row.shape[0]
+    qkv = jnp.dot(x, params.wqkv, preferred_element_type=jnp.float32).astype(
+        x.dtype
+    )
+    q, k, v = dims.split_qkv(qkv)  # [C, h, hd]
+    q = _rms_head(q, params.q_norm)
+    k = _rms_head(k, params.k_norm)
+    pos = q_offset + jnp.arange(c, dtype=jnp.int32)  # [C] absolute
+    q = apply_rope(q.swapaxes(0, 1), pos, dims.rope_theta)  # [h, C, hd]
+    k = apply_rope(k.swapaxes(0, 1), pos, dims.rope_theta)
+    v = v.swapaxes(0, 1)
+
+    # Scatter the chunk's KV through the table. Final-chunk right-padding
+    # may run past the table's capacity; those rows are routed to the
+    # trash page (id 0) instead of letting a clamped gather corrupt the
+    # last real page.
+    valid = pos < pps * page
+    pids = jnp.where(
+        valid, jnp.take(table_row, jnp.clip(pos // page, 0, pps - 1)), 0
+    )
+    offs = jnp.where(valid, pos % page, 0)
+    k_pages = k_pages.at[pids, :, offs, :].set(
+        k.swapaxes(0, 1).astype(k_pages.dtype)
+    )
+    v_pages = v_pages.at[pids, :, offs, :].set(
+        v.swapaxes(0, 1).astype(v_pages.dtype)
+    )
+
+    # Attend over the sequence's dense view (prefix + chunk). The
+    # gather is bounded to ``kv_pages`` table entries — the caller's
+    # static bucket covering q_offset + C — so a short suffix never
+    # materializes the full max_length view (the causal skip saves the
+    # COMPUTE past q_end, but gather traffic is paid for what's
+    # gathered). Positions beyond q_offset + C inside the bucket are
+    # masked by causality (rows live at q_offset..q_offset+C-1), so
+    # stale/trash content there is inert.
+    from triton_distributed_tpu.ops.attention.flash_decode import (
+        pages_to_dense,
+    )
+
+    gather_row = table_row if kv_pages is None else table_row[:kv_pages]
+    k_dense = pages_to_dense(k_pages, gather_row[None])  # [1, h, S_kv, hd]
+    v_dense = pages_to_dense(v_pages, gather_row[None])
+    s_max = gather_row.shape[0] * page
+    o = flash_attention(
+        q[None], k_dense, v_dense, causal=True, kv_offset=q_offset,
+        block_k=128 if s_max % 128 == 0 else page,
+    )[0]  # [h, C, hd]
+    o_flat = o.swapaxes(0, 1).reshape(c, dims.hq_loc * dims.head_dim)
+    o_flat = o_flat.astype(x.dtype)
+    if mode in ("xla", "xla_ar"):
+        part = jnp.dot(o_flat, params.wo, preferred_element_type=jnp.float32)
+        out = jax.lax.psum(part.astype(x.dtype), axis)
+    else:
+        out = gemm_ar(o_flat, params.wo, axis=axis, ctx=ctx)
+    return out, k_pages, v_pages
+
+
 def tp_attn_decode(
     params: TPAttnParams,
     x: jax.Array,        # [B, d] replicated — one new token per sequence

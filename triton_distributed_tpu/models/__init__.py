@@ -19,6 +19,9 @@ from triton_distributed_tpu.models.continuous import (  # noqa: F401
 )
 from triton_distributed_tpu.models.engine import Engine  # noqa: F401
 from triton_distributed_tpu.models.kv_cache import KVCache, init_cache  # noqa: F401
+from triton_distributed_tpu.models.prefix_cache import (  # noqa: F401
+    PrefixCache,
+)
 from triton_distributed_tpu.models.qwen import (  # noqa: F401
     Qwen3,
     Qwen3Params,

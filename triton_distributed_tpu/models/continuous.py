@@ -14,6 +14,20 @@ Design: the decode step stays ONE jitted program over a fixed
 slot's table row + kv_len and prefises the prompt into its pages,
 eviction releases the pages. Inactive slots keep table row 0 and point
 at a reserved trash page, so their (masked-out) appends land harmlessly.
+
+With ``prefix_cache=True`` two serving-path upgrades switch on
+(docs/serving.md):
+
+- **Radix prefix reuse**: finished sequences retire their pages into a
+  :class:`~triton_distributed_tpu.models.prefix_cache.PrefixCache`
+  instead of the free list; admission maps the longest cached prefix
+  into the new slot's table row (refcounted, COW for partially matched
+  tail pages) and prefills ONLY the suffix.
+- **Chunked prefill**: the suffix runs through
+  ``Qwen3.prefill_paged_chunk`` in fixed-width chunks with a decode
+  step of the running batch between chunks, so a long cold prompt never
+  stalls in-flight decodes for its whole prefill (``prefill_chunk=0``
+  keeps one chunk per admission).
 """
 
 from __future__ import annotations
@@ -26,14 +40,22 @@ import jax.numpy as jnp
 import numpy as np
 
 from triton_distributed_tpu.models import sampling
-from triton_distributed_tpu.models.engine import MegaDispatch
+from triton_distributed_tpu.models.engine import (
+    MegaDispatch,
+    prefill_suffix_chunks,
+)
 from triton_distributed_tpu.models.paged_kv_cache import (
-    PagedKVCache,
-    PagePool,
+    copy_page,
     init_paged_cache,
     write_prefill,
 )
+from triton_distributed_tpu.models.prefix_cache import (
+    PrefixCache,
+    PrefixMatch,
+    round_chunk,
+)
 from triton_distributed_tpu.models.qwen import Mode, Qwen3
+from triton_distributed_tpu.runtime.profiling import trace_span
 
 
 @dataclasses.dataclass
@@ -45,6 +67,9 @@ class Request:
     out: list[int] = dataclasses.field(default_factory=list)
     slot: int | None = None
     pages: list[int] = dataclasses.field(default_factory=list)
+    # Prefix-cache bookkeeping: tree nodes whose pages lead this
+    # request's page list (refcounted for the request's lifetime).
+    shared_nodes: list = dataclasses.field(default_factory=list)
 
     @property
     def done(self) -> bool:
@@ -56,7 +81,9 @@ class ContinuousEngine(MegaDispatch):
 
     ``max_batch`` decode slots share ``num_pages`` pool pages; a request
     is admitted when a slot AND enough pages for its prompt+gen_len are
-    free. Page 0 is reserved as the trash page for inactive slots.
+    free (cached prefix pages count as free coverage — they are mapped,
+    not allocated). Page 0 is reserved as the trash page for inactive
+    slots.
     """
 
     def __init__(
@@ -72,6 +99,8 @@ class ContinuousEngine(MegaDispatch):
         eos_id: int | None = None,
         seed: int = 0,
         mega_cfg=None,
+        prefix_cache: bool = False,
+        prefill_chunk: int = 0,
     ):
         self.model = model
         self.mode = mode
@@ -96,8 +125,41 @@ class ContinuousEngine(MegaDispatch):
         self._capacity = len(self.pool.free)
         self._table = np.zeros((max_batch, self.pps), np.int32)
         self._kv_len = np.zeros((max_batch,), np.int32)
-        self._dense1 = model.new_cache(1, self.max_length)
+        self._tok = np.zeros((max_batch,), np.int32)
         self._slots: list[Request | None] = [None] * max_batch
+        self.prefix = PrefixCache(self.pool, page_size) if prefix_cache else None
+        self.prefill_chunk = round_chunk(prefill_chunk) if prefill_chunk else 0
+        # Dense batch-1 prefill scratch — only the legacy (non-prefix)
+        # admission path scatters through it; the chunked path writes
+        # pages directly.
+        self._dense1 = None if prefix_cache else model.new_cache(
+            1, self.max_length
+        )
+        self.stats = self._zero_stats()
+
+    @staticmethod
+    def _zero_stats() -> dict:
+        return {
+            "admitted": 0,
+            "decode_steps": 0,
+            "prefill_tokens": 0,
+            "prefill_chunks": 0,
+            "prefix_hit_tokens": 0,
+            "pages_cow_copied": 0,
+            "admission_stalls": 0,
+        }
+
+    @property
+    def last_stats(self) -> dict:
+        """Serving counters (parity: ``Engine.last_stats``): admission /
+        prefill work done, prefix-cache reuse, COW copies, stalls."""
+        stats = dict(self.stats)
+        stats["free_pages"] = len(self.pool.free)
+        if self.prefix is not None:
+            stats["prefix_cache"] = dict(self.prefix.stats)
+            stats["prefix_hit_rate"] = self.prefix.hit_rate
+            stats["tree_pages"] = self.prefix.node_count
+        return stats
 
     # -- slot management -------------------------------------------------
 
@@ -108,13 +170,17 @@ class ContinuousEngine(MegaDispatch):
             kv_len=jnp.asarray(self._kv_len),
         )
 
-    def _admit(self, req: Request, slot: int) -> jax.Array:
+    def _admit(
+        self, req: Request, slot: int, m: PrefixMatch | None = None
+    ) -> jax.Array:
         """Prefill ``req`` into ``slot``; returns the first sampled token."""
+        if self.prefix is not None:
+            return self._admit_prefix(req, slot, m)
         s = len(req.prompt)
         n = self.model.ctx.axis_size(self.model.axis)
         pad = (-s) % n
         row = np.concatenate([req.prompt, np.zeros(pad, np.int32)])
-        need = -(-(s + req.gen_len) // self.page_size)
+        need = self._needed_pages(s, req.gen_len)
         req.pages = self.pool.allocate(need)
         req.slot = slot
         self._table[slot] = 0
@@ -129,16 +195,128 @@ class ContinuousEngine(MegaDispatch):
         self.cache = write_prefill(
             self.cache, slot, self._dense1.k, self._dense1.v, s
         )
+        self.stats["admitted"] += 1
+        self.stats["prefill_tokens"] += s
         self._slots[slot] = req
         return self._sample(logits)[0]
 
+    def _admit_prefix(
+        self, req: Request, slot: int, m: PrefixMatch
+    ) -> jax.Array:
+        """Prefix-cache admission: map the matched prefix pages into the
+        slot's table row, COW-clone a partially matched tail, then
+        chunk-prefill only the suffix."""
+        s = len(req.prompt)
+        total = self._needed_pages(s, req.gen_len)
+        new_pages = self.prefix.allocate(total - len(m.nodes))
+        assert new_pages is not None, "try_admit availability check failed"
+        req.pages = m.pages + new_pages
+        req.shared_nodes = list(m.nodes)
+        req.slot = slot
+        self._table[slot] = 0
+        self._table[slot, : len(req.pages)] = req.pages
+        matched = m.matched_len
+        if m.cow_len:
+            # The partially matched page becomes this request's first
+            # private page: clone it, count only the matched positions.
+            self.cache = copy_page(self.cache, m.cow_node.page, new_pages[0])
+            self.stats["pages_cow_copied"] += 1
+        self.prefix.finish_cow(m)
+        self._kv_len[slot] = matched
+        self._sync_tables()
+        self.stats["admitted"] += 1
+        self.stats["prefix_hit_tokens"] += matched
+        with trace_span(
+            "prefix_cache:admit", slot=slot, prompt_len=s, matched=matched
+        ):
+            logits = self._prefill_suffix(slot, req.prompt, matched)
+        self._slots[slot] = req
+        return self._sample(logits[None])[0]
+
+    def _prefill_suffix(self, slot: int, prompt: np.ndarray, start: int):
+        """Chunk-prefill ``prompt[start:]`` into ``slot``'s pages,
+        stepping the running batch between chunks (chunked prefill:
+        admission never stalls in-flight decodes for a full prefill).
+        Returns the last real token's logits ``[V]``."""
+
+        def between_chunks(cache, new_len):
+            # Device kv_len is set absolutely by the chunk program, so
+            # host and device agree even after interleaved decode steps
+            # bumped the in-flight slot's device counter.
+            self.cache = cache
+            self._kv_len[slot] = new_len
+            if self._decode_once():
+                # An interleaved decode finished a request: its pages
+                # retired to the tree, and the device table must drop
+                # them BEFORE the next chunk, or the stale row's append
+                # would corrupt a cached page.
+                self._sync_tables()
+            return self.cache
+
+        logits, self.cache, chunks = prefill_suffix_chunks(
+            self.model, self.cache, slot, prompt, start,
+            self.prefill_chunk, self._prefill_mode, between_chunks,
+        )
+        self._kv_len[slot] = len(prompt)
+        self.stats["prefill_tokens"] += len(prompt) - start
+        self.stats["prefill_chunks"] += chunks
+        return logits
+
+    def _decode_once(self) -> bool:
+        """One single-step decode of every active slot; appends sampled
+        tokens and evicts finished requests. Returns whether slot state
+        changed (caller decides when to re-admit/sync)."""
+        active = np.asarray([r is not None for r in self._slots], np.int32)
+        if not active.any():
+            return False
+        logits, self.cache = self._decode_step(
+            jnp.asarray(self._tok), self.cache
+        )
+        self._kv_len += active
+        self.stats["decode_steps"] += 1
+        nxt = np.asarray(self._sample(logits))
+        return self._process(lambda slot: [nxt[slot]])
+
+    def _process(self, slot_tokens) -> bool:
+        """Append per-slot tokens; evict on gen_len/eos. Returns whether
+        slot state changed."""
+        changed = False
+        for slot, req in enumerate(self._slots):
+            if req is None:
+                continue
+            for t in slot_tokens(slot):
+                req.out.append(int(t))
+                self._tok[slot] = int(t)
+                if self._maybe_finish(req, int(t)):
+                    changed = True
+                    break
+        return changed
+
     def _evict(self, req: Request) -> None:
         slot = req.slot
-        self.pool.release(req.pages)
+        if self.prefix is not None:
+            self._retire_to_prefix(req)
+        else:
+            self.pool.release(req.pages)
         self._table[slot] = 0  # back to the trash page
         self._kv_len[slot] = 0
         req.pages, req.slot = [], None
         self._slots[slot] = None
+
+    def _retire_to_prefix(self, req: Request) -> None:
+        """Donate the finished request's KV pages to the radix tree.
+
+        Valid KV covers positions ``[0, s + len(out) - 1)`` — the last
+        sampled token was never fed back, so its KV was never appended
+        (and multi-step overshoot rows beyond it hold discarded-token
+        garbage the retire chunking never references)."""
+        gen_cached = max(len(req.out) - 1, 0)
+        toks = np.concatenate(
+            [req.prompt, np.asarray(req.out[:gen_cached], np.int32)]
+        )
+        with trace_span("prefix_cache:retire", tokens=len(toks)):
+            self.prefix.retire_sequence(toks, req.pages, req.shared_nodes)
+        req.shared_nodes = []
 
     def _sample(self, logits: jax.Array) -> jax.Array:
         if self.temperature <= 0.0:
@@ -176,7 +354,7 @@ class ContinuousEngine(MegaDispatch):
                     f"pool capacity is {self._capacity} (unservable)"
                 )
         queue = deque(reqs)
-        tok = np.zeros((self.max_batch,), np.int32)
+        self.stats = self._zero_stats()
 
         def try_admit() -> bool:
             admitted = False
@@ -185,16 +363,30 @@ class ContinuousEngine(MegaDispatch):
                 progress = False          # slot for the next request
                 for slot in range(self.max_batch):
                     if self._slots[slot] is None and queue:
+                        head = queue[0]
                         need = self._needed_pages(
-                            len(queue[0].prompt), queue[0].gen_len
+                            len(head.prompt), head.gen_len
                         )
-                        if need > len(self.pool.free):
-                            progress = False
-                            break  # head-of-line waits for pages
+                        if self.prefix is not None:
+                            m = self.prefix.match(head.prompt)
+                            avail = (
+                                len(self.pool.free)
+                                + self.prefix.reclaimable_pages()
+                            )
+                            if need - len(m.nodes) > avail:
+                                self.prefix.release_match(m)
+                                self.stats["admission_stalls"] += 1
+                                progress = False
+                                break  # head-of-line waits for pages
+                        else:
+                            m = None
+                            if need > len(self.pool.free):
+                                progress = False
+                                break  # head-of-line waits for pages
                         req = queue.popleft()
-                        first = self._admit(req, slot)
+                        first = self._admit(req, slot, m)
                         req.out.append(int(first))
-                        tok[slot] = int(first)
+                        self._tok[slot] = int(first)
                         admitted = progress = True
                         # The admission token itself can finish the
                         # request (gen_len=1, or eos as first token).
@@ -219,21 +411,6 @@ class ContinuousEngine(MegaDispatch):
         use_multi = self.mode == "mega" and self.temperature <= 0.0
         multi_fn = None
 
-        def process(slot_tokens) -> bool:
-            """Append per-slot tokens; evict on gen_len/eos. Returns
-            whether slot state changed."""
-            changed = False
-            for slot, req in enumerate(self._slots):
-                if req is None:
-                    continue
-                for t in slot_tokens(slot):
-                    req.out.append(int(t))
-                    tok[slot] = int(t)
-                    if self._maybe_finish(req, int(t)):
-                        changed = True
-                        break
-            return changed
-
         try_admit()
         while any(r is not None for r in self._slots):
             active = np.asarray(
@@ -249,18 +426,14 @@ class ContinuousEngine(MegaDispatch):
                 toks, _logits, self.cache = multi_fn(
                     # Q8Params under MegaConfig(wq8=True), else params.
                     self._mega_model()._step_params(),
-                    jnp.asarray(tok), self.cache,
+                    jnp.asarray(self._tok), self.cache,
                 )
                 self._kv_len += NS * active
+                self.stats["decode_steps"] += NS
                 toks_np = np.asarray(toks)  # [NS, max_batch]
-                changed = process(lambda slot: toks_np[:, slot])
+                changed = self._process(lambda slot: toks_np[:, slot])
             else:
-                logits, self.cache = self._decode_step(
-                    jnp.asarray(tok), self.cache
-                )
-                self._kv_len += active
-                nxt = np.asarray(self._sample(logits))
-                changed = process(lambda slot: [nxt[slot]])
+                changed = self._decode_once()
             if changed:
                 # Slot state changed: the device cache threads k/v
                 # pages, but table + kv_len are host-authoritative.

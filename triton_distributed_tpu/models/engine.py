@@ -32,6 +32,76 @@ from triton_distributed_tpu.models.qwen import Mode, Qwen3
 EngineMode = Literal["xla", "pallas", "mega"]
 
 
+class _PrefixState:
+    """Engine's cross-serve prefix-cache state: the pool-backed cache
+    arrays, their pool, and the radix tree over it. ``dirty`` is set for
+    the duration of a serve — a crash mid-serve leaves it set, and the
+    next serve rebuilds instead of reusing donated cache buffers /
+    permanently pinned pages."""
+
+    __slots__ = ("key", "cache", "pool", "tree", "dirty")
+
+    def __init__(self, key, cache, pool, tree):
+        self.key = key
+        self.cache = cache
+        self.pool = pool
+        self.tree = tree
+        self.dirty = False
+
+
+def prefill_suffix_chunks(
+    model,
+    cache,
+    slot: int,
+    prompt,
+    start: int,
+    chunk_width: int,
+    mode,
+    between_chunks=None,
+):
+    """Chunk-prefill ``prompt[start:]`` of one slot/row over the paged
+    cache — the prefix-cache suffix path, shared by ``Engine`` and
+    ``ContinuousEngine`` so the chunk-width rounding and the
+    offset/new_len/last_idx alignment live in exactly one place.
+
+    ``chunk_width=0`` runs the whole suffix as one (rounded) chunk.
+    ``between_chunks(cache, new_len)`` runs after every non-final chunk
+    (the continuous engine interleaves a decode step there) and must
+    return the cache to keep threading. Returns
+    ``(last-token logits [V], cache, chunks_run)``.
+    """
+    from triton_distributed_tpu.models.prefix_cache import round_chunk
+    from triton_distributed_tpu.runtime.profiling import trace_span
+
+    s = len(prompt)
+    c = round_chunk(chunk_width) if chunk_width else round_chunk(s - start)
+    page = int(cache.k_pages.shape[3])
+    pps = int(cache.page_table.shape[1])
+    logits, off, chunks = None, start, 0
+    while off < s:
+        take = min(c, s - off)
+        buf = np.zeros(c, np.int32)
+        buf[:take] = prompt[off : off + take]
+        # Gather bucket: enough table entries to cover the chunk's last
+        # (padded) position, rounded to a power of two so at most
+        # log2(pages_per_seq) programs compile per chunk width — a short
+        # suffix never gathers the full max_length KV view.
+        need = -(-(off + c) // page)
+        kv_pages = min(1 << max(need - 1, 0).bit_length()
+                       if need > 1 else 1, pps)
+        with trace_span("prefix_cache:chunk", slot=slot, offset=off,
+                        take=take):
+            logits, cache = model.prefill_paged_chunk(
+                buf, slot, off, off + take, take - 1, cache, mode,
+                kv_pages=kv_pages,
+            )
+        chunks += 1
+        off += take
+        if off < s and between_chunks is not None:
+            cache = between_chunks(cache, off)
+    return logits, cache, chunks
+
+
 class MegaDispatch:
     """Shared megakernel-mode dispatch (Engine + ContinuousEngine):
     lazy MegaQwen3 construction, xla prefill fallback, and mega-vs-model
@@ -77,6 +147,8 @@ class Engine(MegaDispatch):
         paged: bool = False,
         page_size: int = 128,
         mega_cfg=None,
+        prefix_cache: bool = False,
+        prefill_chunk: int = 0,
     ):
         self.model = model
         self.temperature = temperature
@@ -91,6 +163,18 @@ class Engine(MegaDispatch):
         # through the table, decode attends the pool directly.
         self.paged = paged
         self.page_size = page_size
+        # Prefix-cache mode (requires paged): pool + cache + radix tree
+        # persist ACROSS serve() calls, finished rows retire their pages
+        # into the tree, and later calls prefill only uncached suffixes
+        # (docs/serving.md). ``prefill_chunk`` bounds each chunk program.
+        if prefix_cache and not paged:
+            raise ValueError(
+                "prefix_cache=True requires paged=True (the radix tree "
+                "shares pool pages; a dense cache has none)"
+            )
+        self.prefix_cache = prefix_cache
+        self.prefill_chunk = prefill_chunk
+        self._prefix_state: _PrefixState | None = None
         # Page-pool free list, populated by the first paged serve();
         # continuous-batching admission/eviction draws from it.
         self._pool = None
@@ -169,7 +253,12 @@ class Engine(MegaDispatch):
                 f"({gen_len}) exceeds max_length={max_length}; raise "
                 f"max_length or shorten"
             )
-        if self.paged:
+        row_meta = None
+        if self.paged and self.prefix_cache:
+            logits, cache, row_meta = self._prefix_prefill(
+                rows, true_lens, gen_len, max_length
+            )
+        elif self.paged:
             from triton_distributed_tpu.models.paged_kv_cache import (
                 init_paged_cache,
                 write_prefill,
@@ -287,6 +376,7 @@ class Engine(MegaDispatch):
                 out.append(np.asarray(tok)[:, None])
         t_decode = time.perf_counter() - t0
 
+        result = np.concatenate(out, axis=1)
         self.last_stats = {
             "prefill_s": t_prefill,
             "decode_s": t_decode,
@@ -295,7 +385,155 @@ class Engine(MegaDispatch):
             ),
             "tokens_per_s": b * max(gen_len - 1, 1) / max(t_decode, 1e-9),
         }
+        if row_meta is not None:
+            self._prefix_retire(
+                result, rows, true_lens, gen_len, cache, row_meta
+            )
         if self.verbose:
             print(f"[engine] {self.last_stats}")
-        return np.concatenate(out, axis=1)
+        return result
+
+    # -- prefix-cache paged serving ---------------------------------------
+
+    def _ensure_prefix_state(self, b: int, max_length: int) -> _PrefixState:
+        """Pool + pool-backed cache + radix tree persisted across
+        serve() calls (that persistence IS the prefix cache). Rebuilt —
+        dropping all cached prefixes — when the batch geometry changes,
+        or when the previous serve aborted mid-flight (its cache buffers
+        were donated to device programs and its match pins never
+        released; reuse would fail on deleted arrays / pinned pages)."""
+        from triton_distributed_tpu.models.paged_kv_cache import (
+            init_paged_cache,
+        )
+        from triton_distributed_tpu.models.prefix_cache import PrefixCache
+
+        key = (b, max_length, self.page_size)
+        state = self._prefix_state
+        if state is None or state.key != key or state.dirty:
+            pps = max_length // self.page_size
+            cache, pool = init_paged_cache(
+                self.model.cfg, b, self.model.ctx, self.model.axis,
+                max_length=max_length, page_size=self.page_size,
+                # +1: page 0 reserved as the trash page unused table
+                # entries point at (same convention as ContinuousEngine).
+                num_pages=b * pps + 1, assign_pages=False,
+            )
+            pool.free = [p for p in pool.free if p != 0]
+            self._prefix_state = _PrefixState(
+                key, cache, pool, PrefixCache(pool, self.page_size)
+            )
+            self._pool = pool
+        return self._prefix_state
+
+    def _prefix_prefill(self, rows, true_lens, gen_len: int, max_length: int):
+        """Admission for every batch row: longest-prefix match against
+        the radix tree, map matched pages into the row's table, COW-clone
+        a partially matched tail, chunk-prefill only the suffix. Returns
+        ``(last-token logits [b, V], cache, row_meta)``."""
+        import dataclasses
+
+        from triton_distributed_tpu.models.paged_kv_cache import copy_page
+        from triton_distributed_tpu.runtime.profiling import trace_span
+
+        b = rows.shape[0]
+        state = self._ensure_prefix_state(b, max_length)
+        cache, tree = state.cache, state.tree
+        state.dirty = True  # in-flight; cleared by _prefix_retire
+        pps = max_length // self.page_size
+        table = np.zeros((b, pps), np.int32)
+        row_meta = []
+        matches = []
+        for i in range(b):
+            prompt = rows[i][: int(true_lens[i])]
+            m = tree.match(prompt)
+            # Positions written: the prompt plus gen_len - 1 decode
+            # appends (the final sampled token is never fed back) — the
+            # same bound serve()'s capacity guard enforces, so ``total``
+            # never exceeds pages_per_seq.
+            total = -(
+                -(int(true_lens[i]) + gen_len - 1) // self.page_size
+            )
+            new_pages = tree.allocate(total - len(m.nodes))
+            if new_pages is None and m.cow_node is not None:
+                # A COW pin holds a page WITHOUT covering any of this
+                # row's budget (unlike full-shared nodes), so it alone
+                # can starve the pool — drop it and retry before
+                # degrading further. The dropped span was counted as a
+                # hit at match time; un-count what won't be reused.
+                tree.release_node(m.cow_node)
+                tree.stats["hit_tokens"] -= m.cow_len
+                m.cow_node, m.cow_len = None, 0
+                new_pages = tree.allocate(total - len(m.nodes))
+            if new_pages is None:
+                # Degrade to a cold row: with nothing pinned by this
+                # row, full eviction always covers ≤ pages_per_seq.
+                tree.release_match(m)
+                new_pages = tree.allocate(total)
+            assert new_pages is not None, "prefix pool sizing violated"
+            pages = m.pages + new_pages
+            table[i, : len(pages)] = pages
+            if m.cow_len:
+                # Clone the partially matched page into this row's first
+                # private page NOW and drop the pin: a COW pin held
+                # across later rows' allocations would shrink their
+                # evictable set below the capacity argument above.
+                cache = copy_page(cache, m.cow_node.page, new_pages[0])
+            matches.append(m)
+            row_meta.append([pages, list(m.nodes), m.matched_len,
+                             m.cow_len])
+            tree.finish_cow(m)
+        cache = dataclasses.replace(
+            cache,
+            page_table=jnp.asarray(table),
+            kv_len=jnp.zeros((b,), jnp.int32),
+        )
+
+        hit_tokens = prefill_tokens = cow_pages = 0
+        last_logits = []
+        for i in range(b):
+            m = matches[i]
+            s = int(true_lens[i])
+            start = m.matched_len
+            cow_pages += 1 if row_meta[i][3] else 0
+            hit_tokens += start
+            prompt = rows[i][:s]
+            with trace_span(
+                "prefix_cache:admit", row=i, prompt_len=s, matched=start
+            ):
+                logits_i, cache, _ = prefill_suffix_chunks(
+                    self.model, cache, i, prompt, start,
+                    self.prefill_chunk, self._prefill_mode,
+                )
+            prefill_tokens += s - start
+            last_logits.append(logits_i)
+        self.last_stats = {}  # populated by serve(); stash counters now
+        self._prefix_counters = {
+            "prefix_hit_tokens": hit_tokens,
+            "prefill_tokens": prefill_tokens,
+            "pages_cow_copied": cow_pages,
+            "prefix_hit_rate": tree.hit_rate,
+            "tree_pages": tree.node_count,
+        }
+        return jnp.stack(last_logits), cache, row_meta
+
+    def _prefix_retire(
+        self, result, rows, true_lens, gen_len: int, cache, row_meta
+    ) -> None:
+        """Retire every finished row's pages into the radix tree (valid
+        KV covers prompt + gen_len - 1 fed-back tokens) and persist the
+        cache arrays for the next serve() call."""
+        state = self._prefix_state
+        tree = state.tree
+        s = result.shape[1] - gen_len
+        gen = result[:, s:]
+        for i, (pages, nodes, _matched, _cow) in enumerate(row_meta):
+            toks = np.concatenate(
+                [rows[i][: int(true_lens[i])],
+                 gen[i, : gen_len - 1].astype(np.int32)]
+            )
+            tree.retire_sequence(toks, pages, nodes)
+        state.cache = cache
+        state.dirty = False  # clean: safe to reuse next serve()
+        self.last_stats.update(self._prefix_counters)
+        self.last_stats["prefix_cache"] = dict(tree.stats)
 

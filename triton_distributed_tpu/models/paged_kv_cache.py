@@ -161,6 +161,32 @@ def _scatter(pages, dense, table_row, npages: int, page: int):
 _scatter_jit = jax.jit(_scatter, static_argnums=(3, 4), donate_argnums=(0,))
 
 
+def copy_page(cache: PagedKVCache, src: int, dst: int) -> PagedKVCache:
+    """Copy one pool page (both K and V, all layers) — the prefix
+    cache's copy-on-write: a partially matched shared page is cloned
+    into the new sequence's private page before its suffix writes into
+    it. ``src``/``dst`` are traced, so one compiled program serves every
+    COW."""
+    s = jnp.asarray(src, jnp.int32)
+    d = jnp.asarray(dst, jnp.int32)
+    return PagedKVCache(
+        k_pages=_copy_page_jit(cache.k_pages, s, d),
+        v_pages=_copy_page_jit(cache.v_pages, s, d),
+        page_table=cache.page_table,
+        kv_len=cache.kv_len,
+    )
+
+
+# Donated for the same reason as _scatter_jit: an eager update would
+# copy the whole pool to move one page.
+_copy_page_jit = jax.jit(
+    lambda pages, s, d: jax.lax.dynamic_update_slice_in_dim(
+        pages, jax.lax.dynamic_slice_in_dim(pages, s, 1, axis=1), d, axis=1
+    ),
+    donate_argnums=(0,),
+)
+
+
 def append(
     cache: PagedKVCache,
     k_new: jax.Array,  # [L, B, Hkv_loc, hd] — one token per sequence

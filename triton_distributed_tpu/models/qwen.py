@@ -30,6 +30,7 @@ from triton_distributed_tpu.layers.tp_attn import (
     tp_attn_decode,
     tp_attn_decode_paged,
     tp_attn_prefill,
+    tp_attn_prefill_paged_chunk,
 )
 from triton_distributed_tpu.layers.tp_mlp import TPMLPParams, tp_mlp_fwd
 from triton_distributed_tpu.models.config import ModelConfig
@@ -358,6 +359,95 @@ class Qwen3:
         )
         kv_len = jax.lax.dynamic_update_slice(cache.kv_len, true_lens, (0,))
         return logits, KVCache(k=k_new, v=v_new, kv_len=kv_len)
+
+    def _prefill_chunk_shard(
+        self, params, tokens, cache, slot, q_offset, new_len, last_idx,
+        *, mode: Mode, kv_pages: int | None = None,
+    ):
+        """Chunked-prefill one slot of a :class:`PagedKVCache`, per-shard.
+
+        ``tokens [C]`` is one suffix chunk (right-padded; pads write
+        masked/overwritten KV and are causally inert), ``q_offset`` the
+        slot's already-cached length, ``new_len`` the slot's kv_len after
+        this chunk (set absolutely, so interleaved decode steps bumping
+        the in-flight slot's counter can never leave it skewed), and
+        ``last_idx`` the chunk index whose logits are returned (the
+        prompt's last real token on the final chunk; ignored upstream on
+        earlier chunks). Same layer scan as :meth:`_decode_shard_paged`
+        with chunk attention against prefix pages + chunk.
+        """
+        cfg = self.cfg
+        x = self._embed(params, tokens)  # [C, d]
+        table_row = cache.page_table[slot]
+        ar = "pallas_ar" if mode == "pallas" else "xla_ar"
+
+        def layer_fn(carry, inp):
+            x = carry
+            lp, kp, vp = inp
+            h = rms_norm(x, lp.ln1, cfg.rms_eps)
+            a, kp, vp = tp_attn_prefill_paged_chunk(
+                lp.attn, h, kp, vp, table_row, q_offset, self.dims,
+                kv_pages=kv_pages, axis=self.axis, mode=ar, ctx=self.ctx,
+            )
+            x = x + a
+            h = rms_norm(x, lp.ln2, cfg.rms_eps)
+            x = x + self._mlp_fwd(lp.mlp, h, ar)
+            return x, (kp, vp)
+
+        x, (k_new, v_new) = jax.lax.scan(
+            layer_fn, x, (params.layers, cache.k_pages, cache.v_pages)
+        )
+        x = rms_norm(x, params.norm, cfg.rms_eps)
+        x_last = jnp.take(x, last_idx, axis=0)
+        logits = self._logits(params, x_last[None])[0]
+        from triton_distributed_tpu.models.paged_kv_cache import PagedKVCache
+
+        return logits, PagedKVCache(
+            k_pages=k_new, v_pages=v_new, page_table=cache.page_table,
+            kv_len=cache.kv_len.at[slot].set(new_len.astype(jnp.int32)),
+        )
+
+    def prefill_paged_chunk(
+        self,
+        tokens,          # [C] int32 — one (padded) suffix chunk
+        slot: int,
+        q_offset: int,
+        new_len: int,
+        last_idx: int,
+        cache,           # PagedKVCache
+        mode: Mode = "xla",
+        kv_pages: int | None = None,
+    ):
+        """Jitted chunked prefill of ``slot``'s suffix over the paged
+        pool — the prefix-cache data plane: matched prefix pages are
+        attended, only the chunk is computed. Keyed on chunk width and
+        the ``kv_pages`` gather bucket only (offset/slot/lengths are
+        traced), so a handful of compiled programs serve every
+        admission. Returns ``(last_idx logits [V], cache)``."""
+        from triton_distributed_tpu.models.paged_kv_cache import (
+            paged_cache_specs,
+        )
+
+        key = ("chunk", mode, int(tokens.shape[0]), kv_pages)
+        if key not in self._prefill_jit:
+            f = self.ctx.shard_map(
+                functools.partial(self._prefill_chunk_shard, mode=mode,
+                                  kv_pages=kv_pages),
+                in_specs=(
+                    self.param_specs, P(), paged_cache_specs(self.axis),
+                    P(), P(), P(), P(),
+                ),
+                out_specs=(P(), paged_cache_specs(self.axis)),
+            )
+            self._prefill_jit[key] = jax.jit(
+                lambda p, t, c, s, o, n, li: f(p, t, c, s, o, n, li),
+                donate_argnums=(2,),
+            )
+        return self._prefill_jit[key](
+            self.params, jnp.asarray(tokens, jnp.int32), cache,
+            jnp.asarray(slot, jnp.int32), jnp.asarray(q_offset, jnp.int32),
+            jnp.asarray(new_len, jnp.int32), jnp.asarray(last_idx, jnp.int32),
+        )
 
     # -- jitted SPMD entry points ----------------------------------------
     def decode_fn(self, mode: Mode = "xla"):

@@ -30,6 +30,7 @@ _NEG_INF = -1e30
 
 
 def _attn_kernel(
+    off_ref,  # [1] int32 SMEM (scalar prefetch) or None — kv offset
     q_ref,    # [1, block_q, d] VMEM
     k_ref,    # [1, block_k, d] VMEM
     v_ref,    # [1, block_k, d] VMEM
@@ -50,6 +51,7 @@ def _attn_kernel(
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     num_k = pl.num_programs(2)
+    kv_offset = kv_offset if off_ref is None else off_ref[0]
 
     @pl.when(ki == 0)
     def _init():
@@ -102,7 +104,7 @@ def flash_attention(
     *,
     causal: bool = True,
     sm_scale: float | None = None,
-    kv_offset: int = 0,
+    kv_offset: int | jax.Array = 0,
     block_q: int = 128,
     block_k: int = 128,
     return_lse: bool = False,
@@ -111,7 +113,10 @@ def flash_attention(
     """Causal/GQA flash attention. ``kv_offset``: absolute position of
     ``q[..., 0, :]`` within the kv sequence (non-zero for chunked prefill
     against a KV cache — parity with the reference's offset handling in
-    ``flash_decode.py`` host wrappers).
+    ``flash_decode.py`` host wrappers). A traced/array ``kv_offset``
+    rides as a scalar-prefetch operand, so one compiled kernel serves
+    every chunk offset of a chunked prefill (a static int keeps the
+    constant-folded path).
 
     Returns ``o [B, Hq, Sq, D]`` (and ``lse [B, Hq, Sq]`` f32 when
     ``return_lse`` — base-e log-sum-exp of scaled scores, the quantity the
@@ -143,6 +148,7 @@ def flash_attention(
     kf = k.reshape(b * hkv, sk, d)
     vf = v.reshape(b * hkv, sk, d)
     grid = (b * hq, sq // block_q, sk // block_k)
+    dynamic_off = not isinstance(kv_offset, int)
 
     out_shape = [jax.ShapeDtypeStruct((b * hq, sq, d), q.dtype)]
     out_specs = [
@@ -158,37 +164,64 @@ def flash_attention(
         _attn_kernel,
         sm_scale=sm_scale,
         causal=causal,
-        kv_offset=kv_offset,
+        kv_offset=0 if dynamic_off else kv_offset,
         block_q=block_q,
         block_k=block_k,
     )
-    if not return_lse:
-        kernel = functools.partial(_drop_lse, kernel)
-
-    res = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec(
-                (1, block_k, d), lambda bh, qi, ki, g=group: (bh // g, ki, 0)
-            ),
-            pl.BlockSpec(
-                (1, block_k, d), lambda bh, qi, ki, g=group: (bh // g, ki, 0)
-            ),
-        ],
-        out_specs=out_specs,
-        out_shape=out_shape,
-        scratch_shapes=[
-            pltpu.VMEM((block_q, d), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
-        ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+    kernel = functools.partial(
+        _adapt_refs, kernel, dynamic_off, return_lse
+    )
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+        pl.BlockSpec(
+            (1, block_k, d), lambda bh, qi, ki, g=group: (bh // g, ki, 0)
         ),
-        interpret=interpret,
-    )(qf, kf, vf)
+        pl.BlockSpec(
+            (1, block_k, d), lambda bh, qi, ki, g=group: (bh // g, ki, 0)
+        ),
+    ]
+    scratch_shapes = [
+        pltpu.VMEM((block_q, d), jnp.float32),
+        pltpu.VMEM((block_q, 1), jnp.float32),
+        pltpu.VMEM((block_q, 1), jnp.float32),
+    ]
+    compiler_params = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+    )
+    if dynamic_off:
+        # Dynamic offset rides as scalar prefetch; index maps gain the
+        # scalar ref as a trailing arg (flash_decode's paged idiom).
+        off = jnp.asarray(kv_offset, jnp.int32).reshape(1)
+        res = pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=grid,
+                in_specs=[
+                    pl.BlockSpec(s.block_shape, _drop_scalar_arg(s.index_map))
+                    for s in in_specs
+                ],
+                out_specs=[
+                    pl.BlockSpec(s.block_shape, _drop_scalar_arg(s.index_map))
+                    for s in out_specs
+                ],
+                scratch_shapes=scratch_shapes,
+            ),
+            out_shape=out_shape,
+            compiler_params=compiler_params,
+            interpret=interpret,
+        )(off, qf, kf, vf)
+    else:
+        res = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=out_shape,
+            scratch_shapes=scratch_shapes,
+            compiler_params=compiler_params,
+            interpret=interpret,
+        )(qf, kf, vf)
 
     o = res[0].reshape(b, hq, sq, d)
     if return_lse:
@@ -196,8 +229,22 @@ def flash_attention(
     return o
 
 
-def _drop_lse(kernel, q_ref, k_ref, v_ref, o_ref, acc, m_i, l_i, **kw):
-    kernel(q_ref, k_ref, v_ref, o_ref, None, acc, m_i, l_i, **kw)
+def _drop_scalar_arg(index_map):
+    """Index map adapted for PrefetchScalarGridSpec (which appends the
+    scalar-prefetch ref as a trailing arg the plain map doesn't take)."""
+    return lambda bh, qi, ki, _off: index_map(bh, qi, ki)
+
+
+def _adapt_refs(kernel, has_off: bool, has_lse: bool, *refs):
+    """Route pallas_call's positional refs into ``_attn_kernel``'s
+    keyword-stable signature: optional scalar-prefetch offset first,
+    optional lse output, then the three scratch refs."""
+    refs = list(refs)
+    off_ref = refs.pop(0) if has_off else None
+    q_ref, k_ref, v_ref, o_ref = refs[:4]
+    lse_ref = refs[4] if has_lse else None
+    acc, m_i, l_i = refs[-3:]
+    kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_i, l_i)
 
 
 def mha_reference(

@@ -1,0 +1,76 @@
+"""Guarded compatibility aliases for older JAX releases.
+
+The package targets the current JAX API surface; a few names it uses
+were introduced after 0.4.x:
+
+- ``jax.lax.axis_size(name)``       — static axis size inside shard_map
+- ``pltpu.CompilerParams``          — renamed from ``TPUCompilerParams``
+- ``pltpu.InterpretParams``         — structured interpret-mode params
+
+Each alias below is installed ONLY when the running JAX lacks the name
+(pure ``hasattr`` guards), so on a current JAX this module is a no-op.
+Imported from the package ``__init__`` so every entry point (tests,
+benches, serving) sees a uniform surface.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def _install() -> None:
+    if not hasattr(jax.lax, "axis_size"):
+        def axis_size(axis_name):
+            # Pre-0.6 the static size lives on the axis frame (newer
+            # 0.4.x returns the bare int directly).
+            frame = jax.core.axis_frame(axis_name)
+            return frame if isinstance(frame, int) else frame.size
+
+        jax.lax.axis_size = axis_size
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    if not hasattr(pltpu, "CompilerParams"):
+        import dataclasses as _dc
+
+        _known = {f.name for f in _dc.fields(pltpu.TPUCompilerParams)}
+
+        def _compiler_params(**kw):
+            # Fields added after this JAX release (e.g.
+            # ``has_side_effects``) are advisory on the interpret path
+            # the old release runs here — drop them rather than fail.
+            return pltpu.TPUCompilerParams(
+                **{k: v for k, v in kw.items() if k in _known}
+            )
+
+        pltpu.CompilerParams = _compiler_params
+
+        # Same-era quirk: this release rejects ``unroll`` (even the
+        # default-equivalent ``unroll=False``) when fori_loop bounds are
+        # traced; current JAX accepts it. Retry without the kwarg —
+        # semantics identical (False IS the no-unroll default).
+        _orig_fori = jax.lax.fori_loop
+
+        def _fori_loop(lower, upper, body_fun, init_val, **kw):
+            try:
+                return _orig_fori(lower, upper, body_fun, init_val, **kw)
+            except ValueError as e:
+                if kw.get("unroll") is False and "unroll" in str(e):
+                    kw = dict(kw)
+                    kw.pop("unroll")
+                    return _orig_fori(lower, upper, body_fun, init_val, **kw)
+                raise
+
+        jax.lax.fori_loop = _fori_loop
+
+    if not hasattr(pltpu, "InterpretParams"):
+        # Older Pallas takes ``interpret=True`` (plain bool) instead of a
+        # params object; the call sites only ever pass the result through
+        # to ``pallas_call(interpret=...)``, so truthy-bool is faithful.
+        def _interpret_params(**_kw):
+            return True
+
+        pltpu.InterpretParams = _interpret_params
+
+
+_install()

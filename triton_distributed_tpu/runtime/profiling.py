@@ -27,6 +27,32 @@ import jax
 _PID_STRIDE = 10_000_000
 
 
+@contextlib.contextmanager
+def trace_span(name: str, **args):
+    """Named host-side span on the jax.profiler timeline.
+
+    The serving engines wrap control-plane phases (prefix-cache
+    admission, chunk prefills, evictions) so they land on the same
+    merged trace as the device programs they interleave with. Outside an
+    active capture the annotation is free; a profiler API mismatch must
+    never sink serving, so entry failures degrade to a plain yield
+    (body exceptions still propagate)."""
+    span = None
+    try:
+        span = jax.profiler.TraceAnnotation(name, **args)
+        span.__enter__()
+    except Exception:
+        span = None
+    try:
+        yield
+    finally:
+        if span is not None:
+            try:
+                span.__exit__(None, None, None)
+            except Exception:
+                pass
+
+
 def _load_chrome_trace(path: str) -> dict:
     op = gzip.open if path.endswith(".gz") else open
     with op(path, "rt") as f:
@@ -52,7 +78,11 @@ def _newest_session_trace(rank_dir: str) -> tuple[str, str] | None:
     flat = sorted(glob.glob(os.path.join(rank_dir, "*.trace.json.gz")),
                   key=os.path.getmtime)
     if flat:
-        return "", flat[-1]
+        # Sentinel session name: a rank resolved via the flat fallback
+        # must still participate in the mixed-sessions check — mixing
+        # one rank's session-dir trace with another's flat-layout trace
+        # is exactly the capture skew the warning exists for (ADVICE r5).
+        return "<flat>", flat[-1]
     return None
 
 
@@ -111,7 +141,7 @@ def merge_group_profile(name: str, out_dir: str = "prof") -> str | None:
                 meta.setdefault(k, v)
     if not found:
         return None
-    if len({s for s in sessions_used.values() if s}) > 1:
+    if len(set(sessions_used.values())) > 1:
         import warnings
 
         warnings.warn(

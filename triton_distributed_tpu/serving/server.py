@@ -7,11 +7,19 @@ framed protocol. Here the protocol is newline-delimited JSON over TCP:
 
     → {"input_ids": [[...]], "gen_len": 32}
     ← {"output_ids": [[...]], "stats": {...}}
+    → {"requests": [[...], ...], "gen_lens": [4, ...]}   (continuous
+    ← {"outputs": [[...], ...], "stats": {...}}           batching)
+    → {"cmd": "stats"}           ← {"stats": {...}}
     → {"cmd": "ping"}            ← {"ok": true}
     → {"cmd": "shutdown"}        ← {"ok": true}   (server then exits)
 
 One request at a time (the accelerator is serial anyway — the reference
-server is likewise single-stream).
+server is likewise single-stream). A ``requests`` payload routes to a
+:class:`~triton_distributed_tpu.models.continuous.ContinuousEngine`'s
+admission/eviction loop (mixed prompt/gen lengths, paged pool, prefix
+cache when the engine enables it); ``input_ids`` routes to
+``Engine.serve`` fixed-batch serving. A server constructed over a
+ContinuousEngine only speaks the former, over an Engine only the latter.
 """
 
 from __future__ import annotations
@@ -45,6 +53,29 @@ class ModelServer:
         if req.get("cmd") == "shutdown":
             self._shutdown.set()
             return {"ok": True}
+        if req.get("cmd") == "stats":
+            return {"stats": self.engine.last_stats}
+        if "requests" in req:
+            if not hasattr(self.engine, "run"):
+                raise TypeError(
+                    "'requests' payloads need a ContinuousEngine; this "
+                    "server wraps a fixed-batch Engine"
+                )
+            prompts = [np.asarray(p, np.int32) for p in req["requests"]]
+            gen_lens = req.get("gen_lens")
+            if gen_lens is None:  # [] is malformed, not "use defaults"
+                gen_lens = [16] * len(prompts)
+            if len(gen_lens) != len(prompts):
+                raise ValueError(
+                    f"{len(prompts)} requests but {len(gen_lens)} gen_lens"
+                )
+            outs = self.engine.run(
+                list(zip(prompts, (int(g) for g in gen_lens)))
+            )
+            return {
+                "outputs": [o.tolist() for o in outs],
+                "stats": self.engine.last_stats,
+            }
         input_ids = np.asarray(req["input_ids"], np.int32)
         gen_len = int(req.get("gen_len", 16))
         out = self.engine.serve(
