@@ -1,0 +1,101 @@
+"""Direct coverage for ``models/sampling.py`` — previously exercised
+only indirectly through the engine tests. The filtered-support
+semantics matter doubly now: the speculative verifier scores drafts
+against ``target_probs``, which must be EXACTLY the distribution
+``sample`` draws from.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from triton_distributed_tpu.models import sampling
+
+
+def _logits(vals):
+    return jnp.asarray(np.asarray(vals, np.float32))
+
+
+def test_greedy_and_nonpositive_temperature():
+    logits = _logits([[0.1, 2.0, -1.0, 0.5], [3.0, 0.0, 1.0, 2.9]])
+    np.testing.assert_array_equal(np.asarray(sampling.greedy(logits)), [1, 0])
+    key = jax.random.key(0)
+    for t in (0.0, -1.0):
+        np.testing.assert_array_equal(
+            np.asarray(sampling.sample(logits, key, temperature=t)), [1, 0]
+        )
+
+
+def test_fixed_key_determinism():
+    logits = _logits(np.linspace(-1, 1, 16))
+    key = jax.random.key(7)
+    a = int(sampling.sample(logits, key, temperature=0.9, top_p=0.8, top_k=5))
+    b = int(sampling.sample(logits, key, temperature=0.9, top_p=0.8, top_k=5))
+    assert a == b
+    # A different key must be able to move the draw (flat-ish logits).
+    draws = {
+        int(sampling.sample(logits, jax.random.key(s), temperature=2.0))
+        for s in range(32)
+    }
+    assert len(draws) > 1
+
+
+def test_top_p_keeps_top_token():
+    # One dominant token: even a tiny top_p keeps it (the filter always
+    # retains the argmax), and the sample can only be that token.
+    logits = _logits([10.0, 0.0, -1.0, -2.0])
+    filtered = np.asarray(
+        sampling.filter_logits(logits, temperature=1.0, top_p=0.01)
+    )
+    assert np.isfinite(filtered[0])
+    assert np.all(np.isneginf(filtered[1:]))
+    for s in range(8):
+        assert int(sampling.sample(logits, jax.random.key(s), 1.0, 0.01)) == 0
+
+
+def test_top_p_cutoff_is_smallest_covering_prefix():
+    probs = np.asarray([0.5, 0.3, 0.15, 0.05], np.float64)
+    logits = _logits(np.log(probs))
+    # top_p=0.75 needs {0.5, 0.3} (0.5 alone < 0.75).
+    filtered = np.asarray(sampling.filter_logits(logits, 1.0, top_p=0.75))
+    assert np.isfinite(filtered[:2]).all() and np.isneginf(filtered[2:]).all()
+
+
+def test_top_k_masks_support():
+    logits = _logits([4.0, 3.0, 2.0, 1.0, 0.0])
+    filtered = np.asarray(sampling.filter_logits(logits, 1.0, top_k=2))
+    assert np.isfinite(filtered[:2]).all() and np.isneginf(filtered[2:]).all()
+    # top_k=0 disables; top_k >= V is a no-op.
+    for k in (0, 5, 9):
+        f = np.asarray(sampling.filter_logits(logits, 1.0, top_k=k))
+        assert np.isfinite(f).all()
+    draws = {
+        int(sampling.sample(logits, jax.random.key(s), 2.0, top_k=3))
+        for s in range(64)
+    }
+    assert draws <= {0, 1, 2} and len(draws) > 1
+
+
+def test_target_probs_matches_sample_distribution():
+    """``target_probs`` must be the distribution ``sample`` draws from
+    (the speculative acceptance rule depends on it): empirical sample
+    frequencies converge to it."""
+    rng = np.random.default_rng(3)
+    logits = _logits(rng.normal(size=8) * 2.0)
+    t, p, k = 0.8, 0.9, 5
+    probs = np.asarray(sampling.target_probs(logits, t, p, k), np.float64)
+    assert abs(probs.sum() - 1.0) < 1e-5
+    n = 4000
+    keys = jax.random.split(jax.random.key(11), n)
+    batched = jax.vmap(lambda kk: sampling.sample(logits, kk, t, p, k))
+    draws = np.asarray(batched(keys))
+    emp = np.bincount(draws, minlength=8) / n
+    # Support agrees exactly; frequencies within statistical noise.
+    assert set(np.nonzero(emp)[0]) <= set(np.nonzero(probs > 0)[0])
+    assert np.abs(emp - probs).sum() / 2 < 0.05  # total variation
+
+
+def test_target_probs_greedy_is_one_hot():
+    logits = _logits([0.0, 5.0, 1.0])
+    probs = np.asarray(sampling.target_probs(logits, temperature=0.0))
+    np.testing.assert_allclose(probs, [0.0, 1.0, 0.0])

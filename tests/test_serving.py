@@ -212,6 +212,71 @@ def test_continuous_batching_first_token_finishes(ctx4):
     assert len(outs2[0]) == 1 and int(outs2[0][0]) == first
 
 
+def test_server_per_request_sampling(ctx4):
+    """The ``requests`` payload's sampling knobs: scalar broadcast and
+    per-request lists reach each Request; a temperature-0 override
+    inside a sampled-default engine reproduces the greedy golden."""
+    from triton_distributed_tpu.models.continuous import ContinuousEngine
+
+    model = AutoLLM.from_pretrained("tiny", ctx=ctx4)
+    p = [5, 9, 2, 4]
+    gold = Engine(model, temperature=0.0).serve(
+        np.asarray([p], np.int32), gen_len=4
+    )[0, 4:]
+    eng = ContinuousEngine(
+        model, max_batch=2, page_size=16, max_length=64, temperature=0.9
+    )
+    server = ModelServer(eng).start()
+    try:
+        resp = request(
+            server.host, server.port,
+            {"requests": [p, p], "gen_lens": [4, 4],
+             "temperatures": [0.0, None], "top_ks": 8},
+        )
+        np.testing.assert_array_equal(
+            np.asarray(resp["outputs"][0], np.int32), gold
+        )
+        assert len(resp["outputs"][1]) == 4
+        # Mismatched knob list lengths surface as server errors.
+        import pytest
+
+        with pytest.raises(RuntimeError, match="top_ps"):
+            request(
+                server.host, server.port,
+                {"requests": [p], "gen_lens": [2], "top_ps": [0.9, 0.5]},
+            )
+    finally:
+        server.shutdown()
+
+
+def test_server_speculative_stats(ctx4):
+    """A server over a speculative ContinuousEngine serves the same
+    tokens and reports the accept/rollback ledger in stats."""
+    from triton_distributed_tpu.models.continuous import ContinuousEngine
+
+    model = AutoLLM.from_pretrained("tiny", ctx=ctx4)
+    p = [5, 9, 2, 4, 5, 9, 2, 4]
+    gold = Engine(model, temperature=0.0).serve(
+        np.asarray([p], np.int32), gen_len=6
+    )[0, 8:]
+    eng = ContinuousEngine(
+        model, max_batch=1, page_size=16, max_length=64, speculative=3
+    )
+    server = ModelServer(eng).start()
+    try:
+        resp = request(
+            server.host, server.port,
+            {"requests": [p], "gen_lens": [6]},
+        )
+        np.testing.assert_array_equal(
+            np.asarray(resp["outputs"][0], np.int32), gold
+        )
+        assert resp["stats"]["spec_verify_steps"] >= 1
+        assert "spec_accept_rate" in resp["stats"]
+    finally:
+        server.shutdown()
+
+
 def test_engine_serve_profile_hook(ctx4, tmp_path):
     """Engine.serve(profile=...) must capture a decode-loop trace
     (parity: the reference Engine's built-in profiled decode,

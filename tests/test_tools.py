@@ -258,3 +258,21 @@ def test_anchored_spec_and_straggler_model():
     assert static == pytest.approx(3 / 7)  # [2,1,0,...]/7
     # Lag beyond full cover exposes the remainder either way.
     assert estimate_straggler_stall_ms(10.0, 1.0, 8, True) == 3.0
+
+
+def test_perf_scripts_compile():
+    """Every perf/ script must at least byte-compile (tier-1 guard: the
+    bench harnesses are run ad-hoc on relay windows, so a syntax error
+    would otherwise surface only when a window is burning)."""
+    import os
+    import subprocess
+    import sys
+
+    perf_dir = os.path.join(os.path.dirname(__file__), "..", "perf")
+    proc = subprocess.run(
+        [sys.executable, "-m", "compileall", "-q", "-f", perf_dir],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, (
+        f"perf/ scripts failed to compile:\n{proc.stdout}\n{proc.stderr}"
+    )

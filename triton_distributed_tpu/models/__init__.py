@@ -22,6 +22,10 @@ from triton_distributed_tpu.models.kv_cache import KVCache, init_cache  # noqa: 
 from triton_distributed_tpu.models.prefix_cache import (  # noqa: F401
     PrefixCache,
 )
+from triton_distributed_tpu.models.speculative import (  # noqa: F401
+    NGramDraft,
+    SpecState,
+)
 from triton_distributed_tpu.models.qwen import (  # noqa: F401
     Qwen3,
     Qwen3Params,

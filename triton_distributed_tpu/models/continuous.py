@@ -47,6 +47,7 @@ from triton_distributed_tpu.models.engine import (
 from triton_distributed_tpu.models.paged_kv_cache import (
     copy_page,
     init_paged_cache,
+    truncate_pages,
     write_prefill,
 )
 from triton_distributed_tpu.models.prefix_cache import (
@@ -60,16 +61,27 @@ from triton_distributed_tpu.runtime.profiling import trace_span
 
 @dataclasses.dataclass
 class Request:
-    """One generation request and its accumulated output."""
+    """One generation request and its accumulated output.
+
+    ``temperature``/``top_p``/``top_k`` override the engine's defaults
+    for THIS request (None → engine default) — mixed greedy/sampled
+    batches decode together, each slot sampled under its own knobs.
+    """
 
     prompt: np.ndarray  # [S] int32
     gen_len: int
+    temperature: float | None = None
+    top_p: float | None = None
+    top_k: int | None = None
     out: list[int] = dataclasses.field(default_factory=list)
     slot: int | None = None
     pages: list[int] = dataclasses.field(default_factory=list)
     # Prefix-cache bookkeeping: tree nodes whose pages lead this
     # request's page list (refcounted for the request's lifetime).
     shared_nodes: list = dataclasses.field(default_factory=list)
+    # Speculative-decoding state (``SpecState``), attached at admission
+    # when the engine runs with ``speculative=K``.
+    spec: object | None = None
 
     @property
     def done(self) -> bool:
@@ -96,16 +108,30 @@ class ContinuousEngine(MegaDispatch):
         num_pages: int | None = None,
         mode: str = "xla",  # Mode or "mega" (megakernel decode)
         temperature: float = 0.0,
+        top_p: float = 1.0,
+        top_k: int = 0,
         eos_id: int | None = None,
         seed: int = 0,
         mega_cfg=None,
         prefix_cache: bool = False,
         prefill_chunk: int = 0,
+        speculative: int = 0,
     ):
         self.model = model
         self.mode = mode
         self.mega_cfg = mega_cfg
         self.temperature = temperature
+        self.top_p = top_p
+        self.top_k = top_k
+        # Speculative decoding (docs/serving.md): per-slot n-gram
+        # drafts verified through the chunk-prefill path; rounds with
+        # no draft anywhere fall back to the batched decode step.
+        if speculative and mode == "mega":
+            raise ValueError(
+                "speculative=K composes with mode='xla'/'pallas', not "
+                "the megakernel"
+            )
+        self.speculative = int(speculative)
         self.eos_id = eos_id
         self.key = jax.random.key(seed)
         self.max_batch = max_batch
@@ -147,18 +173,34 @@ class ContinuousEngine(MegaDispatch):
             "prefix_hit_tokens": 0,
             "pages_cow_copied": 0,
             "admission_stalls": 0,
+            "spec_verify_steps": 0,
+            "spec_draft_tokens": 0,
+            "spec_accepted_tokens": 0,
+            "spec_rollback_tokens": 0,
         }
 
     @property
     def last_stats(self) -> dict:
         """Serving counters (parity: ``Engine.last_stats``): admission /
-        prefill work done, prefix-cache reuse, COW copies, stalls."""
+        prefill work done, prefix-cache reuse, COW copies, stalls, and
+        the speculative accept/rollback ledger."""
         stats = dict(self.stats)
         stats["free_pages"] = len(self.pool.free)
         if self.prefix is not None:
             stats["prefix_cache"] = dict(self.prefix.stats)
             stats["prefix_hit_rate"] = self.prefix.hit_rate
             stats["tree_pages"] = self.prefix.node_count
+        if self.speculative:
+            stats["spec_accept_rate"] = (
+                stats["spec_accepted_tokens"]
+                / max(stats["spec_draft_tokens"], 1)
+            )
+            # Target forwards actually paid for decode: batched steps
+            # plus per-slot verify chunks (mega's NS-per-launch counting
+            # never mixes in — speculative excludes mega at the ctor).
+            stats["target_steps"] = (
+                stats["decode_steps"] + stats["spec_verify_steps"]
+            )
         return stats
 
     # -- slot management -------------------------------------------------
@@ -198,7 +240,7 @@ class ContinuousEngine(MegaDispatch):
         self.stats["admitted"] += 1
         self.stats["prefill_tokens"] += s
         self._slots[slot] = req
-        return self._sample(logits)[0]
+        return self._sample_req(req, logits[0])
 
     def _admit_prefix(
         self, req: Request, slot: int, m: PrefixMatch
@@ -231,7 +273,7 @@ class ContinuousEngine(MegaDispatch):
         ):
             logits = self._prefill_suffix(slot, req.prompt, matched)
         self._slots[slot] = req
-        return self._sample(logits[None])[0]
+        return self._sample_req(req, logits)
 
     def _prefill_suffix(self, slot: int, prompt: np.ndarray, start: int):
         """Chunk-prefill ``prompt[start:]`` into ``slot``'s pages,
@@ -274,7 +316,7 @@ class ContinuousEngine(MegaDispatch):
         )
         self._kv_len += active
         self.stats["decode_steps"] += 1
-        nxt = np.asarray(self._sample(logits))
+        nxt = self._sample_slots(logits)
         return self._process(lambda slot: [nxt[slot]])
 
     def _process(self, slot_tokens) -> bool:
@@ -287,6 +329,8 @@ class ContinuousEngine(MegaDispatch):
             for t in slot_tokens(slot):
                 req.out.append(int(t))
                 self._tok[slot] = int(t)
+                if req.spec is not None:
+                    req.spec.observe((int(t),))
                 if self._maybe_finish(req, int(t)):
                     changed = True
                     break
@@ -297,7 +341,11 @@ class ContinuousEngine(MegaDispatch):
         if self.prefix is not None:
             self._retire_to_prefix(req)
         else:
-            self.pool.release(req.pages)
+            # Full truncation: every private page goes back to the pool
+            # (the 0-token case of the speculative rollback helper).
+            req.pages = truncate_pages(
+                self.pool, req.pages, 0, self.page_size
+            )
         self._table[slot] = 0  # back to the trash page
         self._kv_len[slot] = 0
         req.pages, req.slot = [], None
@@ -318,14 +366,105 @@ class ContinuousEngine(MegaDispatch):
             self.prefix.retire_sequence(toks, req.pages, req.shared_nodes)
         req.shared_nodes = []
 
-    def _sample(self, logits: jax.Array) -> jax.Array:
-        if self.temperature <= 0.0:
-            return sampling.greedy(logits)
+    def _request_sampling(self, req: Request) -> tuple[float, float, int]:
+        """Resolve a request's effective (temperature, top_p, top_k):
+        per-request overrides beat the engine defaults."""
+        t = self.temperature if req.temperature is None else req.temperature
+        p = self.top_p if req.top_p is None else req.top_p
+        k = self.top_k if req.top_k is None else req.top_k
+        return float(t), float(p), int(k)
+
+    def _sample_req(self, req: Request, logits: jax.Array) -> int:
+        """Sample one token for ``req`` from ``logits [V]`` under its
+        effective knobs."""
+        t, p, k = self._request_sampling(req)
+        if t <= 0.0:
+            return int(sampling.greedy(logits))
         self.key, sub = jax.random.split(self.key)
-        return sampling.sample(logits, sub, self.temperature, 1.0)
+        return int(sampling.sample(logits, sub, t, p, k))
+
+    def _sample_slots(self, logits: jax.Array) -> np.ndarray:
+        """Per-slot sampling of a batched ``[max_batch, V]`` decode
+        output. All-greedy batches stay one batched argmax; slots with
+        ``temperature > 0`` each draw under their own knobs."""
+        toks = np.array(sampling.greedy(logits))
+        for slot, req in enumerate(self._slots):
+            if req is None:
+                continue
+            t, p, k = self._request_sampling(req)
+            if t <= 0.0:
+                continue
+            self.key, sub = jax.random.split(self.key)
+            toks[slot] = int(sampling.sample(logits[slot], sub, t, p, k))
+        return toks
 
     def _needed_pages(self, prompt_len: int, gen_len: int) -> int:
         return -(-(prompt_len + gen_len) // self.page_size)
+
+    # -- speculative decoding ---------------------------------------------
+
+    def _plan_drafts(self):
+        """Propose a draft for every active slot. Returns
+        ``(drafts, ok)``; ``ok=False`` when some slot is too close to
+        ``max_length`` for even a zero-draft verify chunk (its pad rows
+        would run past the page table) — that round must use the
+        batched single-step decode instead."""
+        from triton_distributed_tpu.models.speculative import cap_draft
+
+        drafts: dict[int, list[int]] = {}
+        for slot, req in enumerate(self._slots):
+            if req is None:
+                continue
+            budget = req.gen_len - len(req.out)
+            k = cap_draft(
+                req.spec.k, int(self._kv_len[slot]), budget, self.max_length
+            )
+            if k < 0:
+                return {}, False
+            drafts[slot] = req.spec.propose(k) if k > 0 else []
+        return drafts, True
+
+    def _spec_round(self, drafts: dict[int, list[int]]) -> bool:
+        """One speculative round: every slot in ``drafts`` verifies its
+        draft in a single chunked forward, rolls rejected KV back (the
+        host-authoritative kv_len resync IS the rollback), and appends
+        ``accepted + 1`` tokens. Slots WITHOUT an entry are untouched —
+        on a mixed round the caller advances them (and the verified
+        slots, one more token) through the ordinary batched decode
+        step. Returns whether slot state changed."""
+        from triton_distributed_tpu.models.speculative import (
+            spec_verify_slot,
+        )
+
+        bursts: dict[int, list[int]] = {}
+        rolled_total = 0
+        for slot, req in enumerate(self._slots):
+            if req is None or slot not in drafts:
+                continue
+            kv = int(self._kv_len[slot])
+            draft = drafts[slot]
+            t, p, k = self._request_sampling(req)
+            emitted, self.cache, a, self.key = spec_verify_slot(
+                self.model, self.cache, slot, int(self._tok[slot]), draft,
+                kv, self._prefill_mode, key=self.key, temperature=t,
+                top_p=p, top_k=k,
+            )
+            req.spec.record(len(draft), a)
+            self.stats["spec_verify_steps"] += 1
+            self.stats["spec_draft_tokens"] += len(draft)
+            self.stats["spec_accepted_tokens"] += a
+            self.stats["spec_rollback_tokens"] += len(draft) - a
+            rolled_total += len(draft) - a
+            self._kv_len[slot] = kv + a + 1
+            bursts[slot] = emitted
+        changed = self._process(lambda slot: bursts.get(slot, []))
+        # Every verify left the device kv_len at the chunk's end
+        # (accepted + rejected rows); resyncing the host table rolls the
+        # rejected tail back and drops any evicted slot's pages in one
+        # write.
+        with trace_span("spec:rollback", tokens=rolled_total):
+            self._sync_tables()
+        return changed
 
     def _maybe_finish(self, req: Request, t: int) -> bool:
         """Evict ``req`` if token ``t`` completed it (gen_len or eos)."""
@@ -336,10 +475,16 @@ class ContinuousEngine(MegaDispatch):
 
     # -- the loop --------------------------------------------------------
 
-    def run(self, requests: list[tuple[np.ndarray, int]]) -> list[np.ndarray]:
-        """Serve ``(prompt, gen_len)`` requests to completion; returns
-        each request's generated tokens (prompt excluded), in order."""
-        reqs = [Request(np.asarray(p, np.int32), g) for p, g in requests]
+    def run(self, requests) -> list[np.ndarray]:
+        """Serve requests to completion; returns each request's
+        generated tokens (prompt excluded), in order. Each entry is a
+        ``(prompt, gen_len)`` tuple or a :class:`Request` (the server
+        builds Requests to carry per-request sampling knobs)."""
+        reqs = [
+            r if isinstance(r, Request)
+            else Request(np.asarray(r[0], np.int32), int(r[1]))
+            for r in requests
+        ]
         for r in reqs:
             total = len(r.prompt) + r.gen_len
             if total > self.max_length:
@@ -385,6 +530,14 @@ class ContinuousEngine(MegaDispatch):
                                 break  # head-of-line waits for pages
                         req = queue.popleft()
                         first = self._admit(req, slot, m)
+                        if self.speculative:
+                            from triton_distributed_tpu.models.speculative import (  # noqa: E501
+                                SpecState,
+                            )
+
+                            req.spec = SpecState(self.speculative)
+                            req.spec.observe(req.prompt)
+                            req.spec.observe((int(first),))
                         req.out.append(int(first))
                         self._tok[slot] = int(first)
                         admitted = progress = True
@@ -417,7 +570,24 @@ class ContinuousEngine(MegaDispatch):
                 [r is not None for r in self._slots], np.int32
             )
             kv_high = int((self._kv_len * active).max())
-            if use_multi and kv_high + NS <= self.max_length:
+            if self.speculative:
+                # Per-slot verify chunks ONLY for slots that drafted;
+                # undraftable slots (or an all-empty plan, or a slot
+                # too near max_length for a padded chunk) ride the ONE
+                # batched decode step — a mixed round costs
+                # 1 + |drafted| forwards, never per-slot chunks for the
+                # no-match majority, so speculation never makes the
+                # no-match case slower than plain serving.
+                drafts, ok = self._plan_drafts()
+                drafted = {s: d for s, d in drafts.items() if d} if ok \
+                    else {}
+                n_active = sum(r is not None for r in self._slots)
+                changed = False
+                if drafted:
+                    changed = self._spec_round(drafted)
+                if not ok or len(drafted) < n_active:
+                    changed = self._decode_once() or changed
+            elif use_multi and kv_high + NS <= self.max_length:
                 if multi_fn is None:
                     multi_fn = self._mega_model().decode_multi_fn(
                         self.max_batch, self.max_length, NS,

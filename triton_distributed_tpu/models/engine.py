@@ -70,6 +70,7 @@ def prefill_suffix_chunks(
     return the cache to keep threading. Returns
     ``(last-token logits [V], cache, chunks_run)``.
     """
+    from triton_distributed_tpu.models.paged_kv_cache import gather_bucket
     from triton_distributed_tpu.models.prefix_cache import round_chunk
     from triton_distributed_tpu.runtime.profiling import trace_span
 
@@ -86,9 +87,7 @@ def prefill_suffix_chunks(
         # (padded) position, rounded to a power of two so at most
         # log2(pages_per_seq) programs compile per chunk width — a short
         # suffix never gathers the full max_length KV view.
-        need = -(-(off + c) // page)
-        kv_pages = min(1 << max(need - 1, 0).bit_length()
-                       if need > 1 else 1, pps)
+        kv_pages = gather_bucket(off + c, page, pps)
         with trace_span("prefix_cache:chunk", slot=slot, offset=off,
                         take=take):
             logits, cache = model.prefill_paged_chunk(
@@ -141,6 +140,7 @@ class Engine(MegaDispatch):
         *,
         temperature: float = 0.0,
         top_p: float = 1.0,
+        top_k: int = 0,
         mode: EngineMode = "xla",
         verbose: bool = False,
         seed: int = 0,
@@ -149,10 +149,12 @@ class Engine(MegaDispatch):
         mega_cfg=None,
         prefix_cache: bool = False,
         prefill_chunk: int = 0,
+        speculative: int = 0,
     ):
         self.model = model
         self.temperature = temperature
         self.top_p = top_p
+        self.top_k = top_k
         self.mode = mode
         self.mega_cfg = mega_cfg
         self.verbose = verbose
@@ -174,6 +176,25 @@ class Engine(MegaDispatch):
             )
         self.prefix_cache = prefix_cache
         self.prefill_chunk = prefill_chunk
+        # Speculative decoding (docs/serving.md): draft up to
+        # ``speculative`` tokens per row from its own n-gram history and
+        # verify them in ONE chunked paged-prefill forward; rejected
+        # tokens roll the KV back. Needs the paged cache (verify chunks
+        # run over the page pool) and the model decode paths (the
+        # megakernel's multi-step launch already amortizes what
+        # speculation would).
+        if speculative:
+            if not paged:
+                raise ValueError(
+                    "speculative=K requires paged=True (verify chunks "
+                    "run through the paged chunk-prefill path)"
+                )
+            if mode == "mega":
+                raise ValueError(
+                    "speculative=K composes with mode='xla'/'pallas', "
+                    "not the megakernel"
+                )
+        self.speculative = int(speculative)
         self._prefix_state: _PrefixState | None = None
         # Page-pool free list, populated by the first paged serve();
         # continuous-batching admission/eviction draws from it.
@@ -187,7 +208,9 @@ class Engine(MegaDispatch):
         if self.temperature <= 0.0:
             return sampling.greedy(logits)
         self.key, sub = jax.random.split(self.key)
-        return sampling.sample(logits, sub, self.temperature, self.top_p)
+        return sampling.sample(
+            logits, sub, self.temperature, self.top_p, self.top_k
+        )
 
     def serve(
         self,
@@ -253,6 +276,23 @@ class Engine(MegaDispatch):
                 f"({gen_len}) exceeds max_length={max_length}; raise "
                 f"max_length or shorten"
             )
+        if self.speculative and gen_len > 1:
+            from triton_distributed_tpu.models.prefix_cache import round_chunk
+
+            # Every verify chunk pads to round_chunk(·) ≥ 16 and its pad
+            # rows write KV too — the furthest row's final chunk must
+            # still fit under max_length or the page table runs out of
+            # entries mid-verify (there is no batched fallback inside
+            # the per-row speculative loop).
+            pad = round_chunk(1)
+            if int(true_lens.max()) + gen_len - 2 + pad > max_length:
+                raise ValueError(
+                    f"speculative serve pads verify chunks to {pad} "
+                    f"tokens; longest prompt ({int(true_lens.max())}) + "
+                    f"gen_len ({gen_len}) + {pad - 1} exceeds "
+                    f"max_length={max_length} — raise max_length or "
+                    f"shorten"
+                )
         row_meta = None
         if self.paged and self.prefix_cache:
             logits, cache, row_meta = self._prefix_prefill(
@@ -309,20 +349,31 @@ class Engine(MegaDispatch):
         kv_high = int(true_lens.max())
         # Sampling composes with multi-step via the Gumbel-max trick
         # (argmax over logits + T*gumbel == categorical(logits/T)) as
-        # long as no top-p filter truncates the distribution.
+        # long as no top-p/top-k filter truncates the distribution.
         # Sampled+paged is the one uncovered combination.
         sampled = self.temperature > 0.0
         multi_launches = 0
         if (
             self.mode == "mega"
-            and (not sampled or (self.top_p >= 1.0 and not self.paged))
+            and not self.speculative
+            and (
+                not sampled
+                or (self.top_p >= 1.0 and self.top_k == 0 and not self.paged)
+            )
         ):
             multi_launches = min(
                 (gen_len - 1) // NS, max(s_max - kv_high, 0) // NS
             )
         t0 = time.perf_counter()
+        spec_counters = None
         with group_profile(profile, do_prof=profile is not None):
-            left = gen_len - 1
+            if self.speculative and gen_len > 1:
+                tail, cache, spec_counters = self._spec_decode(
+                    cache, np.asarray(tok), rows, true_lens, gen_len,
+                    max_length,
+                )
+                out.append(tail)
+            left = 0 if self.speculative else gen_len - 1
             if multi_launches:
                 # Multi-step fast path: NS steps per kernel launch
                 # (in-kernel argmax — Gumbel-perturbed when sampling),
@@ -385,6 +436,8 @@ class Engine(MegaDispatch):
             ),
             "tokens_per_s": b * max(gen_len - 1, 1) / max(t_decode, 1e-9),
         }
+        if spec_counters is not None:
+            self.last_stats.update(spec_counters)
         if row_meta is not None:
             self._prefix_retire(
                 result, rows, true_lens, gen_len, cache, row_meta
@@ -392,6 +445,122 @@ class Engine(MegaDispatch):
         if self.verbose:
             print(f"[engine] {self.last_stats}")
         return result
+
+    # -- speculative decode ------------------------------------------------
+
+    def _spec_decode(self, cache, first_toks, rows, true_lens, gen_len,
+                     max_length):
+        """Per-row speculative decode over the paged cache: each row
+        drafts from its own n-gram history, verifies the draft in one
+        chunked forward (``spec_verify_slot``), and rolls rejected KV
+        back (``rollback_kv``). Rows advance at their own pace — a row
+        with a hot draft emits K+1 tokens per target step while a
+        chaotic row emits 1 — until every row holds ``gen_len`` tokens.
+        Returns ``(tail [b, gen_len-1], cache, counters)`` where tail
+        excludes the prefill-sampled first token (already appended by
+        ``serve``)."""
+        from triton_distributed_tpu.models.paged_kv_cache import rollback_kv
+        from triton_distributed_tpu.models.speculative import (
+            SpecState,
+            cap_draft,
+            spec_verify_slot,
+        )
+        from triton_distributed_tpu.runtime.profiling import trace_span
+
+        b = len(first_toks)
+        kv = true_lens.astype(np.int64).copy()
+        outs, states = [], []
+        for i in range(b):
+            st = SpecState(self.speculative)
+            st.observe(rows[i][: int(true_lens[i])])
+            st.observe([int(first_toks[i])])
+            states.append(st)
+            outs.append([int(first_toks[i])])
+        counters = {
+            "spec_verify_steps": 0,
+            "spec_decode_steps": 0,
+            "spec_draft_tokens": 0,
+            "spec_accepted_tokens": 0,
+            "spec_rollback_tokens": 0,
+        }
+
+        def verify_row(i, draft, cache):
+            emitted, cache, a, self.key = spec_verify_slot(
+                self.model, cache, i, outs[i][-1], draft, int(kv[i]),
+                self._prefill_mode, key=self.key,
+                temperature=self.temperature, top_p=self.top_p,
+                top_k=self.top_k,
+            )
+            counters["spec_verify_steps"] += 1
+            counters["spec_draft_tokens"] += len(draft)
+            counters["spec_accepted_tokens"] += a
+            states[i].record(len(draft), a)
+            new_kv = int(kv[i]) + a + 1
+            if a < len(draft):
+                counters["spec_rollback_tokens"] += len(draft) - a
+                with trace_span("spec:rollback", slot=i,
+                                tokens=len(draft) - a):
+                    cache = rollback_kv(cache, i, new_kv)
+            kv[i] = new_kv
+            states[i].observe(emitted)
+            outs[i].extend(emitted)
+            return cache
+
+        while True:
+            live = [i for i in range(b) if len(outs[i]) < gen_len]
+            if not live:
+                break
+            drafts = {}
+            for i in live:
+                budget = gen_len - len(outs[i])
+                k = cap_draft(
+                    states[i].k, int(kv[i]), budget, max_length
+                )
+                assert k >= 0, "speculative capacity guard violated"
+                d = states[i].propose(k) if k > 0 else []
+                if d:
+                    drafts[i] = d
+            for i, draft in drafts.items():
+                cache = verify_row(i, draft, cache)
+            undrafted = [i for i in live if i not in drafts]
+            if not undrafted:
+                continue
+            if all(len(o) < gen_len for o in outs):
+                # Undraftable rows share ONE batched decode step (the
+                # rollback left every row's device kv_len exact, so the
+                # per-row appends land right even though rows are
+                # desynced); just-verified rows simply advance one more
+                # token. This keeps the no-match case as cheap as plain
+                # serving instead of paying per-row padded chunks.
+                pending = jnp.asarray(
+                    [o[-1] for o in outs], jnp.int32
+                )
+                logits, cache = self._decode_step(pending, cache)
+                toks = np.asarray(self._sample(logits))
+                counters["spec_decode_steps"] += 1
+                for i in range(b):
+                    t = int(toks[i])
+                    outs[i].append(t)
+                    states[i].observe((t,))
+                    kv[i] += 1
+            else:
+                # Some row already finished: a batched step would append
+                # KV past its budgeted pages, so the stragglers step
+                # through zero-draft verify chunks instead.
+                for i in undrafted:
+                    cache = verify_row(i, [], cache)
+        counters["spec_accept_rate"] = (
+            counters["spec_accepted_tokens"]
+            / max(counters["spec_draft_tokens"], 1)
+        )
+        counters["target_steps"] = (
+            counters["spec_verify_steps"] + counters["spec_decode_steps"]
+        )
+        counters["spec_tokens_per_step"] = (
+            b * (gen_len - 1) / max(counters["target_steps"], 1)
+        )
+        tail = np.asarray([o[1:] for o in outs], np.int32)
+        return tail, cache, counters
 
     # -- prefix-cache paged serving ---------------------------------------
 

@@ -103,6 +103,76 @@ def init_paged_cache(
     return cache, pool
 
 
+def gather_bucket(end_pos: int, page_size: int, pages_per_seq: int) -> int:
+    """Page-table gather width for a chunk program whose queries/writes
+    end at position ``end_pos``: enough table entries to cover it,
+    rounded up to a power of two so at most log2(pages_per_seq)
+    programs compile per chunk width. Shared by the prefix-cache suffix
+    prefill and the speculative verify chunks — one definition, one
+    program-key convention."""
+    need = -(-int(end_pos) // page_size)
+    if need <= 1:
+        return 1
+    return min(1 << max(need - 1, 0).bit_length(), pages_per_seq)
+
+
+def rollback_kv(cache: PagedKVCache, slot, new_len) -> PagedKVCache:
+    """Truncate ``slot``'s cached length to ``new_len`` (speculative
+    decoding's KV rollback: a verify chunk wrote K+1 rows, acceptance
+    kept a prefix, and every row past the accepted length becomes
+    ordinary garbage-beyond-kv_len — masked by causality, overwritten
+    by the next append). The page table is untouched: rejected rows
+    live in pages the sequence still owns, so truncation is a length
+    write, never an allocator round trip. ``slot``/``new_len`` are
+    traced — one compiled program serves every rollback."""
+    return dataclasses.replace(
+        cache,
+        kv_len=_set_len_jit(
+            cache.kv_len,
+            jnp.asarray(slot, jnp.int32),
+            jnp.asarray(new_len, jnp.int32),
+        ),
+    )
+
+
+# Donated: rollback runs once per rejected verify chunk — an eager
+# .at[].set would copy the (small) kv_len array but break the cache
+# threading discipline every other cache op follows.
+_set_len_jit = jax.jit(
+    lambda kv_len, slot, n: kv_len.at[slot].set(n), donate_argnums=(0,)
+)
+
+
+def truncate_pages(
+    pool: PagePool,
+    pages: list[int],
+    keep_tokens: int,
+    page_size: int,
+    *,
+    shared: int = 0,
+) -> list[int]:
+    """Release every page of ``pages`` lying wholly past ``keep_tokens``
+    cached tokens back to ``pool``; returns the retained prefix.
+
+    The first ``shared`` entries are prefix-cache-shared (mapped by
+    refcount, owned by the radix tree) and are NEVER freed here
+    regardless of ``keep_tokens`` — releasing them would double-free a
+    page another sequence still attends. No-ops safely at page
+    boundaries: ``keep_tokens`` landing exactly on a boundary keeps
+    ``keep_tokens / page_size`` pages, and ``keep_tokens`` beyond the
+    page list keeps everything.
+    """
+    if shared < 0 or shared > len(pages):
+        raise ValueError(
+            f"shared={shared} out of range for {len(pages)} pages"
+        )
+    keep = max(-(-max(int(keep_tokens), 0) // page_size), shared)
+    if keep >= len(pages):
+        return pages
+    pool.release(pages[keep:])
+    return pages[:keep]
+
+
 def paged_cache_specs(axis: str = "tp"):
     """shard_map PartitionSpecs matching :func:`init_paged_cache`."""
     from jax.sharding import PartitionSpec as P

@@ -363,6 +363,7 @@ class Qwen3:
     def _prefill_chunk_shard(
         self, params, tokens, cache, slot, q_offset, new_len, last_idx,
         *, mode: Mode, kv_pages: int | None = None,
+        all_logits: bool = False,
     ):
         """Chunked-prefill one slot of a :class:`PagedKVCache`, per-shard.
 
@@ -398,8 +399,13 @@ class Qwen3:
             layer_fn, x, (params.layers, cache.k_pages, cache.v_pages)
         )
         x = rms_norm(x, params.norm, cfg.rms_eps)
-        x_last = jnp.take(x, last_idx, axis=0)
-        logits = self._logits(params, x_last[None])[0]
+        if all_logits:
+            # Per-position logits [C, V] — the speculative verifier
+            # scores every drafted token from ONE chunk forward.
+            logits = self._logits(params, x)
+        else:
+            x_last = jnp.take(x, last_idx, axis=0)
+            logits = self._logits(params, x_last[None])[0]
         from triton_distributed_tpu.models.paged_kv_cache import PagedKVCache
 
         return logits, PagedKVCache(
@@ -417,22 +423,25 @@ class Qwen3:
         cache,           # PagedKVCache
         mode: Mode = "xla",
         kv_pages: int | None = None,
+        all_logits: bool = False,
     ):
         """Jitted chunked prefill of ``slot``'s suffix over the paged
         pool — the prefix-cache data plane: matched prefix pages are
         attended, only the chunk is computed. Keyed on chunk width and
         the ``kv_pages`` gather bucket only (offset/slot/lengths are
         traced), so a handful of compiled programs serve every
-        admission. Returns ``(last_idx logits [V], cache)``."""
+        admission. Returns ``(last_idx logits [V], cache)`` — or
+        ``(per-position logits [C, V], cache)`` with ``all_logits=True``
+        (the speculative verify path scores every chunk position)."""
         from triton_distributed_tpu.models.paged_kv_cache import (
             paged_cache_specs,
         )
 
-        key = ("chunk", mode, int(tokens.shape[0]), kv_pages)
+        key = ("chunk", mode, int(tokens.shape[0]), kv_pages, all_logits)
         if key not in self._prefill_jit:
             f = self.ctx.shard_map(
                 functools.partial(self._prefill_chunk_shard, mode=mode,
-                                  kv_pages=kv_pages),
+                                  kv_pages=kv_pages, all_logits=all_logits),
                 in_specs=(
                     self.param_specs, P(), paged_cache_specs(self.axis),
                     P(), P(), P(), P(),

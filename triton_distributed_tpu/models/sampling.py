@@ -1,5 +1,13 @@
 """Token sampling (parity: reference ``models/utils.py`` sampling helpers
-— greedy, temperature, top-p nucleus)."""
+— greedy, temperature, top-k, top-p nucleus).
+
+``filter_logits`` is the ONE definition of the post-processing chain
+(temperature → top-k → top-p): ``sample`` draws a categorical over it,
+and ``target_probs`` exposes the same filtered distribution as explicit
+probabilities — the speculative verifier's acceptance test must score
+draft tokens against EXACTLY the distribution ``sample`` draws from, or
+rejection sampling stops being distribution-preserving.
+"""
 
 from __future__ import annotations
 
@@ -12,16 +20,22 @@ def greedy(logits: jax.Array) -> jax.Array:
     return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
 
-def sample(
+def filter_logits(
     logits: jax.Array,
-    key: jax.Array,
     temperature: float = 1.0,
     top_p: float = 1.0,
+    top_k: int = 0,
 ) -> jax.Array:
-    """Temperature + nucleus sampling. ``temperature<=0`` → greedy."""
-    if temperature <= 0.0:
-        return greedy(logits)
+    """Temperature-scale then mask ``logits [..., V]`` to the sampled
+    support: tokens outside the top-k / nucleus go to ``-inf``.
+    ``top_k=0`` disables the top-k filter; ties at the k-th value all
+    survive (standard threshold semantics). Requires ``temperature > 0``
+    (greedy is a separate path, not a limit of this one)."""
     logits = logits.astype(jnp.float32) / temperature
+    v = logits.shape[-1]
+    if top_k and 0 < top_k < v:
+        kth = jnp.sort(logits, axis=-1)[..., v - top_k, None]
+        logits = jnp.where(logits >= kth, logits, -jnp.inf)
     if top_p < 1.0:
         sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
         probs = jax.nn.softmax(sorted_logits, axis=-1)
@@ -33,4 +47,37 @@ def sample(
             jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
         )
         logits = jnp.where(logits >= cutoff, logits, -jnp.inf)
-    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+    return logits
+
+
+def sample(
+    logits: jax.Array,
+    key: jax.Array,
+    temperature: float = 1.0,
+    top_p: float = 1.0,
+    top_k: int = 0,
+) -> jax.Array:
+    """Temperature + top-k + nucleus sampling. ``temperature<=0`` →
+    greedy."""
+    if temperature <= 0.0:
+        return greedy(logits)
+    filtered = filter_logits(logits, temperature, top_p, top_k)
+    return jax.random.categorical(key, filtered, axis=-1).astype(jnp.int32)
+
+
+def target_probs(
+    logits: jax.Array,
+    temperature: float = 1.0,
+    top_p: float = 1.0,
+    top_k: int = 0,
+) -> jax.Array:
+    """The exact distribution :func:`sample` draws from, as
+    probabilities ``[..., V]`` (speculative decoding scores draft tokens
+    against this). ``temperature<=0`` → one-hot at the argmax."""
+    if temperature <= 0.0:
+        return jax.nn.one_hot(
+            jnp.argmax(logits, axis=-1), logits.shape[-1], dtype=jnp.float32
+        )
+    return jax.nn.softmax(
+        filter_logits(logits, temperature, top_p, top_k), axis=-1
+    )

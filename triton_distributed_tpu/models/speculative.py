@@ -1,0 +1,296 @@
+"""Self-drafting speculative decoding: n-gram draft + one-forward verify.
+
+Steady-state decode pays one full target forward per emitted token —
+the serial bottleneck the paper's philosophy (hide latency behind work
+already in flight, arXiv:2504.19442) says to amortize. Speculative
+decoding does exactly that on the sequential-decode axis: draft K cheap
+candidate tokens, score ALL of them in ONE target forward through the
+existing chunked paged-prefill path (``Qwen3.prefill_paged_chunk``
+returns per-position logits with ``all_logits=True``), accept the
+longest correct prefix, and roll the KV back past the rejection point
+(``paged_kv_cache.rollback_kv``). One target step then emits
+``accepted + 1`` tokens instead of one.
+
+**Self-drafting**: the drafter is a prompt-lookup n-gram table over the
+request's OWN prompt + generated tokens (the PLD / lookahead-free
+idea) — no second model, no extra weights, runs anywhere the engine
+runs. Repetitive and structured traffic (code, templated answers,
+retrieval-heavy prompts, greedy cycles) drafts extremely well; chaotic
+text degrades gracefully to K=0, i.e. plain decode.
+
+**Exactness**: greedy acceptance compares each draft against the
+argmax of the target logits at its position — output is bit-identical
+to non-speculative greedy decode. For ``temperature>0`` the acceptance
+is the standard rejection-sampling rule specialized to a deterministic
+(delta) proposal: accept draft ``d`` with probability ``p(d)`` under
+the FILTERED target distribution (``sampling.target_probs`` — the very
+distribution ``sampling.sample`` draws from), and on rejection sample
+from the residual ``p`` with ``d`` zeroed, renormalized. The emitted
+distribution is exactly the target's (tests carry the statistical
+proof).
+
+**Acceptance bookkeeping** (the T3-style tracking/trigger discipline,
+arXiv:2401.16677): per-slot ``SpecState`` counts proposed/accepted and
+adapts K — additive growth on full acceptance, multiplicative back-off
+on any rejection — so a slot whose traffic stops drafting well stops
+paying verify overhead.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from triton_distributed_tpu.models import sampling
+from triton_distributed_tpu.models.paged_kv_cache import gather_bucket
+from triton_distributed_tpu.models.prefix_cache import round_chunk
+from triton_distributed_tpu.runtime.profiling import trace_span
+
+
+class NGramDraft:
+    """Prompt-lookup drafter: an n-gram table over one request's token
+    history (prompt + every emitted token).
+
+    For each n in ``[min_ngram, max_ngram]`` the table maps every
+    n-gram to its two most recent end positions. Drafting takes the
+    history's tail n-gram (longest n first), finds its PREVIOUS
+    occurrence, and proposes the tokens that followed it — the
+    continuation the sequence used last time it was here.
+    """
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1):
+        if not 1 <= min_ngram <= max_ngram:
+            raise ValueError(
+                f"need 1 <= min_ngram <= max_ngram, got "
+                f"[{min_ngram}, {max_ngram}]"
+            )
+        self.history: list[int] = []
+        # n → {ngram tuple: (latest end pos, previous end pos | None)}
+        self._index: dict[int, dict] = {
+            n: {} for n in range(min_ngram, max_ngram + 1)
+        }
+
+    def observe(self, tokens) -> None:
+        """Append ``tokens`` to the history, updating every n-gram's
+        latest/previous occurrence incrementally (O(ngrams) per
+        token)."""
+        for t in tokens:
+            self.history.append(int(t))
+            end = len(self.history)
+            for n, idx in self._index.items():
+                if end >= n:
+                    key = tuple(self.history[end - n:end])
+                    prev = idx.get(key)
+                    idx[key] = (end, prev[0] if prev is not None else None)
+
+    def propose(self, k: int) -> list[int]:
+        """Up to ``k`` draft tokens continuing the history's tail, from
+        the longest n-gram with a previous occurrence; ``[]`` when
+        nothing matches (the caller decodes normally)."""
+        end = len(self.history)
+        if k <= 0 or end == 0:
+            return []
+        for n in sorted(self._index, reverse=True):
+            if end < n:
+                continue
+            entry = self._index[n].get(tuple(self.history[end - n:end]))
+            if entry is None:
+                continue
+            # The latest occurrence IS the tail itself; the previous
+            # one (if any) carries the continuation.
+            pos = entry[1] if entry[0] == end else entry[0]
+            if pos is None:
+                continue
+            cont = self.history[pos:pos + k]
+            if cont:
+                return list(cont)
+        return []
+
+
+class SpecState:
+    """Per-slot speculative state: the drafter plus adaptive draft
+    length K and accept/propose counters.
+
+    The K controller tracks ACCEPTED-RUN length rather than classic
+    AIMD: a fully accepted draft grows K by 2 (the run was at least as
+    long as we dared), a rejection resets K to ``accepted + 1`` (next
+    time, dare one past the run we actually got), floored at ``k_min``.
+    A rejected verify still emits one token, so over-drafting costs
+    only the wasted tail compute of one chunk — the controller's job is
+    to bound that waste when acceptance collapses, not to give up
+    drafting on the first miss."""
+
+    def __init__(
+        self,
+        k_max: int,
+        *,
+        k_min: int = 1,
+        max_ngram: int = 3,
+        min_ngram: int = 1,
+    ):
+        self.k_max = max(int(k_max), 1)
+        self.k_min = max(min(int(k_min), self.k_max), 1)
+        self.k = self.k_max
+        self.draft = NGramDraft(max_ngram, min_ngram)
+        self.proposed = 0
+        self.accepted = 0
+
+    def observe(self, tokens) -> None:
+        self.draft.observe(tokens)
+
+    def propose(self, budget: int) -> list[int]:
+        """Draft up to ``min(current K, budget)`` tokens."""
+        return self.draft.propose(min(self.k, int(budget)))
+
+    def record(self, proposed: int, accepted: int) -> None:
+        """Fold one verify's outcome into the counters + adaptive K."""
+        self.proposed += proposed
+        self.accepted += accepted
+        if proposed:
+            if accepted == proposed:
+                self.k = min(self.k + 2, self.k_max)
+            else:
+                self.k = min(max(accepted + 1, self.k_min), self.k_max)
+
+    @property
+    def accept_rate(self) -> float:
+        return self.accepted / max(self.proposed, 1)
+
+
+def cap_draft(k: int, kv_len: int, budget: int, max_length: int) -> int:
+    """Largest usable draft length this step: at most ``k``, at most
+    ``budget - 1`` (the verify emits up to ``draft + 1`` tokens — never
+    draft past the generation budget, so verify overshoot can't blow
+    the page capacity the admission guard sized), and small enough that
+    the PADDED chunk (``round_chunk(draft + 1)``) stays under
+    ``max_length`` — pad rows write KV too, and past ``max_length`` the
+    table runs out of entries. Returns ``-1`` when not even a
+    zero-draft chunk fits (the caller must fall back to a plain decode
+    step for this slot)."""
+    k = min(int(k), int(budget) - 1)
+    while k >= 0 and int(kv_len) + round_chunk(k + 1) > int(max_length):
+        k -= 1
+    return k
+
+
+def verify_greedy(logits: np.ndarray, draft: list[int]) -> tuple[int, int]:
+    """Greedy acceptance: ``logits [n+1, V]`` are the target's
+    per-position outputs for inputs ``[pending, d_1..d_n]``. Accept
+    ``d_i`` while it equals the argmax at its position; the token at
+    the first mismatch (or the bonus position after a full accept) is
+    emitted from the target's own argmax — so the emitted stream is
+    exactly what non-speculative greedy decode would produce. Returns
+    ``(accepted, next_token)``."""
+    preds = np.argmax(logits, axis=-1)
+    a = 0
+    while a < len(draft) and int(preds[a]) == int(draft[a]):
+        a += 1
+    return a, int(preds[a])
+
+
+def verify_sampled(
+    logits: np.ndarray,
+    draft: list[int],
+    key: jax.Array,
+    temperature: float,
+    top_p: float = 1.0,
+    top_k: int = 0,
+) -> tuple[int, int, jax.Array]:
+    """Distribution-preserving acceptance for ``temperature > 0``.
+
+    With a deterministic (delta) draft proposal, the rejection-sampling
+    rule collapses to: accept ``d_i`` with probability ``p_i(d_i)``
+    under the filtered target distribution; on rejection, emit a sample
+    of the residual ``p_i`` with ``d_i`` zeroed and renormalized. The
+    marginal of each emitted token is exactly ``p_i`` — speculative
+    sampling changes latency, never the distribution. Returns
+    ``(accepted, next_token, key)``.
+    """
+    n = len(draft)
+    probs = np.asarray(
+        sampling.target_probs(
+            jnp.asarray(logits[: n + 1]), temperature, top_p, top_k
+        )
+    )
+    for i, d in enumerate(draft):
+        d = int(d)
+        key, sub = jax.random.split(key)
+        if float(jax.random.uniform(sub)) < float(probs[i, d]):
+            continue
+        resid = probs[i].astype(np.float64)
+        resid[d] = 0.0
+        total = resid.sum()
+        key, sub = jax.random.split(key)
+        if total <= 0.0:
+            # p(d) was numerically 1 yet the draw rejected — the
+            # residual is empty; the target distribution IS d's
+            # one-hot, so resample it directly.
+            nxt = int(
+                sampling.sample(
+                    jnp.asarray(logits[i]), sub, temperature, top_p, top_k
+                )
+            )
+        else:
+            nxt = int(
+                jax.random.categorical(sub, jnp.log(jnp.asarray(resid / total)))
+            )
+        return i, nxt, key
+    key, sub = jax.random.split(key)
+    bonus = int(
+        sampling.sample(jnp.asarray(logits[n]), sub, temperature, top_p, top_k)
+    )
+    return n, bonus, key
+
+
+def spec_verify_slot(
+    model,
+    cache,
+    slot: int,
+    pending: int,
+    draft: list[int],
+    kv_len: int,
+    mode,
+    *,
+    key: jax.Array | None = None,
+    temperature: float = 0.0,
+    top_p: float = 1.0,
+    top_k: int = 0,
+):
+    """One speculative verify of ``slot``: run ``[pending] + draft``
+    through a single chunked paged-prefill forward (per-position
+    logits), accept a prefix, and return
+    ``(emitted tokens, cache, accepted, key)``.
+
+    The chunk program writes KV for every input row and sets the slot's
+    device ``kv_len`` to ``kv_len + 1 + len(draft)``; the CALLER owns
+    the rollback to ``kv_len + accepted + 1`` (host-authoritative
+    engines resync their tables, the fixed-batch engine calls
+    ``rollback_kv``). Emitted tokens are ``draft[:accepted]`` plus one
+    token from the target's own logits — the correction at the first
+    mismatch, or the bonus token after a full accept — so every verify
+    emits at least one token.
+    """
+    toks = [int(pending)] + [int(d) for d in draft]
+    n = len(toks)
+    c = round_chunk(n)
+    page = int(cache.k_pages.shape[3])
+    pps = int(cache.page_table.shape[1])
+    buf = np.zeros(c, np.int32)
+    buf[:n] = toks
+    kv_pages = gather_bucket(int(kv_len) + c, page, pps)
+    with trace_span("spec:verify", slot=slot, drafted=len(draft),
+                    offset=int(kv_len)):
+        logits, cache = model.prefill_paged_chunk(
+            buf, slot, int(kv_len), int(kv_len) + n, n - 1, cache, mode,
+            kv_pages=kv_pages, all_logits=True,
+        )
+    arr = np.asarray(logits[:n], np.float32)
+    if temperature <= 0.0:
+        accepted, nxt = verify_greedy(arr, draft)
+    else:
+        accepted, nxt, key = verify_sampled(
+            arr, draft, key, temperature, top_p, top_k
+        )
+    emitted = [int(d) for d in draft[:accepted]] + [nxt]
+    return emitted, cache, accepted, key

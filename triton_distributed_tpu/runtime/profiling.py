@@ -32,13 +32,20 @@ def trace_span(name: str, **args):
     """Named host-side span on the jax.profiler timeline.
 
     The serving engines wrap control-plane phases (prefix-cache
-    admission, chunk prefills, evictions) so they land on the same
-    merged trace as the device programs they interleave with. Outside an
+    admission, chunk prefills, evictions, speculative verify/rollback)
+    so they land on the same merged trace as the device programs they
+    interleave with. Arg values outside the profiler's metadata types
+    (ints/strings) are stringified rather than risking the whole span —
+    the speculative path tags spans with float accept rates. Outside an
     active capture the annotation is free; a profiler API mismatch must
     never sink serving, so entry failures degrade to a plain yield
     (body exceptions still propagate)."""
     span = None
     try:
+        args = {
+            k: (v if isinstance(v, (int, str)) else str(v))
+            for k, v in args.items()
+        }
         span = jax.profiler.TraceAnnotation(name, **args)
         span.__enter__()
     except Exception:

@@ -7,11 +7,17 @@ framed protocol. Here the protocol is newline-delimited JSON over TCP:
 
     → {"input_ids": [[...]], "gen_len": 32}
     ← {"output_ids": [[...]], "stats": {...}}
-    → {"requests": [[...], ...], "gen_lens": [4, ...]}   (continuous
-    ← {"outputs": [[...], ...], "stats": {...}}           batching)
+    → {"requests": [[...], ...], "gen_lens": [4, ...],   (continuous
+       "temperatures": [0.8, ...], "top_ps": [...],       batching;
+       "top_ks": [...]}                                   sampling keys
+    ← {"outputs": [[...], ...], "stats": {...}}           optional)
     → {"cmd": "stats"}           ← {"stats": {...}}
     → {"cmd": "ping"}            ← {"ok": true}
     → {"cmd": "shutdown"}        ← {"ok": true}   (server then exits)
+
+The per-request sampling keys are scalars (applied to every request)
+or per-request lists; omitted/null entries fall back to the engine's
+defaults.
 
 One request at a time (the accelerator is serial anyway — the reference
 server is likewise single-stream). A ``requests`` payload routes to a
@@ -69,9 +75,32 @@ class ModelServer:
                 raise ValueError(
                     f"{len(prompts)} requests but {len(gen_lens)} gen_lens"
                 )
-            outs = self.engine.run(
-                list(zip(prompts, (int(g) for g in gen_lens)))
-            )
+
+            def knob(name, cast):
+                """Per-request sampling knob: scalar → broadcast,
+                list → per request, absent/null → engine default."""
+                v = req.get(name)
+                if v is None:
+                    return [None] * len(prompts)
+                if isinstance(v, (int, float)):
+                    return [cast(v)] * len(prompts)
+                if len(v) != len(prompts):
+                    raise ValueError(
+                        f"{len(prompts)} requests but {len(v)} {name}"
+                    )
+                return [None if x is None else cast(x) for x in v]
+
+            temps = knob("temperatures", float)
+            top_ps = knob("top_ps", float)
+            top_ks = knob("top_ks", int)
+            from triton_distributed_tpu.models.continuous import Request
+
+            outs = self.engine.run([
+                Request(p, int(g), temperature=t, top_p=tp, top_k=tk)
+                for p, g, t, tp, tk in zip(
+                    prompts, gen_lens, temps, top_ps, top_ks
+                )
+            ])
             return {
                 "outputs": [o.tolist() for o in outs],
                 "stats": self.engine.last_stats,
