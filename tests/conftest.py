@@ -61,6 +61,34 @@ def rng():
     return np.random.default_rng(42)
 
 
+@pytest.fixture(autouse=True)
+def _audit_serving_pools():
+    """Pool/radix invariant audit after EVERY test (docs/serving.md
+    "Fault tolerance"): any engine or prefix tree the test touched must
+    end with free list ∪ slot pages ∪ tree pages partitioning the pool
+    exactly — a leak fails the test that caused it, not a later one.
+    Tests that never import the serving stack pay a dict lookup."""
+    yield
+    import sys
+
+    problems = []
+    cont = sys.modules.get("triton_distributed_tpu.models.continuous")
+    if cont is not None:
+        for eng in list(cont.ContinuousEngine._live):
+            problems += [f"ContinuousEngine: {p}" for p in eng.audit()]
+    engmod = sys.modules.get("triton_distributed_tpu.models.engine")
+    if engmod is not None:
+        for eng in list(engmod.Engine._live):
+            problems += [f"Engine: {p}" for p in eng.audit()]
+    pcmod = sys.modules.get("triton_distributed_tpu.models.prefix_cache")
+    if pcmod is not None:
+        for tree in list(pcmod.PrefixCache._live):
+            problems += [f"PrefixCache: {p}" for p in tree.audit()]
+    assert not problems, (
+        "pool/radix audit failed after test: " + "; ".join(problems)
+    )
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",

@@ -277,6 +277,207 @@ def test_server_speculative_stats(ctx4):
         server.shutdown()
 
 
+def test_server_unknown_payload_and_malformed_json(ctx4):
+    """Unknown payloads return a structured error naming the accepted
+    shapes (was: a bare KeyError 'input_ids'); malformed JSON is
+    reported AND the connection keeps serving; both bump the server
+    error counter exposed via {"cmd": "stats"}."""
+    import json
+    import socket
+
+    model = AutoLLM.from_pretrained("tiny", ctx=ctx4)
+    server = ModelServer(Engine(model, mode="xla")).start()
+    try:
+        with pytest.raises(RuntimeError, match="accepted payloads"):
+            request(server.host, server.port, {"whatever": 1})
+        with socket.create_connection(
+            (server.host, server.port), timeout=10
+        ) as s, s.makefile("rwb") as f:
+            f.write(b"{not json}\n")
+            f.flush()
+            resp = json.loads(f.readline())
+            assert resp["error"]["status"] == "bad_request"
+            assert "malformed JSON" in resp["error"]["reason"]
+            # The SAME connection still serves after the bad line.
+            f.write(json.dumps({"cmd": "ping"}).encode() + b"\n")
+            f.flush()
+            assert json.loads(f.readline())["ok"]
+        stats = request(server.host, server.port, {"cmd": "stats"})["stats"]
+        assert stats["server"]["errors"] >= 2
+    finally:
+        server.shutdown()
+
+
+def test_server_oversized_line_bounded(ctx4):
+    """A giant request line is refused at the byte bound (no OOM-sized
+    buffering), the connection is dropped (framing is lost), and the
+    server stays serviceable."""
+    import json
+    import socket
+
+    model = AutoLLM.from_pretrained("tiny", ctx=ctx4)
+    server = ModelServer(Engine(model, mode="xla")).start()
+    server.MAX_LINE_BYTES = 1024  # instance override for the test
+    try:
+        with socket.create_connection(
+            (server.host, server.port), timeout=10
+        ) as s, s.makefile("rwb") as f:
+            f.write(b"x" * 4096 + b"\n")
+            f.flush()
+            resp = json.loads(f.readline())
+            assert resp["error"]["status"] == "bad_request"
+            assert "exceeds" in resp["error"]["reason"]
+            assert f.readline() == b""  # server dropped the connection
+        # A line far larger than any stream buffer: the server must
+        # drain the unread tail before closing, or its close() turns
+        # into an RST that destroys the error response client-side.
+        with socket.create_connection(
+            (server.host, server.port), timeout=10
+        ) as s, s.makefile("rwb") as f:
+            f.write(b"y" * (1 << 20) + b"\n")
+            f.flush()
+            resp = json.loads(f.readline())
+            assert resp["error"]["status"] == "bad_request"
+        assert request(server.host, server.port, {"cmd": "ping"})["ok"]
+    finally:
+        server.shutdown()
+
+
+def test_server_client_disconnect_mid_request(ctx4):
+    """A client that sends a generation payload and hard-closes (RST)
+    before reading must not kill the server: the failure is counted as
+    a connection error and the engine/pool stay clean."""
+    import json
+    import socket
+    import struct
+    import time as _time
+
+    from triton_distributed_tpu.models.continuous import ContinuousEngine
+
+    model = AutoLLM.from_pretrained("tiny", ctx=ctx4)
+    eng = ContinuousEngine(model, max_batch=1, page_size=16, max_length=64)
+    server = ModelServer(eng).start()
+    try:
+        s = socket.create_connection((server.host, server.port), timeout=10)
+        s.sendall(json.dumps(
+            {"requests": [[5, 9, 2, 4]], "gen_lens": [4]}
+        ).encode() + b"\n")
+        # SO_LINGER(0): close sends RST, so the server's response write
+        # fails instead of landing in a dead buffer.
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                     struct.pack("ii", 1, 0))
+        s.close()
+        deadline = _time.monotonic() + 60
+        while _time.monotonic() < deadline:
+            stats = request(
+                server.host, server.port, {"cmd": "stats"}, timeout=10
+            )["stats"]["server"]
+            if stats["conn_errors"] >= 1:
+                break
+            _time.sleep(0.1)
+        assert stats["conn_errors"] >= 1
+        assert request(server.host, server.port, {"cmd": "ping"})["ok"]
+        assert eng.audit() == []
+    finally:
+        server.shutdown()
+
+
+def test_server_concurrent_requests_and_stats(ctx4):
+    """stats/ping payloads bypass the engine lock: they answer while a
+    generation payload is in flight on another connection."""
+    import threading
+
+    from triton_distributed_tpu.models.continuous import ContinuousEngine
+
+    model = AutoLLM.from_pretrained("tiny", ctx=ctx4)
+    eng = ContinuousEngine(model, max_batch=1, page_size=16, max_length=64)
+    server = ModelServer(eng).start()
+    try:
+        done = {}
+
+        def gen():
+            done["resp"] = request(
+                server.host, server.port,
+                {"requests": [[5, 9, 2, 4]], "gen_lens": [8]},
+            )
+
+        t = threading.Thread(target=gen, daemon=True)
+        t.start()
+        probes = 0
+        while t.is_alive():
+            r = request(server.host, server.port, {"cmd": "stats"},
+                        timeout=10)
+            assert "server" in r["stats"]
+            assert request(server.host, server.port, {"cmd": "ping"},
+                           timeout=10)["ok"]
+            probes += 1
+        t.join(timeout=60)
+        # The probes above answered while (and after) generation ran;
+        # at least one stats round trip always completes.
+        r = request(server.host, server.port, {"cmd": "stats"}, timeout=10)
+        assert r["stats"]["server"]["requests"] >= 1
+        assert done["resp"]["results"][0]["status"] == "ok"
+    finally:
+        server.shutdown()
+
+
+def test_server_graceful_drain(ctx4):
+    """Shutdown while a generation is in flight: the in-flight payload
+    finishes and its response arrives intact; a payload on an already-
+    open connection is refused with `shutting_down`; fresh connections
+    are refused once the listener closes."""
+    import json
+    import socket
+    import threading
+    import time as _time
+
+    from triton_distributed_tpu.models.continuous import ContinuousEngine
+
+    model = AutoLLM.from_pretrained("tiny", ctx=ctx4)
+    eng = ContinuousEngine(model, max_batch=1, page_size=16, max_length=64)
+    server = ModelServer(eng).start()
+    done = {}
+
+    def gen():
+        done["resp"] = request(
+            server.host, server.port,
+            {"requests": [[5, 9, 2, 4]], "gen_lens": [12]}, timeout=120,
+        )
+
+    t = threading.Thread(target=gen, daemon=True)
+    t.start()
+    # A second connection, accepted BEFORE the drain begins.
+    held = socket.create_connection((server.host, server.port), timeout=10)
+    _time.sleep(0.5)  # let the generation payload reach the engine
+    assert request(server.host, server.port, {"cmd": "shutdown"})["ok"]
+    # New generation work on the held connection is refused...
+    with held, held.makefile("rwb") as f:
+        f.write(json.dumps(
+            {"requests": [[1, 2, 3, 4]], "gen_lens": [2]}
+        ).encode() + b"\n")
+        f.flush()
+        resp = json.loads(f.readline())
+        assert resp["error"]["status"] == "shutting_down"
+    # ...while the in-flight generation drains to completion.
+    t.join(timeout=120)
+    assert done["resp"]["results"][0]["status"] == "ok"
+    assert len(done["resp"]["outputs"][0]) == 12
+    # The listener is (eventually) closed to fresh connections.
+    deadline = _time.monotonic() + 10
+    refused = False
+    while _time.monotonic() < deadline and not refused:
+        try:
+            socket.create_connection(
+                (server.host, server.port), timeout=1
+            ).close()
+            _time.sleep(0.1)
+        except OSError:
+            refused = True
+    assert refused
+    server.shutdown()
+    assert eng.audit() == []
+
+
 def test_engine_serve_profile_hook(ctx4, tmp_path):
     """Engine.serve(profile=...) must capture a decode-loop trace
     (parity: the reference Engine's built-in profiled decode,

@@ -260,6 +260,28 @@ def test_anchored_spec_and_straggler_model():
     assert estimate_straggler_stall_ms(10.0, 1.0, 8, True) == 3.0
 
 
+def test_runtime_faults_compiles():
+    """The fault-injection harness (runtime/faults.py) must
+    byte-compile: its seams are imported by the pool allocator and the
+    server, so a syntax error there takes down the whole serving
+    stack at import time."""
+    import os
+    import subprocess
+    import sys
+
+    target = os.path.join(
+        os.path.dirname(__file__), "..", "triton_distributed_tpu",
+        "runtime", "faults.py",
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "compileall", "-q", "-f", target],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, (
+        f"runtime/faults.py failed to compile:\n{proc.stdout}\n{proc.stderr}"
+    )
+
+
 def test_perf_scripts_compile():
     """Every perf/ script must at least byte-compile (tier-1 guard: the
     bench harnesses are run ad-hoc on relay windows, so a syntax error

@@ -16,8 +16,16 @@ import jax
 from triton_distributed_tpu.models.config import ModelConfig, get_config  # noqa: F401
 from triton_distributed_tpu.models.continuous import (  # noqa: F401
     ContinuousEngine,
+    Request,
+    RequestError,
+    RequestFailedError,
+    RequestResult,
 )
 from triton_distributed_tpu.models.engine import Engine  # noqa: F401
+from triton_distributed_tpu.models.paged_kv_cache import (  # noqa: F401
+    PoolAuditError,
+    audit_pool,
+)
 from triton_distributed_tpu.models.kv_cache import KVCache, init_cache  # noqa: F401
 from triton_distributed_tpu.models.prefix_cache import (  # noqa: F401
     PrefixCache,

@@ -28,12 +28,23 @@ With ``prefix_cache=True`` two serving-path upgrades switch on
   step of the running batch between chunks, so a long cold prompt never
   stalls in-flight decodes for its whole prefill (``prefill_chunk=0``
   keeps one chunk per admission).
+
+**Fault tolerance** (docs/serving.md "Fault tolerance"): requests fail
+*individually*. An unservable, deadline-expired, shed, or crashed
+request tears down only its own slot — private pages back to the pool,
+prefix pins back to the tree, table row back to the trash page — and
+surfaces a structured :class:`RequestResult` (status, reason, partial
+tokens) via ``run(results=True)`` while every other request completes.
+``run()`` ends with a pool/radix invariant audit (:meth:`audit`) so a
+leak is caught at the batch that caused it, not three batches later.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from collections import deque
+import time
+import weakref
+from collections import Counter, deque
 
 import jax
 import jax.numpy as jnp
@@ -45,6 +56,8 @@ from triton_distributed_tpu.models.engine import (
     prefill_suffix_chunks,
 )
 from triton_distributed_tpu.models.paged_kv_cache import (
+    PoolAuditError,
+    audit_pool,
     copy_page,
     init_paged_cache,
     truncate_pages,
@@ -56,7 +69,71 @@ from triton_distributed_tpu.models.prefix_cache import (
     round_chunk,
 )
 from triton_distributed_tpu.models.qwen import Mode, Qwen3
+from triton_distributed_tpu.runtime.faults import (
+    FaultError,
+    fault_point,
+    mutate_point,
+)
 from triton_distributed_tpu.runtime.profiling import trace_span
+
+
+@dataclasses.dataclass
+class RequestError:
+    """Structured failure: a machine-readable ``status`` plus a human
+    ``reason``. Statuses: ``unservable`` (can never fit), ``overloaded``
+    (shed by the bounded admission queue — retry with backoff),
+    ``deadline_exceeded``, ``nan_logits`` (non-finite model output),
+    ``failed`` (crash isolated to this request), ``aborted`` (the
+    engine loop itself died)."""
+
+    status: str
+    reason: str
+
+
+@dataclasses.dataclass
+class RequestResult:
+    """One request's outcome: generated tokens (PARTIAL when the
+    request failed mid-decode — everything emitted before the failure)
+    and its status."""
+
+    tokens: np.ndarray
+    status: str = "ok"
+    reason: str = ""
+
+    @property
+    def ok(self) -> bool:
+        return self.status == "ok"
+
+    @property
+    def error(self) -> RequestError | None:
+        return None if self.ok else RequestError(self.status, self.reason)
+
+
+class RequestFailedError(RuntimeError):
+    """Raised by ``run(results=False)`` when requests failed: the
+    legacy list-of-arrays interface has no failure channel, so the
+    engine completes what it can, tears the failures down cleanly, and
+    raises this with every per-request failure attached."""
+
+    def __init__(self, failures):
+        self.failures = failures  # list[(index, Request)]
+        msgs = "; ".join(
+            f"request {i}: [{r.status}] {r.reason}" for i, r in failures
+        )
+        super().__init__(f"{len(failures)} request(s) failed: {msgs}")
+
+
+# NaN/Inf logits raised by the admission sampler and the speculative
+# verify guard; both map to a structured `nan_logits` request failure.
+_NonFiniteLogits = sampling.NonFiniteLogitsError
+
+# Finite mask + greedy argmax of a decode step's logits in ONE device
+# program: the NaN guard rides the token fetch the loop already pays.
+_finite_greedy = jax.jit(
+    lambda logits: (
+        jnp.isfinite(logits).all(axis=-1), sampling.greedy(logits)
+    )
+)
 
 
 @dataclasses.dataclass
@@ -66,6 +143,9 @@ class Request:
     ``temperature``/``top_p``/``top_k`` override the engine's defaults
     for THIS request (None → engine default) — mixed greedy/sampled
     batches decode together, each slot sampled under its own knobs.
+    ``deadline_s`` is a per-request wall-clock budget measured from
+    ``run()`` entry; an expired request fails with
+    ``deadline_exceeded`` and its partial tokens.
     """
 
     prompt: np.ndarray  # [S] int32
@@ -73,6 +153,7 @@ class Request:
     temperature: float | None = None
     top_p: float | None = None
     top_k: int | None = None
+    deadline_s: float | None = None
     out: list[int] = dataclasses.field(default_factory=list)
     slot: int | None = None
     pages: list[int] = dataclasses.field(default_factory=list)
@@ -82,10 +163,19 @@ class Request:
     # Speculative-decoding state (``SpecState``), attached at admission
     # when the engine runs with ``speculative=K``.
     spec: object | None = None
+    # Failure channel (``ok`` until something fails this request).
+    status: str = "ok"
+    reason: str = ""
+    deadline_at: float | None = dataclasses.field(default=None, repr=False)
 
     @property
     def done(self) -> bool:
         return len(self.out) >= self.gen_len
+
+    def result(self) -> RequestResult:
+        return RequestResult(
+            np.asarray(self.out, np.int32), self.status, self.reason
+        )
 
 
 class ContinuousEngine(MegaDispatch):
@@ -96,7 +186,17 @@ class ContinuousEngine(MegaDispatch):
     free (cached prefix pages count as free coverage — they are mapped,
     not allocated). Page 0 is reserved as the trash page for inactive
     slots.
+
+    ``max_queue`` bounds the admission queue: requests beyond it are
+    shed with a structured ``overloaded`` error instead of wedging the
+    batch (None → unbounded).
     """
+
+    NS = 8  # megakernel multi-step launch width
+
+    # Live engines, auditable by the shared pytest fixture
+    # (tests/conftest.py) after every test.
+    _live: "weakref.WeakSet[ContinuousEngine]" = weakref.WeakSet()
 
     def __init__(
         self,
@@ -116,6 +216,7 @@ class ContinuousEngine(MegaDispatch):
         prefix_cache: bool = False,
         prefill_chunk: int = 0,
         speculative: int = 0,
+        max_queue: int | None = None,
     ):
         self.model = model
         self.mode = mode
@@ -138,6 +239,7 @@ class ContinuousEngine(MegaDispatch):
         self.page_size = page_size
         self.max_length = max_length or model.cfg.max_length
         self.pps = self.max_length // page_size
+        self.max_queue = max_queue
 
         # +1: page 0 is reserved as the trash page every inactive slot's
         # table points at, and must not shave serviceable capacity.
@@ -161,7 +263,9 @@ class ContinuousEngine(MegaDispatch):
         self._dense1 = None if prefix_cache else model.new_cache(
             1, self.max_length
         )
+        self._multi_fn = None  # lazy megakernel multi-step program
         self.stats = self._zero_stats()
+        ContinuousEngine._live.add(self)
 
     @staticmethod
     def _zero_stats() -> dict:
@@ -177,13 +281,21 @@ class ContinuousEngine(MegaDispatch):
             "spec_draft_tokens": 0,
             "spec_accepted_tokens": 0,
             "spec_rollback_tokens": 0,
+            # Fault-tolerance ledger (docs/serving.md "Fault tolerance").
+            "failed_requests": 0,
+            "shed_requests": 0,
+            "deadline_expired": 0,
+            "nonfinite_logits": 0,
+            "decode_faults": 0,
         }
 
     @property
     def last_stats(self) -> dict:
         """Serving counters (parity: ``Engine.last_stats``): admission /
-        prefill work done, prefix-cache reuse, COW copies, stalls, and
-        the speculative accept/rollback ledger."""
+        prefill work done, prefix-cache reuse, COW copies, stalls, the
+        speculative accept/rollback ledger, and the fault-tolerance
+        ledger (failed/shed/expired requests, non-finite logits,
+        isolated decode faults)."""
         stats = dict(self.stats)
         stats["free_pages"] = len(self.pool.free)
         if self.prefix is not None:
@@ -216,6 +328,7 @@ class ContinuousEngine(MegaDispatch):
         self, req: Request, slot: int, m: PrefixMatch | None = None
     ) -> jax.Array:
         """Prefill ``req`` into ``slot``; returns the first sampled token."""
+        fault_point("engine.admit", slot=slot)
         if self.prefix is not None:
             return self._admit_prefix(req, slot, m)
         s = len(req.prompt)
@@ -223,8 +336,8 @@ class ContinuousEngine(MegaDispatch):
         pad = (-s) % n
         row = np.concatenate([req.prompt, np.zeros(pad, np.int32)])
         need = self._needed_pages(s, req.gen_len)
+        req.slot = slot  # before any allocation: teardown keys off it
         req.pages = self.pool.allocate(need)
-        req.slot = slot
         self._table[slot] = 0
         self._table[slot, : len(req.pages)] = req.pages
         self._kv_len[slot] = s
@@ -250,14 +363,18 @@ class ContinuousEngine(MegaDispatch):
         chunk-prefill only the suffix."""
         s = len(req.prompt)
         total = self._needed_pages(s, req.gen_len)
+        req.slot = slot  # before any allocation: teardown keys off it
         new_pages = self.prefix.allocate(total - len(m.nodes))
         assert new_pages is not None, "try_admit availability check failed"
+        matched = m.matched_len
         req.pages = m.pages + new_pages
         req.shared_nodes = list(m.nodes)
-        req.slot = slot
+        # Pins now ride on the request: the admission failure handler
+        # releases m's REMAINING pins, the slot teardown releases the
+        # request's — moving them here keeps each pin owned exactly once.
+        m.nodes = []
         self._table[slot] = 0
         self._table[slot, : len(req.pages)] = req.pages
-        matched = m.matched_len
         if m.cow_len:
             # The partially matched page becomes this request's first
             # private page: clone it, count only the matched positions.
@@ -287,11 +404,11 @@ class ContinuousEngine(MegaDispatch):
             # bumped the in-flight slot's device counter.
             self.cache = cache
             self._kv_len[slot] = new_len
-            if self._decode_once():
-                # An interleaved decode finished a request: its pages
-                # retired to the tree, and the device table must drop
-                # them BEFORE the next chunk, or the stale row's append
-                # would corrupt a cached page.
+            if self._step_guard(self._decode_once):
+                # An interleaved decode finished (or failed) a request:
+                # its pages retired/released, and the device table must
+                # drop them BEFORE the next chunk, or the stale row's
+                # append would corrupt a cached page.
                 self._sync_tables()
             return self.cache
 
@@ -311,13 +428,42 @@ class ContinuousEngine(MegaDispatch):
         active = np.asarray([r is not None for r in self._slots], np.int32)
         if not active.any():
             return False
+        fault_point("engine.decode", step=self.stats["decode_steps"])
         logits, self.cache = self._decode_step(
             jnp.asarray(self._tok), self.cache
         )
+        logits = mutate_point(
+            "engine.logits", logits, step=self.stats["decode_steps"]
+        )
         self._kv_len += active
         self.stats["decode_steps"] += 1
-        nxt = self._sample_slots(logits)
-        return self._process(lambda slot: [nxt[slot]])
+        # One device program computes the finite mask AND the greedy
+        # base tokens, so the NaN guard adds no extra host-sync round
+        # trip to the hot decode loop.
+        finite, greedy_base = _finite_greedy(logits)
+        failed = self._guard_logits(np.asarray(finite))
+        nxt = self._sample_slots(logits, np.array(greedy_base))
+        changed = self._process(lambda slot: [nxt[slot]])
+        return changed or bool(failed)
+
+    def _guard_logits(self, finite: np.ndarray) -> list[int]:
+        """Per-slot NaN/Inf guard on a batched decode output: fail ONLY
+        the slots whose logits went non-finite (structured
+        ``nan_logits`` error, counted in ``last_stats``) instead of
+        silently sampling garbage. ``finite`` is the per-slot
+        all-finite mask. Returns the failed slot indices."""
+        failed = []
+        for slot, req in enumerate(self._slots):
+            if req is None or bool(finite[slot]):
+                continue
+            self.stats["nonfinite_logits"] += 1
+            self._fail(
+                req, "nan_logits",
+                f"non-finite logits at decode step "
+                f"{self.stats['decode_steps']} after {len(req.out)} tokens",
+            )
+            failed.append(slot)
+        return failed
 
     def _process(self, slot_tokens) -> bool:
         """Append per-slot tokens; evict on gen_len/eos. Returns whether
@@ -351,6 +497,104 @@ class ContinuousEngine(MegaDispatch):
         req.pages, req.slot = [], None
         self._slots[slot] = None
 
+    # -- failure isolation -----------------------------------------------
+
+    def _fail(self, req: Request, status: str, reason) -> None:
+        """Fail ONE request: record the structured error and, if it
+        holds a slot, tear that slot down. Everything else keeps
+        serving."""
+        req.status, req.reason = status, str(reason)
+        self.stats["failed_requests"] += 1
+        if status == "deadline_exceeded":
+            self.stats["deadline_expired"] += 1
+        if req.slot is not None:
+            self._teardown_slot(req)
+
+    def _teardown_slot(self, req: Request) -> None:
+        """Crash-safe slot release: private pages to the pool, shared
+        prefix pins back to the tree (the TREE owns those pages — they
+        must not be freed here), table row back to the trash page.
+
+        Unlike ``_evict`` nothing is donated to the prefix tree: a
+        failed request's KV is suspect (non-finite logits, a partial
+        verify chunk) and caching it would poison later matches."""
+        slot = req.slot
+        truncate_pages(
+            self.pool, req.pages, 0, self.page_size,
+            shared=len(req.shared_nodes),
+        )
+        if self.prefix is not None:
+            for node in req.shared_nodes:
+                self.prefix.release_node(node)
+        req.shared_nodes = []
+        req.pages = []
+        self._table[slot] = 0
+        self._kv_len[slot] = 0
+        self._slots[slot] = None
+        req.slot = None
+
+    def _admit_failure(self, req: Request, m: PrefixMatch | None, e) -> None:
+        """Clean up a failed admission: release whatever prefix pins
+        were NOT yet transferred to the request, tear down any slot
+        state it acquired, mark it failed, and resync the device
+        table — the engine stays serviceable."""
+        if self.prefix is not None and m is not None:
+            if m.cow_node is not None:
+                self.prefix.release_node(m.cow_node)
+                m.cow_node = None
+            for node in m.nodes:
+                self.prefix.release_node(node)
+            m.nodes = []
+        status = "failed"
+        if isinstance(e, _NonFiniteLogits):
+            status = "nan_logits"
+            self.stats["nonfinite_logits"] += 1
+        self._fail(req, status, f"{type(e).__name__}: {e}")
+        self._sync_tables()
+
+    def _step_guard(self, fn) -> bool:
+        """Run one decode-phase step with per-request error isolation:
+        an exception carrying a ``slot`` attribute (injected faults,
+        slot-attributable guards) fails exactly that request; anything
+        else poisons the whole in-flight set — every active request
+        gets a structured error and a clean teardown, and the engine
+        (slots, pool, tree, device table) remains reusable. Returns
+        whether slot state changed."""
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — isolation boundary
+            self.stats["decode_faults"] += 1
+            slot = getattr(e, "slot", None)
+            if (isinstance(slot, int) and 0 <= slot < self.max_batch
+                    and self._slots[slot] is not None):
+                victims = [self._slots[slot]]
+            else:
+                victims = [r for r in self._slots if r is not None]
+            for r in victims:
+                self._fail(r, "failed", f"{type(e).__name__}: {e}")
+            self._sync_tables()
+            return True
+
+    def _expire_deadlines(self) -> bool:
+        """Fail every active request whose wall-clock deadline passed
+        (structured ``deadline_exceeded`` + partial tokens). Returns
+        whether slot state changed."""
+        now = time.monotonic()
+        changed = False
+        for req in list(self._slots):
+            if req is None or req.deadline_at is None:
+                continue
+            if now > req.deadline_at:
+                self._fail(
+                    req, "deadline_exceeded",
+                    f"deadline_s={req.deadline_s} exceeded after "
+                    f"{len(req.out)} generated tokens",
+                )
+                changed = True
+        return changed
+
+    # -- sampling ---------------------------------------------------------
+
     def _retire_to_prefix(self, req: Request) -> None:
         """Donate the finished request's KV pages to the radix tree.
 
@@ -377,17 +621,27 @@ class ContinuousEngine(MegaDispatch):
     def _sample_req(self, req: Request, logits: jax.Array) -> int:
         """Sample one token for ``req`` from ``logits [V]`` under its
         effective knobs."""
+        if not bool(jnp.isfinite(logits).all()):
+            raise _NonFiniteLogits(
+                "non-finite logits from the admission prefill",
+                slot=req.slot,
+            )
         t, p, k = self._request_sampling(req)
         if t <= 0.0:
             return int(sampling.greedy(logits))
         self.key, sub = jax.random.split(self.key)
         return int(sampling.sample(logits, sub, t, p, k))
 
-    def _sample_slots(self, logits: jax.Array) -> np.ndarray:
+    def _sample_slots(
+        self, logits: jax.Array, toks: np.ndarray | None = None
+    ) -> np.ndarray:
         """Per-slot sampling of a batched ``[max_batch, V]`` decode
-        output. All-greedy batches stay one batched argmax; slots with
-        ``temperature > 0`` each draw under their own knobs."""
-        toks = np.array(sampling.greedy(logits))
+        output. All-greedy batches stay one batched argmax (``toks``,
+        when given, is that argmax already fetched by the caller);
+        slots with ``temperature > 0`` each draw under their own
+        knobs."""
+        if toks is None:
+            toks = np.array(sampling.greedy(logits))
         for slot, req in enumerate(self._slots):
             if req is None:
                 continue
@@ -431,24 +685,56 @@ class ContinuousEngine(MegaDispatch):
         ``accepted + 1`` tokens. Slots WITHOUT an entry are untouched —
         on a mixed round the caller advances them (and the verified
         slots, one more token) through the ordinary batched decode
-        step. Returns whether slot state changed."""
+        step. A verify that raises fails ONLY its own slot (structured
+        error, clean teardown); the other slots' round proceeds.
+        Returns whether slot state changed."""
         from triton_distributed_tpu.models.speculative import (
             spec_verify_slot,
         )
 
         bursts: dict[int, list[int]] = {}
         rolled_total = 0
+        any_failed = False
         for slot, req in enumerate(self._slots):
             if req is None or slot not in drafts:
                 continue
             kv = int(self._kv_len[slot])
             draft = drafts[slot]
             t, p, k = self._request_sampling(req)
-            emitted, self.cache, a, self.key = spec_verify_slot(
-                self.model, self.cache, slot, int(self._tok[slot]), draft,
-                kv, self._prefill_mode, key=self.key, temperature=t,
-                top_p=p, top_k=k,
-            )
+            try:
+                emitted, self.cache, a, self.key = spec_verify_slot(
+                    self.model, self.cache, slot, int(self._tok[slot]),
+                    draft, kv, self._prefill_mode, key=self.key,
+                    temperature=t, top_p=p, top_k=k,
+                )
+            except FaultError as e:
+                # Injected faults fire at the seam BEFORE the chunk
+                # program consumed (donated) the cache — per-slot
+                # isolation is safe.
+                self.stats["decode_faults"] += 1
+                self._fail(req, "failed", f"{type(e).__name__}: {e}")
+                any_failed = True
+                continue
+            except Exception:
+                # A real mid-chunk failure may have raised AFTER the
+                # chunk donated self.cache's buffers: continuing the
+                # round on deleted arrays would cascade crashes across
+                # the surviving slots. Re-raise to _step_guard, which
+                # fails the whole in-flight set and leaves the engine
+                # reusable — the honest policy when the cache can't be
+                # trusted.
+                raise
+            if emitted is None:
+                # Non-finite verify logits (the cache was still
+                # threaded through — only this request is poisoned).
+                self.stats["nonfinite_logits"] += 1
+                self._fail(
+                    req, "nan_logits",
+                    f"non-finite logits in speculative verify chunk "
+                    f"after {len(req.out)} tokens",
+                )
+                any_failed = True
+                continue
             req.spec.record(len(draft), a)
             self.stats["spec_verify_steps"] += 1
             self.stats["spec_draft_tokens"] += len(draft)
@@ -460,11 +746,11 @@ class ContinuousEngine(MegaDispatch):
         changed = self._process(lambda slot: bursts.get(slot, []))
         # Every verify left the device kv_len at the chunk's end
         # (accepted + rejected rows); resyncing the host table rolls the
-        # rejected tail back and drops any evicted slot's pages in one
-        # write.
+        # rejected tail back and drops any evicted/failed slot's pages
+        # in one write.
         with trace_span("spec:rollback", tokens=rolled_total):
             self._sync_tables()
-        return changed
+        return changed or any_failed
 
     def _maybe_finish(self, req: Request, t: int) -> bool:
         """Evict ``req`` if token ``t`` completed it (gen_len or eos)."""
@@ -475,84 +761,97 @@ class ContinuousEngine(MegaDispatch):
 
     # -- the loop --------------------------------------------------------
 
-    def run(self, requests) -> list[np.ndarray]:
-        """Serve requests to completion; returns each request's
-        generated tokens (prompt excluded), in order. Each entry is a
-        ``(prompt, gen_len)`` tuple or a :class:`Request` (the server
-        builds Requests to carry per-request sampling knobs)."""
-        reqs = [
-            r if isinstance(r, Request)
-            else Request(np.asarray(r[0], np.int32), int(r[1]))
-            for r in requests
-        ]
-        for r in reqs:
-            total = len(r.prompt) + r.gen_len
-            if total > self.max_length:
-                raise ValueError(
-                    f"prompt+gen_len = {total} exceeds max_length "
-                    f"{self.max_length}"
-                )
-            need = self._needed_pages(len(r.prompt), r.gen_len)
-            if need > self._capacity:
-                raise ValueError(
-                    f"request needs {need} pages; "
-                    f"pool capacity is {self._capacity} (unservable)"
-                )
-        queue = deque(reqs)
-        self.stats = self._zero_stats()
+    def _try_admit(self, queue: deque) -> bool:
+        """Admit queue heads into free slots while pages allow. Failed
+        admissions (injected faults, pool exhaustion races, non-finite
+        prefill logits, expired deadlines) fail ONLY their request and
+        the scan continues. Returns whether anything was admitted."""
+        admitted = False
+        progress = True
+        while progress:  # re-scan: a first-token eviction frees its
+            progress = False          # slot for the next request
+            for slot in range(self.max_batch):
+                if self._slots[slot] is not None or not queue:
+                    continue
+                head = queue[0]
+                if (head.deadline_at is not None
+                        and time.monotonic() > head.deadline_at):
+                    queue.popleft()
+                    self._fail(
+                        head, "deadline_exceeded",
+                        f"deadline_s={head.deadline_s} expired before "
+                        "admission",
+                    )
+                    progress = True
+                    break
+                need = self._needed_pages(len(head.prompt), head.gen_len)
+                m = None
+                if self.prefix is not None:
+                    m = self.prefix.match(head.prompt)
+                    avail = (
+                        len(self.pool.free)
+                        + self.prefix.reclaimable_pages()
+                    )
+                    if need - len(m.nodes) > avail:
+                        self.prefix.release_match(m)
+                        self.stats["admission_stalls"] += 1
+                        progress = False  # end the scan: a rescan would
+                        break             # just re-stall the same head
+                elif need > len(self.pool.free):
+                    progress = False
+                    break  # head-of-line waits for pages
+                req = queue.popleft()
+                try:
+                    first = self._admit(req, slot, m)
+                except Exception as e:  # noqa: BLE001 — isolation
+                    self._admit_failure(req, m, e)
+                    progress = True
+                    break
+                if self.speculative:
+                    from triton_distributed_tpu.models.speculative import (  # noqa: E501
+                        SpecState,
+                    )
 
-        def try_admit() -> bool:
-            admitted = False
-            progress = True
-            while progress:  # re-scan: a first-token eviction frees its
-                progress = False          # slot for the next request
-                for slot in range(self.max_batch):
-                    if self._slots[slot] is None and queue:
-                        head = queue[0]
-                        need = self._needed_pages(
-                            len(head.prompt), head.gen_len
-                        )
-                        if self.prefix is not None:
-                            m = self.prefix.match(head.prompt)
-                            avail = (
-                                len(self.pool.free)
-                                + self.prefix.reclaimable_pages()
-                            )
-                            if need - len(m.nodes) > avail:
-                                self.prefix.release_match(m)
-                                self.stats["admission_stalls"] += 1
-                                progress = False
-                                break  # head-of-line waits for pages
-                        else:
-                            m = None
-                            if need > len(self.pool.free):
-                                progress = False
-                                break  # head-of-line waits for pages
-                        req = queue.popleft()
-                        first = self._admit(req, slot, m)
-                        if self.speculative:
-                            from triton_distributed_tpu.models.speculative import (  # noqa: E501
-                                SpecState,
-                            )
+                    req.spec = SpecState(self.speculative)
+                    req.spec.observe(req.prompt)
+                    req.spec.observe((int(first),))
+                req.out.append(int(first))
+                self._tok[slot] = int(first)
+                admitted = progress = True
+                # The admission token itself can finish the request
+                # (gen_len=1, or eos as first token).
+                self._maybe_finish(req, int(first))
+        if admitted:
+            # A trailing first-token eviction leaves the device table
+            # pointing at released pages until synced — and every exit
+            # path must reach this sync (an early return here once left
+            # a zombie slot decoding into freed pages).
+            self._sync_tables()
+        return admitted
 
-                            req.spec = SpecState(self.speculative)
-                            req.spec.observe(req.prompt)
-                            req.spec.observe((int(first),))
-                        req.out.append(int(first))
-                        self._tok[slot] = int(first)
-                        admitted = progress = True
-                        # The admission token itself can finish the
-                        # request (gen_len=1, or eos as first token).
-                        self._maybe_finish(req, int(first))
-            if admitted:
-                # A trailing first-token eviction leaves the device
-                # table pointing at released pages until synced — and
-                # every exit path must reach this sync (an early return
-                # here once left a zombie slot decoding into freed
-                # pages).
-                self._sync_tables()
-            return admitted
-
+    def _step(self) -> bool:
+        """One scheduling round of the in-flight batch: a speculative
+        verify + batched-decode mix, a megakernel multi-step launch, or
+        one batched decode step. Returns whether slot state changed."""
+        active = np.asarray([r is not None for r in self._slots], np.int32)
+        kv_high = int((self._kv_len * active).max())
+        if self.speculative:
+            # Per-slot verify chunks ONLY for slots that drafted;
+            # undraftable slots (or an all-empty plan, or a slot too
+            # near max_length for a padded chunk) ride the ONE batched
+            # decode step — a mixed round costs 1 + |drafted| forwards,
+            # never per-slot chunks for the no-match majority, so
+            # speculation never makes the no-match case slower than
+            # plain serving.
+            drafts, ok = self._plan_drafts()
+            drafted = {s: d for s, d in drafts.items() if d} if ok else {}
+            n_active = int(active.sum())
+            changed = False
+            if drafted:
+                changed = self._spec_round(drafted)
+            if not ok or len(drafted) < n_active:
+                changed = self._decode_once() or changed
+            return changed
         # Megakernel greedy serving decodes in NS-step chunks: one
         # launch emits NS tokens per slot (in-kernel argmax), then the
         # host checks eos/gen_len. A finished row's overshoot tokens
@@ -560,54 +859,208 @@ class ContinuousEngine(MegaDispatch):
         # allocated pages, where the zeroed table entries route them to
         # the trash page. Rows near max_length fall back to single
         # steps for the tail.
-        NS = 8
-        use_multi = self.mode == "mega" and self.temperature <= 0.0
-        multi_fn = None
-
-        try_admit()
-        while any(r is not None for r in self._slots):
-            active = np.asarray(
-                [r is not None for r in self._slots], np.int32
-            )
-            kv_high = int((self._kv_len * active).max())
-            if self.speculative:
-                # Per-slot verify chunks ONLY for slots that drafted;
-                # undraftable slots (or an all-empty plan, or a slot
-                # too near max_length for a padded chunk) ride the ONE
-                # batched decode step — a mixed round costs
-                # 1 + |drafted| forwards, never per-slot chunks for the
-                # no-match majority, so speculation never makes the
-                # no-match case slower than plain serving.
-                drafts, ok = self._plan_drafts()
-                drafted = {s: d for s, d in drafts.items() if d} if ok \
-                    else {}
-                n_active = sum(r is not None for r in self._slots)
-                changed = False
-                if drafted:
-                    changed = self._spec_round(drafted)
-                if not ok or len(drafted) < n_active:
-                    changed = self._decode_once() or changed
-            elif use_multi and kv_high + NS <= self.max_length:
-                if multi_fn is None:
-                    multi_fn = self._mega_model().decode_multi_fn(
-                        self.max_batch, self.max_length, NS,
-                        page=self.page_size,
-                    )
-                toks, _logits, self.cache = multi_fn(
-                    # Q8Params under MegaConfig(wq8=True), else params.
-                    self._mega_model()._step_params(),
-                    jnp.asarray(self._tok), self.cache,
+        if (self.mode == "mega" and self.temperature <= 0.0
+                and kv_high + self.NS <= self.max_length):
+            if self._multi_fn is None:
+                self._multi_fn = self._mega_model().decode_multi_fn(
+                    self.max_batch, self.max_length, self.NS,
+                    page=self.page_size,
                 )
-                self._kv_len += NS * active
-                self.stats["decode_steps"] += NS
-                toks_np = np.asarray(toks)  # [NS, max_batch]
-                changed = self._process(lambda slot: toks_np[:, slot])
-            else:
-                changed = self._decode_once()
-            if changed:
-                # Slot state changed: the device cache threads k/v
-                # pages, but table + kv_len are host-authoritative.
-                try_admit()
+            toks, _logits, self.cache = self._multi_fn(
+                # Q8Params under MegaConfig(wq8=True), else params.
+                self._mega_model()._step_params(),
+                jnp.asarray(self._tok), self.cache,
+            )
+            self._kv_len += self.NS * active
+            self.stats["decode_steps"] += self.NS
+            toks_np = np.asarray(toks)  # [NS, max_batch]
+            return self._process(lambda slot: toks_np[:, slot])
+        return self._decode_once()
+
+    def run(self, requests, *, results: bool = False):
+        """Serve requests to completion with per-request error
+        isolation. Each entry is a ``(prompt, gen_len)`` tuple or a
+        :class:`Request` (the server builds Requests to carry
+        per-request sampling knobs and deadlines).
+
+        ``results=False`` (legacy): returns each request's generated
+        tokens (prompt excluded), in order. Unservable requests raise
+        ``ValueError`` up front (nothing runs); runtime failures finish
+        the surviving requests, tear the failures down cleanly, and
+        raise :class:`RequestFailedError`.
+
+        ``results=True``: never raises for per-request failures —
+        returns one :class:`RequestResult` per request (partial tokens
+        + structured status/reason), the contract the model server
+        speaks.
+
+        Every run ends with the pool/radix invariant audit
+        (:meth:`audit`); a bookkeeping leak raises
+        :class:`PoolAuditError` at the batch that caused it.
+        """
+        reqs = [
+            r if isinstance(r, Request)
+            else Request(np.asarray(r[0], np.int32), int(r[1]))
+            for r in requests
+        ]
+        self.stats = self._zero_stats()
+        t0 = time.monotonic()
+        # Load shedding: the admission queue is bounded — excess
+        # requests get a structured `overloaded` error immediately
+        # instead of wedging the batch (clients retry with backoff).
+        if self.max_queue is not None and len(reqs) > self.max_queue:
+            for r in reqs[self.max_queue:]:
+                r.status = "overloaded"
+                r.reason = (
+                    f"admission queue bounded at {self.max_queue} "
+                    f"requests ({len(reqs)} submitted); retry with backoff"
+                )
+                self.stats["shed_requests"] += 1
+                self.stats["failed_requests"] += 1
+        for r in reqs:
+            if r.status != "ok":
+                continue
+            total = len(r.prompt) + r.gen_len
+            if total > self.max_length:
+                msg = (
+                    f"prompt+gen_len = {total} exceeds max_length "
+                    f"{self.max_length}"
+                )
+                if not results:
+                    raise ValueError(msg)
+                r.status, r.reason = "unservable", msg
+                self.stats["failed_requests"] += 1
+                continue
+            need = self._needed_pages(len(r.prompt), r.gen_len)
+            if need > self._capacity:
+                msg = (
+                    f"request needs {need} pages; "
+                    f"pool capacity is {self._capacity} (unservable)"
+                )
+                if not results:
+                    raise ValueError(msg)
+                r.status, r.reason = "unservable", msg
+                self.stats["failed_requests"] += 1
+                continue
+            if r.deadline_s is not None:
+                r.deadline_at = t0 + float(r.deadline_s)
+        queue = deque(r for r in reqs if r.status == "ok")
+
+        try:
+            self._try_admit(queue)
+            while True:
+                if self._expire_deadlines():
+                    # An expiry freed a slot AND its pages: admit from
+                    # the queue NOW — waiting for the next slot-state
+                    # change would starve queued requests on a free
+                    # slot for the remainder of a long decode.
+                    self._sync_tables()
+                    self._try_admit(queue)
+                if not any(r is not None for r in self._slots):
+                    if not queue:
+                        break
+                    if not self._try_admit(queue) and queue:
+                        # Nothing in flight and the head still can't
+                        # admit: capacity was validated, so this is a
+                        # bookkeeping leak — fail the head rather than
+                        # spin forever (the audit below will name it).
+                        # The re-check matters: _try_admit itself drains
+                        # expired/failed heads, so the queue may already
+                        # be empty even though nothing was admitted.
+                        head = queue.popleft()
+                        if head.status == "ok":
+                            self._fail(
+                                head, "failed",
+                                "admission made no progress on an idle "
+                                "engine (page accounting leak?)",
+                            )
+                    continue
+                if self._step_guard(self._step):
+                    # Slot state changed: the device cache threads k/v
+                    # pages, but table + kv_len are host-authoritative.
+                    self._try_admit(queue)
+                    self._sync_tables()
+        finally:
+            # Crash-safe teardown: NO exit path — injected fault,
+            # engine bug, KeyboardInterrupt — leaves a slot holding
+            # pages, a dangling tree pin, or a stale device table; the
+            # engine object stays reusable.
+            leftover = [r for r in self._slots if r is not None]
+            for r in leftover:
+                self._fail(r, "aborted", "engine loop aborted mid-flight")
+            while queue:
+                r = queue.popleft()
+                if r.status == "ok":
+                    r.status = "aborted"
+                    r.reason = "engine loop aborted before admission"
+                    self.stats["failed_requests"] += 1
+            if leftover:
                 self._sync_tables()
 
+        self.audit(raise_on_violation=True)
+        if results:
+            return [r.result() for r in reqs]
+        failures = [(i, r) for i, r in enumerate(reqs) if r.status != "ok"]
+        if failures:
+            raise RequestFailedError(failures)
         return [np.asarray(r.out, np.int32) for r in reqs]
+
+    # -- auditing ---------------------------------------------------------
+
+    def audit(self, *, raise_on_violation: bool = False) -> list[str]:
+        """Pool/radix invariant audit (docs/serving.md): free list ∪
+        slot-private pages ∪ tree pages ∪ trash page partition the pool
+        exactly; shared mappings target live tree pages; tree refcounts
+        equal live slot references; host table rows mirror each
+        request's page list. Host-side and cheap — ``run()`` calls it
+        after every batch, tests after every case. Returns violation
+        strings; raises :class:`PoolAuditError` instead when asked."""
+        problems: list[str] = []
+        owners: dict[str, list[int]] = {}
+        shared: dict[str, list[int]] = {}
+        for slot, req in enumerate(self._slots):
+            if req is None:
+                continue
+            n_sh = len(req.shared_nodes)
+            owners[f"slot{slot}"] = [int(p) for p in req.pages[n_sh:]]
+            shared[f"slot{slot}"] = [int(p) for p in req.pages[:n_sh]]
+        if self.prefix is not None:
+            problems += self.prefix.audit()
+            owners["tree"] = [n.page for n in self.prefix.walk()]
+            pin_counts: Counter = Counter()
+            for req in self._slots:
+                if req is None:
+                    continue
+                for node in req.shared_nodes:
+                    pin_counts[id(node)] += 1
+            for node in self.prefix.walk():
+                live = pin_counts.get(id(node), 0)
+                if node.refcount != live:
+                    problems.append(
+                        f"tree node page {node.page}: refcount "
+                        f"{node.refcount} != {live} live slot references"
+                    )
+        problems += audit_pool(
+            self.pool, self.pool.num_pages, owners, shared=shared,
+            reserved=(0,),
+        )
+        for slot in range(self.max_batch):
+            req = self._slots[slot]
+            row = self._table[slot]
+            if req is None:
+                if row.any():
+                    problems.append(
+                        f"inactive slot {slot} still has a nonzero "
+                        "page-table row"
+                    )
+            else:
+                want = np.zeros(self.pps, np.int32)
+                want[: len(req.pages)] = req.pages
+                if not np.array_equal(row, want):
+                    problems.append(
+                        f"slot {slot} table row disagrees with its "
+                        "request's page list"
+                    )
+        if problems and raise_on_violation:
+            raise PoolAuditError("; ".join(problems))
+        return problems

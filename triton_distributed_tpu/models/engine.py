@@ -14,6 +14,7 @@ logic in Python while each step is a single device program.
 from __future__ import annotations
 
 import time
+import weakref
 from typing import Literal
 
 import jax
@@ -134,6 +135,10 @@ class MegaDispatch:
 class Engine(MegaDispatch):
     """Parity: reference ``Engine`` (``models/engine.py:37``)."""
 
+    # Live engines, auditable by the shared pytest fixture
+    # (tests/conftest.py) after every test.
+    _live: "weakref.WeakSet[Engine]" = weakref.WeakSet()
+
     def __init__(
         self,
         model: Qwen3,
@@ -203,6 +208,38 @@ class Engine(MegaDispatch):
         # fresh closure per serve() would retrace + recompile the
         # megakernel program every call.
         self._sampled_multi: dict = {}
+        Engine._live.add(self)
+
+    def audit(self, *, raise_on_violation: bool = False) -> list[str]:
+        """Pool/radix invariant audit of the cross-serve prefix state
+        (parity with :meth:`ContinuousEngine.audit`): between serves
+        every page is either free or tree-owned (finished rows retired
+        their pages), no page has two owners, and no pins are left
+        behind. A ``dirty`` state (aborted serve) is skipped — it is
+        rebuilt, not reused, on the next serve. Returns violation
+        strings; raises ``PoolAuditError`` instead when asked."""
+        state = self._prefix_state
+        if state is None or state.dirty:
+            return []
+        from triton_distributed_tpu.models.paged_kv_cache import (
+            PoolAuditError,
+            audit_pool,
+        )
+
+        problems = state.tree.audit()
+        for node in state.tree.walk():
+            if node.refcount:
+                problems.append(
+                    f"idle tree node page {node.page} still pinned "
+                    f"(refcount {node.refcount}) between serves"
+                )
+        problems += audit_pool(
+            state.pool, state.pool.num_pages,
+            {"tree": [n.page for n in state.tree.walk()]}, reserved=(0,),
+        )
+        if problems and raise_on_violation:
+            raise PoolAuditError("; ".join(problems))
+        return problems
 
     def _sample(self, logits: jax.Array) -> jax.Array:
         if self.temperature <= 0.0:
@@ -491,6 +528,18 @@ class Engine(MegaDispatch):
                 temperature=self.temperature, top_p=self.top_p,
                 top_k=self.top_k,
             )
+            if emitted is None:
+                # Non-finite verify logits: the fixed-batch engine has
+                # no per-request failure channel — fail the serve loud
+                # (prefix state, if any, is marked dirty and rebuilt).
+                from triton_distributed_tpu.models.sampling import (
+                    NonFiniteLogitsError,
+                )
+
+                raise NonFiniteLogitsError(
+                    f"non-finite logits in speculative verify chunk "
+                    f"(row {i})", slot=i,
+                )
             counters["spec_verify_steps"] += 1
             counters["spec_draft_tokens"] += len(draft)
             counters["spec_accepted_tokens"] += a

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from triton_distributed_tpu.models.config import ModelConfig
+from triton_distributed_tpu.runtime.faults import fault_point
 from triton_distributed_tpu.runtime.mesh import DistContext
 from triton_distributed_tpu.runtime.pytree import register_param_dataclass
 
@@ -48,9 +49,11 @@ class PagePool:
     """
 
     def __init__(self, num_pages: int):
+        self.num_pages = num_pages
         self.free = list(range(num_pages - 1, -1, -1))
 
     def allocate(self, n: int) -> list[int]:
+        fault_point("pool.allocate", n=n, free=len(self.free))
         if n > len(self.free):
             raise RuntimeError(f"page pool exhausted ({n} > {len(self.free)})")
         return [self.free.pop() for _ in range(n)]
@@ -101,6 +104,88 @@ def init_paged_cache(
         kv_len=ctx.replicate(jnp.zeros((batch_size,), jnp.int32)),
     )
     return cache, pool
+
+
+class PoolAuditError(RuntimeError):
+    """The pool/radix invariant audit found leaked, double-owned, or
+    phantom pages — the serving loop's bookkeeping is corrupt."""
+
+
+def audit_pool(
+    pool: PagePool,
+    num_pages: int | None = None,
+    owners: dict[str, list[int]] | None = None,
+    *,
+    shared: dict[str, list[int]] | None = None,
+    reserved: tuple[int, ...] = (0,),
+) -> list[str]:
+    """Cross-check the pool's ownership partition; returns violation
+    strings (empty == clean).
+
+    ``owners`` maps an owner name (``"slot3"``, ``"tree"``) to the
+    pages it holds EXCLUSIVELY. ``shared`` maps an owner to pages it
+    maps *by reference* (a slot's refcounted prefix pages) — those must
+    belong to exactly one exclusive owner and never be free. The audit
+    proves:
+
+    - free list ∪ exclusive owners ∪ ``reserved`` == all pages
+      (nothing leaked, nothing phantom),
+    - no page has two exclusive owners, is both owned and free, or is
+      a reserved page (the trash page is nobody's),
+    - the free list holds no duplicates,
+    - every shared mapping targets a live exclusively-owned page.
+
+    Host-side and allocation-free: cheap enough to run after every
+    ``run()`` (the continuous engine does) and from every test.
+    """
+    problems: list[str] = []
+    total = pool.num_pages if num_pages is None else int(num_pages)
+    free = list(pool.free)
+    free_set = set(free)
+    if len(free_set) != len(free):
+        dup = sorted(p for p in free_set if free.count(p) > 1)
+        problems.append(f"free list holds duplicate pages {dup}")
+    claimed: dict[int, str] = {}
+    for name, pages in (owners or {}).items():
+        seen_local: set[int] = set()
+        for p in pages:
+            p = int(p)
+            if p in seen_local:
+                problems.append(f"{name} lists page {p} twice")
+                continue
+            seen_local.add(p)
+            if p in claimed:
+                problems.append(
+                    f"page {p} owned by both {claimed[p]} and {name}"
+                )
+                continue
+            claimed[p] = name
+            if p in free_set:
+                problems.append(
+                    f"page {p} owned by {name} but also on the free list"
+                )
+            if p in reserved:
+                problems.append(f"{name} owns reserved page {p}")
+    all_pages = set(range(total))
+    accounted = free_set | set(claimed) | set(reserved)
+    leaked = all_pages - accounted
+    if leaked:
+        problems.append(f"leaked pages (no owner, not free): {sorted(leaked)}")
+    phantom = accounted - all_pages
+    if phantom:
+        problems.append(f"unknown page ids: {sorted(phantom)}")
+    for name, pages in (shared or {}).items():
+        for p in pages:
+            p = int(p)
+            if p in free_set:
+                problems.append(
+                    f"{name} maps shared page {p} that is on the free list"
+                )
+            elif p not in claimed:
+                problems.append(
+                    f"{name} maps shared page {p} that no owner holds"
+                )
+    return problems
 
 
 def gather_bucket(end_pos: int, page_size: int, pages_per_seq: int) -> int:

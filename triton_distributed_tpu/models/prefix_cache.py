@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import dataclasses
 import heapq
+import weakref
 from typing import Iterable
 
 
@@ -96,11 +97,16 @@ class PrefixMatch:
 class PrefixCache:
     """Host-side radix tree owning retired KV pages of a ``PagePool``."""
 
+    # Live instances, auditable by the shared pytest fixture
+    # (tests/conftest.py) after every test.
+    _live: "weakref.WeakSet[PrefixCache]" = weakref.WeakSet()
+
     def __init__(self, pool, page_size: int):
         self.pool = pool
         self.page_size = page_size
         self.root = RadixNode((), -1, None)
         self._clock = 0
+        PrefixCache._live.add(self)
         self.node_count = 0  # == pages held by the tree
         self.stats = {
             "lookups": 0,
@@ -341,6 +347,74 @@ class PrefixCache:
         return self.evict_until(len(self.pool.free) + self.node_count + 1)
 
     # -- introspection ----------------------------------------------------
+
+    def audit(self) -> list[str]:
+        """Structural invariant check; returns violation strings
+        (empty == clean). Verifies what the tree can see on its own —
+        the engine-level :meth:`ContinuousEngine.audit` adds the
+        refcount-vs-live-slot and pool-partition cross-checks:
+
+        - no page appears under two nodes, or under a node AND on the
+          free list,
+        - children are indexed by their chunk's first token and parent
+          links are consistent,
+        - a partially filled page is a leaf, chunks are non-empty and
+          at most ``page_size`` tokens,
+        - refcounts are non-negative and ``node_count`` matches the
+          walk.
+        """
+        problems: list[str] = []
+        free = set(self.pool.free)
+        seen: dict[int, RadixNode] = {}
+        count = 0
+        stack: list[RadixNode] = [self.root]
+        while stack:
+            node = stack.pop()
+            for key, child in node.children.items():
+                count += 1
+                if not child.chunk:
+                    problems.append(f"node page {child.page} has an "
+                                    "empty chunk")
+                elif key != child.chunk[0]:
+                    problems.append(
+                        f"child indexed by {key} but chunk starts with "
+                        f"{child.chunk[0]} (page {child.page})"
+                    )
+                if len(child.chunk) > self.page_size:
+                    problems.append(
+                        f"node page {child.page} chunk overflows the "
+                        f"page ({len(child.chunk)} > {self.page_size})"
+                    )
+                if len(child.chunk) < self.page_size and child.children:
+                    problems.append(
+                        f"partial page {child.page} "
+                        f"({len(child.chunk)} tokens) has children"
+                    )
+                if child.parent is not node:
+                    problems.append(
+                        f"node page {child.page} has a broken parent link"
+                    )
+                if child.refcount < 0:
+                    problems.append(
+                        f"node page {child.page} refcount underflow "
+                        f"({child.refcount})"
+                    )
+                if child.page in seen:
+                    problems.append(f"page {child.page} cached by two "
+                                    "tree nodes")
+                else:
+                    seen[child.page] = child
+                if child.page in free:
+                    problems.append(
+                        f"page {child.page} cached by the tree AND on "
+                        "the free list"
+                    )
+                stack.append(child)
+        if count != self.node_count:
+            problems.append(
+                f"node_count={self.node_count} but the walk found {count}"
+            )
+        return problems
 
     @property
     def hit_rate(self) -> float:

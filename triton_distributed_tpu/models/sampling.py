@@ -15,6 +15,17 @@ import jax
 import jax.numpy as jnp
 
 
+class NonFiniteLogitsError(RuntimeError):
+    """The model produced NaN/Inf logits. Raised by the serving-path
+    guards (admission sampling, speculative verify) so engines map it
+    to a structured ``nan_logits`` request failure instead of silently
+    argmax-ing garbage; ``slot`` (when set) attributes it."""
+
+    def __init__(self, msg: str, slot: int | None = None):
+        super().__init__(msg)
+        self.slot = slot
+
+
 def greedy(logits: jax.Array) -> jax.Array:
     """``logits [..., V]`` → token ids ``[...]``."""
     return jnp.argmax(logits, axis=-1).astype(jnp.int32)

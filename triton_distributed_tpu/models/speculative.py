@@ -45,6 +45,7 @@ import numpy as np
 from triton_distributed_tpu.models import sampling
 from triton_distributed_tpu.models.paged_kv_cache import gather_bucket
 from triton_distributed_tpu.models.prefix_cache import round_chunk
+from triton_distributed_tpu.runtime.faults import fault_point, mutate_point
 from triton_distributed_tpu.runtime.profiling import trace_span
 
 
@@ -260,7 +261,10 @@ def spec_verify_slot(
     """One speculative verify of ``slot``: run ``[pending] + draft``
     through a single chunked paged-prefill forward (per-position
     logits), accept a prefix, and return
-    ``(emitted tokens, cache, accepted, key)``.
+    ``(emitted tokens, cache, accepted, key)``. ``emitted`` is None
+    when the chunk produced non-finite logits — the returned cache is
+    still valid (the old one was donated to the chunk program) and the
+    caller must fail this slot's request as ``nan_logits``.
 
     The chunk program writes KV for every input row and sets the slot's
     device ``kv_len`` to ``kv_len + 1 + len(draft)``; the CALLER owns
@@ -271,6 +275,7 @@ def spec_verify_slot(
     mismatch, or the bonus token after a full accept — so every verify
     emits at least one token.
     """
+    fault_point("spec.verify", slot=slot)
     toks = [int(pending)] + [int(d) for d in draft]
     n = len(toks)
     c = round_chunk(n)
@@ -286,6 +291,17 @@ def spec_verify_slot(
             kv_pages=kv_pages, all_logits=True,
         )
     arr = np.asarray(logits[:n], np.float32)
+    arr = mutate_point("spec.logits", arr, slot=slot)
+    if not np.isfinite(arr).all():
+        # Same contract as the batched-decode guard: never silently
+        # argmax/sample a non-finite row (np.argmax over NaN returns
+        # index 0). Signalled as ``emitted=None`` rather than raised:
+        # the chunk program already consumed (donated) the caller's
+        # cache arrays, so the caller MUST receive the new cache to
+        # stay serviceable — a raise here would strand it on deleted
+        # buffers. Callers map None to a ``nan_logits`` failure of
+        # exactly this slot's request.
+        return None, cache, 0, key
     if temperature <= 0.0:
         accepted, nxt = verify_greedy(arr, draft)
     else:

@@ -1,0 +1,280 @@
+"""Deterministic fault injection for the serving stack.
+
+Triton-distributed ships its overlap kernels with correctness
+scaffolding because async resource-sharing bugs are silent until they
+corrupt outputs (arXiv:2504.19442); the same discipline applies to the
+shared-page serving loop: a refcount leak after a mid-batch failure is
+invisible until the pool wedges under load. This module makes those
+failures *reproducible*: a seeded :class:`FaultPlan` arms named seams in
+the engine/pool/server code, and the chaos suite (``tests/test_faults.py``)
+proves every injected fault leaves the engine serviceable and the
+pool/radix audit clean.
+
+Seams currently instrumented (grep for ``fault_point``/``mutate_point``):
+
+=================  =====================================================
+``pool.allocate``  ``PagePool.allocate`` — pool-exhaustion faults
+``engine.admit``   ``ContinuousEngine._admit`` — prefill-time failures
+``engine.decode``  ``ContinuousEngine._decode_once`` — decode-step
+                   exceptions (attributable via ``slot=``)
+``engine.logits``  decode logits mutation hook — NaN/Inf injection
+``spec.verify``    ``speculative.spec_verify_slot`` — verify failures
+``server.recv``    ``ModelServer._serve_lines`` read side — socket
+                   drops / slow clients (``delay=``)
+``server.send``    ``ModelServer._serve_lines`` write side
+=================  =====================================================
+
+Usage::
+
+    plan = (FaultPlan(seed=7)
+            .exhaust_pool(at=2)          # 2nd allocation raises
+            .nan_logits(at=3, slot=1))   # 3rd decode step: slot 1 NaN
+    with plan:
+        results = engine.run(reqs, results=True)
+    assert plan.fired  # every firing is logged for assertions
+
+A plan is deterministic by construction: rules fire on exact per-seam
+hit counts (``at``/``every``) or on a coin drawn from the plan's own
+seeded RNG (``prob``) — same seed, same call order, same faults. When
+no plan is active every seam is a single ``is None`` check.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+import time
+from collections import Counter
+from typing import Any, Callable
+
+
+class FaultError(RuntimeError):
+    """An injected fault. ``seam`` names the injection point; ``slot``
+    (when not None) attributes the fault to one engine slot, so the
+    engine's per-request isolation evicts exactly that request instead
+    of failing the whole batch."""
+
+    def __init__(self, seam: str, note: str = "injected fault",
+                 slot: int | None = None):
+        where = f"{note} at seam '{seam}'"
+        if slot is not None:
+            where += f" (slot {slot})"
+        super().__init__(where)
+        self.seam = seam
+        self.slot = slot
+
+
+@dataclasses.dataclass
+class FaultRule:
+    """One arming of one seam. Fires when the seam's hit count is in
+    ``at``, or divides ``every``, or the seeded coin lands under
+    ``prob`` — at most ``times`` total — and then raises ``exc`` (a
+    :class:`FaultError` by default), sleeps ``delay`` seconds, or runs
+    ``mutate(value, ctx)`` over the seam's value (mutation seams
+    only). ``match`` keys must equal the seam's context kwargs."""
+
+    seam: str
+    at: tuple[int, ...] = ()
+    every: int = 0
+    prob: float = 0.0
+    times: int = 1
+    slot: int | None = None
+    exc: BaseException | None = None
+    mutate: Callable[[Any, dict], Any] | None = None
+    delay: float = 0.0
+    match: dict = dataclasses.field(default_factory=dict)
+    fired: int = 0
+
+
+class FaultPlan:
+    """A seeded, self-logging set of :class:`FaultRule`\\ s.
+
+    Activate with ``with plan:`` — activation is process-global (the
+    server thread must see the same plan as the test thread), guarded
+    against nesting. ``plan.fired`` records ``(seam, hit, ctx)`` for
+    every firing so tests can assert the plan actually exercised its
+    seams."""
+
+    def __init__(self, seed: int = 0):
+        self.rng = random.Random(seed)
+        self.rules: list[FaultRule] = []
+        self.hits: Counter = Counter()
+        self.fired: list[tuple[str, int, dict]] = []
+        # Seams fire from multiple threads (the server is
+        # thread-per-connection): hit counting and rule bookkeeping
+        # must be atomic or times=1 rules double-fire under races.
+        self._lock = threading.Lock()
+
+    # -- arming ----------------------------------------------------------
+
+    def on(
+        self,
+        seam: str,
+        *,
+        at: int | tuple[int, ...] | None = None,
+        every: int = 0,
+        prob: float = 0.0,
+        times: int = 1,
+        slot: int | None = None,
+        exc: BaseException | None = None,
+        mutate: Callable[[Any, dict], Any] | None = None,
+        delay: float = 0.0,
+        **match,
+    ) -> "FaultPlan":
+        """Arm ``seam``; returns ``self`` for chaining."""
+        ats = () if at is None else (
+            (int(at),) if isinstance(at, int) else tuple(int(a) for a in at)
+        )
+        if not ats and not every and prob <= 0.0:
+            ats = (1,)  # default: fire on the first hit
+        self.rules.append(FaultRule(
+            seam=seam, at=ats, every=int(every), prob=float(prob),
+            times=int(times), slot=slot, exc=exc, mutate=mutate,
+            delay=float(delay), match=dict(match),
+        ))
+        return self
+
+    # Named-seam conveniences (the chaos suite reads as a fault menu).
+
+    def exhaust_pool(self, at: int = 1, times: int = 1) -> "FaultPlan":
+        """Nth ``PagePool.allocate`` raises as if the pool were empty."""
+        return self.on("pool.allocate", at=at, times=times,
+                       exc=RuntimeError("page pool exhausted (injected)"))
+
+    def admit_exc(self, at: int = 1, times: int = 1) -> "FaultPlan":
+        """Nth admission prefill raises."""
+        return self.on("engine.admit", at=at, times=times)
+
+    def decode_exc(self, at: int = 1, slot: int | None = None,
+                   times: int = 1) -> "FaultPlan":
+        """Nth decode step raises; ``slot`` attributes the fault so
+        only that request fails (None → the whole step is poisoned)."""
+        return self.on("engine.decode", at=at, slot=slot, times=times)
+
+    def nan_logits(self, at: int = 1, slot: int = 0,
+                   times: int = 1) -> "FaultPlan":
+        """Nth decode step's logits for ``slot`` become NaN."""
+
+        def _nanify(value, _ctx):
+            import jax.numpy as jnp
+            import numpy as np
+
+            arr = np.array(value, np.float32)
+            arr[slot] = np.nan
+            return jnp.asarray(arr)
+
+        return self.on("engine.logits", at=at, times=times, mutate=_nanify)
+
+    def verify_exc(self, at: int = 1, times: int = 1) -> "FaultPlan":
+        """Nth speculative verify raises (attributed to its slot by the
+        seam's own context)."""
+        return self.on("spec.verify", at=at, times=times)
+
+    def drop_connection(self, at: int = 1, times: int = 1) -> "FaultPlan":
+        """Nth server response write raises mid-stream (client vanishes
+        between request and response)."""
+        return self.on("server.send", at=at, times=times,
+                       exc=BrokenPipeError("connection dropped (injected)"))
+
+    def slow_client(self, delay: float, at: int = 1,
+                    times: int = 1) -> "FaultPlan":
+        """Nth server read stalls ``delay`` seconds before proceeding."""
+        return self.on("server.recv", at=at, times=times, delay=delay)
+
+    # -- firing ----------------------------------------------------------
+
+    def _matches(self, rule: FaultRule, hit: int, ctx: dict) -> bool:
+        if rule.fired >= rule.times:
+            return False
+        for k, v in rule.match.items():
+            if ctx.get(k) != v:
+                return False
+        if hit in rule.at:
+            return True
+        if rule.every and hit % rule.every == 0:
+            return True
+        if rule.prob > 0.0 and self.rng.random() < rule.prob:
+            return True
+        return False
+
+    def fire(self, seam: str, **ctx) -> None:
+        """Raise/sleep per the armed rules; no-op if nothing matches.
+        The decision runs under the plan lock (atomic hit counting);
+        the sleep/raise happens outside it so a delay rule can't
+        serialize every other seam."""
+        delay = 0.0
+        exc: BaseException | None = None
+        with self._lock:
+            self.hits[seam] += 1
+            hit = self.hits[seam]
+            for rule in self.rules:
+                if rule.seam != seam or rule.mutate is not None:
+                    continue
+                if not self._matches(rule, hit, ctx):
+                    continue
+                rule.fired += 1
+                self.fired.append((seam, hit, dict(ctx)))
+                if rule.delay:
+                    delay = rule.delay
+                    continue
+                exc = rule.exc if rule.exc is not None else FaultError(
+                    seam, slot=rule.slot
+                )
+                break
+        if delay:
+            time.sleep(delay)
+        if exc is not None:
+            raise exc
+
+    def mutate(self, seam: str, value: Any, **ctx) -> Any:
+        """Pass ``value`` through the armed mutation rules."""
+        matched: list[FaultRule] = []
+        with self._lock:
+            self.hits[seam] += 1
+            hit = self.hits[seam]
+            for rule in self.rules:
+                if rule.seam != seam or rule.mutate is None:
+                    continue
+                if not self._matches(rule, hit, ctx):
+                    continue
+                rule.fired += 1
+                self.fired.append((seam, hit, dict(ctx)))
+                matched.append(rule)
+        for rule in matched:
+            value = rule.mutate(value, ctx)
+        return value
+
+    # -- activation ------------------------------------------------------
+
+    def __enter__(self) -> "FaultPlan":
+        global _ACTIVE
+        with _LOCK:
+            if _ACTIVE is not None:
+                raise RuntimeError("a FaultPlan is already active")
+            _ACTIVE = self
+        return self
+
+    def __exit__(self, *exc) -> None:
+        global _ACTIVE
+        with _LOCK:
+            _ACTIVE = None
+
+
+_ACTIVE: FaultPlan | None = None
+_LOCK = threading.Lock()
+
+
+def fault_point(seam: str, **ctx) -> None:
+    """A raise-style seam: no-op unless a plan is active and armed."""
+    plan = _ACTIVE
+    if plan is not None:
+        plan.fire(seam, **ctx)
+
+
+def mutate_point(seam: str, value: Any, **ctx) -> Any:
+    """A value-corruption seam: identity unless a plan is armed."""
+    plan = _ACTIVE
+    if plan is not None:
+        return plan.mutate(seam, value, **ctx)
+    return value

@@ -9,18 +9,33 @@ framed protocol. Here the protocol is newline-delimited JSON over TCP:
     ← {"output_ids": [[...]], "stats": {...}}
     → {"requests": [[...], ...], "gen_lens": [4, ...],   (continuous
        "temperatures": [0.8, ...], "top_ps": [...],       batching;
-       "top_ks": [...]}                                   sampling keys
-    ← {"outputs": [[...], ...], "stats": {...}}           optional)
-    → {"cmd": "stats"}           ← {"stats": {...}}
-    → {"cmd": "ping"}            ← {"ok": true}
-    → {"cmd": "shutdown"}        ← {"ok": true}   (server then exits)
+       "top_ks": [...], "deadline_s": [5.0, ...]}         knobs optional)
+    ← {"outputs": [[...], ...],                 (partial on failure)
+       "results": [{"status": "ok"|..., "reason": ...}, ...],
+       "stats": {...}}
+    → {"cmd": "stats"}           ← {"stats": {..., "server": {...}}}
+    → {"cmd": "ping"}            ← {"ok": true, "draining": false}
+    → {"cmd": "shutdown"}        ← {"ok": true}   (server then drains)
 
-The per-request sampling keys are scalars (applied to every request)
-or per-request lists; omitted/null entries fall back to the engine's
-defaults.
+The per-request sampling/deadline keys are scalars (applied to every
+request) or per-request lists; omitted/null entries fall back to the
+engine's defaults.
 
-One request at a time (the accelerator is serial anyway — the reference
-server is likewise single-stream). A ``requests`` payload routes to a
+**Concurrency + fault tolerance** (docs/serving.md "Fault tolerance"):
+each connection is served on its own thread; generation payloads
+serialize on an engine lock (the accelerator is serial anyway), while
+``ping``/``stats`` bypass it — the server answers health probes even
+mid-generation. At most ``max_pending`` generation payloads may wait on
+the lock; excess load is shed with a structured ``overloaded`` error
+(clients retry with backoff — see :func:`request`). Errors are
+structured ``{"error": {"status": ..., "reason": ...}}`` objects:
+``bad_request`` (malformed JSON, oversized line, unknown payload,
+validation), ``overloaded``, ``shutting_down`` (graceful drain: the
+server finishes in-flight work, answers pings, refuses new generation),
+``internal``. Per-request failures inside a ``requests`` payload do NOT
+fail the payload — the response carries per-request statuses.
+
+A ``requests`` payload routes to a
 :class:`~triton_distributed_tpu.models.continuous.ContinuousEngine`'s
 admission/eviction loop (mixed prompt/gen lengths, paged pool, prefix
 cache when the engine enables it); ``input_ids`` routes to
@@ -33,37 +48,160 @@ from __future__ import annotations
 import json
 import socket
 import threading
+import time
 
 import numpy as np
 
 from triton_distributed_tpu.models.engine import Engine
+from triton_distributed_tpu.runtime.faults import fault_point
+
+
+class _BadRequest(ValueError):
+    """Client-side protocol error: mapped to status ``bad_request``."""
 
 
 class ModelServer:
     """Own a listening socket + an Engine; serve generation requests."""
 
-    def __init__(self, engine: Engine, host: str = "127.0.0.1", port: int = 0):
+    # An idle client must not wedge a connection thread forever: a
+    # connection that sends nothing within this window is dropped.
+    IDLE_TIMEOUT_S = 10.0
+    # Bound on one accepted request line: a giant payload must not OOM
+    # the server before JSON parsing even starts.
+    MAX_LINE_BYTES = 1 << 20
+    # Graceful-drain bound: how long serve_forever waits for in-flight
+    # connections after shutdown (threads are daemonized — a wedged
+    # client cannot hold process exit hostage).
+    DRAIN_TIMEOUT_S = 30.0
+
+    def __init__(
+        self,
+        engine: Engine,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        *,
+        max_pending: int = 8,
+    ):
         self.engine = engine
+        self.max_pending = max_pending
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
-        self._sock.listen(4)
+        self._sock.listen(16)
         self.host, self.port = self._sock.getsockname()
         self._shutdown = threading.Event()
         self._thread: threading.Thread | None = None
+        # One generation at a time (the accelerator is serial); probes
+        # (ping/stats) never take this lock, so the server answers them
+        # mid-generation.
+        self._engine_lock = threading.Lock()
+        self._pending = 0
+        self._pending_lock = threading.Lock()
+        self._counters = {
+            "connections": 0,
+            "requests": 0,
+            "errors": 0,       # per-payload failures (bad/unknown/internal)
+            "conn_errors": 0,  # per-connection failures (drop/timeout)
+            "shed": 0,         # generation payloads shed as overloaded
+            "refused": 0,      # generation payloads refused while draining
+        }
+        self._counters_lock = threading.Lock()
+        self._last_conn_error: str | None = None
+
+    def _count(self, key: str) -> None:
+        with self._counters_lock:
+            self._counters[key] += 1
+
+    @property
+    def server_stats(self) -> dict:
+        with self._counters_lock:
+            stats = dict(self._counters)
+            stats["last_conn_error"] = self._last_conn_error
+        with self._pending_lock:
+            stats["pending"] = self._pending
+        stats["draining"] = self._shutdown.is_set()
+        return stats
 
     # -- request handling ------------------------------------------------
-    def _handle(self, req: dict) -> dict:
-        if req.get("cmd") == "ping":
-            return {"ok": True}
-        if req.get("cmd") == "shutdown":
-            self._shutdown.set()
-            return {"ok": True}
-        if req.get("cmd") == "stats":
-            return {"stats": self.engine.last_stats}
+
+    @staticmethod
+    def _error(status: str, reason: str, **extra) -> dict:
+        return {"error": {"status": status, "reason": reason, **extra}}
+
+    def _dispatch(self, req) -> dict:
+        """Route one parsed payload; every failure becomes a structured
+        error response — nothing escapes to kill the connection."""
+        try:
+            if not isinstance(req, dict):
+                raise _BadRequest("payload must be a JSON object")
+            cmd = req.get("cmd")
+            if cmd == "ping":
+                return {"ok": True, "draining": self._shutdown.is_set()}
+            if cmd == "shutdown":
+                self._shutdown.set()
+                return {"ok": True}
+            if cmd == "stats":
+                stats = dict(self.engine.last_stats)
+                stats["server"] = self.server_stats
+                return {"stats": stats}
+            if "requests" in req or "input_ids" in req:
+                return self._generate_guarded(req)
+            accepted = [
+                "cmd (ping|stats|shutdown)",
+                "requests + gen_lens/temperatures/top_ps/top_ks/"
+                "deadline_s (continuous batching)",
+                "input_ids + gen_len/prompt_start (fixed batch)",
+            ]
+            raise _BadRequest(
+                f"unknown request with keys {sorted(req.keys())}; "
+                f"accepted payloads: {accepted}"
+            )
+        except _BadRequest as e:
+            self._count("errors")
+            return self._error("bad_request", str(e))
+        except ValueError as e:
+            # Engine-side request validation (knob/gen_lens mismatch,
+            # prompt_start out of range, oversized fixed-batch serve)
+            # is the client's fault; anything else escaping the engine
+            # (TypeError/KeyError deep in a forward pass) is OURS and
+            # must read as `internal`, not as a malformed request.
+            self._count("errors")
+            return self._error("bad_request", f"{type(e).__name__}: {e}")
+        except Exception as e:  # noqa: BLE001 — keep the server alive
+            self._count("errors")
+            return self._error("internal", f"{type(e).__name__}: {e}")
+
+    def _generate_guarded(self, req: dict) -> dict:
+        """Admission control around the engine: refuse while draining,
+        shed when too many payloads already wait on the engine lock."""
+        if self._shutdown.is_set():
+            self._count("refused")
+            return self._error(
+                "shutting_down",
+                "server is draining; no new generation work accepted",
+            )
+        with self._pending_lock:
+            if self._pending >= self.max_pending:
+                self._count("shed")
+                return self._error(
+                    "overloaded",
+                    f"{self._pending} generation payloads already "
+                    f"pending (bound {self.max_pending}); retry with "
+                    "backoff",
+                )
+            self._pending += 1
+        try:
+            with self._engine_lock:
+                self._count("requests")
+                return self._generate(req)
+        finally:
+            with self._pending_lock:
+                self._pending -= 1
+
+    def _generate(self, req: dict) -> dict:
         if "requests" in req:
             if not hasattr(self.engine, "run"):
-                raise TypeError(
+                raise _BadRequest(
                     "'requests' payloads need a ContinuousEngine; this "
                     "server wraps a fixed-batch Engine"
                 )
@@ -77,8 +215,8 @@ class ModelServer:
                 )
 
             def knob(name, cast):
-                """Per-request sampling knob: scalar → broadcast,
-                list → per request, absent/null → engine default."""
+                """Per-request knob: scalar → broadcast, list → per
+                request, absent/null → engine default."""
                 v = req.get(name)
                 if v is None:
                     return [None] * len(prompts)
@@ -93,16 +231,27 @@ class ModelServer:
             temps = knob("temperatures", float)
             top_ps = knob("top_ps", float)
             top_ks = knob("top_ks", int)
+            deadlines = knob("deadline_s", float)
             from triton_distributed_tpu.models.continuous import Request
 
-            outs = self.engine.run([
-                Request(p, int(g), temperature=t, top_p=tp, top_k=tk)
-                for p, g, t, tp, tk in zip(
-                    prompts, gen_lens, temps, top_ps, top_ks
-                )
-            ])
+            results = self.engine.run(
+                [
+                    Request(
+                        p, int(g), temperature=t, top_p=tp, top_k=tk,
+                        deadline_s=dl,
+                    )
+                    for p, g, t, tp, tk, dl in zip(
+                        prompts, gen_lens, temps, top_ps, top_ks, deadlines
+                    )
+                ],
+                results=True,
+            )
             return {
-                "outputs": [o.tolist() for o in outs],
+                "outputs": [r.tokens.tolist() for r in results],
+                "results": [
+                    {"status": r.status, "reason": r.reason}
+                    for r in results
+                ],
                 "stats": self.engine.last_stats,
             }
         input_ids = np.asarray(req["input_ids"], np.int32)
@@ -115,42 +264,109 @@ class ModelServer:
             "stats": self.engine.last_stats,
         }
 
-    # An idle client must not wedge the single-threaded accept loop: a
-    # connection that sends nothing within this window is dropped.
-    IDLE_TIMEOUT_S = 10.0
-
     def _serve_conn(self, conn: socket.socket) -> None:
         conn.settimeout(self.IDLE_TIMEOUT_S)
         try:
-            self._serve_lines(conn)
-        except (socket.timeout, TimeoutError, OSError):
-            conn.close()
+            with conn:
+                self._serve_lines(conn)
+        except Exception as e:  # noqa: BLE001 — a conn thread never dies loud
+            # Connection-level failure: client vanished mid-request,
+            # injected drop/recv fault, idle timeout. Catching broadly
+            # keeps the contract that per-connection failures are
+            # COUNTED (an injected FaultError is a RuntimeError, not an
+            # OSError) — and the last failure is kept diagnosable in
+            # the stats instead of vanishing into a bare counter. The
+            # `with conn` above already closed the socket — the old
+            # except-path conn.close() double-close could itself raise.
+            with self._counters_lock:
+                self._last_conn_error = f"{type(e).__name__}: {e}"
+            self._count("conn_errors")
 
     def _serve_lines(self, conn: socket.socket) -> None:
-        with conn, conn.makefile("rwb") as f:
-            for line in f:
+        with conn.makefile("rwb") as f:
+            while True:
+                fault_point("server.recv")
+                line = f.readline(self.MAX_LINE_BYTES + 1)
+                if not line:
+                    return  # client closed cleanly
+                if len(line) > self.MAX_LINE_BYTES:
+                    # Framing is lost beyond the bound (the line's tail
+                    # is still in flight): answer, then drop the conn.
+                    self._count("errors")
+                    self._respond(f, self._error(
+                        "bad_request",
+                        f"request line exceeds {self.MAX_LINE_BYTES} "
+                        "bytes; connection closed",
+                    ))
+                    # Drain the line's remainder before closing:
+                    # unread bytes in the kernel queue turn close()
+                    # into an RST, which makes the client discard the
+                    # error response we just sent. The socket timeout
+                    # is dropped to the drain budget too — the wall
+                    # deadline alone only bounds the number of
+                    # readline calls, not one call's duration, and a
+                    # client dripping bytes could otherwise pin the
+                    # thread (each drip resetting the 10 s idle
+                    # timeout). A timeout here raises and is counted
+                    # as a conn error, which a hostile client is.
+                    conn.settimeout(2.0)
+                    drain_deadline = time.monotonic() + 2.0
+                    while time.monotonic() < drain_deadline:
+                        rest = f.readline(self.MAX_LINE_BYTES)
+                        if not rest or rest.endswith(b"\n"):
+                            break
+                    return
                 line = line.strip()
                 if not line:
                     continue
                 try:
-                    resp = self._handle(json.loads(line))
+                    payload = json.loads(line)
                 except Exception as e:  # report, keep serving
-                    resp = {"error": f"{type(e).__name__}: {e}"}
-                f.write(json.dumps(resp).encode() + b"\n")
-                f.flush()
+                    self._count("errors")
+                    self._respond(f, self._error(
+                        "bad_request",
+                        f"malformed JSON: {type(e).__name__}: {e}",
+                    ))
+                    continue
+                self._respond(f, self._dispatch(payload))
                 if self._shutdown.is_set():
                     return
 
+    def _respond(self, f, resp: dict) -> None:
+        fault_point("server.send")
+        f.write(json.dumps(resp).encode() + b"\n")
+        f.flush()
+
     def serve_forever(self) -> None:
-        """Accept loop; returns after a shutdown request."""
+        """Accept loop; spawns one thread per connection and returns
+        after a shutdown request has drained in-flight connections."""
         self._sock.settimeout(0.2)
+        threads: list[threading.Thread] = []
         while not self._shutdown.is_set():
             try:
                 conn, _ = self._sock.accept()
             except socket.timeout:
+                threads = [t for t in threads if t.is_alive()]
                 continue
-            self._serve_conn(conn)
+            except OSError:
+                break  # listener closed under us
+            # Prune on EVERY accept, not just idle timeouts — under
+            # continuous traffic the timeout branch never runs and the
+            # list would grow one dead Thread per connection.
+            threads = [t for t in threads if t.is_alive()]
+            self._count("connections")
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            )
+            t.start()
+            threads.append(t)
         self._sock.close()
+        # Graceful drain: in-flight payloads (generation included)
+        # finish and answer; connection threads then exit on their own
+        # (new generation payloads are refused with `shutting_down`).
+        deadline = time.monotonic() + self.DRAIN_TIMEOUT_S
+        for t in threads:
+            t.join(max(deadline - time.monotonic(), 0.0))
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "ModelServer":
@@ -162,19 +378,58 @@ class ModelServer:
     def shutdown(self) -> None:
         self._shutdown.set()
         if self._thread is not None:
-            self._thread.join(timeout=5)
+            # Cover serve_forever's full drain window: returning while
+            # a connection thread is still inside engine.run() would
+            # let callers (and the test-suite audit fixture) observe
+            # the engine mid-mutation.
+            self._thread.join(timeout=self.DRAIN_TIMEOUT_S + 5)
 
 
-def request(host: str, port: int, payload: dict, timeout: float = 120.0) -> dict:
-    """One JSON request/response round trip (client side)."""
-    with socket.create_connection((host, port), timeout=timeout) as s, \
-            s.makefile("rwb") as f:
-        f.write(json.dumps(payload).encode() + b"\n")
-        f.flush()
-        line = f.readline()
-    if not line:
-        raise ConnectionError("server closed connection without a response")
-    resp = json.loads(line)
-    if "error" in resp:
-        raise RuntimeError(f"server error: {resp['error']}")
-    return resp
+def request(
+    host: str,
+    port: int,
+    payload: dict,
+    timeout: float = 120.0,
+    *,
+    retries: int = 0,
+    backoff_s: float = 0.25,
+) -> dict:
+    """One JSON request/response round trip (client side).
+
+    With ``retries > 0`` transient failures — connection refused/reset,
+    the server vanishing mid-response, and structured ``overloaded``
+    shedding — are retried with exponential backoff
+    (``backoff_s * 2**attempt``). Non-transient server errors raise
+    ``RuntimeError`` immediately.
+    """
+    attempt = 0
+    while True:
+        try:
+            with socket.create_connection((host, port), timeout=timeout) \
+                    as s, s.makefile("rwb") as f:
+                f.write(json.dumps(payload).encode() + b"\n")
+                f.flush()
+                line = f.readline()
+            if not line:
+                raise ConnectionError(
+                    "server closed connection without a response"
+                )
+            resp = json.loads(line)
+        except (ConnectionError, socket.timeout, TimeoutError, OSError,
+                json.JSONDecodeError):
+            # JSONDecodeError covers the server dying mid-response: a
+            # truncated line is as transient as no line at all.
+            if attempt >= retries:
+                raise
+            time.sleep(backoff_s * (2 ** attempt))
+            attempt += 1
+            continue
+        err = resp.get("error")
+        if err is not None:
+            status = err.get("status") if isinstance(err, dict) else None
+            if status == "overloaded" and attempt < retries:
+                time.sleep(backoff_s * (2 ** attempt))
+                attempt += 1
+                continue
+            raise RuntimeError(f"server error: {err}")
+        return resp
