@@ -1,0 +1,196 @@
+"""Quantized paged KV cache microbench: bytes/token, accuracy, capacity.
+
+CPU-runnable (``JAX_PLATFORMS=cpu``, tiny model, interpret-mode
+kernels). Steady-state decode streams the whole paged pool per step, so
+the platform-independent lever is **KV bytes per cached token** — int8
+codes + per-page-per-head f32 scales vs the full-width pool — and the
+accuracy cost of reading attention through int8. Four quantities land
+in ``perf/KV_QUANT.json``:
+
+- ``kv_bytes_per_token`` both arms + the reduction ratio vs the
+  measured full-width pool AND vs an arithmetic bf16 pool (the tiny
+  test model stores f32; production serves bf16, so the honest
+  headline is the bf16 ratio, labeled as arithmetic),
+- ``decode_ms_per_step`` both arms (CPU interpret-mode wall-clock —
+  advisory only; the chip-level claim is the bytes ratio, decode being
+  KV-bandwidth-bound per the decode ladder in docs/RESULTS.md),
+- max |Δlogits| and greedy argmax agreement vs full-width under
+  teacher forcing (the documented accuracy tolerance),
+- capacity head-room: tokens one pool byte holds, int8 vs full-width
+  (the factor by which the radix prefix cache's retention and the
+  continuous engine's admissible slots grow at fixed HBM).
+
+Output follows perf/MEASURED.json conventions: one JSON object with a
+``provenance`` block, printed to stdout and written to
+``perf/KV_QUANT.json``.
+
+Usage:  JAX_PLATFORMS=cpu python perf/kv_quant_bench.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("TDT_AUTOTUNE_CACHE", "0")
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=4"
+)
+
+import jax  # noqa: E402
+
+if jax.default_backend() != "tpu":
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from triton_distributed_tpu.runtime import mesh as mesh_mod  # noqa: E402
+
+BATCH = 2
+PROMPT_LEN = 24
+PAGE_SIZE = 16
+MAX_LENGTH = 128
+TEACHER_STEPS = 16
+TIMED_STEPS = 8
+
+
+def build_caches(model, ctx, prompt, kv_dtype):
+    """Prefill BATCH rows into a fresh paged pool; returns (first
+    logits, cache)."""
+    from triton_distributed_tpu.models.paged_kv_cache import (
+        init_paged_cache,
+        write_prefill,
+    )
+
+    cache, _pool = init_paged_cache(
+        model.cfg, BATCH, ctx, "tp", max_length=MAX_LENGTH,
+        page_size=PAGE_SIZE, kv_dtype=kv_dtype,
+    )
+    dense1 = model.new_cache(1, MAX_LENGTH)
+    logits = []
+    for i in range(BATCH):
+        lg, dense1 = model.prefill_batched(
+            jnp.asarray(prompt[i : i + 1]), dense1, "xla",
+            jnp.asarray([PROMPT_LEN], np.int32),
+        )
+        cache = write_prefill(cache, i, dense1.k, dense1.v, PROMPT_LEN)
+        logits.append(lg[0])
+    return jnp.stack(logits), cache
+
+
+def main() -> int:
+    from triton_distributed_tpu.models import AutoLLM
+    from triton_distributed_tpu.models.paged_kv_cache import (
+        kv_bytes_per_token,
+    )
+
+    ctx = mesh_mod.initialize_distributed(
+        tp=min(4, len(jax.devices())), devices=jax.devices()[:4]
+    )
+    model = AutoLLM.from_pretrained("tiny", ctx=ctx, max_length=MAX_LENGTH)
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(1, 200, size=(BATCH, PROMPT_LEN)).astype(np.int32)
+
+    lf, cache_full = build_caches(model, ctx, prompt, None)
+    lq, cache_q = build_caches(model, ctx, prompt, "int8")
+
+    bytes_full = kv_bytes_per_token(cache_full)
+    bytes_q = kv_bytes_per_token(cache_q)
+    cfg = model.cfg
+    # Arithmetic bf16 baseline: production pools store bf16 (2 B/elem);
+    # the tiny test model stores f32, which would flatter the ratio.
+    bytes_bf16 = float(
+        2 * cfg.num_layers * cfg.num_kv_heads * cfg.head_dim * 2
+    )
+
+    # Teacher-forced accuracy: identical token stream into both caches.
+    tok = jnp.argmax(lf, -1).astype(jnp.int32)
+    max_dlogits, agree = 0.0, 0
+    for _ in range(TEACHER_STEPS):
+        lgf, cache_full = model.decode_step(tok, cache_full, "xla")
+        lgq, cache_q = model.decode_step(tok, cache_q, "xla")
+        max_dlogits = max(max_dlogits, float(jnp.max(jnp.abs(lgf - lgq))))
+        agree += int((jnp.argmax(lgf, -1) == jnp.argmax(lgq, -1)).sum())
+        tok = jnp.argmax(lgf, -1).astype(jnp.int32)
+    agree_frac = agree / (BATCH * TEACHER_STEPS)
+
+    # Decode step time, both arms (programs are warm from the loop
+    # above for full-width; warm the int8 program shape too).
+    def time_steps(cache):
+        nonlocal_tok = jnp.argmax(lf, -1).astype(jnp.int32)
+        lg, cache = model.decode_step(nonlocal_tok, cache, "xla")
+        jax.block_until_ready(lg)
+        t0 = time.perf_counter()
+        for _ in range(TIMED_STEPS):
+            lg, cache = model.decode_step(nonlocal_tok, cache, "xla")
+        jax.block_until_ready(lg)
+        return (time.perf_counter() - t0) / TIMED_STEPS * 1e3
+
+    ms_full = time_steps(cache_full)
+    ms_q = time_steps(cache_q)
+
+    result = {
+        "metric": "kv_quant_bytes_accuracy_capacity",
+        "workload": {
+            "model": "tiny",
+            "batch": BATCH,
+            "prompt_len": PROMPT_LEN,
+            "page_size": PAGE_SIZE,
+            "teacher_forced_steps": TEACHER_STEPS,
+        },
+        "platform": jax.default_backend(),
+        "kv_bytes_per_token": {
+            "full_width": bytes_full,
+            "int8": bytes_q,
+            "bf16_arithmetic": bytes_bf16,
+        },
+        "reduction_vs_full_width": round(bytes_full / bytes_q, 3),
+        "reduction_vs_bf16": round(bytes_bf16 / bytes_q, 3),
+        "capacity_headroom": {
+            "tokens_per_pool_byte_ratio": round(bytes_full / bytes_q, 3),
+            "note": "pages the same HBM holds grow by this factor — the "
+            "radix tree retains that many more prefix tokens and the "
+            "continuous engine admits proportionally more slots before "
+            "shedding",
+        },
+        "accuracy": {
+            "max_abs_dlogits": round(max_dlogits, 5),
+            "greedy_argmax_agreement": round(agree_frac, 4),
+            "tolerance_documented": "atol 0.25 on logits; flips only "
+            "where full-width top1-top2 gap < quant noise "
+            "(random-init tiny model has near-uniform logits — real "
+            "checkpoints have far larger gaps)",
+        },
+        "decode_ms_per_step": {
+            "full_width": round(ms_full, 2),
+            "int8": round(ms_q, 2),
+        },
+        "provenance": {
+            "harness": "perf/kv_quant_bench.py — paged tiny-model decode "
+            "with teacher-forced token stream; int8 pool via "
+            "init_paged_cache(kv_dtype='int8'), in-kernel dequant "
+            "(interpret mode on CPU)",
+            "caveat": "CPU wall-clock is interpret-mode-taxed and "
+            "advisory (the int8 arm pays dequant FLOPs the interpreter "
+            "does not hide); the platform-independent levers are "
+            "bytes/token and capacity_headroom — on-chip decode is "
+            "KV-bandwidth-bound (docs/RESULTS.md decode ladder), so "
+            "the bytes ratio bounds the step-time win",
+        },
+    }
+    print(json.dumps(result), flush=True)
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "KV_QUANT.json")
+    with open(out, "w") as f:
+        f.write(json.dumps(result, indent=2) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -34,6 +34,10 @@ def main(argv=None) -> int:
                    choices=["xla", "pallas", "mega"])
     p.add_argument("--gen-len", type=int, default=32)
     p.add_argument("--cpu", action="store_true")
+    p.add_argument("--kv-dtype", default=None, choices=["int8"],
+                   help="int8-quantized paged KV pool (forces the paged "
+                   "xla/pallas engine; stats payload then carries "
+                   "kv_bytes_per_token/kv_dtype through the wire)")
     args = p.parse_args(argv)
 
     import jax
@@ -55,7 +59,10 @@ def main(argv=None) -> int:
     )
     jax.block_until_ready(model.params)
     mode = args.mode if not (args.cpu and args.mode == "mega") else "xla"
-    eng = Engine(model, temperature=0.0, mode=mode)
+    if args.kv_dtype and mode == "mega":
+        mode = "xla"  # quantized pool composes with xla/pallas decode
+    eng = Engine(model, temperature=0.0, mode=mode,
+                 paged=bool(args.kv_dtype), kv_dtype=args.kv_dtype)
     server = ModelServer(eng).start()
     print(json.dumps({"serving": args.model, "mode": mode,
                       "port": server.port,

@@ -298,3 +298,31 @@ def test_perf_scripts_compile():
     assert proc.returncode == 0, (
         f"perf/ scripts failed to compile:\n{proc.stdout}\n{proc.stderr}"
     )
+
+
+def test_kv_quant_modules_compile():
+    """The quantized-KV stack must byte-compile: the scale-aware pool,
+    the dequantizing attention kernels, and the CPU-runnable bench that
+    writes perf/KV_QUANT.json (run ad-hoc like the other perf
+    harnesses — a syntax error must fail tier-1, not a relay window)."""
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    targets = [
+        os.path.join(root, "triton_distributed_tpu", "models",
+                     "paged_kv_cache.py"),
+        os.path.join(root, "triton_distributed_tpu", "ops", "attention",
+                     "flash_decode.py"),
+        os.path.join(root, "triton_distributed_tpu", "ops", "attention",
+                     "flash_attention.py"),
+        os.path.join(root, "perf", "kv_quant_bench.py"),
+    ]
+    proc = subprocess.run(
+        [sys.executable, "-m", "compileall", "-q", "-f", *targets],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, (
+        f"kv-quant modules failed to compile:\n{proc.stdout}\n{proc.stderr}"
+    )

@@ -141,6 +141,9 @@ def tp_attn_prefill_paged_chunk(
     axis: str = "tp",
     mode: Mode = "xla_ar",
     ctx: DistContext | None = None,
+    k_scale: jax.Array | None = None,  # [P, hkv_loc] f32 — int8 pool scales
+    v_scale: jax.Array | None = None,
+    q_end: jax.Array | None = None,    # scalar int32 — end of REAL rows
 ):
     """Per-shard chunked-prefill step over the paged pool (inside
     ``shard_map``): QKV for ``C`` suffix tokens, rope at absolute
@@ -150,14 +153,27 @@ def tp_attn_prefill_paged_chunk(
     ``kv_offset``. This is the prefix-cache suffix prefill: matched
     prefix pages are read, never recomputed.
 
+    With ``k_scale``/``v_scale`` (int8 pool) the scatter quantizes the
+    chunk's rows (growing/resetting the touched pages' scales) and the
+    attention reads int8 codes with per-page scales dequantized inside
+    the kernel (``block_k = page_size`` so pool pages ARE kv blocks).
+    Quantized chunks route PAD rows (positions ≥ ``q_end``, the
+    round_chunk right-padding) to the trash page: on the full-width
+    path pad KV is inert (overwritten/masked), but a quantized pad row
+    would grow — or, at page offset 0, seed — the touched page's scale
+    with garbage amax, permanently requantizing accepted history
+    against rows that are not part of the sequence.
+
     Activations stay replicated (decode's AR layout, not prefill's
     sequence-sharded one): chunks are short, so the ag/rs overlap machinery
     would buy nothing, and replication keeps one compiled program valid for
-    every chunk offset. Returns ``(out [C, d], k_pages, v_pages)``.
+    every chunk offset. Returns
+    ``(out [C, d], k_pages, v_pages, k_scale, v_scale)``.
     """
     c = x.shape[0]
     page = k_pages.shape[2]
     pps = table_row.shape[0]
+    quant = k_scale is not None
     qkv = jnp.dot(x, params.wqkv, preferred_element_type=jnp.float32).astype(
         x.dtype
     )
@@ -178,12 +194,27 @@ def tp_attn_prefill_paged_chunk(
         valid, jnp.take(table_row, jnp.clip(pos // page, 0, pps - 1)), 0
     )
     offs = jnp.where(valid, pos % page, 0)
-    k_pages = k_pages.at[pids, :, offs, :].set(
-        k.swapaxes(0, 1).astype(k_pages.dtype)
-    )
-    v_pages = v_pages.at[pids, :, offs, :].set(
-        v.swapaxes(0, 1).astype(v_pages.dtype)
-    )
+    if quant:
+        from triton_distributed_tpu.models.paged_kv_cache import (
+            quantized_row_scatter,
+        )
+
+        real = valid if q_end is None else valid & (pos < q_end)
+        pids_q = jnp.where(real, pids, 0)
+        offs_q = jnp.where(real, offs, 0)
+        k_pages, k_scale = quantized_row_scatter(
+            k_pages, k_scale, k.swapaxes(0, 1), pids_q, offs_q
+        )
+        v_pages, v_scale = quantized_row_scatter(
+            v_pages, v_scale, v.swapaxes(0, 1), pids_q, offs_q
+        )
+    else:
+        k_pages = k_pages.at[pids, :, offs, :].set(
+            k.swapaxes(0, 1).astype(k_pages.dtype)
+        )
+        v_pages = v_pages.at[pids, :, offs, :].set(
+            v.swapaxes(0, 1).astype(v_pages.dtype)
+        )
 
     # Attend over the sequence's dense view (prefix + chunk). The
     # gather is bounded to ``kv_pages`` table entries — the caller's
@@ -201,10 +232,21 @@ def tp_attn_prefill_paged_chunk(
     k_dense = pages_to_dense(k_pages, gather_row[None])  # [1, h, S_kv, hd]
     v_dense = pages_to_dense(v_pages, gather_row[None])
     s_max = gather_row.shape[0] * page
-    o = flash_attention(
-        q[None], k_dense, v_dense, causal=True, kv_offset=q_offset,
-        block_k=128 if s_max % 128 == 0 else page,
-    )[0]  # [h, C, hd]
+    if quant:
+        # The gathered view keeps int8 codes; per-page scales gather
+        # through the same bucket and dequantize inside the kernel
+        # (block_k = page so pages and kv blocks coincide).
+        ks_dense = jnp.take(k_scale, gather_row, axis=0).T[None]  # [1,h,pps]
+        vs_dense = jnp.take(v_scale, gather_row, axis=0).T[None]
+        o = flash_attention(
+            q[None], k_dense, v_dense, causal=True, kv_offset=q_offset,
+            block_k=page, k_scale=ks_dense, v_scale=vs_dense,
+        )[0]  # [h, C, hd]
+    else:
+        o = flash_attention(
+            q[None], k_dense, v_dense, causal=True, kv_offset=q_offset,
+            block_k=128 if s_max % 128 == 0 else page,
+        )[0]  # [h, C, hd]
     o_flat = o.swapaxes(0, 1).reshape(c, dims.hq_loc * dims.head_dim)
     o_flat = o_flat.astype(x.dtype)
     if mode in ("xla", "xla_ar"):
@@ -212,7 +254,7 @@ def tp_attn_prefill_paged_chunk(
         out = jax.lax.psum(part.astype(x.dtype), axis)
     else:
         out = gemm_ar(o_flat, params.wo, axis=axis, ctx=ctx)
-    return out, k_pages, v_pages
+    return out, k_pages, v_pages, k_scale, v_scale
 
 
 def tp_attn_decode(
@@ -278,6 +320,8 @@ def tp_attn_decode_paged(
     axis: str = "tp",
     mode: Mode = "pallas_ar",
     ctx: DistContext | None = None,
+    k_scale: jax.Array | None = None,  # [P, hkv_loc] f32 — int8 pool scales
+    v_scale: jax.Array | None = None,
 ):
     """Per-shard decode step over a paged KV pool (inside ``shard_map``).
 
@@ -286,11 +330,18 @@ def tp_attn_decode_paged(
     is :func:`paged_flash_decode` (table-indexed BlockSpecs — no dense
     gather). Parity: the reference megakernel's paged decode
     (``mega_triton_kernel/models/paged_kv_cache.py``).
+
+    With ``k_scale``/``v_scale`` (int8 pool) the append quantizes each
+    new row into its page (growing the page scale, requantizing when it
+    moves) and the attention streams int8 codes, dequantized inside the
+    kernel — the decode step's KV read is half the bf16 bytes. Returns
+    ``(out [B, d], k_pages, v_pages, k_scale, v_scale)``.
     """
     from triton_distributed_tpu.ops.attention import paged_flash_decode
 
     b = x.shape[0]
     page = k_pages.shape[2]
+    quant = k_scale is not None
     qkv = jnp.dot(x, params.wqkv, preferred_element_type=jnp.float32).astype(
         x.dtype
     )
@@ -310,10 +361,30 @@ def tp_attn_decode_paged(
             )
         return pages
 
-    k_pages = upd(k_pages, k)
-    v_pages = upd(v_pages, v)
+    def upd_q(pages, scales, new):
+        from triton_distributed_tpu.models.paged_kv_cache import (
+            quantized_row_scatter,
+        )
 
-    o = paged_flash_decode(q, k_pages, v_pages, page_table, kv_len + 1)
+        # One batched scatter for all B sequences (active rows never
+        # share a page; inactive rows fan into the trash page, where
+        # the scatter's duplicate-pid contract holds).
+        pids = page_table[jnp.arange(b), kv_len // page]
+        return quantized_row_scatter(
+            pages, scales, new, pids, kv_len % page
+        )
+
+    if quant:
+        k_pages, k_scale = upd_q(k_pages, k_scale, k)
+        v_pages, v_scale = upd_q(v_pages, v_scale, v)
+    else:
+        k_pages = upd(k_pages, k)
+        v_pages = upd(v_pages, v)
+
+    o = paged_flash_decode(
+        q, k_pages, v_pages, page_table, kv_len + 1,
+        k_scale=k_scale, v_scale=v_scale,
+    )
     o_flat = o.reshape(b, dims.hq_loc * dims.head_dim).astype(x.dtype)
     if mode in ("xla", "xla_ar"):
         part = jnp.dot(o_flat, params.wo, preferred_element_type=jnp.float32)
@@ -322,7 +393,7 @@ def tp_attn_decode_paged(
         out = gemm_ar(o_flat, params.wo, axis=axis, ctx=ctx)
     else:
         raise ValueError(f"unknown mode {mode!r}")
-    return out, k_pages, v_pages
+    return out, k_pages, v_pages, k_scale, v_scale
 
 
 class TPAttn:

@@ -39,6 +39,11 @@ class ModelConfig:
     # runtime
     max_length: int = 4096
     dtype: jnp.dtype = jnp.bfloat16
+    # Paged-KV storage width: None stores the pool in ``dtype``
+    # (bit-identical legacy layout); "int8" stores int8 codes plus
+    # symmetric per-page-per-head scales, dequantized inside the
+    # attention kernels (docs/serving.md "Quantized KV cache").
+    kv_dtype: str | None = None
 
 
 # Architecture presets (numbers from the public HF configs the reference

@@ -217,6 +217,7 @@ class ContinuousEngine(MegaDispatch):
         prefill_chunk: int = 0,
         speculative: int = 0,
         max_queue: int | None = None,
+        kv_dtype: str | None = None,
     ):
         self.model = model
         self.mode = mode
@@ -233,6 +234,20 @@ class ContinuousEngine(MegaDispatch):
                 "the megakernel"
             )
         self.speculative = int(speculative)
+        # Quantized KV storage (docs/serving.md "Quantized KV cache"):
+        # int8 pool + per-page-per-head scales — halves the bytes every
+        # decode step streams AND doubles how many tokens the same pool
+        # HBM holds, so the radix tree retains more prefixes and more
+        # slots admit before shedding. Explicit knob wins over
+        # ``cfg.kv_dtype``.
+        self.kv_dtype = kv_dtype if kv_dtype is not None else (
+            model.cfg.kv_dtype
+        )
+        if self.kv_dtype is not None and mode == "mega":
+            raise ValueError(
+                "kv_dtype composes with mode='xla'/'pallas', not the "
+                "megakernel (its fused decode reads the pool full-width)"
+            )
         self.eos_id = eos_id
         self.key = jax.random.key(seed)
         self.max_batch = max_batch
@@ -248,6 +263,7 @@ class ContinuousEngine(MegaDispatch):
             model.cfg, max_batch, model.ctx, model.axis,
             max_length=self.max_length, page_size=page_size,
             num_pages=n_pages, assign_pages=False,
+            kv_dtype=self.kv_dtype,
         )
         self.pool.free = [p for p in self.pool.free if p != 0]
         self._capacity = len(self.pool.free)
@@ -298,6 +314,14 @@ class ContinuousEngine(MegaDispatch):
         isolated decode faults)."""
         stats = dict(self.stats)
         stats["free_pages"] = len(self.pool.free)
+        from triton_distributed_tpu.models.paged_kv_cache import (
+            kv_bytes_per_token,
+        )
+
+        stats["kv_bytes_per_token"] = kv_bytes_per_token(self.cache)
+        stats["kv_dtype"] = (
+            self.kv_dtype or str(jnp.dtype(self.cache.k_pages.dtype))
+        )
         if self.prefix is not None:
             stats["prefix_cache"] = dict(self.prefix.stats)
             stats["prefix_hit_rate"] = self.prefix.hit_rate

@@ -155,6 +155,7 @@ class Engine(MegaDispatch):
         prefix_cache: bool = False,
         prefill_chunk: int = 0,
         speculative: int = 0,
+        kv_dtype: str | None = None,
     ):
         self.model = model
         self.temperature = temperature
@@ -170,6 +171,25 @@ class Engine(MegaDispatch):
         # through the table, decode attends the pool directly.
         self.paged = paged
         self.page_size = page_size
+        # Quantized KV storage (docs/serving.md "Quantized KV cache"):
+        # int8 pool + per-page-per-head scales, dequantized inside the
+        # attention kernels. The explicit knob wins over the model
+        # config's ``kv_dtype``.
+        self.kv_dtype = kv_dtype if kv_dtype is not None else (
+            model.cfg.kv_dtype
+        )
+        if self.kv_dtype is not None:
+            if not paged:
+                raise ValueError(
+                    "kv_dtype requires paged=True (scales live on the "
+                    "page pool; the dense cache has no pages)"
+                )
+            if mode == "mega":
+                raise ValueError(
+                    "kv_dtype composes with mode='xla'/'pallas', not "
+                    "the megakernel (its fused decode reads the pool "
+                    "full-width)"
+                )
         # Prefix-cache mode (requires paged): pool + cache + radix tree
         # persist ACROSS serve() calls, finished rows retire their pages
         # into the tree, and later calls prefill only uncached suffixes
@@ -344,6 +364,7 @@ class Engine(MegaDispatch):
             cache, self._pool = init_paged_cache(
                 self.model.cfg, b, self.model.ctx, self.model.axis,
                 max_length=max_length, page_size=self.page_size,
+                kv_dtype=self.kv_dtype,
             )
             # One batch-1 dense scratch, reused per row then scattered
             # into pages — a full-batch dense cache alongside the pool
@@ -473,6 +494,21 @@ class Engine(MegaDispatch):
             ),
             "tokens_per_s": b * max(gen_len - 1, 1) / max(t_decode, 1e-9),
         }
+        if self.paged:
+            from triton_distributed_tpu.models.paged_kv_cache import (
+                kv_bytes_per_token,
+            )
+
+            self.last_stats["kv_bytes_per_token"] = kv_bytes_per_token(cache)
+            self.last_stats["kv_dtype"] = (
+                self.kv_dtype or str(jnp.dtype(cache.k_pages.dtype))
+            )
+        else:
+            L, _b, H, _s, hd = cache.k.shape
+            self.last_stats["kv_bytes_per_token"] = float(
+                2 * L * H * hd * cache.k.dtype.itemsize
+            )
+            self.last_stats["kv_dtype"] = str(jnp.dtype(cache.k.dtype))
         if spec_counters is not None:
             self.last_stats.update(spec_counters)
         if row_meta is not None:
@@ -625,7 +661,7 @@ class Engine(MegaDispatch):
         )
         from triton_distributed_tpu.models.prefix_cache import PrefixCache
 
-        key = (b, max_length, self.page_size)
+        key = (b, max_length, self.page_size, self.kv_dtype)
         state = self._prefix_state
         if state is None or state.key != key or state.dirty:
             pps = max_length // self.page_size
@@ -635,6 +671,7 @@ class Engine(MegaDispatch):
                 # +1: page 0 reserved as the trash page unused table
                 # entries point at (same convention as ContinuousEngine).
                 num_pages=b * pps + 1, assign_pages=False,
+                kv_dtype=self.kv_dtype,
             )
             pool.free = [p for p in pool.free if p != 0]
             self._prefix_state = _PrefixState(

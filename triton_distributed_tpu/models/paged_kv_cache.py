@@ -33,10 +33,128 @@ class PagedKVCache:
     v_pages: jax.Array
     page_table: jax.Array  # [B, pages_per_seq] int32 — page ids
     kv_len: jax.Array      # [B] int32
+    # Symmetric per-page-per-head dequantization scales ``[L, P,
+    # Hkv_loc]`` f32 — present iff the pool stores int8
+    # (``kv_dtype="int8"``). ``None`` keeps the full-width layout (and
+    # every code path over it) bit-identical to the unquantized build.
+    k_scale: jax.Array | None = None
+    v_scale: jax.Array | None = None
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
 
 
 register_param_dataclass(
-    PagedKVCache, ["k_pages", "v_pages", "page_table", "kv_len"]
+    PagedKVCache,
+    ["k_pages", "v_pages", "page_table", "kv_len", "k_scale", "v_scale"],
+)
+
+
+# -- int8 quantization ----------------------------------------------------
+#
+# Storage mode ``kv_dtype="int8"``: the pool keeps int8 codes plus ONE
+# symmetric scale per (layer, page, kv head) — ``x ≈ code * scale`` with
+# ``scale = amax / 127`` over the page's (page_size, head_dim) block.
+# Scales are *monotone within a page's lifetime*: a fresh write at page
+# offset 0 sets the scale absolutely (the page has no valid prior rows),
+# later appends grow it via max and re-quantize the already-stored codes
+# under the grown scale (ratio 1 → value-exact no-op when the scale did
+# not move). Decode/prefill kernels dequantize in-register
+# (``ops/attention/flash_decode.py`` / ``flash_attention.py``), so
+# full-width KV never materializes in HBM.
+
+_Q_MAX = 127.0
+# Safe-division floor: an all-zero page has amax 0 → scale 0; dividing
+# by the floor instead maps 0/eps → 0 rather than NaN.
+_SCALE_EPS = 1e-30
+
+
+def page_scales(x: jax.Array) -> jax.Array:
+    """Symmetric per-page-per-head scale of ``x [..., page, hd]``:
+    amax over the trailing (page, hd) block / 127."""
+    return jnp.max(jnp.abs(x.astype(jnp.float32)), axis=(-2, -1)) / _Q_MAX
+
+
+def quantize_page(x: jax.Array, scale: jax.Array) -> jax.Array:
+    """Quantize ``x [..., page, hd]`` under ``scale [...]`` (int8,
+    round-to-nearest, clipped symmetric at ±127)."""
+    s = jnp.maximum(scale, _SCALE_EPS)[..., None, None]
+    q = jnp.round(x.astype(jnp.float32) / s)
+    return jnp.clip(q, -_Q_MAX, _Q_MAX).astype(jnp.int8)
+
+
+def dequantize_page(q: jax.Array, scale: jax.Array) -> jax.Array:
+    """Inverse of :func:`quantize_page` (f32)."""
+    return q.astype(jnp.float32) * scale[..., None, None]
+
+
+def quantize_pages(pages: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """One-shot pool quantization (tests/benches): ``[..., page, hd]``
+    → ``(int8 codes, per-page-per-head scales [...])``."""
+    scale = page_scales(pages)
+    return quantize_page(pages, scale), scale
+
+
+def quantized_row_scatter(pages, scales, rows, pids, offs):
+    """Scatter ``rows [C, H, hd]`` into a ONE-LAYER int8 pool
+    ``pages [P, H, page, hd]`` at ``(pids[c], offs[c])``: grow each
+    touched page's ``scales [P, H]`` to cover its new rows (reset, not
+    grown, when a row lands at page offset 0 — a fresh page has no
+    valid prior rows, and a stale tenant's scale must not survive page
+    recycling), re-quantize the touched pages' existing codes under the
+    grown scale (value-exact when it did not move), then write the rows
+    as int8.
+
+    THE one implementation of the scale protocol: the chunk-prefill
+    scatter and the decode append (``layers/tp_attn.py``) call it
+    directly (C = chunk width / batch), and :func:`append_n` vmaps it
+    over the layer axis — any change to the reset/grow/requant rule
+    lands in every write path at once.
+
+    Duplicate ``pids`` (several rows in one page, trash-page fan-in)
+    are safe: scatter-min/max are associative and duplicate requant
+    writes are identical."""
+    rows = rows.astype(jnp.float32)
+    row_sc = jnp.max(jnp.abs(rows), axis=-1) / _Q_MAX  # [C, H]
+    clear = jnp.broadcast_to(
+        jnp.where(offs == 0, 0.0, jnp.inf)[:, None], row_sc.shape
+    )
+    new_scales = scales.at[pids].min(clear)
+    new_scales = new_scales.at[pids].max(row_sc)
+    old_sc = jnp.take(scales, pids, axis=0)      # [C, H]
+    new_sc = jnp.take(new_scales, pids, axis=0)
+
+    def requant(pages):
+        ratio = old_sc / jnp.maximum(new_sc, _SCALE_EPS)
+        got = jnp.take(pages, pids, axis=0).astype(jnp.float32)
+        req = jnp.clip(
+            jnp.round(got * ratio[..., None, None]), -_Q_MAX, _Q_MAX
+        ).astype(jnp.int8)
+        return pages.at[pids].set(req)
+
+    # Steady-state decode rarely moves a scale (a page's amax settles
+    # after its first rows): skip the page-sized read+rewrite entirely
+    # when every touched scale is unchanged — the requant would be a
+    # value-exact no-op, but its HBM traffic is real. (Under append_n's
+    # layer vmap the cond lowers to a select and both branches run;
+    # that path is the mega/test batch append, not the decode loop.)
+    pages = jax.lax.cond(
+        jnp.any(new_sc != old_sc), requant, lambda p: p, pages
+    )
+    q_rows = jnp.clip(
+        jnp.round(rows / jnp.maximum(new_sc[..., None], _SCALE_EPS)),
+        -_Q_MAX, _Q_MAX,
+    ).astype(jnp.int8)
+    pages = pages.at[pids, :, offs, :].set(q_rows)
+    return pages, new_scales
+
+
+# vmap of the row scatter over a leading layer axis — append_n's
+# quantized write ([L, P, H, page, hd] pools, rows [L, C, H, hd],
+# shared (pids, offs)).
+_row_scatter_layers = jax.vmap(
+    quantized_row_scatter, in_axes=(0, 0, 0, None, None)
 )
 
 
@@ -72,6 +190,7 @@ def init_paged_cache(
     page_size: int = 128,
     num_pages: int | None = None,
     assign_pages: bool = True,
+    kv_dtype: str | None = None,
 ) -> tuple[PagedKVCache, PagePool]:
     """Allocate the pool + page tables for ``batch_size`` sequences.
 
@@ -79,7 +198,19 @@ def init_paged_cache(
     for callers that manage page assignment themselves (continuous
     batching admits/evicts per request, possibly with ``num_pages``
     oversubscribed below ``batch_size * pages_per_seq``).
+
+    ``kv_dtype="int8"`` (or ``cfg.kv_dtype``; the explicit argument
+    wins) allocates the pool as int8 plus per-page-per-head
+    ``k_scale``/``v_scale`` arrays — roughly half the bf16 pool's HBM
+    bytes, dequantized inside the attention kernels. Unset keeps the
+    full-width ``cfg.dtype`` pool bit-identical to the unquantized
+    build.
     """
+    resolved_kv = kv_dtype if kv_dtype is not None else cfg.kv_dtype
+    if resolved_kv not in (None, "int8"):
+        raise ValueError(
+            f"kv_dtype={resolved_kv!r} unsupported; expected None or 'int8'"
+        )
     s_max = max_length or cfg.max_length
     if s_max % page_size:
         raise ValueError(f"max_length {s_max} not a page multiple")
@@ -97,13 +228,40 @@ def init_paged_cache(
         cfg.num_layers, num_pages, cfg.num_kv_heads, page_size, cfg.head_dim
     )
     spec = (None, None, axis, None, None)
+    pool_dtype = jnp.int8 if resolved_kv == "int8" else cfg.dtype
+    if resolved_kv == "int8":
+        scale_shape = (cfg.num_layers, num_pages, cfg.num_kv_heads)
+        k_scale = ctx.shard(jnp.zeros(scale_shape, jnp.float32),
+                            None, None, axis)
+        v_scale = ctx.shard(jnp.zeros(scale_shape, jnp.float32),
+                            None, None, axis)
+    else:
+        k_scale = v_scale = None
     cache = PagedKVCache(
-        k_pages=ctx.shard(jnp.zeros(shape, cfg.dtype), *spec),
-        v_pages=ctx.shard(jnp.zeros(shape, cfg.dtype), *spec),
+        k_pages=ctx.shard(jnp.zeros(shape, pool_dtype), *spec),
+        v_pages=ctx.shard(jnp.zeros(shape, pool_dtype), *spec),
         page_table=ctx.replicate(jnp.asarray(table)),
         kv_len=ctx.replicate(jnp.zeros((batch_size,), jnp.int32)),
+        k_scale=k_scale,
+        v_scale=v_scale,
     )
     return cache, pool
+
+
+def kv_bytes_per_token(cache: PagedKVCache) -> float:
+    """HBM bytes one cached token costs across K+V pools (+ scale
+    overhead when quantized) — the quantity steady-state decode streams
+    per token per step. Computed from the GLOBAL array shapes (the pool
+    is head-sharded; shapes here are pre-shard)."""
+    L, _p, H, page, hd = cache.k_pages.shape
+    per = (
+        cache.k_pages.dtype.itemsize + cache.v_pages.dtype.itemsize
+    ) * L * H * hd
+    if cache.quantized:
+        per += (
+            cache.k_scale.dtype.itemsize + cache.v_scale.dtype.itemsize
+        ) * L * H / page
+    return float(per)
 
 
 class PoolAuditError(RuntimeError):
@@ -209,7 +367,14 @@ def rollback_kv(cache: PagedKVCache, slot, new_len) -> PagedKVCache:
     by the next append). The page table is untouched: rejected rows
     live in pages the sequence still owns, so truncation is a length
     write, never an allocator round trip. ``slot``/``new_len`` are
-    traced — one compiled program serves every rollback."""
+    traced — one compiled program serves every rollback.
+
+    Quantized pools roll back in lockstep for free: scales are
+    per-page, not per-row, and monotone within a page's lifetime, so
+    the scale that covered the rejected rows still upper-bounds every
+    retained row — truncating ``kv_len`` leaves the codes/scale pair
+    exact for the live prefix (the next append may grow it again;
+    a later write at page offset 0 resets it)."""
     return dataclasses.replace(
         cache,
         kv_len=_set_len_jit(
@@ -258,8 +423,10 @@ def truncate_pages(
     return pages[:keep]
 
 
-def paged_cache_specs(axis: str = "tp"):
-    """shard_map PartitionSpecs matching :func:`init_paged_cache`."""
+def paged_cache_specs(axis: str = "tp", quantized: bool = False):
+    """shard_map PartitionSpecs matching :func:`init_paged_cache`.
+    ``quantized`` adds the per-page-per-head scale specs (head-sharded
+    like the pool); unset matches the scale-less pytree exactly."""
     from jax.sharding import PartitionSpec as P
 
     return PagedKVCache(
@@ -267,6 +434,8 @@ def paged_cache_specs(axis: str = "tp"):
         v_pages=P(None, None, axis, None, None),
         page_table=P(),
         kv_len=P(),
+        k_scale=P(None, None, axis) if quantized else None,
+        v_scale=P(None, None, axis) if quantized else None,
     )
 
 
@@ -290,11 +459,27 @@ def write_prefill(
         )
 
     row = cache.page_table[b_idx]
+    kv_len = cache.kv_len.at[b_idx].set(jnp.asarray(true_len, jnp.int32))
+    if cache.quantized:
+        from triton_distributed_tpu.runtime.profiling import trace_span
+
+        tl = jnp.asarray(true_len, jnp.int32)
+        with trace_span("kv:quant", op="write_prefill", pages=2 * npages):
+            k_pages, k_scale = _scatter_q_jit(
+                cache.k_pages, cache.k_scale, k_dense, row, tl, npages, page
+            )
+            v_pages, v_scale = _scatter_q_jit(
+                cache.v_pages, cache.v_scale, v_dense, row, tl, npages, page
+            )
+        return PagedKVCache(
+            k_pages=k_pages, v_pages=v_pages, page_table=cache.page_table,
+            kv_len=kv_len, k_scale=k_scale, v_scale=v_scale,
+        )
     return PagedKVCache(
         k_pages=_scatter_jit(cache.k_pages, k_dense, row, npages, page),
         v_pages=_scatter_jit(cache.v_pages, v_dense, row, npages, page),
         page_table=cache.page_table,
-        kv_len=cache.kv_len.at[b_idx].set(jnp.asarray(true_len, jnp.int32)),
+        kv_len=kv_len,
     )
 
 
@@ -310,10 +495,43 @@ def _scatter(pages, dense, table_row, npages: int, page: int):
     return pages
 
 
+def _scatter_q(pages, scales, dense, table_row, true_len, npages: int,
+               page: int):
+    """Quantized :func:`_scatter`: every written page is a FRESH full
+    write, so its scale is set absolutely from the page's amax (never
+    grown from a previous tenant's stale scale). Dense rows at
+    positions ≥ ``true_len`` are ZEROED before quantization: the dense
+    scratch is reused across prefills, so the last partial page would
+    otherwise fold a PREVIOUS request's stale KV into this page's amax
+    — inflating the scale and making the codes depend on admission
+    order."""
+    for j in range(npages):
+        pid = table_row[j]
+        chunk = jax.lax.dynamic_slice_in_dim(
+            dense, j * page, page, axis=3
+        )[:, 0]  # [L, H, page, hd]
+        pos = j * page + jnp.arange(page, dtype=jnp.int32)
+        chunk = jnp.where(
+            (pos < true_len)[None, None, :, None],
+            chunk.astype(jnp.float32), 0.0,
+        )
+        sc = page_scales(chunk)  # [L, H]
+        pages = jax.lax.dynamic_update_slice(
+            pages, quantize_page(chunk, sc)[:, None], (0, pid, 0, 0, 0)
+        )
+        scales = jax.lax.dynamic_update_slice(
+            scales, sc[:, None], (0, pid, 0)
+        )
+    return pages, scales
+
+
 # Donated + jitted: the page-by-page scatter updates the pool in place;
 # eager dynamic_update_slices would copy the whole (GB-scale) pool once
 # per page.
 _scatter_jit = jax.jit(_scatter, static_argnums=(3, 4), donate_argnums=(0,))
+_scatter_q_jit = jax.jit(
+    _scatter_q, static_argnums=(5, 6), donate_argnums=(0, 1)
+)
 
 
 def copy_page(cache: PagedKVCache, src: int, dst: int) -> PagedKVCache:
@@ -329,11 +547,24 @@ def copy_page(cache: PagedKVCache, src: int, dst: int) -> PagedKVCache:
         v_pages=_copy_page_jit(cache.v_pages, s, d),
         page_table=cache.page_table,
         kv_len=cache.kv_len,
+        # COW on a quantized pool clones the scale WITH the codes — the
+        # pair is the page's content; cloning one without the other
+        # would dequantize the copy under the wrong amax.
+        k_scale=(
+            None if cache.k_scale is None
+            else _copy_page_jit(cache.k_scale, s, d)
+        ),
+        v_scale=(
+            None if cache.v_scale is None
+            else _copy_page_jit(cache.v_scale, s, d)
+        ),
     )
 
 
 # Donated for the same reason as _scatter_jit: an eager update would
-# copy the whole pool to move one page.
+# copy the whole pool to move one page. Shape-polymorphic over the
+# trailing dims, so the same program body serves pools AND their
+# [L, P, H] scale arrays (jit re-specializes per shape).
 _copy_page_jit = jax.jit(
     lambda pages, s, d: jax.lax.dynamic_update_slice_in_dim(
         pages, jax.lax.dynamic_slice_in_dim(pages, s, 1, axis=1), d, axis=1
@@ -384,6 +615,22 @@ def append_n(
         upd = new.transpose(1, 3, 0, 2, 4).reshape(B * NS, L, H, hd)
         return pages.at[:, flat_p, :, flat_o, :].set(upd.astype(pages.dtype))
 
+    def write_q(pages, scales, new):
+        # Quantized append: ONE scale-protocol implementation
+        # (:func:`quantized_row_scatter` — reset at offset 0, grow +
+        # requant otherwise), vmapped over the layer axis with the
+        # (page, offset) routing shared across layers.
+        rows = new.transpose(0, 1, 3, 2, 4).reshape(L, B * NS, H, hd)
+        return _row_scatter_layers(pages, scales, rows, flat_p, flat_o)
+
+    if cache.quantized:
+        k_pages, k_scale = write_q(cache.k_pages, cache.k_scale, k_new)
+        v_pages, v_scale = write_q(cache.v_pages, cache.v_scale, v_new)
+        return PagedKVCache(
+            k_pages=k_pages, v_pages=v_pages,
+            page_table=cache.page_table, kv_len=cache.kv_len + NS,
+            k_scale=k_scale, v_scale=v_scale,
+        )
     return PagedKVCache(
         k_pages=write(cache.k_pages, k_new),
         v_pages=write(cache.v_pages, v_new),
@@ -395,14 +642,27 @@ def append_n(
 def as_dense(cache: PagedKVCache, layer=None):
     """Materialize contiguous ``[L?, B, Hkv_loc, S_max, hd]`` views by
     gathering pages through the table (decode feeds this to
-    ``flash_decode``; the page gather is a take on the page axis)."""
+    ``flash_decode``; the page gather is a take on the page axis).
+    Quantized pools dequantize the gathered view (f32) — this is the
+    tests/fallback path, never the serving hot path, which reads int8
+    codes straight through the kernels."""
     from triton_distributed_tpu.ops.attention.flash_decode import (
         pages_to_dense,
+        scales_to_dense,
     )
 
     kp = cache.k_pages if layer is None else cache.k_pages[layer]
     vp = cache.v_pages if layer is None else cache.v_pages[layer]
-    return (
-        pages_to_dense(kp, cache.page_table),
-        pages_to_dense(vp, cache.page_table),
-    )
+    k = pages_to_dense(kp, cache.page_table)
+    v = pages_to_dense(vp, cache.page_table)
+    if cache.quantized:
+        page = cache.k_pages.shape[3]
+        ks = cache.k_scale if layer is None else cache.k_scale[layer]
+        vs = cache.v_scale if layer is None else cache.v_scale[layer]
+        k = k.astype(jnp.float32) * scales_to_dense(
+            ks, cache.page_table, page
+        )[..., None]
+        v = v.astype(jnp.float32) * scales_to_dense(
+            vs, cache.page_table, page
+        )[..., None]
+    return k, v

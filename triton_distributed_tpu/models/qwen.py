@@ -275,25 +275,32 @@ class Qwen3:
 
         def layer_fn(carry, inp):
             x = carry
-            lp, kp, vp = inp  # kp/vp: [P, hkv_loc, page, hd] layer pool
+            # kp/vp: [P, hkv_loc, page, hd] layer pool; ks/vs are the
+            # int8 per-page-per-head scales, or None on a full-width
+            # pool (lax.scan threads the empty subtree through).
+            lp, kp, vp, ks, vs = inp
             h = rms_norm(x, lp.ln1, cfg.rms_eps)
-            a, kp, vp = tp_attn_decode_paged(
+            a, kp, vp, ks, vs = tp_attn_decode_paged(
                 lp.attn, h, kp, vp, cache.page_table, cache.kv_len,
                 self.dims, axis=self.axis, mode=ar, ctx=self.ctx,
+                k_scale=ks, v_scale=vs,
             )
             x = x + a
             h = rms_norm(x, lp.ln2, cfg.rms_eps)
             x = x + self._mlp_fwd(lp.mlp, h, ar)
-            return x, (kp, vp)
+            return x, (kp, vp, ks, vs)
 
-        x, (k_new, v_new) = jax.lax.scan(
-            layer_fn, x, (params.layers, cache.k_pages, cache.v_pages)
+        x, (k_new, v_new, ks_new, vs_new) = jax.lax.scan(
+            layer_fn, x,
+            (params.layers, cache.k_pages, cache.v_pages,
+             cache.k_scale, cache.v_scale),
         )
         x = rms_norm(x, params.norm, cfg.rms_eps)
         logits = self._logits(params, x)
         return logits, PagedKVCache(
             k_pages=k_new, v_pages=v_new,
             page_table=cache.page_table, kv_len=cache.kv_len + 1,
+            k_scale=ks_new, v_scale=vs_new,
         )
 
     def _prefill_batch_shard(
@@ -384,19 +391,22 @@ class Qwen3:
 
         def layer_fn(carry, inp):
             x = carry
-            lp, kp, vp = inp
+            lp, kp, vp, ks, vs = inp  # ks/vs: int8 scales or None
             h = rms_norm(x, lp.ln1, cfg.rms_eps)
-            a, kp, vp = tp_attn_prefill_paged_chunk(
+            a, kp, vp, ks, vs = tp_attn_prefill_paged_chunk(
                 lp.attn, h, kp, vp, table_row, q_offset, self.dims,
                 kv_pages=kv_pages, axis=self.axis, mode=ar, ctx=self.ctx,
+                k_scale=ks, v_scale=vs, q_end=new_len,
             )
             x = x + a
             h = rms_norm(x, lp.ln2, cfg.rms_eps)
             x = x + self._mlp_fwd(lp.mlp, h, ar)
-            return x, (kp, vp)
+            return x, (kp, vp, ks, vs)
 
-        x, (k_new, v_new) = jax.lax.scan(
-            layer_fn, x, (params.layers, cache.k_pages, cache.v_pages)
+        x, (k_new, v_new, ks_new, vs_new) = jax.lax.scan(
+            layer_fn, x,
+            (params.layers, cache.k_pages, cache.v_pages,
+             cache.k_scale, cache.v_scale),
         )
         x = rms_norm(x, params.norm, cfg.rms_eps)
         if all_logits:
@@ -411,6 +421,7 @@ class Qwen3:
         return logits, PagedKVCache(
             k_pages=k_new, v_pages=v_new, page_table=cache.page_table,
             kv_len=cache.kv_len.at[slot].set(new_len.astype(jnp.int32)),
+            k_scale=ks_new, v_scale=vs_new,
         )
 
     def prefill_paged_chunk(
@@ -437,16 +448,19 @@ class Qwen3:
             paged_cache_specs,
         )
 
-        key = ("chunk", mode, int(tokens.shape[0]), kv_pages, all_logits)
+        quant = cache.k_scale is not None
+        key = ("chunk", mode, int(tokens.shape[0]), kv_pages, all_logits,
+               quant)
         if key not in self._prefill_jit:
             f = self.ctx.shard_map(
                 functools.partial(self._prefill_chunk_shard, mode=mode,
                                   kv_pages=kv_pages, all_logits=all_logits),
                 in_specs=(
-                    self.param_specs, P(), paged_cache_specs(self.axis),
+                    self.param_specs, P(),
+                    paged_cache_specs(self.axis, quant),
                     P(), P(), P(), P(),
                 ),
-                out_specs=(P(), paged_cache_specs(self.axis)),
+                out_specs=(P(), paged_cache_specs(self.axis, quant)),
             )
             self._prefill_jit[key] = jax.jit(
                 lambda p, t, c, s, o, n, li: f(p, t, c, s, o, n, li),
@@ -469,29 +483,37 @@ class Qwen3:
             out_specs=(P(), cache_specs(self.axis)),
         )
 
-    def decode_fn_paged(self, mode: Mode = "xla"):
+    def decode_fn_paged(self, mode: Mode = "xla", quantized: bool = False):
         """Paged-cache analog of :meth:`decode_fn`:
-        ``(params, tokens, PagedKVCache) → (logits, PagedKVCache)``."""
+        ``(params, tokens, PagedKVCache) → (logits, PagedKVCache)``.
+        ``quantized`` matches an int8 pool's pytree (scale leaves ride
+        the shard_map specs)."""
         from triton_distributed_tpu.models.paged_kv_cache import (
             paged_cache_specs,
         )
 
         return self.ctx.shard_map(
             functools.partial(self._decode_shard_paged, mode=mode),
-            in_specs=(self.param_specs, P(), paged_cache_specs(self.axis)),
-            out_specs=(P(), paged_cache_specs(self.axis)),
+            in_specs=(self.param_specs, P(),
+                      paged_cache_specs(self.axis, quantized)),
+            out_specs=(P(), paged_cache_specs(self.axis, quantized)),
         )
 
     def decode_step(self, tokens: jax.Array, cache, mode: Mode = "xla"):
         """Jitted one-token step for the whole batch (CUDA-graph analog).
         ``tokens [B]`` int32 → ``(logits [B, V] f32, cache)``. Accepts a
-        dense :class:`KVCache` or a :class:`PagedKVCache`."""
+        dense :class:`KVCache` or a :class:`PagedKVCache` (full-width or
+        int8-quantized — keyed separately, the pytrees differ)."""
         from triton_distributed_tpu.models.paged_kv_cache import PagedKVCache
 
         paged = isinstance(cache, PagedKVCache)
-        key = (mode, "paged") if paged else mode
+        quant = paged and cache.k_scale is not None
+        key = (mode, "paged", quant) if paged else mode
         if key not in self._decode_jit:
-            f = self.decode_fn_paged(mode) if paged else self.decode_fn(mode)
+            f = (
+                self.decode_fn_paged(mode, quantized=quant) if paged
+                else self.decode_fn(mode)
+            )
             self._decode_jit[key] = jax.jit(
                 lambda p, t, c: f(p, t, c), donate_argnums=(2,)
             )

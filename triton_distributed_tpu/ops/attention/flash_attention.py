@@ -32,8 +32,10 @@ _NEG_INF = -1e30
 def _attn_kernel(
     off_ref,  # [1] int32 SMEM (scalar prefetch) or None — kv offset
     q_ref,    # [1, block_q, d] VMEM
-    k_ref,    # [1, block_k, d] VMEM
+    k_ref,    # [1, block_k, d] VMEM — full-width, or int8 codes
     v_ref,    # [1, block_k, d] VMEM
+    ks_ref,   # [1, 1] VMEM f32 or None — this kv block's K dequant scale
+    vs_ref,   # [1, 1] VMEM f32 or None — this kv block's V dequant scale
     o_ref,    # [1, block_q, d] VMEM
     lse_ref,  # [1, 1, sq] VMEM or None — full row; slice qi written at
               # finalize (Mosaic requires the block's trailing dims to
@@ -67,9 +69,13 @@ def _attn_kernel(
     def _body():
         q = q_ref[0].astype(jnp.float32)
         k = k_ref[0].astype(jnp.float32)
+        # In-register dequant (int8 KV): the per-block symmetric scale
+        # is a scalar, so it folds into the softmax multiplier after
+        # QK^T — full-width K never materializes.
+        mult = sm_scale if ks_ref is None else sm_scale * ks_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * sm_scale  # [block_q, block_k]
+        ) * mult  # [block_q, block_k]
         if causal:
             rows = kv_offset + qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
@@ -82,9 +88,17 @@ def _attn_kernel(
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_i[:] - m_new)
         l_i[:] = l_i[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
-        acc[:] = acc[:] * alpha + jnp.dot(
-            p.astype(v_ref.dtype), v_ref[0], preferred_element_type=jnp.float32
-        )
+        if vs_ref is None:
+            pv = jnp.dot(
+                p.astype(v_ref.dtype), v_ref[0],
+                preferred_element_type=jnp.float32,
+            )
+        else:
+            pv = jnp.dot(
+                p, v_ref[0].astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            ) * vs_ref[0, 0]
+        acc[:] = acc[:] * alpha + pv
         m_i[:] = m_new
 
     @pl.when(ki == num_k - 1)
@@ -108,6 +122,8 @@ def flash_attention(
     block_q: int = 128,
     block_k: int = 128,
     return_lse: bool = False,
+    k_scale: jax.Array | None = None,  # [B, Hkv, Sk/block_k] f32
+    v_scale: jax.Array | None = None,
     interpret=None,
 ):
     """Causal/GQA flash attention. ``kv_offset``: absolute position of
@@ -117,6 +133,13 @@ def flash_attention(
     rides as a scalar-prefetch operand, so one compiled kernel serves
     every chunk offset of a chunked prefill (a static int keeps the
     constant-folded path).
+
+    ``k_scale``/``v_scale`` enable the int8 KV mode (the paged-prefill
+    chunk path over a quantized pool): ``k``/``v`` hold int8 codes and
+    one symmetric f32 scale per ``block_k`` block per head dequantizes
+    in-register after QK^T / P·V. Callers align ``block_k`` with the
+    quantization granularity (the chunk path sets ``block_k =
+    page_size`` so per-page pool scales ARE per-block scales).
 
     Returns ``o [B, Hq, Sq, D]`` (and ``lse [B, Hq, Sq]`` f32 when
     ``return_lse`` — base-e log-sum-exp of scaled scores, the quantity the
@@ -129,17 +152,37 @@ def flash_attention(
     group = hq // hkv
     if sm_scale is None:
         sm_scale = d**-0.5
+    quant = k_scale is not None
+    if quant != (v_scale is not None):
+        raise ValueError("k_scale and v_scale must be passed together")
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    # Validate BOTH scale layouts BEFORE the portable early-return: the
+    # reference path below would otherwise dequantize a mis-shaped
+    # scale at the wrong granularity, and the Pallas path's clamped
+    # block indices would silently read the wrong page's scale.
+    for name, sc in (("k_scale", k_scale), ("v_scale", v_scale)):
+        if quant and sc.shape != (b, hkv, sk // block_k):
+            raise ValueError(
+                f"{name} shape {sc.shape} != per-block layout "
+                f"{(b, hkv, sk // block_k)} (block_k={block_k})"
+            )
     # jax.export can't serialize the host callbacks interpret-mode
     # Pallas lowers to; portable exports take the XLA-reference path
     # (same contract as flash_decode's portable fallback).
     interpret = interpret_mode() if interpret is None else interpret
     if interpret and exporting_portable():
+        if quant:
+            k = k.astype(jnp.float32) * jnp.repeat(
+                k_scale, block_k, axis=-1
+            )[..., None]
+            v = v.astype(jnp.float32) * jnp.repeat(
+                v_scale, block_k, axis=-1
+            )[..., None]
         return mha_reference(
             q, k, v, causal=causal, sm_scale=sm_scale,
             kv_offset=kv_offset, return_lse=return_lse,
         )
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
     if sq % block_q or sk % block_k:
         raise ValueError(f"seq ({sq},{sk}) not divisible by blocks "
                          f"({block_q},{block_k}); pad upstream")
@@ -169,7 +212,7 @@ def flash_attention(
         block_k=block_k,
     )
     kernel = functools.partial(
-        _adapt_refs, kernel, dynamic_off, return_lse
+        _adapt_refs, kernel, dynamic_off, quant, return_lse
     )
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
@@ -180,6 +223,19 @@ def flash_attention(
             (1, block_k, d), lambda bh, qi, ki, g=group: (bh // g, ki, 0)
         ),
     ]
+    operands = [qf, kf, vf]
+    if quant:
+        in_specs += [
+            pl.BlockSpec(
+                (1, 1), lambda bh, qi, ki, g=group: (bh // g, ki)
+            ),
+            pl.BlockSpec(
+                (1, 1), lambda bh, qi, ki, g=group: (bh // g, ki)
+            ),
+        ]
+        operands += [
+            k_scale.reshape(b * hkv, -1), v_scale.reshape(b * hkv, -1)
+        ]
     scratch_shapes = [
         pltpu.VMEM((block_q, d), jnp.float32),
         pltpu.VMEM((block_q, 1), jnp.float32),
@@ -210,7 +266,7 @@ def flash_attention(
             out_shape=out_shape,
             compiler_params=compiler_params,
             interpret=interpret,
-        )(off, qf, kf, vf)
+        )(off, *operands)
     else:
         res = pl.pallas_call(
             kernel,
@@ -221,7 +277,7 @@ def flash_attention(
             scratch_shapes=scratch_shapes,
             compiler_params=compiler_params,
             interpret=interpret,
-        )(qf, kf, vf)
+        )(*operands)
 
     o = res[0].reshape(b, hq, sq, d)
     if return_lse:
@@ -235,16 +291,25 @@ def _drop_scalar_arg(index_map):
     return lambda bh, qi, ki, _off: index_map(bh, qi, ki)
 
 
-def _adapt_refs(kernel, has_off: bool, has_lse: bool, *refs):
+def _adapt_refs(kernel, has_off: bool, has_scales: bool, has_lse: bool,
+                *refs):
     """Route pallas_call's positional refs into ``_attn_kernel``'s
     keyword-stable signature: optional scalar-prefetch offset first,
-    optional lse output, then the three scratch refs."""
+    optional int8 dequant scales after v, optional lse output, then the
+    three scratch refs."""
     refs = list(refs)
     off_ref = refs.pop(0) if has_off else None
-    q_ref, k_ref, v_ref, o_ref = refs[:4]
-    lse_ref = refs[4] if has_lse else None
+    q_ref, k_ref, v_ref = refs[:3]
+    nxt = 3
+    ks_ref = vs_ref = None
+    if has_scales:
+        ks_ref, vs_ref = refs[3:5]
+        nxt = 5
+    o_ref = refs[nxt]
+    lse_ref = refs[nxt + 1] if has_lse else None
     acc, m_i, l_i = refs[-3:]
-    kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_i, l_i)
+    kernel(off_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, lse_ref,
+           acc, m_i, l_i)
 
 
 def mha_reference(

@@ -31,11 +31,13 @@ from triton_distributed_tpu.ops.common import exporting_portable, interpret_mode
 _NEG_INF = -1e30
 
 
-def _decode_kernel(
+def _decode_body(
     kv_len_ref,  # [B] int32 SMEM (scalar prefetch)
     q_ref,       # [1, 1, group, d] VMEM
-    k_ref,       # [1, 1, chunk, d] VMEM
+    k_ref,       # [1, 1, chunk, d] VMEM — full-width, or int8 codes
     v_ref,       # [1, 1, chunk, d] VMEM
+    ks_ref,      # [1, 1, 1] VMEM f32 or None — this chunk's K dequant scale
+    vs_ref,      # [1, 1, 1] VMEM f32 or None — this chunk's V dequant scale
     o_ref,       # [1, 1, 1, group, d] VMEM f32 — partial output, chunk ci
     lse_ref,     # [1, 1, C, group] VMEM f32 — full chunk column, row ci
                  # written per step (Mosaic needs the block's trailing two
@@ -54,17 +56,30 @@ def _decode_kernel(
         q = q_ref[0, 0].astype(jnp.float32)
         k = k_ref[0, 0].astype(jnp.float32)
         group = q.shape[0]
+        # In-register dequant: the symmetric per-chunk scale is a
+        # scalar, so it folds into the softmax multiplier AFTER QK^T —
+        # the MXU sees the raw int8-widened codes and full-width K
+        # never exists anywhere (not even in VMEM).
+        mult = sm_scale if ks_ref is None else sm_scale * ks_ref[0, 0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * sm_scale  # [group, chunk]
+        ) * mult  # [group, chunk]
         cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where(cols < valid, s, _NEG_INF)
         m = jnp.max(s, axis=1, keepdims=True)
         p = jnp.exp(s - m)
         l = jnp.sum(p, axis=1, keepdims=True)
-        o = jnp.dot(
-            p.astype(v_ref.dtype), v_ref[0, 0], preferred_element_type=jnp.float32
-        )
+        if vs_ref is None:
+            o = jnp.dot(
+                p.astype(v_ref.dtype), v_ref[0, 0],
+                preferred_element_type=jnp.float32,
+            )
+        else:
+            # P·V over the codes, scale folded after the matmul.
+            o = jnp.dot(
+                p, v_ref[0, 0].astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            ) * vs_ref[0, 0, 0]
         o_ref[0, 0, 0] = o / l
         lse_ref[0, 0, ci] = (m + jnp.log(l))[:, 0]
 
@@ -72,6 +87,20 @@ def _decode_kernel(
     def _skip():
         o_ref[:] = jnp.zeros_like(o_ref)
         lse_ref[0, 0, ci] = jnp.full(lse_ref.shape[-1:], _NEG_INF, jnp.float32)
+
+
+def _decode_kernel(kv_len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, **kw):
+    _decode_body(
+        kv_len_ref, q_ref, k_ref, v_ref, None, None, o_ref, lse_ref, **kw
+    )
+
+
+def _decode_kernel_q(
+    kv_len_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, lse_ref, **kw
+):
+    _decode_body(
+        kv_len_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, lse_ref, **kw
+    )
 
 
 def lse_combine(o_parts: jax.Array, lse_parts: jax.Array, part_axis: int = 0):
@@ -101,6 +130,8 @@ def flash_decode(
     sm_scale: float | None = None,
     chunk_k: int = 256,
     return_lse: bool = False,
+    k_scale: jax.Array | None = None,  # [B, Hkv, S/chunk_k] f32
+    v_scale: jax.Array | None = None,
     interpret=None,
 ):
     """Single-token GQA decode attention over a (possibly padded) KV cache.
@@ -108,6 +139,11 @@ def flash_decode(
     Parity: ``gqa_fwd_batch_decode`` (``flash_decode.py:763``). Returns
     ``o [B, Hq, D]`` (q.dtype) and optionally ``lse [B, Hq]`` f32 for the
     cross-rank combine.
+
+    ``k_scale``/``v_scale`` enable the int8 storage mode: ``k_cache``/
+    ``v_cache`` hold int8 codes and the per-chunk-per-head symmetric
+    scales (one f32 per ``chunk_k`` block) dequantize IN-REGISTER inside
+    the kernel — full-width KV never materializes.
     """
     b, hq, d = q.shape
     _, hkv, s, _ = k_cache.shape
@@ -121,11 +157,27 @@ def flash_decode(
         raise ValueError(f"cache len {s} not divisible by chunk_k {chunk_k}")
     num_chunks = s // chunk_k
     kv_len = jnp.broadcast_to(jnp.asarray(kv_len, jnp.int32), (b,))
+    quant = k_scale is not None
+    if quant != (v_scale is not None):
+        raise ValueError("k_scale and v_scale must be passed together")
+    for name, sc in (("k_scale", k_scale), ("v_scale", v_scale)):
+        if quant and sc.shape != (b, hkv, num_chunks):
+            raise ValueError(
+                f"{name} shape {sc.shape} != per-chunk layout "
+                f"{(b, hkv, num_chunks)} (chunk_k={chunk_k})"
+            )
 
     # jax.export can't serialize the host callbacks interpret-mode Pallas
     # lowers to; exports traced off-TPU take the pure-XLA reference path.
     resolved = interpret_mode() if interpret is None else interpret
     if resolved and exporting_portable():
+        if quant:
+            k_cache = k_cache.astype(jnp.float32) * jnp.repeat(
+                k_scale, chunk_k, axis=-1
+            )[..., None]
+            v_cache = v_cache.astype(jnp.float32) * jnp.repeat(
+                v_scale, chunk_k, axis=-1
+            )[..., None]
         return gqa_decode_reference(
             q, k_cache, v_cache, kv_len,
             sm_scale=sm_scale, return_lse=return_lse,
@@ -133,21 +185,33 @@ def flash_decode(
 
     qg = q.reshape(b, hkv, group, d)
     grid = (b, hkv, num_chunks)
+    in_specs = [
+        pl.BlockSpec((1, 1, group, d), lambda b, h, ci, _: (b, h, 0, 0)),
+        pl.BlockSpec(
+            (1, 1, chunk_k, d), lambda b, h, ci, _: (b, h, ci, 0)
+        ),
+        pl.BlockSpec(
+            (1, 1, chunk_k, d), lambda b, h, ci, _: (b, h, ci, 0)
+        ),
+    ]
+    operands = [qg, k_cache, v_cache]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, 1, 1), lambda b, h, ci, _: (b, h, ci)),
+            pl.BlockSpec((1, 1, 1), lambda b, h, ci, _: (b, h, ci)),
+        ]
+        operands += [k_scale, v_scale]
+    kernel = functools.partial(
+        _decode_kernel_q if quant else _decode_kernel,
+        sm_scale=sm_scale, chunk_k=chunk_k,
+    )
     o_parts, lse_parts = pl.pallas_call(
-        functools.partial(_decode_kernel, sm_scale=sm_scale, chunk_k=chunk_k),
+        kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=grid,
             # index maps receive the scalar-prefetch ref as a trailing arg
-            in_specs=[
-                pl.BlockSpec((1, 1, group, d), lambda b, h, ci, _: (b, h, 0, 0)),
-                pl.BlockSpec(
-                    (1, 1, chunk_k, d), lambda b, h, ci, _: (b, h, ci, 0)
-                ),
-                pl.BlockSpec(
-                    (1, 1, chunk_k, d), lambda b, h, ci, _: (b, h, ci, 0)
-                ),
-            ],
+            in_specs=in_specs,
             out_specs=[
                 pl.BlockSpec(
                     (1, 1, 1, group, d), lambda b, h, ci, _: (b, h, ci, 0, 0)
@@ -165,7 +229,7 @@ def flash_decode(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=resolved,
-    )(kv_len, qg, k_cache, v_cache)
+    )(kv_len, *operands)
 
     o, lse = lse_combine(o_parts, lse_parts, part_axis=2)  # [B, Hkv, group, d]
     o = o.reshape(b, hq, d).astype(q.dtype)
@@ -183,6 +247,8 @@ def paged_flash_decode(
     *,
     sm_scale: float | None = None,
     return_lse: bool = False,
+    k_scale: jax.Array | None = None,  # [P, Hkv] f32 — per-page-per-head
+    v_scale: jax.Array | None = None,
     interpret=None,
 ):
     """Single-token GQA decode attention straight over a paged KV pool.
@@ -194,6 +260,12 @@ def paged_flash_decode(
     dereference it — ``block ci of sequence b`` fetches pool page
     ``table[b, ci]``, so the kernel body is exactly the dense split-KV
     kernel with ``chunk_k = page_size`` and no gather materializes.
+
+    With ``k_scale``/``v_scale`` (the pool's per-page-per-head int8
+    scales), the K/V blocks are int8 codes and each program fetches its
+    page's scale through the SAME table indirection, dequantizing
+    in-register after QK^T / P·V — the decode step streams HALF the
+    bf16 pool's HBM bytes and full-width KV never exists.
     """
     b, hq, d = q.shape
     p, hkv, page, _ = k_pages.shape
@@ -204,37 +276,70 @@ def paged_flash_decode(
         sm_scale = d**-0.5
     pps = page_table.shape[1]
     kv_len = jnp.broadcast_to(jnp.asarray(kv_len, jnp.int32), (b,))
+    quant = k_scale is not None
+    if quant != (v_scale is not None):
+        raise ValueError("k_scale and v_scale must be passed together")
+    for name, sc in (("k_scale", k_scale), ("v_scale", v_scale)):
+        if quant and sc.shape != (p, hkv):
+            raise ValueError(
+                f"{name} shape {sc.shape} != per-page layout {(p, hkv)}"
+            )
 
     resolved = interpret_mode() if interpret is None else interpret
     if resolved and exporting_portable():
         k_d, v_d = _pages_to_dense(k_pages, v_pages, page_table)
+        if quant:
+            k_d = k_d.astype(jnp.float32) * scales_to_dense(
+                k_scale, page_table, page
+            )[..., None]
+            v_d = v_d.astype(jnp.float32) * scales_to_dense(
+                v_scale, page_table, page
+            )[..., None]
         return gqa_decode_reference(
             q, k_d, v_d, kv_len, sm_scale=sm_scale, return_lse=return_lse
         )
 
     qg = q.reshape(b, hkv, group, d)
     grid = (b, hkv, pps)
+    in_specs = [
+        pl.BlockSpec(
+            (1, 1, group, d), lambda b, h, ci, _, __: (b, h, 0, 0)
+        ),
+        # The paged part: block ci of row b is pool page
+        # table[b, ci].
+        pl.BlockSpec(
+            (1, 1, page, d),
+            lambda b, h, ci, _, tab: (tab[b, ci], h, 0, 0),
+        ),
+        pl.BlockSpec(
+            (1, 1, page, d),
+            lambda b, h, ci, _, tab: (tab[b, ci], h, 0, 0),
+        ),
+    ]
+    operands = [qg, k_pages, v_pages]
+    if quant:
+        # Scales ride the same table indirection as their pages
+        # (trailing singleton so the kernel reads a uniform [1,1,1]
+        # block in both the dense and paged layouts).
+        in_specs += [
+            pl.BlockSpec(
+                (1, 1, 1), lambda b, h, ci, _, tab: (tab[b, ci], h, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, 1), lambda b, h, ci, _, tab: (tab[b, ci], h, 0)
+            ),
+        ]
+        operands += [k_scale[..., None], v_scale[..., None]]
+    kernel = functools.partial(
+        _paged_decode_kernel_q if quant else _paged_decode_kernel,
+        sm_scale=sm_scale, chunk_k=page,
+    )
     o_parts, lse_parts = pl.pallas_call(
-        functools.partial(_paged_decode_kernel, sm_scale=sm_scale,
-                          chunk_k=page),
+        kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,  # kv_len, page_table
             grid=grid,
-            in_specs=[
-                pl.BlockSpec(
-                    (1, 1, group, d), lambda b, h, ci, _, __: (b, h, 0, 0)
-                ),
-                # The paged part: block ci of row b is pool page
-                # table[b, ci].
-                pl.BlockSpec(
-                    (1, 1, page, d),
-                    lambda b, h, ci, _, tab: (tab[b, ci], h, 0, 0),
-                ),
-                pl.BlockSpec(
-                    (1, 1, page, d),
-                    lambda b, h, ci, _, tab: (tab[b, ci], h, 0, 0),
-                ),
-            ],
+            in_specs=in_specs,
             out_specs=[
                 pl.BlockSpec(
                     (1, 1, 1, group, d), lambda b, h, ci, _, __: (b, h, ci, 0, 0)
@@ -252,7 +357,7 @@ def paged_flash_decode(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=resolved,
-    )(kv_len, page_table, qg, k_pages, v_pages)
+    )(kv_len, page_table, *operands)
 
     o, lse = lse_combine(o_parts, lse_parts, part_axis=2)
     o = o.reshape(b, hq, d).astype(q.dtype)
@@ -266,6 +371,11 @@ def _paged_decode_kernel(kv_len_ref, table_ref, *args, **kw):
     return _decode_kernel(kv_len_ref, *args, **kw)
 
 
+def _paged_decode_kernel_q(kv_len_ref, table_ref, *args, **kw):
+    del table_ref  # consumed by the BlockSpec index maps
+    return _decode_kernel_q(kv_len_ref, *args, **kw)
+
+
 def pages_to_dense(pages: jax.Array, page_table: jax.Array) -> jax.Array:
     """Gather a page pool ``[..., P, H, page, d]`` into a dense
     ``[..., B, H, S, d]`` view through the table. Single source of the
@@ -274,6 +384,15 @@ def pages_to_dense(pages: jax.Array, page_table: jax.Array) -> jax.Array:
     g = jnp.swapaxes(g, -4, -3)               # [..., B, H, pps, page, d]
     s = g.shape
     return g.reshape(*s[:-3], s[-3] * s[-2], s[-1])
+
+
+def scales_to_dense(scales: jax.Array, page_table: jax.Array, page: int):
+    """Per-position dequant scales matching a :func:`pages_to_dense`
+    view: ``[..., P, H] → [..., B, H, S]`` through the table (every
+    position of a page shares its page's scale)."""
+    g = jnp.take(scales, page_table, axis=-2)  # [..., B, pps, H]
+    g = jnp.swapaxes(g, -2, -1)                # [..., B, H, pps]
+    return jnp.repeat(g, page, axis=-1)        # [..., B, H, S]
 
 
 def _pages_to_dense(k_pages, v_pages, page_table):
@@ -312,6 +431,8 @@ def distributed_flash_decode(
     sm_scale: float | None = None,
     chunk_k: int = 256,
     method: str = "xla",
+    k_scale: jax.Array | None = None,  # [B, Hkv, S_loc/chunk_k] f32
+    v_scale: jax.Array | None = None,
     ctx=None,
 ):
     """Decode attention with the KV cache sequence-sharded over ``axis``.
@@ -322,6 +443,13 @@ def distributed_flash_decode(
     (``flash_decode.py:482``) which putmem_signals partials between GPUs.
     ``method='pallas'`` uses the device-initiated ring all-gather;
     ``'xla'`` the XLA collective.
+
+    ``k_scale``/``v_scale`` (this rank's per-chunk-per-head int8 scales)
+    switch the local split-KV pass to in-kernel dequant over int8
+    shards — exactly the regime the paper's low-latency decode kernels
+    target: the ICI exchange already ships only (O, LSE) partials, so
+    quantization halves the HBM stream on every rank without touching
+    the combine.
     """
     me = jax.lax.axis_index(axis)
     s_loc = k_shard.shape[2]
@@ -330,6 +458,7 @@ def distributed_flash_decode(
     o, lse = flash_decode(
         q, k_shard, v_shard, local_len,
         sm_scale=sm_scale, chunk_k=chunk_k, return_lse=True,
+        k_scale=k_scale, v_scale=v_scale,
     )
     merged, _ = _gather_merge(o.astype(jnp.float32), lse, axis, method, ctx)
     return merged.astype(q.dtype)
@@ -346,6 +475,8 @@ def distributed_flash_decode_2level(
     sm_scale: float | None = None,
     chunk_k: int = 256,
     method: str = "xla",
+    k_scale: jax.Array | None = None,  # [B, Hkv, S_loc/chunk_k] f32
+    v_scale: jax.Array | None = None,
     ctx=None,
 ):
     """Decode attention with the KV cache sequence-sharded over
@@ -358,6 +489,8 @@ def distributed_flash_decode_2level(
     (O, LSE) merge first across the fast intra-slice fabric (optionally
     the device-initiated Pallas ring when ``method='pallas'``), then the
     per-slice results merge once over DCN with XLA collectives.
+    ``k_scale``/``v_scale`` switch the local pass to int8 shards with
+    in-kernel dequant (see :func:`distributed_flash_decode`).
     """
     n_in = jax.lax.axis_size(inner_axis)
     me = jax.lax.axis_index(outer_axis) * n_in + jax.lax.axis_index(inner_axis)
@@ -366,6 +499,7 @@ def distributed_flash_decode_2level(
     o, lse = flash_decode(
         q, k_shard, v_shard, local_len,
         sm_scale=sm_scale, chunk_k=chunk_k, return_lse=True,
+        k_scale=k_scale, v_scale=v_scale,
     )
     # Level 1: intra-slice merge over ICI; level 2: one inter-slice
     # merge over DCN (always XLA — DCN traffic is XLA's domain).

@@ -19,7 +19,10 @@ framed protocol. Here the protocol is newline-delimited JSON over TCP:
 
 The per-request sampling/deadline keys are scalars (applied to every
 request) or per-request lists; omitted/null entries fall back to the
-engine's defaults.
+engine's defaults. ``stats`` payloads surface the engine's serving
+counters verbatim — including, on paged engines, ``kv_bytes_per_token``
+and ``kv_dtype`` (the quantized-KV knob, docs/serving.md "Quantized KV
+cache"), so a client can read the storage mode through the wire.
 
 **Concurrency + fault tolerance** (docs/serving.md "Fault tolerance"):
 each connection is served on its own thread; generation payloads
