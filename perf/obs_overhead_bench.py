@@ -1,0 +1,272 @@
+"""Telemetry overhead bench: <1% decode cost, bit-identical outputs.
+
+ISSUE 5 acceptance artifact: the obs/ subsystem (metrics registry +
+event ring + per-request timelines, docs/observability.md) must cost
+under 1% of decode-step time when enabled, and with telemetry disabled
+the engine's outputs must be bit-identical to the telemetry-enabled
+run — the token path never reads telemetry state, this bench proves
+it end-to-end.
+
+Measurements, written to ``perf/OBS_OVERHEAD.json``:
+
+1. **Primitive costs** — ns/op for counter.inc, histogram.observe and
+   ring.emit, enabled and disabled, from 100k-iteration loops (the
+   disabled numbers show the near-no-op claim). These loops
+   self-average, so they are stable even on a noisy host.
+2. **Decode overhead, attributed** (the headline ``overhead_pct``) —
+   the exact telemetry ops a continuous-batching workload performs
+   (counted by wrapping the obs entry points; the engine is
+   deterministic, so the counts are too) priced at the measured
+   enabled per-op cost, over the median decode wall time. This is the
+   estimator with sub-0.1% resolution: on this 1-core container,
+   wall clock for IDENTICAL back-to-back runs swings up to ~6x
+   (.claude/skills/verify/SKILL.md), orders of magnitude above the
+   µs-scale cost being measured.
+3. **Decode overhead, wall-clock A/B** — the same workload with
+   telemetry on vs off, gc-settled, interleaved, median of paired
+   deltas (never min — repo benchmarking rule). Reported for honesty
+   with its per-pair spread; on this host it is noise-dominated.
+4. **Bit-identity** — per-request token streams from the on/off runs
+   compared exactly.
+
+CPU-runnable like the other perf harnesses:
+
+    python perf/obs_overhead_bench.py [--reps 3] [--requests 8]
+"""
+
+import argparse
+import contextlib
+import gc
+import json
+import os
+import statistics
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def bench_primitives(n: int = 100_000) -> dict:
+    from triton_distributed_tpu import obs
+    from triton_distributed_tpu.obs import events, metrics
+
+    reg = metrics.Registry(enabled=True)
+    ring = events.EventRing(capacity=2048, enabled=True)
+    c = reg.counter("bench_total")
+    h = reg.histogram("bench_seconds")
+
+    out = {}
+    for state in ("enabled", "disabled"):
+        reg.enabled = ring.enabled = state == "enabled"
+        t0 = time.perf_counter()
+        for _ in range(n):
+            c.inc()
+        t_inc = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for i in range(n):
+            h.observe(0.001 * (i % 97))
+        t_obs = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for i in range(n):
+            ring.emit("bench", i=i)
+        t_emit = time.perf_counter() - t0
+        out[state] = {
+            "counter_inc_ns": round(t_inc / n * 1e9, 1),
+            "histogram_observe_ns": round(t_obs / n * 1e9, 1),
+            "ring_emit_ns": round(t_emit / n * 1e9, 1),
+        }
+    # Leave the process-global switch alone; only local objects used.
+    del obs
+    return out
+
+
+def run_once(make_engine, prompts):
+    """One workload run on a FRESH engine (prefix reuse across reps
+    would skew later reps): (outputs, wall seconds, last_stats).
+    gc is collected before and held off during the timed region —
+    telemetry's extra allocations otherwise shift WHICH run absorbs a
+    gen-2 pass over jax's object graph (tens of ms, lumpy)."""
+    eng = make_engine()
+    gc.collect()
+    gc.disable()
+    try:
+        t0 = time.perf_counter()
+        res = eng.run(prompts)
+        dt = time.perf_counter() - t0
+    finally:
+        gc.enable()
+    return [o.tolist() for o in res], dt, dict(eng.last_stats)
+
+
+@contextlib.contextmanager
+def count_obs_ops():
+    """Count calls into the obs mutation entry points (bench-local
+    wrappers; restored on exit). The workload is deterministic, so one
+    counted run gives THE op profile of the workload."""
+    from triton_distributed_tpu.obs import events, metrics
+
+    counts = {"counter_inc": 0, "histogram_observe": 0, "gauge_set": 0,
+              "ring_emit": 0}
+    originals = {
+        "counter_inc": metrics.Counter.inc,
+        "histogram_observe": metrics.Histogram.observe,
+        "gauge_set": metrics.Gauge.set,
+        "ring_emit": events.EventRing.emit,
+    }
+
+    def wrap(key):
+        orig = originals[key]
+
+        def counted(self, *a, **kw):
+            counts[key] += 1
+            return orig(self, *a, **kw)
+
+        return counted
+
+    metrics.Counter.inc = wrap("counter_inc")
+    metrics.Histogram.observe = wrap("histogram_observe")
+    metrics.Gauge.set = wrap("gauge_set")
+    events.EventRing.emit = wrap("ring_emit")
+    try:
+        yield counts
+    finally:
+        metrics.Counter.inc = originals["counter_inc"]
+        metrics.Histogram.observe = originals["histogram_observe"]
+        metrics.Gauge.set = originals["gauge_set"]
+        events.EventRing.emit = originals["ring_emit"]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--reps", type=int, default=3)
+    p.add_argument("--requests", type=int, default=8)
+    p.add_argument("--gen-len", type=int, default=24)
+    p.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "OBS_OVERHEAD.json"
+    ))
+    args = p.parse_args(argv)
+
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from triton_distributed_tpu import obs
+    from triton_distributed_tpu.models import AutoLLM, ContinuousEngine
+    from triton_distributed_tpu.runtime.mesh import initialize_distributed
+
+    prims = bench_primitives()
+
+    ctx = initialize_distributed(tp=4, devices=jax.devices()[:4])
+    model = AutoLLM.from_pretrained("tiny", ctx=ctx)
+
+    rng = np.random.default_rng(0)
+    shared = rng.integers(1, 200, size=12).tolist()
+    prompts = [
+        (shared + rng.integers(1, 200, size=4 + i % 5).tolist(),
+         args.gen_len)
+        for i in range(args.requests)
+    ]
+
+    def make_engine():
+        return ContinuousEngine(
+            model, max_batch=4, page_size=16, max_length=128,
+            prefix_cache=True, prefill_chunk=16,
+        )
+
+    # Warm the jit caches once so rep 1 is not a compile measurement.
+    make_engine().run(prompts[:2])
+
+    # One counted enabled run: the workload's exact telemetry op
+    # profile (deterministic engine => deterministic counts).
+    obs.set_enabled(True)
+    with count_obs_ops() as op_counts:
+        outs_counted, _, stats_on = run_once(make_engine, prompts)
+
+    # Interleaved on/off pairs, median of wall times and of paired
+    # deltas (never min — repo benchmarking rule, see module docstring).
+    outs_on = outs_off = None
+    walls = {True: [], False: []}
+    pair_deltas = []
+    for rep in range(args.reps):
+        order = ((True, False) if rep % 2 == 0 else (False, True))
+        for enabled in order:
+            obs.set_enabled(enabled)
+            outs, dt, _ = run_once(make_engine, prompts)
+            walls[enabled].append(dt)
+            if enabled:
+                outs_on = outs
+            else:
+                outs_off = outs
+    obs.set_enabled(True)
+    for t_on_i, t_off_i in zip(walls[True], walls[False]):
+        pair_deltas.append((t_on_i - t_off_i) / t_off_i * 100.0)
+
+    t_on = statistics.median(walls[True])
+    t_off = statistics.median(walls[False])
+    decode_steps = stats_on["decode_steps"]
+
+    # Attributed overhead: ops x measured enabled per-op cost over the
+    # median decode wall. Gauge.set does the same key+lock+store work
+    # as a counter inc; timeline stamps (one monotonic read + attr
+    # store per lifecycle edge) are priced at counter cost too, via
+    # 5 stamps per request.
+    en = prims["enabled"]
+    obs_ns = (
+        (op_counts["counter_inc"] + op_counts["gauge_set"]
+         + 5 * args.requests) * en["counter_inc_ns"]
+        + op_counts["histogram_observe"] * en["histogram_observe_ns"]
+        + op_counts["ring_emit"] * en["ring_emit_ns"]
+    )
+    overhead_pct = obs_ns * 1e-9 / t_off * 100.0
+    wall_delta_pct = statistics.median(pair_deltas)
+
+    bit_identical = outs_on == outs_off == outs_counted
+
+    report = {
+        "bench": "obs_overhead",
+        "workload": {
+            "requests": args.requests,
+            "gen_len": args.gen_len,
+            "decode_steps": decode_steps,
+            "reps": args.reps,
+            "platform": jax.devices()[0].platform,
+        },
+        "primitive_ns_per_op": prims,
+        "obs_op_counts": dict(op_counts),
+        "obs_total_ms": round(obs_ns * 1e-6, 4),
+        "decode_wall_s": {"enabled_median": round(t_on, 4),
+                          "disabled_median": round(t_off, 4)},
+        "per_step_ms": {
+            "enabled": round(t_on / max(decode_steps, 1) * 1e3, 4),
+            "disabled": round(t_off / max(decode_steps, 1) * 1e3, 4),
+        },
+        "overhead_pct": round(overhead_pct, 4),
+        "overhead_under_1pct": overhead_pct < 1.0,
+        "walltime_ab": {
+            "median_paired_delta_pct": round(wall_delta_pct, 3),
+            "paired_deltas_pct": [round(d, 2) for d in pair_deltas],
+            "note": ("noise-dominated on this host: identical "
+                     "back-to-back runs swing far beyond the µs-scale "
+                     "telemetry cost; overhead_pct above is the "
+                     "op-attributed estimator"),
+        },
+        "outputs_bit_identical": bit_identical,
+    }
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps(report, indent=2))
+    if not bit_identical:
+        print("FAIL: outputs differ between telemetry on/off",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

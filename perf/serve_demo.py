@@ -38,6 +38,10 @@ def main(argv=None) -> int:
                    help="int8-quantized paged KV pool (forces the paged "
                    "xla/pallas engine; stats payload then carries "
                    "kv_bytes_per_token/kv_dtype through the wire)")
+    p.add_argument("--stats", action="store_true",
+                   help="after generating, fetch {'cmd':'stats'} and "
+                   "{'cmd':'metrics'} through the wire and pretty-print "
+                   "the payloads (docs/observability.md)")
     args = p.parse_args(argv)
 
     import jax
@@ -88,6 +92,17 @@ def main(argv=None) -> int:
             "wire_tok_s": round(args.gen_len / warm_s, 2),
             "engine_stats": r2.get("stats"),
         }), flush=True)
+        if args.stats:
+            stats = request(server.host, server.port, {"cmd": "stats"})
+            print("== stats ==", flush=True)
+            print(json.dumps(stats["stats"], indent=2, default=str),
+                  flush=True)
+            m = request(server.host, server.port, {"cmd": "metrics"})
+            print("== metrics (json snapshot) ==", flush=True)
+            print(json.dumps(m["metrics"], indent=2, default=str),
+                  flush=True)
+            print("== metrics (prometheus) ==", flush=True)
+            print(m["prometheus"], flush=True)
     finally:
         # A wedged generate (chip hang) leaves the accept loop busy: the
         # shutdown request would then time out too — never let it mask

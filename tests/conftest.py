@@ -61,6 +61,25 @@ def rng():
     return np.random.default_rng(42)
 
 
+@pytest.fixture
+def fresh_telemetry():
+    """Opt-in: enable and zero the process-global metrics registry and
+    event ring around one test, restoring the prior enabled state.
+    Tests asserting ABSOLUTE counter/event totals need it — engines
+    emit into the process globals from any test in the suite. The ONE
+    reset protocol; tests/test_obs.py makes it autouse file-wide."""
+    from triton_distributed_tpu import obs
+    from triton_distributed_tpu.obs import events as obs_events
+    from triton_distributed_tpu.obs import metrics as obs_metrics
+
+    prev = obs.is_enabled()
+    obs.set_enabled(True)
+    obs_metrics.default_registry().clear()
+    obs_events.default_ring().clear()
+    yield
+    obs.set_enabled(prev)
+
+
 @pytest.fixture(autouse=True)
 def _audit_serving_pools():
     """Pool/radix invariant audit after EVERY test (docs/serving.md

@@ -87,6 +87,22 @@ def test_faultplan_determinism_and_counting():
     assert plan.mutate("m", 10) == 10   # hit 1: untouched
     assert plan.mutate("m", 10) == 11   # hit 2: mutated
 
+    # A ctx key colliding with the telemetry event's own fields (or
+    # emit's positional ``kind``) must not TypeError out of the
+    # injection site — the ctx value survives under a ctx_ prefix.
+    with pytest.raises(FaultError):
+        FaultPlan().on("c", at=1).fire("c", hit="ctx-collides",
+                                       kind="timeout")
+    from triton_distributed_tpu.obs import events as obs_events
+    ev = [e for e in obs_events.default_ring().tail(0)[0]
+          if e.kind == "fault" and e.fields.get("seam") == "c"]
+    if ev:  # ring enabled in this run
+        assert ev[-1].fields["ctx_kind"] == "timeout"
+        assert ev[-1].fields["ctx_hit"] == "ctx-collides"
+        assert ev[-1].fields["hit"] == 1
+    plan2 = FaultPlan().on("m2", at=1, mutate=lambda v, ctx: v * 2)
+    assert plan2.mutate("m2", 3, kind="k", hit="h") == 6  # no TypeError
+
 
 def test_fault_points_inert_without_plan():
     fault_point("engine.decode", step=0)
@@ -452,3 +468,61 @@ def test_server_deadline_payload(ctx4):
         assert resp["results"][1]["status"] == "deadline_exceeded"
     finally:
         server.shutdown()
+
+
+def test_chaos_counters_and_events_fire(ctx4, fresh_telemetry):
+    """ISSUE 5 satellite: chaos scenarios leave matching telemetry —
+    the shed/deadline/nan counters in the metrics registry AND the
+    corresponding shed/deadline/nan_guard/fault events in the ring,
+    each consistent with the engine's own last_stats ledger."""
+    from triton_distributed_tpu.obs import events as obs_events
+    from triton_distributed_tpu.obs import metrics as obs_metrics
+
+    model, eng = tiny_engine(ctx4, max_batch=1, max_queue=2)
+    with FaultPlan().nan_logits(at=2, slot=0):
+        results = eng.run(
+            [
+                Request(np.asarray(P_A, np.int32), 6),  # poisoned
+                Request(np.asarray(P_B, np.int32), 4, deadline_s=0.0),
+                Request(np.asarray(P_A, np.int32), 4),  # > max_queue
+            ],
+            results=True,
+        )
+    assert [r.status for r in results] == [
+        "nan_logits", "deadline_exceeded", "overloaded"
+    ]
+    assert eng.audit() == []
+
+    # Counters mirror last_stats exactly (registry cleared above).
+    def val(name):
+        m = obs_metrics.default_registry().get(name)
+        return m.value() if m is not None else 0
+
+    stats = eng.last_stats
+    assert (val("tdt_engine_shed_requests_total")
+            == stats["shed_requests"] == 1)
+    assert (val("tdt_engine_deadline_expired_total")
+            == stats["deadline_expired"] == 1)
+    assert (val("tdt_engine_nonfinite_logits_total")
+            == stats["nonfinite_logits"] == 1)
+    assert (val("tdt_engine_failed_requests_total")
+            == stats["failed_requests"] == 3)
+
+    # Status-labeled request totals pick up the full taxonomy mix.
+    totals = obs_metrics.default_registry().get("tdt_requests_total")
+    for status in ("nan_logits", "deadline_exceeded", "overloaded"):
+        assert totals.value(status=status) == 1, status
+
+    # Events: the injected fault itself plus each failure's kind.
+    evts, _ = obs_events.default_ring().tail(0)
+    kinds = [e.kind for e in evts]
+    assert "fault" in kinds       # runtime/faults.py activation
+    assert "shed" in kinds        # overloaded
+    assert "deadline" in kinds    # deadline_exceeded
+    assert "nan_guard" in kinds   # nan_logits
+    fault = next(e for e in evts if e.kind == "fault")
+    assert fault.fields["seam"] == "engine.logits"
+    # Seqs are strictly increasing — the ring is tail-consistent
+    # even after a chaos run.
+    seqs = [e.seq for e in evts]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)

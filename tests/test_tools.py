@@ -300,6 +300,31 @@ def test_perf_scripts_compile():
     )
 
 
+def test_obs_modules_compile():
+    """The telemetry stack must byte-compile: obs/ is imported by the
+    engines, the server, the fault harness, and the profiler span
+    wrapper — a syntax error there takes the whole serving stack down
+    at import time. The CPU-runnable overhead bench rides along (repo
+    convention: perf harnesses fail tier-1, not a relay window)."""
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    targets = [
+        os.path.join(root, "triton_distributed_tpu", "obs"),
+        os.path.join(root, "triton_distributed_tpu", "models", "stats.py"),
+        os.path.join(root, "perf", "obs_overhead_bench.py"),
+    ]
+    proc = subprocess.run(
+        [sys.executable, "-m", "compileall", "-q", "-f", *targets],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, (
+        f"obs modules failed to compile:\n{proc.stdout}\n{proc.stderr}"
+    )
+
+
 def test_kv_quant_modules_compile():
     """The quantized-KV stack must byte-compile: the scale-aware pool,
     the dequantizing attention kernels, and the CPU-runnable bench that

@@ -55,6 +55,10 @@ from triton_distributed_tpu.models.engine import (
     MegaDispatch,
     prefill_suffix_chunks,
 )
+from triton_distributed_tpu.models.stats import STAT_METRICS
+from triton_distributed_tpu.obs import events as obs_events
+from triton_distributed_tpu.obs import metrics as obs_metrics
+from triton_distributed_tpu.obs.timeline import Timeline, observe_request
 from triton_distributed_tpu.models.paged_kv_cache import (
     PoolAuditError,
     audit_pool,
@@ -135,6 +139,21 @@ _finite_greedy = jax.jit(
     )
 )
 
+# Serving counters mirrored live into the process metrics registry
+# (docs/observability.md): same numbers as ``last_stats``, but
+# scrapeable through ``{"cmd": "metrics"}`` MID-generation instead of
+# only after a run() returns. Keys match ``_zero_stats``; the (name,
+# help) table lives in models/stats.py so Engine.serve shares the
+# exact declarations.
+
+# Event-ring kind for each terminal failure status (PR 3 taxonomy);
+# statuses not listed emit a generic ``request_failed`` event.
+_FAIL_EVENT_KIND = {
+    "overloaded": "shed",
+    "deadline_exceeded": "deadline",
+    "nan_logits": "nan_guard",
+}
+
 
 @dataclasses.dataclass
 class Request:
@@ -166,6 +185,10 @@ class Request:
     # Failure channel (``ok`` until something fails this request).
     status: str = "ok"
     reason: str = ""
+    # Telemetry (docs/observability.md): lifecycle stamps yielding the
+    # queue-wait/TTFT/TPOT/e2e histograms. The server stamps enqueue at
+    # payload decode; ``run()`` backfills for direct callers.
+    timeline: Timeline | None = dataclasses.field(default=None, repr=False)
     deadline_at: float | None = dataclasses.field(default=None, repr=False)
 
     @property
@@ -281,6 +304,23 @@ class ContinuousEngine(MegaDispatch):
         )
         self._multi_fn = None  # lazy megakernel multi-step program
         self.stats = self._zero_stats()
+        # Metric handles resolved ONCE: the hot decode loop pays a dict
+        # lookup + inc per _bump, not a registry get-or-create.
+        # Registry.clear zeroes series in place, so the handles stay
+        # valid across test resets.
+        self._metric_handles = {
+            key: obs_metrics.counter(name, help)
+            for key, (name, help) in STAT_METRICS.items()
+        }
+        # Last-write-wins and UNLABELED by design: a serving process
+        # hosts one engine (ModelServer owns exactly one), so one
+        # series is the truth there; with several engines in-process
+        # (the test suite) the gauge flaps to whichever synced last —
+        # a per-engine label would instead leak a series per engine
+        # into the never-GC'd process registry (docs/observability.md).
+        self._free_pages_gauge = obs_metrics.gauge(
+            "tdt_engine_free_pages", "Pool pages on the free list."
+        )
         ContinuousEngine._live.add(self)
 
     @staticmethod
@@ -289,6 +329,7 @@ class ContinuousEngine(MegaDispatch):
             "admitted": 0,
             "decode_steps": 0,
             "prefill_tokens": 0,
+            "generated_tokens": 0,
             "prefill_chunks": 0,
             "prefix_hit_tokens": 0,
             "pages_cow_copied": 0,
@@ -339,9 +380,32 @@ class ContinuousEngine(MegaDispatch):
             )
         return stats
 
+    # -- telemetry ---------------------------------------------------------
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        """Increment a serving counter in ``stats`` AND its mirrored
+        registry metric, so the same number is visible per-run
+        (``last_stats``) and fleet-wide (``{"cmd": "metrics"}``).
+        ``inc`` no-ops when telemetry is disabled."""
+        self.stats[key] += n
+        self._metric_handles[key].inc(n)
+
+    def _finish_obs(self, req: Request) -> None:
+        """Latch a request's terminal timeline stamp and fold it into
+        the latency histograms — exactly once per request, whichever
+        teardown path gets here first."""
+        tl = req.timeline
+        if tl is None:
+            return
+        tl.tokens_in = len(req.prompt)
+        tl.tokens_out = len(req.out)
+        if tl.finish(req.status):
+            observe_request(tl)
+
     # -- slot management -------------------------------------------------
 
     def _sync_tables(self) -> None:
+        self._free_pages_gauge.set(len(self.pool.free))
         self.cache = dataclasses.replace(
             self.cache,
             page_table=jnp.asarray(self._table),
@@ -353,6 +417,8 @@ class ContinuousEngine(MegaDispatch):
     ) -> jax.Array:
         """Prefill ``req`` into ``slot``; returns the first sampled token."""
         fault_point("engine.admit", slot=slot)
+        if req.timeline is not None:
+            req.timeline.stamp_admit()
         if self.prefix is not None:
             return self._admit_prefix(req, slot, m)
         s = len(req.prompt)
@@ -367,6 +433,8 @@ class ContinuousEngine(MegaDispatch):
         self._kv_len[slot] = s
         self._sync_tables()
 
+        if req.timeline is not None:
+            req.timeline.stamp_first_chunk()
         logits, self._dense1 = self.model.prefill_batched(
             jnp.asarray(row[None]), self._dense1, self._prefill_mode,
             jnp.asarray([s], jnp.int32),
@@ -374,8 +442,12 @@ class ContinuousEngine(MegaDispatch):
         self.cache = write_prefill(
             self.cache, slot, self._dense1.k, self._dense1.v, s
         )
-        self.stats["admitted"] += 1
-        self.stats["prefill_tokens"] += s
+        self._bump("admitted")
+        self._bump("prefill_tokens", s)
+        # Emitted HERE, aligned with the `admitted` counter — a failed
+        # allocation/prefill must not leave a phantom admit event for
+        # consumers correlating admits against counters or evicts.
+        obs_events.emit("admit", slot=slot, prompt_len=s, matched=0)
         self._slots[slot] = req
         return self._sample_req(req, logits[0])
 
@@ -403,16 +475,23 @@ class ContinuousEngine(MegaDispatch):
             # The partially matched page becomes this request's first
             # private page: clone it, count only the matched positions.
             self.cache = copy_page(self.cache, m.cow_node.page, new_pages[0])
-            self.stats["pages_cow_copied"] += 1
+            self._bump("pages_cow_copied")
+            obs_events.emit("cow", slot=slot, matched=m.cow_len)
         self.prefix.finish_cow(m)
         self._kv_len[slot] = matched
         self._sync_tables()
-        self.stats["admitted"] += 1
-        self.stats["prefix_hit_tokens"] += matched
+        if req.timeline is not None:
+            req.timeline.stamp_first_chunk()
         with trace_span(
             "prefix_cache:admit", slot=slot, prompt_len=s, matched=matched
         ):
             logits = self._prefill_suffix(slot, req.prompt, matched)
+        # Counted/emitted only AFTER the suffix prefill: a chunk that
+        # fails must not leave a phantom admit event or `admitted`
+        # count (the same contract the non-prefix path states above).
+        self._bump("admitted")
+        self._bump("prefix_hit_tokens", matched)
+        obs_events.emit("admit", slot=slot, prompt_len=s, matched=matched)
         self._slots[slot] = req
         return self._sample_req(req, logits)
 
@@ -441,8 +520,8 @@ class ContinuousEngine(MegaDispatch):
             self.prefill_chunk, self._prefill_mode, between_chunks,
         )
         self._kv_len[slot] = len(prompt)
-        self.stats["prefill_tokens"] += len(prompt) - start
-        self.stats["prefill_chunks"] += chunks
+        self._bump("prefill_tokens", len(prompt) - start)
+        self._bump("prefill_chunks", chunks)
         return logits
 
     def _decode_once(self) -> bool:
@@ -460,7 +539,7 @@ class ContinuousEngine(MegaDispatch):
             "engine.logits", logits, step=self.stats["decode_steps"]
         )
         self._kv_len += active
-        self.stats["decode_steps"] += 1
+        self._bump("decode_steps")
         # One device program computes the finite mask AND the greedy
         # base tokens, so the NaN guard adds no extra host-sync round
         # trip to the hot decode loop.
@@ -480,7 +559,7 @@ class ContinuousEngine(MegaDispatch):
         for slot, req in enumerate(self._slots):
             if req is None or bool(finite[slot]):
                 continue
-            self.stats["nonfinite_logits"] += 1
+            self._bump("nonfinite_logits")
             self._fail(
                 req, "nan_logits",
                 f"non-finite logits at decode step "
@@ -493,21 +572,27 @@ class ContinuousEngine(MegaDispatch):
         """Append per-slot tokens; evict on gen_len/eos. Returns whether
         slot state changed."""
         changed = False
+        emitted = 0
         for slot, req in enumerate(self._slots):
             if req is None:
                 continue
             for t in slot_tokens(slot):
                 req.out.append(int(t))
+                emitted += 1
                 self._tok[slot] = int(t)
                 if req.spec is not None:
                     req.spec.observe((int(t),))
                 if self._maybe_finish(req, int(t)):
                     changed = True
                     break
+        if emitted:
+            self._bump("generated_tokens", emitted)
         return changed
 
     def _evict(self, req: Request) -> None:
         slot = req.slot
+        self._finish_obs(req)  # status "ok": _evict only runs on success
+        obs_events.emit("evict", slot=slot, tokens_out=len(req.out))
         if self.prefix is not None:
             self._retire_to_prefix(req)
         else:
@@ -528,11 +613,19 @@ class ContinuousEngine(MegaDispatch):
         holds a slot, tear that slot down. Everything else keeps
         serving."""
         req.status, req.reason = status, str(reason)
-        self.stats["failed_requests"] += 1
+        self._bump("failed_requests")
         if status == "deadline_exceeded":
-            self.stats["deadline_expired"] += 1
+            self._bump("deadline_expired")
+        elif status == "overloaded":
+            self._bump("shed_requests")
         if req.slot is not None:
             self._teardown_slot(req)
+        obs_events.emit(
+            _FAIL_EVENT_KIND.get(status, "request_failed"),
+            status=status, tokens_out=len(req.out),
+            reason=str(reason)[:200],
+        )
+        self._finish_obs(req)
 
     def _teardown_slot(self, req: Request) -> None:
         """Crash-safe slot release: private pages to the pool, shared
@@ -572,7 +665,7 @@ class ContinuousEngine(MegaDispatch):
         status = "failed"
         if isinstance(e, _NonFiniteLogits):
             status = "nan_logits"
-            self.stats["nonfinite_logits"] += 1
+            self._bump("nonfinite_logits")
         self._fail(req, status, f"{type(e).__name__}: {e}")
         self._sync_tables()
 
@@ -587,7 +680,7 @@ class ContinuousEngine(MegaDispatch):
         try:
             return fn()
         except Exception as e:  # noqa: BLE001 — isolation boundary
-            self.stats["decode_faults"] += 1
+            self._bump("decode_faults")
             slot = getattr(e, "slot", None)
             if (isinstance(slot, int) and 0 <= slot < self.max_batch
                     and self._slots[slot] is not None):
@@ -717,7 +810,7 @@ class ContinuousEngine(MegaDispatch):
         )
 
         bursts: dict[int, list[int]] = {}
-        rolled_total = 0
+        rolled_total = drafted_total = accepted_total = 0
         any_failed = False
         for slot, req in enumerate(self._slots):
             if req is None or slot not in drafts:
@@ -735,7 +828,7 @@ class ContinuousEngine(MegaDispatch):
                 # Injected faults fire at the seam BEFORE the chunk
                 # program consumed (donated) the cache — per-slot
                 # isolation is safe.
-                self.stats["decode_faults"] += 1
+                self._bump("decode_faults")
                 self._fail(req, "failed", f"{type(e).__name__}: {e}")
                 any_failed = True
                 continue
@@ -751,7 +844,7 @@ class ContinuousEngine(MegaDispatch):
             if emitted is None:
                 # Non-finite verify logits (the cache was still
                 # threaded through — only this request is poisoned).
-                self.stats["nonfinite_logits"] += 1
+                self._bump("nonfinite_logits")
                 self._fail(
                     req, "nan_logits",
                     f"non-finite logits in speculative verify chunk "
@@ -760,10 +853,12 @@ class ContinuousEngine(MegaDispatch):
                 any_failed = True
                 continue
             req.spec.record(len(draft), a)
-            self.stats["spec_verify_steps"] += 1
-            self.stats["spec_draft_tokens"] += len(draft)
-            self.stats["spec_accepted_tokens"] += a
-            self.stats["spec_rollback_tokens"] += len(draft) - a
+            self._bump("spec_verify_steps")
+            self._bump("spec_draft_tokens", len(draft))
+            self._bump("spec_accepted_tokens", a)
+            self._bump("spec_rollback_tokens", len(draft) - a)
+            drafted_total += len(draft)
+            accepted_total += a
             rolled_total += len(draft) - a
             self._kv_len[slot] = kv + a + 1
             bursts[slot] = emitted
@@ -771,8 +866,13 @@ class ContinuousEngine(MegaDispatch):
         # Every verify left the device kv_len at the chunk's end
         # (accepted + rejected rows); resyncing the host table rolls the
         # rejected tail back and drops any evicted/failed slot's pages
-        # in one write.
-        with trace_span("spec:rollback", tokens=rolled_total):
+        # in one write. The round's accept rate rides the span as a
+        # NATIVE float (trace_span keeps numbers numeric in the event
+        # ring; only the profiler's metadata may stringify).
+        with trace_span(
+            "spec:rollback", tokens=rolled_total,
+            accept_rate=accepted_total / max(drafted_total, 1),
+        ):
             self._sync_tables()
         return changed or any_failed
 
@@ -818,7 +918,7 @@ class ContinuousEngine(MegaDispatch):
                     )
                     if need - len(m.nodes) > avail:
                         self.prefix.release_match(m)
-                        self.stats["admission_stalls"] += 1
+                        self._bump("admission_stalls")
                         progress = False  # end the scan: a rescan would
                         break             # just re-stall the same head
                 elif need > len(self.pool.free):
@@ -831,6 +931,8 @@ class ContinuousEngine(MegaDispatch):
                     self._admit_failure(req, m, e)
                     progress = True
                     break
+                if req.timeline is not None:
+                    req.timeline.stamp_first_token()
                 if self.speculative:
                     from triton_distributed_tpu.models.speculative import (  # noqa: E501
                         SpecState,
@@ -840,6 +942,10 @@ class ContinuousEngine(MegaDispatch):
                     req.spec.observe(req.prompt)
                     req.spec.observe((int(first),))
                 req.out.append(int(first))
+                # The admission-sampled token is emitted output too —
+                # without this, generated_tokens undercounts by one per
+                # request vs tokens_out and Engine.serve's b*gen_len.
+                self._bump("generated_tokens")
                 self._tok[slot] = int(first)
                 admitted = progress = True
                 # The admission token itself can finish the request
@@ -896,7 +1002,7 @@ class ContinuousEngine(MegaDispatch):
                 jnp.asarray(self._tok), self.cache,
             )
             self._kv_len += self.NS * active
-            self.stats["decode_steps"] += self.NS
+            self._bump("decode_steps", self.NS)
             toks_np = np.asarray(toks)  # [NS, max_batch]
             return self._process(lambda slot: toks_np[:, slot])
         return self._decode_once()
@@ -929,18 +1035,23 @@ class ContinuousEngine(MegaDispatch):
         ]
         self.stats = self._zero_stats()
         t0 = time.monotonic()
+        # Telemetry: every request gets a lifecycle timeline; the
+        # server stamps enqueue at payload decode, direct callers get
+        # it backfilled here (docs/observability.md).
+        for r in reqs:
+            if r.timeline is None:
+                r.timeline = Timeline()
+            r.timeline.stamp_enqueue()
         # Load shedding: the admission queue is bounded — excess
         # requests get a structured `overloaded` error immediately
         # instead of wedging the batch (clients retry with backoff).
         if self.max_queue is not None and len(reqs) > self.max_queue:
             for r in reqs[self.max_queue:]:
-                r.status = "overloaded"
-                r.reason = (
+                self._fail(
+                    r, "overloaded",
                     f"admission queue bounded at {self.max_queue} "
-                    f"requests ({len(reqs)} submitted); retry with backoff"
+                    f"requests ({len(reqs)} submitted); retry with backoff",
                 )
-                self.stats["shed_requests"] += 1
-                self.stats["failed_requests"] += 1
         for r in reqs:
             if r.status != "ok":
                 continue
@@ -952,8 +1063,7 @@ class ContinuousEngine(MegaDispatch):
                 )
                 if not results:
                     raise ValueError(msg)
-                r.status, r.reason = "unservable", msg
-                self.stats["failed_requests"] += 1
+                self._fail(r, "unservable", msg)
                 continue
             need = self._needed_pages(len(r.prompt), r.gen_len)
             if need > self._capacity:
@@ -963,8 +1073,7 @@ class ContinuousEngine(MegaDispatch):
                 )
                 if not results:
                     raise ValueError(msg)
-                r.status, r.reason = "unservable", msg
-                self.stats["failed_requests"] += 1
+                self._fail(r, "unservable", msg)
                 continue
             if r.deadline_s is not None:
                 r.deadline_at = t0 + float(r.deadline_s)
@@ -1015,9 +1124,9 @@ class ContinuousEngine(MegaDispatch):
             while queue:
                 r = queue.popleft()
                 if r.status == "ok":
-                    r.status = "aborted"
-                    r.reason = "engine loop aborted before admission"
-                    self.stats["failed_requests"] += 1
+                    self._fail(
+                        r, "aborted", "engine loop aborted before admission"
+                    )
             if leftover:
                 self._sync_tables()
 

@@ -24,6 +24,8 @@ import numpy as np
 from triton_distributed_tpu.models import sampling
 from triton_distributed_tpu.models.kv_cache import KVCache
 from triton_distributed_tpu.models.qwen import Mode, Qwen3
+from triton_distributed_tpu.models.stats import STAT_METRICS
+from triton_distributed_tpu.obs import metrics as obs_metrics
 
 # Engine modes: the model's xla/pallas decode paths plus the megakernel
 # ("mega"): whole-step single-kernel decode, with a multi-step fast
@@ -228,6 +230,20 @@ class Engine(MegaDispatch):
         # fresh closure per serve() would retrace + recompile the
         # megakernel program every call.
         self._sampled_multi: dict = {}
+        # Registry handles resolved ONCE (the ContinuousEngine
+        # `_metric_handles` convention): serve() then increments
+        # without paying a name lookup under the global registry lock.
+        self._metric_handles = {
+            "decode_steps": obs_metrics.counter(
+                *STAT_METRICS["decode_steps"]),
+            "prefill_tokens": obs_metrics.counter(
+                *STAT_METRICS["prefill_tokens"]),
+            "generated_tokens": obs_metrics.counter(
+                *STAT_METRICS["generated_tokens"]),
+            "serve_seconds": obs_metrics.histogram(
+                "tdt_engine_serve_seconds",
+                "Wall time of one fixed-batch serve() call."),
+        }
         Engine._live.add(self)
 
     def audit(self, *, raise_on_violation: bool = False) -> list[str]:
@@ -486,6 +502,20 @@ class Engine(MegaDispatch):
         t_decode = time.perf_counter() - t0
 
         result = np.concatenate(out, axis=1)
+        # Core serving-stats keys (models/stats.py): one schema both
+        # engines expose, so dashboards never fork on engine type.
+        # decode_steps counts batched decode programs ONLY — under
+        # speculation the verify-chunk forwards ride spec_verify_steps
+        # and target_steps, matching the continuous engine's ledger
+        # (target_steps == decode_steps + spec_verify_steps).
+        steps = max(gen_len - 1, 0)
+        if spec_counters is not None:
+            steps = spec_counters["spec_decode_steps"]
+        # Work DONE, not accepted: prefix-cache serves count only the
+        # suffix tokens actually prefilled (hits ride prefix_hit_tokens).
+        prefill_toks = int(true_lens.sum())
+        if row_meta is not None:
+            prefill_toks = self._prefix_counters["prefill_tokens"]
         self.last_stats = {
             "prefill_s": t_prefill,
             "decode_s": t_decode,
@@ -493,7 +523,18 @@ class Engine(MegaDispatch):
                 t_decode / max(gen_len - 1, 1) * 1e3
             ),
             "tokens_per_s": b * max(gen_len - 1, 1) / max(t_decode, 1e-9),
+            "decode_steps": steps,
+            "prefill_tokens": prefill_toks,
+            "generated_tokens": int(b * gen_len),
         }
+        if obs_metrics.default_registry().enabled:
+            h = self._metric_handles
+            h["decode_steps"].inc(steps)
+            h["prefill_tokens"].inc(prefill_toks)
+            h["generated_tokens"].inc(
+                self.last_stats["generated_tokens"]
+            )
+            h["serve_seconds"].observe(t_prefill + t_decode)
         if self.paged:
             from triton_distributed_tpu.models.paged_kv_cache import (
                 kv_bytes_per_token,

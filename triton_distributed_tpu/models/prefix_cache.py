@@ -44,6 +44,9 @@ import heapq
 import weakref
 from typing import Iterable
 
+from triton_distributed_tpu.obs import events as obs_events
+from triton_distributed_tpu.obs import metrics as obs_metrics
+
 
 def round_chunk(n: int) -> int:
     """Chunk widths ``prefill_paged_chunk`` accepts: ≤128 → multiple of
@@ -117,6 +120,14 @@ class PrefixCache:
             "deduped_pages": 0,
             "evicted_pages": 0,
         }
+        # Resolved ONCE (the ContinuousEngine `_metric_handles`
+        # convention): evictions run inside the admission path, and a
+        # per-eviction registry get-or-create would contend on the
+        # process-global lock with the decode loop's increments.
+        self._evicted_counter = obs_metrics.counter(
+            "tdt_prefix_evicted_pages_total",
+            "Radix-tree pages evicted back to the pool.",
+        )
 
     # -- matching ---------------------------------------------------------
 
@@ -329,6 +340,9 @@ class PrefixCache:
                     and parent.refcount == 0):
                 heapq.heappush(heap, (parent.last_use, id(parent), parent))
         self.stats["evicted_pages"] += evicted
+        if evicted:
+            obs_events.emit("prefix_evict", pages=evicted)
+            self._evicted_counter.inc(evicted)
         return evicted
 
     def allocate(self, n: int) -> list[int] | None:

@@ -45,6 +45,7 @@ import numpy as np
 from triton_distributed_tpu.models import sampling
 from triton_distributed_tpu.models.paged_kv_cache import gather_bucket
 from triton_distributed_tpu.models.prefix_cache import round_chunk
+from triton_distributed_tpu.obs import events as obs_events
 from triton_distributed_tpu.runtime.faults import fault_point, mutate_point
 from triton_distributed_tpu.runtime.profiling import trace_span
 
@@ -285,7 +286,7 @@ def spec_verify_slot(
     buf[:n] = toks
     kv_pages = gather_bucket(int(kv_len) + c, page, pps)
     with trace_span("spec:verify", slot=slot, drafted=len(draft),
-                    offset=int(kv_len)):
+                    offset=int(kv_len), _ring=False):
         logits, cache = model.prefill_paged_chunk(
             buf, slot, int(kv_len), int(kv_len) + n, n - 1, cache, mode,
             kv_pages=kv_pages, all_logits=True,
@@ -308,5 +309,10 @@ def spec_verify_slot(
         accepted, nxt, key = verify_sampled(
             arr, draft, key, temperature, top_p, top_k
         )
+    # One emit site covers both engines (each verify chunk routes
+    # through here); rollbacks surface via the spec:rollback span.
+    obs_events.emit(
+        "spec_verify", slot=slot, drafted=len(draft), accepted=accepted
+    )
     emitted = [int(d) for d in draft[:accepted]] + [nxt]
     return emitted, cache, accepted, key

@@ -1,0 +1,83 @@
+"""Shared serving-stats schema.
+
+``Engine.last_stats`` (a plain dict rebuilt per serve) and
+``ContinuousEngine.last_stats`` (a property over live counters) grew
+independently across PRs 1–4 and drifted silently — a dashboard keyed
+on one engine's shape broke on the other. The CORE key set below is
+the contract both engines MUST expose (asserted by
+``tests/test_obs.py::test_core_stats_keys_unified``); everything else
+remains engine-specific.
+
+=====================  ================================================
+``decode_steps``       batched decode device programs run — verify
+                       chunks excluded; speculative serving counts
+                       those in ``spec_verify_steps``, and BOTH engines
+                       expose ``target_steps = decode_steps +
+                       spec_verify_steps`` when speculation is on (the
+                       "target forwards" a throughput model needs)
+``prefill_tokens``     prompt tokens actually prefilled (prefix-cache
+                       hits excluded — this is work DONE, not accepted)
+``generated_tokens``   tokens emitted to callers (partials included)
+``kv_bytes_per_token`` per-token KV footprint of the active cache
+``kv_dtype``           KV storage dtype (the PR 4 quantization knob)
+=====================  ================================================
+"""
+
+from __future__ import annotations
+
+CORE_STATS_KEYS = (
+    "decode_steps",
+    "prefill_tokens",
+    "generated_tokens",
+    "kv_bytes_per_token",
+    "kv_dtype",
+)
+
+
+def missing_core_stats(stats: dict) -> list[str]:
+    """Core keys absent from ``stats`` (empty == conforming)."""
+    return [k for k in CORE_STATS_KEYS if k not in stats]
+
+
+# Registry metric (name, help) for each serving counter mirrored into
+# the process metrics registry (docs/observability.md). ONE table for
+# both engines: Registry._get_or_create keeps the first help string it
+# sees for a name, so duplicated literals would drift silently with
+# engine construction order.
+STAT_METRICS = {
+    "admitted": ("tdt_engine_admitted_total",
+                 "Requests admitted to a decode slot."),
+    "decode_steps": ("tdt_engine_decode_steps_total",
+                     "Batched decode device programs run."),
+    "prefill_tokens": ("tdt_engine_prefill_tokens_total",
+                       "Prompt tokens prefilled (prefix hits excluded)."),
+    "prefill_chunks": ("tdt_engine_prefill_chunks_total",
+                       "Chunked-prefill programs run."),
+    "prefix_hit_tokens": ("tdt_engine_prefix_hit_tokens_total",
+                          "Prompt tokens served from the radix tree."),
+    "pages_cow_copied": ("tdt_engine_pages_cow_total",
+                         "Pages COW-cloned at admission."),
+    "admission_stalls": ("tdt_engine_admission_stalls_total",
+                         "Admission scans stalled for pool pages."),
+    "generated_tokens": ("tdt_engine_generated_tokens_total",
+                         "Tokens emitted (partials included)."),
+    "spec_verify_steps": ("tdt_engine_spec_verify_steps_total",
+                          "Speculative verify chunk programs run."),
+    "spec_draft_tokens": ("tdt_engine_spec_draft_tokens_total",
+                          "Draft tokens proposed."),
+    "spec_accepted_tokens": ("tdt_engine_spec_accepted_tokens_total",
+                             "Draft tokens accepted by verify."),
+    "spec_rollback_tokens": ("tdt_engine_spec_rollback_tokens_total",
+                             "Draft tokens rolled back after verify."),
+    "failed_requests": ("tdt_engine_failed_requests_total",
+                        "Requests finished with a non-ok status."),
+    "shed_requests": ("tdt_engine_shed_requests_total",
+                      "Requests shed by the bounded admission queue."),
+    "deadline_expired": ("tdt_engine_deadline_expired_total",
+                         "Requests failed on a wall-clock deadline."),
+    "nonfinite_logits": ("tdt_engine_nonfinite_logits_total",
+                         "Steps guarded for non-finite logits."),
+    "decode_faults": ("tdt_engine_decode_faults_total",
+                      "Exceptions isolated by the decode-phase step "
+                      "guard."),
+}

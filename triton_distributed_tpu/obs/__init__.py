@@ -1,0 +1,54 @@
+"""Serving telemetry (docs/observability.md): metrics registry,
+per-request timelines, bounded event ring, Prometheus exposition.
+
+Zero-dependency and host-side only — nothing here touches the token
+path, so engine outputs are bit-identical with telemetry on or off
+(``perf/obs_overhead_bench.py`` proves it, along with <1% decode-step
+overhead enabled). ``set_enabled(False)`` (or env ``TDT_OBS=0``)
+drops every mutation to an attribute check.
+
+- :mod:`~triton_distributed_tpu.obs.metrics` — counters, gauges,
+  log-bucketed histograms; :func:`prometheus_text` renders the
+  process-global registry for the server's ``{"cmd": "metrics"}`` verb.
+- :mod:`~triton_distributed_tpu.obs.timeline` — per-request lifecycle
+  stamps yielding queue-wait/TTFT/TPOT/e2e histograms labeled by the
+  PR 3 finish-status taxonomy.
+- :mod:`~triton_distributed_tpu.obs.events` — bounded structured-event
+  ring with gap-free seq numbers for drop-aware tailing
+  (``{"cmd": "events"}``).
+"""
+
+from triton_distributed_tpu.obs.events import (  # noqa: F401
+    Event,
+    EventRing,
+    default_ring,
+    emit,
+)
+from triton_distributed_tpu.obs.metrics import (  # noqa: F401
+    LATENCY_BUCKETS,
+    SIZE_BUCKETS,
+    Registry,
+    counter,
+    default_registry,
+    gauge,
+    histogram,
+    log_buckets,
+    prometheus_text,
+)
+from triton_distributed_tpu.obs.timeline import (  # noqa: F401
+    FINISH_STATUSES,
+    Timeline,
+    observe_request,
+)
+
+
+def set_enabled(flag: bool) -> None:
+    """Master switch for the process-global telemetry (registry AND
+    event ring). Off turns every emit/inc/observe into an attribute
+    check + return; the token path is untouched either way."""
+    default_registry().enabled = bool(flag)
+    default_ring().enabled = bool(flag)
+
+
+def is_enabled() -> bool:
+    return default_registry().enabled

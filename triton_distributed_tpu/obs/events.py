@@ -1,0 +1,147 @@
+"""Bounded structured-event ring with sequence numbers.
+
+The serving loop's interesting moments — admissions, evictions, COW
+clones, load shedding, deadline expiries, NaN guards, speculative
+accept/rollback, injected faults, host trace spans — are low-rate but
+high-value when diagnosing a stall after the fact. This ring keeps the
+last ``capacity`` of them in memory with a monotonically increasing
+``seq`` per event, so a consumer tailing the ring (e.g. the server's
+``{"cmd": "events"}`` verb) can detect drops exactly: request
+``since=<last seq seen>`` and the reply carries how many events were
+overwritten in between — tailing is drop-AWARE even though the ring
+itself is bounded.
+
+Writers never block readers for long: emit is one lock-guarded slot
+write; there is no per-event allocation beyond the event itself.
+``default_ring()`` is the process-global ring the serving stack emits
+into; ``enabled = False`` (or ``TDT_OBS=0``) turns ``emit`` into an
+attribute check + return.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+
+class Event:
+    """One structured event: ``seq`` (1-based, gap-free across the
+    ring's lifetime), monotonic timestamp ``t``, a ``kind`` tag, and
+    free-form ``fields``. Numeric field values stay numeric — the
+    profiler may stringify its metadata, the ring never does."""
+
+    __slots__ = ("seq", "t", "kind", "fields")
+
+    def __init__(self, seq: int, t: float, kind: str, fields: dict):
+        self.seq = seq
+        self.t = t
+        self.kind = kind
+        self.fields = fields
+
+    def as_dict(self) -> dict:
+        return {"seq": self.seq, "t": self.t, "kind": self.kind,
+                "fields": self.fields}
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"Event(seq={self.seq}, kind={self.kind!r}, {self.fields})"
+
+
+def safe_fields(raw: dict, reserved: tuple = ()) -> dict:
+    """Make arbitrary caller-supplied fields safe to ``emit``: keys
+    colliding with ``emit``'s positional ``kind`` or with the caller's
+    ``reserved`` event keys survive under a ``ctx_`` prefix (never a
+    TypeError out of an instrumentation site), and non-primitive
+    values are stringified so a ring consumer (``{"cmd": "events"}``)
+    can always JSON-serialize them. The ONE implementation of the
+    collision-escape rule — spans and fault events both use it."""
+    out = {}
+    for k, v in raw.items():
+        if v is not None and not isinstance(v, (bool, int, float, str)):
+            v = str(v)
+        out["ctx_" + k if (k == "kind" or k in reserved) else k] = v
+    return out
+
+
+class EventRing:
+    """Fixed-capacity ring of :class:`Event`\\ s."""
+
+    def __init__(self, capacity: int = 2048, enabled: bool | None = None):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._buf: list[Event | None] = [None] * capacity
+        self._next_seq = 1
+        self._floor = 0  # events with seq <= floor were cleared
+        self._lock = threading.Lock()
+        if enabled is None:
+            enabled = os.environ.get("TDT_OBS", "1") != "0"
+        self.enabled = enabled
+
+    def emit(self, kind: str, **fields) -> int:
+        """Record one event; returns its seq (0 when disabled)."""
+        if not self.enabled:
+            return 0
+        t = time.monotonic()
+        with self._lock:
+            seq = self._next_seq
+            self._next_seq += 1
+            self._buf[seq % self.capacity] = Event(seq, t, kind, fields)
+        return seq
+
+    @property
+    def next_seq(self) -> int:
+        return self._next_seq
+
+    def tail(self, since: int = 0,
+             limit: int | None = None) -> tuple[list[Event], int]:
+        """Events with ``seq > since``, oldest first, plus how many such
+        events are GONE (overwritten by the ring). ``limit`` is a page
+        size: it keeps the OLDEST ``limit`` so ``since=<last seq seen>``
+        pages through a backlog without skipping anything still
+        buffered. ``dropped == 0`` means the consumer saw (or will see,
+        on later pages) everything since its last call. A negative
+        ``since`` clamps to 0 (the before-everything cursor) — it must
+        not read as phantom drops to a drop-summing consumer (the
+        server additionally rejects it wire-side as ``bad_request``)."""
+        since = max(since, 0)
+        with self._lock:
+            newest = self._next_seq - 1
+            oldest = max(self._floor + 1, self._next_seq - self.capacity)
+            start = max(since + 1, oldest)
+            events = [self._buf[s % self.capacity]
+                      for s in range(start, newest + 1)]
+        if events:
+            dropped = events[0].seq - since - 1
+        else:
+            dropped = max(0, newest - since)
+        if limit is not None and limit >= 0:
+            events = events[:limit]
+        return events, dropped
+
+    def clear(self) -> None:
+        """Drop buffered events; seq numbering keeps increasing, so a
+        tailer across a clear correctly observes a drop, not a reset."""
+        with self._lock:
+            self._buf = [None] * self.capacity
+            self._floor = self._next_seq - 1
+
+    def reset(self) -> None:
+        """Hard reset (tests only): empty ring AND seq back to 1."""
+        with self._lock:
+            self._buf = [None] * self.capacity
+            self._next_seq = 1
+            self._floor = 0
+
+
+_DEFAULT = EventRing()
+
+
+def default_ring() -> EventRing:
+    """The process-global ring the serving stack emits into."""
+    return _DEFAULT
+
+
+def emit(kind: str, **fields) -> int:
+    """Emit into the default ring (the serving stack's one-liner)."""
+    return _DEFAULT.emit(kind, **fields)

@@ -1,0 +1,377 @@
+"""Metrics registry: counters, gauges, log-bucketed histograms.
+
+Zero-dependency serving telemetry (docs/observability.md). The serving
+stack's only pre-existing observability was the merged chrome-trace
+profiler (``runtime/profiling.py``) — fine for offline kernel work,
+useless for a fleet: no latency distributions, no way to scrape a
+server. This registry is the aggregation layer under the
+``{"cmd": "metrics"}`` server verb:
+
+- **Counters / gauges** — labeled, thread-safe, monotonically
+  increasing / last-write-wins.
+- **Histograms** — FIXED log-spaced bucket edges chosen at
+  construction, so ``observe`` is one bisect + two adds and a snapshot
+  is allocation-free (no per-sample storage, ever). p50/p90/p99 are
+  derived from the bucket counts by interpolation — accurate to one
+  bucket's width, which the default edges keep under ~33% relative
+  error across nine decades.
+- **Exposition** — :func:`prometheus_text` renders the whole registry
+  in the Prometheus text format (HELP/TYPE comments, cumulative
+  ``_bucket{le=...}`` rows, ``_sum``/``_count``); :meth:`Registry.snapshot`
+  returns the same data as a JSON-ready dict with the derived
+  quantiles inlined.
+- **Disabled mode** — ``registry.enabled = False`` (or ``TDT_OBS=0``)
+  turns every mutation into a single attribute check + return, so the
+  telemetry can be priced at ~zero without recompiling anything. The
+  token path never reads a metric, so outputs are bit-identical either
+  way (``perf/obs_overhead_bench.py`` proves both properties).
+
+One process-global default registry (:func:`default_registry`) backs
+the engines and the server; tests reset it with ``Registry.clear``.
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import os
+import re
+import threading
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+def log_buckets(lo: float, hi: float, per_decade: int = 4) -> tuple:
+    """Geometric bucket edges from ``lo`` to (at least) ``hi`` with
+    ``per_decade`` edges per factor of 10. The default latency edges
+    (:data:`LATENCY_BUCKETS`) span 100 µs .. ~100 s."""
+    if lo <= 0 or hi <= lo or per_decade < 1:
+        raise ValueError(f"bad bucket spec lo={lo} hi={hi}/{per_decade}")
+    edges = []
+    k = math.ceil(math.log10(lo) * per_decade)
+    while True:
+        e = 10.0 ** (k / per_decade)
+        edges.append(e)
+        if e >= hi:
+            return tuple(edges)
+        k += 1
+
+
+# Shared latency edges: ~78%-wide buckets over 1e-4 .. ~1e2 seconds.
+LATENCY_BUCKETS = log_buckets(1e-4, 100.0, per_decade=4)
+# Token-count edges for size-ish histograms (1 .. ~1e6).
+SIZE_BUCKETS = log_buckets(1.0, 1e6, per_decade=2)
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample value: integers stay integral, floats use
+    shortest-repr ``g`` formatting."""
+    if isinstance(v, bool):
+        return str(int(v))
+    if isinstance(v, int) or (isinstance(v, float) and v.is_integer()
+                              and abs(v) < 1e15):
+        return str(int(v))
+    return format(v, ".10g")
+
+
+def _escape(v) -> str:
+    """Escape a label value per the exposition grammar."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+class _Metric:
+    """Base: a named, labeled family of series. Series are keyed by the
+    tuple of label VALUES in the family's declared label-name order."""
+
+    kind = "untyped"
+
+    def __init__(self, registry: "Registry", name: str, help: str,
+                 label_names: tuple):
+        self._registry = registry
+        self.name = name
+        self.help = help
+        self.label_names = label_names
+        self._series: dict = {}
+
+    def _key(self, labels: dict) -> tuple:
+        if tuple(sorted(labels)) != tuple(sorted(self.label_names)):
+            raise ValueError(
+                f"{self.name}: got labels {sorted(labels)}, declared "
+                f"{sorted(self.label_names)}"
+            )
+        return tuple(labels[k] for k in self.label_names)
+
+    def _label_str(self, key: tuple, extra: str = "") -> str:
+        parts = [f'{n}="{_escape(v)}"'
+                 for n, v in zip(self.label_names, key)]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class Counter(_Metric):
+    """Monotonic counter. ``inc`` is a no-op when the owning registry
+    is disabled."""
+
+    kind = "counter"
+
+    def inc(self, n: float = 1, **labels) -> None:
+        reg = self._registry
+        if not reg.enabled:
+            return
+        if n < 0:
+            raise ValueError(f"{self.name}: counters only go up (n={n})")
+        key = self._key(labels)
+        with reg._lock:
+            self._series[key] = self._series.get(key, 0) + n
+
+    def value(self, **labels) -> float:
+        return self._series.get(self._key(labels), 0)
+
+    def _render(self, out: list) -> None:
+        for key in sorted(self._series):
+            out.append(f"{self.name}{self._label_str(key)} "
+                       f"{_fmt(self._series[key])}")
+
+    def _snap(self):
+        return [{"labels": dict(zip(self.label_names, k)), "value": v}
+                for k, v in sorted(self._series.items())]
+
+
+class Gauge(_Metric):
+    """Last-write-wins instantaneous value."""
+
+    kind = "gauge"
+
+    def set(self, v: float, **labels) -> None:
+        reg = self._registry
+        if not reg.enabled:
+            return
+        key = self._key(labels)
+        with reg._lock:
+            self._series[key] = v
+
+    def add(self, n: float, **labels) -> None:
+        reg = self._registry
+        if not reg.enabled:
+            return
+        key = self._key(labels)
+        with reg._lock:
+            self._series[key] = self._series.get(key, 0) + n
+
+    def value(self, **labels) -> float:
+        return self._series.get(self._key(labels), 0)
+
+    _render = Counter._render
+    _snap = Counter._snap
+
+
+class Histogram(_Metric):
+    """Log-bucketed histogram with FIXED edges.
+
+    A series is ``[counts, sum]`` where ``counts[i]`` holds
+    observations ``<= edges[i]`` (exclusive of lower edges) and
+    ``counts[-1]`` is the +Inf overflow — per-bucket, cumulated only at
+    exposition time. No per-sample state: snapshots cost O(buckets)."""
+
+    kind = "histogram"
+
+    def __init__(self, registry, name, help, label_names,
+                 buckets: tuple = LATENCY_BUCKETS):
+        super().__init__(registry, name, help, label_names)
+        self.edges = tuple(float(e) for e in buckets)
+        if list(self.edges) != sorted(set(self.edges)):
+            raise ValueError(f"{name}: bucket edges must strictly increase")
+
+    def observe(self, v: float, **labels) -> None:
+        reg = self._registry
+        if not reg.enabled:
+            return
+        key = self._key(labels)
+        i = bisect.bisect_left(self.edges, v)
+        with reg._lock:
+            series = self._series.get(key)
+            if series is None:
+                series = self._series[key] = [
+                    [0] * (len(self.edges) + 1), 0.0
+                ]
+            series[0][i] += 1
+            series[1] += v
+
+    def count(self, **labels) -> int:
+        s = self._series.get(self._key(labels))
+        return sum(s[0]) if s else 0
+
+    def quantile(self, q: float, **labels) -> float | None:
+        """Derive quantile ``q`` (0..1) from the bucket counts by
+        linear interpolation inside the holding bucket; None when the
+        series is empty. Accurate to one bucket's width."""
+        s = self._series.get(self._key(labels))
+        if not s or not sum(s[0]):
+            return None
+        counts = s[0]
+        total = sum(counts)
+        rank = q * total
+        cum = 0
+        for i, c in enumerate(counts):
+            cum += c
+            if cum >= rank and c > 0:
+                if i >= len(self.edges):
+                    return self.edges[-1]  # overflow bucket: clamp
+                hi = self.edges[i]
+                lo = self.edges[i - 1] if i > 0 else 0.0
+                frac = (rank - (cum - c)) / c
+                return lo + (hi - lo) * frac
+        return self.edges[-1]
+
+    def _render(self, out: list) -> None:
+        for key in sorted(self._series):
+            counts, total = self._series[key]
+            cum = 0
+            for i, edge in enumerate(self.edges):
+                cum += counts[i]
+                le = f'le="{_fmt(edge)}"'
+                out.append(f"{self.name}_bucket{self._label_str(key, le)} "
+                           f"{cum}")
+            cum += counts[-1]
+            inf = 'le="+Inf"'
+            out.append(f"{self.name}_bucket{self._label_str(key, inf)} "
+                       f"{cum}")
+            out.append(f"{self.name}_sum{self._label_str(key)} "
+                       f"{_fmt(total)}")
+            out.append(f"{self.name}_count{self._label_str(key)} {cum}")
+
+    def _snap(self):
+        snaps = []
+        for key, (counts, total) in sorted(self._series.items()):
+            labels = dict(zip(self.label_names, key))
+            snaps.append({
+                "labels": labels,
+                "count": sum(counts),
+                "sum": total,
+                "p50": self.quantile(0.50, **labels),
+                "p90": self.quantile(0.90, **labels),
+                "p99": self.quantile(0.99, **labels),
+                "buckets": {"edges": list(self.edges),
+                            "counts": list(counts)},
+            })
+        return snaps
+
+
+class Registry:
+    """Thread-safe named-metric registry. Re-registering a name with
+    the same kind/labels returns the existing family (many engine
+    instances share one process registry); a mismatched redeclaration
+    raises."""
+
+    def __init__(self, enabled: bool | None = None):
+        self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+        if enabled is None:
+            enabled = os.environ.get("TDT_OBS", "1") != "0"
+        self.enabled = enabled
+
+    def _get_or_create(self, cls, name, help, labels, **kw):
+        if not _NAME_RE.match(name):
+            raise ValueError(f"bad metric name {name!r}")
+        label_names = tuple(labels)
+        for ln in label_names:
+            if not _LABEL_RE.match(ln):
+                raise ValueError(f"bad label name {ln!r} on {name}")
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls) or m.label_names != label_names:
+                    raise ValueError(
+                        f"metric {name} redeclared as {cls.kind}"
+                        f"{sorted(label_names)} but exists as {m.kind}"
+                        f"{sorted(m.label_names)}"
+                    )
+                want = kw.get("buckets")
+                if (want is not None
+                        and tuple(float(e) for e in want) != m.edges):
+                    raise ValueError(
+                        f"metric {name} redeclared with buckets "
+                        f"{tuple(want)} but exists with {m.edges}"
+                    )
+                return m
+            m = cls(self, name, help, label_names, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str = "", labels=()) -> Counter:
+        return self._get_or_create(Counter, name, help, labels)
+
+    def gauge(self, name: str, help: str = "", labels=()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labels)
+
+    def histogram(self, name: str, help: str = "", labels=(),
+                  buckets: tuple = LATENCY_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, labels,
+                                   buckets=buckets)
+
+    def get(self, name: str) -> _Metric | None:
+        return self._metrics.get(name)
+
+    def clear(self) -> None:
+        """Zero every series IN PLACE: cached metric handles held by
+        long-lived engines stay valid (tests reset between cases)."""
+        with self._lock:
+            for m in self._metrics.values():
+                m._series.clear()
+
+    def snapshot(self) -> dict:
+        """JSON-ready view: per family kind/help + series with derived
+        p50/p90/p99 for histograms. Families with no series yet are
+        omitted, same as :func:`prometheus_text` (registration alone —
+        e.g. eagerly cached handles — is not data)."""
+        with self._lock:
+            return {
+                name: {"type": m.kind, "help": m.help, "series": m._snap()}
+                for name, m in sorted(self._metrics.items())
+                if m._series
+            }
+
+
+def prometheus_text(registry: "Registry | None" = None) -> str:
+    """Render the registry in the Prometheus text exposition format.
+    Every emitted line matches the grammar (tests parse it back)."""
+    reg = registry if registry is not None else default_registry()
+    out: list[str] = []
+    with reg._lock:
+        for name in sorted(reg._metrics):
+            m = reg._metrics[name]
+            if not m._series:
+                continue
+            if m.help:
+                out.append(f"# HELP {name} {_escape_help(m.help)}")
+            out.append(f"# TYPE {name} {m.kind}")
+            m._render(out)
+    return "\n".join(out) + ("\n" if out else "")
+
+
+def _escape_help(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+_DEFAULT = Registry()
+
+
+def default_registry() -> Registry:
+    """The process-global registry the engines and server publish to."""
+    return _DEFAULT
+
+
+def counter(name: str, help: str = "", labels=()) -> Counter:
+    return _DEFAULT.counter(name, help, labels)
+
+
+def gauge(name: str, help: str = "", labels=()) -> Gauge:
+    return _DEFAULT.gauge(name, help, labels)
+
+
+def histogram(name: str, help: str = "", labels=(),
+              buckets: tuple = LATENCY_BUCKETS) -> Histogram:
+    return _DEFAULT.histogram(name, help, labels, buckets)

@@ -48,6 +48,8 @@ import time
 from collections import Counter
 from typing import Any, Callable
 
+from triton_distributed_tpu.obs import events as obs_events
+
 
 class FaultError(RuntimeError):
     """An injected fault. ``seam`` names the injection point; ``slot``
@@ -85,6 +87,17 @@ class FaultRule:
     delay: float = 0.0
     match: dict = dataclasses.field(default_factory=dict)
     fired: int = 0
+
+
+def _event_fields(ctx: dict, seam: str, hit: int) -> dict:
+    """Fault-event fields from an arbitrary seam ctx: the event's own
+    keys always win; colliding ctx keys survive under a ``ctx_``
+    prefix (see :func:`obs.events.safe_fields`) instead of
+    TypeError-ing out of an injection site or being dropped."""
+    fields = obs_events.safe_fields(ctx, reserved=("seam", "hit"))
+    fields["seam"] = seam
+    fields["hit"] = hit
+    return fields
 
 
 class FaultPlan:
@@ -205,6 +218,7 @@ class FaultPlan:
         serialize every other seam."""
         delay = 0.0
         exc: BaseException | None = None
+        fired_hit: int | None = None
         with self._lock:
             self.hits[seam] += 1
             hit = self.hits[seam]
@@ -215,6 +229,7 @@ class FaultPlan:
                     continue
                 rule.fired += 1
                 self.fired.append((seam, hit, dict(ctx)))
+                fired_hit = hit
                 if rule.delay:
                     delay = rule.delay
                     continue
@@ -222,6 +237,11 @@ class FaultPlan:
                     seam, slot=rule.slot
                 )
                 break
+        if fired_hit is not None:
+            # Telemetry (docs/observability.md): every activation lands
+            # in the event ring, so a chaos run's injected faults line
+            # up with the shed/deadline/nan events they trigger.
+            obs_events.emit("fault", **_event_fields(ctx, seam, fired_hit))
         if delay:
             time.sleep(delay)
         if exc is not None:
@@ -241,6 +261,8 @@ class FaultPlan:
                 rule.fired += 1
                 self.fired.append((seam, hit, dict(ctx)))
                 matched.append(rule)
+        if matched:
+            obs_events.emit("fault", **_event_fields(ctx, seam, hit))
         for rule in matched:
             value = rule.mutate(value, ctx)
         return value

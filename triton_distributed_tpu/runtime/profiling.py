@@ -18,38 +18,89 @@ import glob
 import gzip
 import json
 import os
+import time
 
 import jax
+
+from triton_distributed_tpu.obs import events as obs_events
 
 # Rank pid namespace stride: chrome-trace pids from one process stay
 # below this, so ``rank * _PID_STRIDE + pid`` never collides across
 # ranks (the reference remaps pids the same way, ``utils.py:430-470``).
 _PID_STRIDE = 10_000_000
 
+# Whether the installed profiler accepts float metadata values. Settled
+# by the first float-carrying span (None = not yet probed): a profiler
+# that rejects floats costs ONE failed TraceAnnotation construction
+# ever, not exception-driven control flow on every spec:rollback span
+# in the serving loop. Unsynchronized on purpose — a race just repeats
+# the probe.
+_FLOAT_META_OK: bool | None = None
 
 @contextlib.contextmanager
 def trace_span(name: str, **args):
-    """Named host-side span on the jax.profiler timeline.
+    """Named host-side span on the jax.profiler timeline AND the
+    telemetry event ring.
 
     The serving engines wrap control-plane phases (prefix-cache
     admission, chunk prefills, evictions, speculative verify/rollback)
     so they land on the same merged trace as the device programs they
-    interleave with. Arg values outside the profiler's metadata types
-    (ints/strings) are stringified rather than risking the whole span —
-    the speculative path tags spans with float accept rates. Outside an
-    active capture the annotation is free; a profiler API mismatch must
-    never sink serving, so entry failures degrade to a plain yield
-    (body exceptions still propagate)."""
+    interleave with. For the profiler, arg values outside its metadata
+    types are stringified rather than risking the whole span — floats
+    (e.g. spec accept rates) are tried natively first and the span is
+    RETRIED with them stringified if the installed profiler rejects
+    them, so a float-metadata mismatch costs precision, never the
+    span — and the rejection is remembered process-wide
+    (``_FLOAT_META_OK``), so later float spans go straight to the
+    stringified form. Outside an active capture the annotation is free; a profiler
+    API mismatch must never sink serving, so entry failures degrade to
+    a plain yield (body exceptions still propagate).
+
+    On exit the span also lands in the event ring (kind ``span``, with
+    the span's wall duration and its args — numerics kept native), so
+    host spans are visible through ``{"cmd": "events"}`` without an
+    active profiler capture (docs/observability.md). A span whose site
+    already emits a dedicated, richer ring event (e.g. ``spec_verify``)
+    passes ``_ring=False`` to skip the duplicate ``span`` entry —
+    bounded ring space shouldn't hold the same moment twice."""
+    global _FLOAT_META_OK
+    ring_emit = args.pop("_ring", True)
     span = None
-    try:
-        args = {
-            k: (v if isinstance(v, (int, str)) else str(v))
-            for k, v in args.items()
-        }
-        span = jax.profiler.TraceAnnotation(name, **args)
-        span.__enter__()
-    except Exception:
-        span = None
+    has_float = any(
+        isinstance(v, float) and not isinstance(v, bool)
+        for v in args.values()
+    )
+    if has_float and _FLOAT_META_OK is not False:
+        variants = ((int, str, float), (int, str))
+    else:
+        variants = ((int, str),)
+    for num_ok in variants:
+        try:
+            prof_args = {
+                k: (v if isinstance(v, num_ok) else str(v))
+                for k, v in args.items()
+            }
+            span = jax.profiler.TraceAnnotation(name, **prof_args)
+            span.__enter__()
+            if has_float:
+                # Probe settled: either floats passed natively, or the
+                # stringified retry succeeded where the float attempt
+                # failed (so the floats were the rejection's cause —
+                # a wholly broken profiler never reaches here).
+                _FLOAT_META_OK = float in num_ok
+            break
+        except Exception:
+            span = None
+    if span is None and has_float and _FLOAT_META_OK is None:
+        # Both variants failed (profiler wholly broken, not a float
+        # rejection): settle the probe anyway so future float spans
+        # pay ONE failed construction like every other span, not two.
+        _FLOAT_META_OK = False
+    # Honor the disabled-mode contract (attribute check + return):
+    # skip the clock reads and the kwargs coercion entirely when the
+    # ring won't record the event anyway.
+    ring = obs_events.default_ring()
+    t0 = time.monotonic() if (ring_emit and ring.enabled) else None
     try:
         yield
     finally:
@@ -57,6 +108,19 @@ def trace_span(name: str, **args):
             try:
                 span.__exit__(None, None, None)
             except Exception:
+                pass
+        if t0 is not None:
+            try:
+                # Arg keys colliding with the event's own fields
+                # survive under a ctx_ prefix (the shared
+                # collision-escape rule, obs.events.safe_fields).
+                fields = obs_events.safe_fields(
+                    args, reserved=("name", "dur_s")
+                )
+                ring.emit("span", name=name,
+                          dur_s=time.monotonic() - t0, **fields)
+            except Exception:
+                # Telemetry must never sink the span's body.
                 pass
 
 

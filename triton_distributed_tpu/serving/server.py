@@ -14,6 +14,10 @@ framed protocol. Here the protocol is newline-delimited JSON over TCP:
        "results": [{"status": "ok"|..., "reason": ...}, ...],
        "stats": {...}}
     → {"cmd": "stats"}           ← {"stats": {..., "server": {...}}}
+    → {"cmd": "metrics"}         ← {"prometheus": "...", "metrics": {...}}
+    → {"cmd": "events", "since": 0, "limit": 100}
+                                 ← {"events": [...], "dropped": 0,
+                                    "next_since": 17}
     → {"cmd": "ping"}            ← {"ok": true, "draining": false}
     → {"cmd": "shutdown"}        ← {"ok": true}   (server then drains)
 
@@ -23,6 +27,15 @@ engine's defaults. ``stats`` payloads surface the engine's serving
 counters verbatim — including, on paged engines, ``kv_bytes_per_token``
 and ``kv_dtype`` (the quantized-KV knob, docs/serving.md "Quantized KV
 cache"), so a client can read the storage mode through the wire.
+
+**Telemetry** (docs/observability.md): ``{"cmd": "metrics"}`` returns
+the process metrics registry as a Prometheus-text-format string AND a
+JSON snapshot with derived p50/p90/p99; ``{"cmd": "events"}`` tails
+the bounded structured-event ring drop-aware by seq number. Both are
+probe verbs: they never touch the engine lock, so scraping works
+mid-generation. Every payload is also counted/timed per verb
+(``tdt_server_requests_total``, ``tdt_server_request_seconds``,
+``tdt_server_errors_total``).
 
 **Concurrency + fault tolerance** (docs/serving.md "Fault tolerance"):
 each connection is served on its own thread; generation payloads
@@ -56,7 +69,17 @@ import time
 import numpy as np
 
 from triton_distributed_tpu.models.engine import Engine
+from triton_distributed_tpu.obs import events as obs_events
+from triton_distributed_tpu.obs import metrics as obs_metrics
+from triton_distributed_tpu.obs.metrics import prometheus_text
+from triton_distributed_tpu.obs.timeline import Timeline
 from triton_distributed_tpu.runtime.faults import fault_point
+
+
+# The probe verbs _dispatch_inner answers. ONE tuple: the metrics
+# label in _verb_of and the `accepted payloads` help both derive from
+# it, so a new verb can't silently label its traffic `unknown`.
+PROBE_CMDS = ("ping", "stats", "metrics", "events", "shutdown")
 
 
 class _BadRequest(ValueError):
@@ -110,6 +133,24 @@ class ModelServer:
         }
         self._counters_lock = threading.Lock()
         self._last_conn_error: str | None = None
+        self._t0 = time.monotonic()
+        # Metric handles resolved ONCE (engine-convention): a payload
+        # must not pay registry get-or-create lookups on the same
+        # global lock the decode loop's counters contend on.
+        self._m_requests = obs_metrics.counter(
+            "tdt_server_requests_total",
+            "Payloads dispatched, by verb.", labels=("verb",),
+        )
+        self._m_seconds = obs_metrics.histogram(
+            "tdt_server_request_seconds",
+            "Wall time handling one payload, by verb.",
+            labels=("verb",),
+        )
+        self._m_errors = obs_metrics.counter(
+            "tdt_server_errors_total",
+            "Structured error responses, by verb and status.",
+            labels=("verb", "status"),
+        )
 
     def _count(self, key: str) -> None:
         with self._counters_lock:
@@ -123,6 +164,12 @@ class ModelServer:
         with self._pending_lock:
             stats["pending"] = self._pending
         stats["draining"] = self._shutdown.is_set()
+        # ``snapshot_at`` is the same monotonic clock the per-request
+        # timelines use, so a scraper can order stats snapshots against
+        # event-ring timestamps without wall-clock skew.
+        now = time.monotonic()
+        stats["uptime_s"] = now - self._t0
+        stats["snapshot_at"] = now
         return stats
 
     # -- request handling ------------------------------------------------
@@ -131,9 +178,38 @@ class ModelServer:
     def _error(status: str, reason: str, **extra) -> dict:
         return {"error": {"status": status, "reason": reason, **extra}}
 
+    @staticmethod
+    def _verb_of(req) -> str:
+        """Metrics label for a payload: its probe cmd, or which
+        generation form it takes (bounded cardinality by construction —
+        unknown cmds all land under ``unknown``)."""
+        if not isinstance(req, dict):
+            return "unknown"
+        cmd = req.get("cmd")
+        if cmd in PROBE_CMDS:
+            return cmd
+        if "requests" in req:
+            return "requests"
+        if "input_ids" in req:
+            return "generate"
+        return "unknown"
+
     def _dispatch(self, req) -> dict:
-        """Route one parsed payload; every failure becomes a structured
-        error response — nothing escapes to kill the connection."""
+        """Route one parsed payload with per-verb telemetry; every
+        failure becomes a structured error response — nothing escapes
+        to kill the connection."""
+        verb = self._verb_of(req)
+        t0 = time.monotonic()
+        resp = self._dispatch_inner(req)
+        if obs_metrics.default_registry().enabled:
+            self._m_requests.inc(verb=verb)
+            self._m_seconds.observe(time.monotonic() - t0, verb=verb)
+            err = resp.get("error")
+            if isinstance(err, dict):
+                self._m_errors.inc(verb=verb, status=str(err.get("status")))
+        return resp
+
+    def _dispatch_inner(self, req) -> dict:
         try:
             if not isinstance(req, dict):
                 raise _BadRequest("payload must be a JSON object")
@@ -147,10 +223,57 @@ class ModelServer:
                 stats = dict(self.engine.last_stats)
                 stats["server"] = self.server_stats
                 return {"stats": stats}
+            if cmd == "metrics":
+                # Probe verb: reads the registry under its own short
+                # lock, never the engine lock — scraping answers
+                # mid-generation (docs/observability.md).
+                reg = obs_metrics.default_registry()
+                return {
+                    "prometheus": prometheus_text(reg),
+                    "metrics": reg.snapshot(),
+                }
+            if cmd == "events":
+                try:
+                    # JSON null is a natural "from the start" / "no
+                    # cap" spelling; anything else must be an int —
+                    # and a wrong TYPE is the client's fault, not an
+                    # `internal` server error.
+                    since = req.get("since")
+                    since = 0 if since is None else int(since)
+                    limit = req.get("limit")
+                    limit = None if limit is None else int(limit)
+                except (TypeError, ValueError) as e:
+                    raise _BadRequest(
+                        f"events since/limit must be integers: {e}"
+                    )
+                if since < 0 or (limit is not None and limit < 0):
+                    # A negative cursor would manufacture phantom
+                    # `dropped` counts (tail reports events[0].seq -
+                    # since - 1), corrupting drop-summing consumers.
+                    raise _BadRequest(
+                        "events since/limit must be >= 0"
+                    )
+                ring = obs_events.default_ring()
+                evts, dropped = ring.tail(since, limit)
+                # Empty tail still advances the cursor past anything
+                # the ring dropped (e.g. a clear()), or a drop-summing
+                # consumer would re-count the same loss every poll —
+                # but never past events a `limit` deferred to the next
+                # page (tail keeps the oldest, so since+dropped is
+                # always the seq just before the first undelivered
+                # event).
+                next_since = (
+                    evts[-1].seq if evts else since + dropped
+                )
+                return {
+                    "events": [e.as_dict() for e in evts],
+                    "dropped": dropped,
+                    "next_since": next_since,
+                }
             if "requests" in req or "input_ids" in req:
                 return self._generate_guarded(req)
             accepted = [
-                "cmd (ping|stats|shutdown)",
+                f"cmd ({'|'.join(PROBE_CMDS)})",
                 "requests + gen_lens/temperatures/top_ps/top_ks/"
                 "deadline_s (continuous batching)",
                 "input_ids + gen_len/prompt_start (fixed batch)",
@@ -193,15 +316,19 @@ class ModelServer:
                     "backoff",
                 )
             self._pending += 1
+        # Enqueue stamp BEFORE the engine lock: a request's queue-wait
+        # must include the time its payload spent waiting on other
+        # generations, not just the engine's admission queue.
+        enqueue_t = time.monotonic()
         try:
             with self._engine_lock:
                 self._count("requests")
-                return self._generate(req)
+                return self._generate(req, enqueue_t)
         finally:
             with self._pending_lock:
                 self._pending -= 1
 
-    def _generate(self, req: dict) -> dict:
+    def _generate(self, req: dict, enqueue_t: float | None = None) -> dict:
         if "requests" in req:
             if not hasattr(self.engine, "run"):
                 raise _BadRequest(
@@ -237,11 +364,16 @@ class ModelServer:
             deadlines = knob("deadline_s", float)
             from triton_distributed_tpu.models.continuous import Request
 
+            def _timeline() -> Timeline:
+                tl = Timeline()
+                tl.enqueue_t = enqueue_t  # pre-engine-lock arrival
+                return tl
+
             results = self.engine.run(
                 [
                     Request(
                         p, int(g), temperature=t, top_p=tp, top_k=tk,
-                        deadline_s=dl,
+                        deadline_s=dl, timeline=_timeline(),
                     )
                     for p, g, t, tp, tk, dl in zip(
                         prompts, gen_lens, temps, top_ps, top_ks, deadlines
