@@ -42,6 +42,15 @@ def main(argv=None) -> int:
                    help="after generating, fetch {'cmd':'stats'} and "
                    "{'cmd':'metrics'} through the wire and pretty-print "
                    "the payloads (docs/observability.md)")
+    p.add_argument("--replicas", type=int, default=0,
+                   help="serve N ContinuousEngine replicas behind the "
+                   "prefix-affinity router (docs/scale-out.md); the "
+                   "demo then drives 'requests' payloads and the "
+                   "repeat doubles as the affinity-hit check")
+    p.add_argument("--request-timeout", type=float, default=0.0,
+                   help="with --replicas: router-observed replica "
+                   "timeout (seconds; 0 = off — a cold compile must "
+                   "not read as a hang)")
     args = p.parse_args(argv)
 
     import jax
@@ -63,34 +72,66 @@ def main(argv=None) -> int:
     )
     jax.block_until_ready(model.params)
     mode = args.mode if not (args.cpu and args.mode == "mega") else "xla"
-    if args.kv_dtype and mode == "mega":
-        mode = "xla"  # quantized pool composes with xla/pallas decode
-    eng = Engine(model, temperature=0.0, mode=mode,
-                 paged=bool(args.kv_dtype), kv_dtype=args.kv_dtype)
+    if (args.kv_dtype or args.replicas) and mode == "mega":
+        mode = "xla"  # quantized pool / router compose with xla/pallas
+    if args.replicas > 0:
+        from triton_distributed_tpu.models.continuous import ContinuousEngine
+        from triton_distributed_tpu.serving.router import Router
+
+        eng = Router([
+            ContinuousEngine(
+                model, max_batch=2, max_length=1024, mode=mode,
+                temperature=0.0, prefix_cache=True,
+                kv_dtype=args.kv_dtype,
+            )
+            for _ in range(args.replicas)
+        ], request_timeout_s=args.request_timeout or None)
+    else:
+        eng = Engine(model, temperature=0.0, mode=mode,
+                     paged=bool(args.kv_dtype), kv_dtype=args.kv_dtype)
     server = ModelServer(eng).start()
     print(json.dumps({"serving": args.model, "mode": mode,
-                      "port": server.port,
+                      "replicas": args.replicas, "port": server.port,
                       "startup_s": round(time.time() - t0, 1)}), flush=True)
     try:
         assert request(server.host, server.port, {"cmd": "ping"})["ok"]
         prompt = list(range(1, 33))
-        payload = {"input_ids": [prompt], "gen_len": args.gen_len}
+        if args.replicas > 0:
+            payload = {"requests": [prompt], "gen_lens": [args.gen_len]}
+        else:
+            payload = {"input_ids": [prompt], "gen_len": args.gen_len}
         t1 = time.time()
         r1 = request(server.host, server.port, payload, timeout=1200)
         cold_s = time.time() - t1
         t2 = time.time()
         r2 = request(server.host, server.port, payload, timeout=1200)
         warm_s = time.time() - t2
-        gen1 = np.asarray(r1["output_ids"])[0, len(prompt):]
-        gen2 = np.asarray(r2["output_ids"])[0, len(prompt):]
+        if args.replicas > 0:
+            gen1 = np.asarray(r1["outputs"][0])
+            gen2 = np.asarray(r2["outputs"][0])
+            router = r2["stats"].get("router", {})
+            extra = {
+                "statuses": [x["status"] for x in r2["results"]],
+                # The repeat shares the full prompt: a working mirror
+                # routes it back to the seeded replica as a hit.
+                "affinity_hits": router.get("affinity_hits"),
+                "routed": router.get("routed"),
+            }
+        else:
+            gen1 = np.asarray(r1["output_ids"])[0, len(prompt):]
+            gen2 = np.asarray(r2["output_ids"])[0, len(prompt):]
+            extra = {}
         print(json.dumps({
             "platform": jax.devices()[0].platform,
             "transcript_tokens": gen1.tolist(),
-            "deterministic": bool((gen1 == gen2).all()),
+            "deterministic": bool(
+                gen1.shape == gen2.shape and (gen1 == gen2).all()
+            ),
             "cold_wall_s": round(cold_s, 2),
             "warm_wall_s": round(warm_s, 2),
             "wire_tok_s": round(args.gen_len / warm_s, 2),
             "engine_stats": r2.get("stats"),
+            **extra,
         }), flush=True)
         if args.stats:
             stats = request(server.host, server.port, {"cmd": "stats"})

@@ -478,6 +478,127 @@ def test_server_graceful_drain(ctx4):
     assert eng.audit() == []
 
 
+def test_server_scrape_while_draining(ctx4):
+    """metrics/events/ping verbs keep answering after shutdown has been
+    requested but before the in-flight generation finishes (a drain is
+    exactly when an operator wants to watch the tier). Post-shutdown a
+    connection closes after one response, so each probe rides its own
+    pre-opened connection."""
+    import json
+    import socket
+    import threading
+    import time as _time
+
+    from triton_distributed_tpu.models.continuous import ContinuousEngine
+
+    model = AutoLLM.from_pretrained("tiny", ctx=ctx4)
+    eng = ContinuousEngine(model, max_batch=1, page_size=16, max_length=64)
+    server = ModelServer(eng).start()
+    done = {}
+
+    def gen():
+        done["resp"] = request(
+            server.host, server.port,
+            {"requests": [[5, 9, 2, 4]], "gen_lens": [16]}, timeout=120,
+        )
+
+    def probe(conn, payload):
+        with conn, conn.makefile("rwb") as f:
+            f.write(json.dumps(payload).encode() + b"\n")
+            f.flush()
+            return json.loads(f.readline())
+
+    t = threading.Thread(target=gen, daemon=True)
+    t.start()
+    # Pre-open the probe connections BEFORE the drain begins (the
+    # listener closes to fresh connections shortly after shutdown).
+    conns = [
+        socket.create_connection((server.host, server.port), timeout=10)
+        for _ in range(3)
+    ]
+    _time.sleep(0.3)  # let the generation payload reach the engine
+    assert request(server.host, server.port, {"cmd": "shutdown"})["ok"]
+
+    ping = probe(conns[0], {"cmd": "ping"})
+    assert ping["ok"] and ping["draining"]
+    m = probe(conns[1], {"cmd": "metrics"})
+    assert "prometheus" in m and "tdt_" in m["prometheus"]
+    ev = probe(conns[2], {"cmd": "events"})
+    assert "events" in ev and "next_since" in ev
+
+    # The drained generation still finishes intact.
+    t.join(timeout=120)
+    assert done["resp"]["results"][0]["status"] == "ok"
+    assert len(done["resp"]["outputs"][0]) == 16
+    server.shutdown()
+    assert eng.audit() == []
+
+
+def test_client_honors_server_backoff_hint(ctx4):
+    """The overloaded shed reply carries ``retry_after_s``; the client
+    retry loop sleeps THAT instead of its local exponential backoff —
+    a local backoff_s large enough to fail the test proves the hint
+    was used."""
+    import json
+    import socket
+    import threading
+    import time as _time
+
+    hint = 0.05
+    seen = []
+    lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(4)
+    host, port = lsock.getsockname()
+
+    def fake_server():
+        # First payload: overloaded + hint; second: success.
+        for i in range(2):
+            conn, _ = lsock.accept()
+            with conn, conn.makefile("rwb") as f:
+                f.readline()
+                seen.append(_time.monotonic())
+                resp = (
+                    {"error": {"status": "overloaded", "reason": "full",
+                               "retry_after_s": hint}}
+                    if i == 0 else {"ok": True}
+                )
+                f.write(json.dumps(resp).encode() + b"\n")
+                f.flush()
+
+    t = threading.Thread(target=fake_server, daemon=True)
+    t.start()
+    try:
+        t0 = _time.monotonic()
+        resp = request(host, port, {"cmd": "ping"}, timeout=10,
+                       retries=2, backoff_s=30.0)
+        wall = _time.monotonic() - t0
+        assert resp["ok"]
+        assert len(seen) == 2
+        # Retried after ~hint seconds, nowhere near the 30 s local
+        # backoff; >= proves it actually slept the hint.
+        assert hint <= (seen[1] - seen[0]) < 5.0
+        assert wall < 10.0
+    finally:
+        lsock.close()
+        t.join(timeout=10)
+
+    # A real server's shed reply carries the hint on the wire.
+    from triton_distributed_tpu.models.continuous import ContinuousEngine
+
+    model = AutoLLM.from_pretrained("tiny", ctx=ctx4)
+    eng = ContinuousEngine(model, max_batch=1, page_size=16, max_length=64)
+    server = ModelServer(eng, max_pending=0).start()
+    try:
+        with pytest.raises(RuntimeError, match="server error") as ei:
+            request(server.host, server.port,
+                    {"requests": [[1, 2, 3, 4]], "gen_lens": [2]})
+        assert "retry_after_s" in str(ei.value)
+    finally:
+        server.shutdown()
+
+
 def test_engine_serve_profile_hook(ctx4, tmp_path):
     """Engine.serve(profile=...) must capture a decode-loop trace
     (parity: the reference Engine's built-in profiled decode,

@@ -325,6 +325,36 @@ def test_obs_modules_compile():
     )
 
 
+def test_serving_tier_modules_compile():
+    """The multi-engine serving tier must byte-compile: the router and
+    replica modules are imported by the serving package (so a syntax
+    error takes the whole server down at import time), and the
+    CPU-runnable bench that writes perf/ROUTER.json rides along (repo
+    convention: perf harnesses fail tier-1, not a relay window)."""
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    targets = [
+        os.path.join(root, "triton_distributed_tpu", "serving",
+                     "router.py"),
+        os.path.join(root, "triton_distributed_tpu", "serving",
+                     "replica.py"),
+        os.path.join(root, "triton_distributed_tpu", "serving",
+                     "run_server.py"),
+        os.path.join(root, "perf", "router_bench.py"),
+    ]
+    proc = subprocess.run(
+        [sys.executable, "-m", "compileall", "-q", "-f", *targets],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, (
+        f"serving-tier modules failed to compile:\n"
+        f"{proc.stdout}\n{proc.stderr}"
+    )
+
+
 def test_kv_quant_modules_compile():
     """The quantized-KV stack must byte-compile: the scale-aware pool,
     the dequantizing attention kernels, and the CPU-runnable bench that

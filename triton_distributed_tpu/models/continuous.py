@@ -1138,6 +1138,28 @@ class ContinuousEngine(MegaDispatch):
             raise RequestFailedError(failures)
         return [np.asarray(r.out, np.int32) for r in reqs]
 
+    # -- serving-tier hooks ------------------------------------------------
+
+    def prefix_digest(self) -> list | None:
+        """Router-side mirror export (docs/scale-out.md): the radix
+        tree's cached token chains as a serializable forest
+        (:meth:`PrefixCache.prefix_digest`), or None when
+        ``prefix_cache`` is off. The multi-replica router scores
+        prefix affinity against each replica's latest digest instead
+        of touching live engine state from another thread."""
+        return None if self.prefix is None else self.prefix.prefix_digest()
+
+    def drain(self) -> int:
+        """Graceful-drain hook for the serving tier: release every
+        unreferenced radix page back to the pool, so a replica being
+        taken out of rotation returns its cache HBM instead of
+        stranding it behind a dead worker. In-use (refcounted) chains
+        are never dropped — call with no requests in flight for a full
+        flush. Returns the number of pages released."""
+        if self.prefix is None:
+            return 0
+        return self.prefix.flush()
+
     # -- auditing ---------------------------------------------------------
 
     def audit(self, *, raise_on_violation: bool = False) -> list[str]:

@@ -362,6 +362,30 @@ class PrefixCache:
 
     # -- introspection ----------------------------------------------------
 
+    def prefix_digest(self) -> list:
+        """Compact, serializable snapshot of the cached prefix
+        population: a recursive ``[chunk, children]`` forest mirroring
+        the radix tree's token chains (pages, refcounts, and LRU state
+        are deliberately omitted — a consumer routes on WHAT is cached,
+        not on how it is stored). The serving router
+        (``serving/router.py``) keeps one digest per replica as its
+        router-side mirror and scores prefix affinity against it with
+        :func:`digest_match_len`; replicas re-publish after every
+        engine batch, so the view tracks admit/evict churn at batch
+        granularity."""
+
+        # Iterative (like :meth:`walk`): a single cached chain deeper
+        # than Python's recursion limit must not blow up a replica's
+        # digest publish.
+        out: list = []
+        stack = [(c, out) for c in self.root.children.values()]
+        while stack:
+            node, siblings = stack.pop()
+            entry = [list(node.chunk), []]
+            siblings.append(entry)
+            stack.extend((c, entry[1]) for c in node.children.values())
+        return out
+
     def audit(self) -> list[str]:
         """Structural invariant check; returns violation strings
         (empty == clean). Verifies what the tree can see on its own —
@@ -441,3 +465,37 @@ class PrefixCache:
             n = stack.pop()
             yield n
             stack.extend(n.children.values())
+
+
+def digest_match_len(digest: list | None, tokens) -> int:
+    """Longest cached prefix of ``tokens`` visible in a
+    :meth:`PrefixCache.prefix_digest` snapshot, in tokens. Matching
+    mirrors :meth:`PrefixCache.match`: descend only through fully
+    matched chunks, count a partial in-chunk match (the COW case) once
+    and stop. No cap at ``len(tokens) - 1`` — this scores affinity for
+    routing, it does not reserve pages."""
+    if not digest:
+        return 0
+    # An already-converted int list (the router caches one per ticket
+    # so N replicas don't each re-convert the prompt) passes through.
+    toks = tokens if type(tokens) is list else [int(t) for t in tokens]
+    level: list | None = digest
+    i = 0
+    while level:
+        nxt = None
+        for chunk, children in level:
+            if not chunk or i >= len(toks) or chunk[0] != toks[i]:
+                continue
+            # Index-bounded compare — a toks[i:] slice here would copy
+            # the remaining prompt once per matched chunk (O(L²/page)
+            # per replica per routing decision).
+            lcp = 0
+            limit = min(len(chunk), len(toks) - i)
+            while lcp < limit and chunk[lcp] == toks[i + lcp]:
+                lcp += 1
+            i += lcp
+            if lcp == len(chunk):
+                nxt = children  # fully matched page: descend
+            break
+        level = nxt
+    return i

@@ -55,7 +55,8 @@ class Timeline:
     queue-wait the client actually experienced)."""
 
     __slots__ = ("enqueue_t", "admit_t", "first_chunk_t", "first_token_t",
-                 "finish_t", "tokens_in", "tokens_out", "status")
+                 "finish_t", "tokens_in", "tokens_out", "status",
+                 "reroutes")
 
     def __init__(self):
         self.enqueue_t: float | None = None
@@ -66,6 +67,11 @@ class Timeline:
         self.tokens_in = 0
         self.tokens_out = 0
         self.status: str | None = None
+        # Multi-replica serving (docs/scale-out.md): how many times the
+        # router re-routed this request off a dead/timed-out replica
+        # before this attempt. Stamped by the router, folded into
+        # ``tdt_request_reroutes_total`` at finish.
+        self.reroutes = 0
 
     def _stamp(self, attr: str) -> None:
         if getattr(self, attr) is None:
@@ -158,6 +164,11 @@ def _handles(reg) -> dict:
                 "tdt_request_tokens_out", "Output tokens per request.",
                 buckets=_metrics.SIZE_BUCKETS,
             ),
+            "reroutes": reg.counter(
+                "tdt_request_reroutes_total",
+                "Times requests were re-routed off a dead or "
+                "timed-out replica (docs/scale-out.md).",
+            ),
             "queue_wait": reg.histogram(
                 "tdt_request_queue_wait_seconds",
                 "Enqueue-to-admission wait.",
@@ -196,6 +207,8 @@ def observe_request(tl: Timeline, registry=None) -> None:
     h = _handles(reg)
     status = tl.status or "ok"
     h["requests"].inc(status=status)
+    if tl.reroutes:
+        h["reroutes"].inc(tl.reroutes)
     if tl.tokens_in:
         h["tokens_in"].inc(tl.tokens_in)
         h["tokens_in_size"].observe(tl.tokens_in)

@@ -22,6 +22,9 @@ Seams currently instrumented (grep for ``fault_point``/``mutate_point``):
 ``server.recv``    ``ModelServer._serve_lines`` read side — socket
                    drops / slow clients (``delay=``)
 ``server.send``    ``ModelServer._serve_lines`` write side
+``replica.run``    ``EngineReplica._run_batch`` — replica-kill /
+                   replica-hang for the multi-engine router tier
+                   (``replica=`` narrows to one replica by name)
 =================  =====================================================
 
 Usage::
@@ -194,6 +197,28 @@ class FaultPlan:
                     times: int = 1) -> "FaultPlan":
         """Nth server read stalls ``delay`` seconds before proceeding."""
         return self.on("server.recv", at=at, times=times, delay=delay)
+
+    def kill_replica(self, replica: str | None = None, at: int = 0,
+                     times: int = 1) -> "FaultPlan":
+        """A router-tier replica's batch run raises as if its engine
+        thread crashed. ``replica`` (the replica's name) narrows the
+        seam to one replica and fires on its FIRST matching run;
+        ``at`` instead fires on the Nth ``replica.run`` hit across all
+        replicas (hit counts are per-seam, not per-replica)."""
+        match = {} if replica is None else {"replica": replica}
+        if at:
+            return self.on("replica.run", at=at, times=times, **match)
+        return self.on("replica.run", every=1, times=times, **match)
+
+    def hang_replica(self, delay: float, replica: str | None = None,
+                     times: int = 1) -> "FaultPlan":
+        """A replica's batch run stalls ``delay`` seconds before
+        touching its engine — the router-observed-timeout scenario
+        (the router marks it unhealthy and re-routes; the late run's
+        results latch harmlessly)."""
+        match = {} if replica is None else {"replica": replica}
+        return self.on("replica.run", every=1, times=times, delay=delay,
+                       **match)
 
     # -- firing ----------------------------------------------------------
 

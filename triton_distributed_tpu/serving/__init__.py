@@ -1,10 +1,17 @@
-"""Serving front-ends: socket model server + chat client.
+"""Serving front-ends: socket model server, chat client, and the
+multi-engine scale-out tier.
 
 Parity: reference ``mega_triton_kernel/test/models/model_server.py``
 (socket server :112-198) and ``chat.py`` (interactive client) — the
-demo/deployment surface on top of the Engine.
+demo/deployment surface on top of the Engine. Beyond parity, the
+replicated serving tier (docs/scale-out.md): ``Router`` fans requests
+across N ``EngineReplica``\\ s by prefix affinity with replica
+health/drain and shed-aware balancing; ``ModelServer(Router(...))``
+keeps the wire server as the transport.
 """
 
+from triton_distributed_tpu.serving.replica import EngineReplica, Ticket
+from triton_distributed_tpu.serving.router import Router
 from triton_distributed_tpu.serving.server import ModelServer, request
 
-__all__ = ["ModelServer", "request"]
+__all__ = ["EngineReplica", "ModelServer", "Router", "Ticket", "request"]

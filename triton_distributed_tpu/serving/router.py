@@ -1,0 +1,462 @@
+"""Prefix-affinity router over replicated engines (the serving tier).
+
+One ``ContinuousEngine`` behind one socket is the single-process scale
+ceiling; the measured 64% prefill-work saving (perf/PREFIX_CACHE.json)
+only survives scale-out if requests sharing a prefix land on the
+replica whose radix tree already holds that KV. This module is the
+front tier that preserves it (docs/scale-out.md):
+
+- **Prefix-affinity routing** — each request is scored by longest
+  cached prefix against a router-side mirror of every replica's radix
+  population (``PrefixCache.prefix_digest`` snapshots, re-published by
+  each replica at batch boundaries) and lands on the best match;
+  least-loaded wins when no prefix does.
+- **Shed-aware balancing** — a replica whose queued+in-flight load
+  reaches its ``max_pending`` bound is skipped BEFORE the request
+  bounces off the engine's own ``overloaded`` shed; when every healthy
+  replica is saturated the router queues to the least-loaded one
+  rather than dropping (the engine-side bounds still apply).
+- **Health, drain, re-route** — a replica whose engine raises, whose
+  batch exceeds ``request_timeout_s``, or that is killed through the
+  ``replica.run`` fault seam is marked dead; its queued (and, on
+  death, in-flight) tickets are re-routed to surviving replicas up to
+  ``max_reroutes`` times, then failed with a structured status from
+  the PR 3 taxonomy. Nothing is ever silently dropped.
+- **Telemetry** — routing decisions, affinity hits, shed-skips,
+  re-routes, and replica lifecycle land in the process metrics
+  registry (``tdt_router_*``) and event ring (``route``/``reroute``/
+  ``replica_dead``/``replica_drain``), so the server's existing
+  ``{"cmd": "metrics"}``/``{"cmd": "events"}`` verbs scrape the tier
+  with no new protocol.
+
+The router duck-types the engine surface the model server speaks —
+``run(requests, results=True)``, ``last_stats``, ``audit()`` — so
+``ModelServer(Router(...))`` is the deployment form: the wire server
+stays the transport, the router is the brain behind it. It also sets
+``concurrent_safe = True``, telling the server to dispatch generation
+payloads WITHOUT the engine lock: payloads from many connections fan
+out across replicas concurrently instead of serializing on one.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from triton_distributed_tpu.models.continuous import (
+    RequestFailedError,
+    RequestResult,
+)
+from triton_distributed_tpu.obs import events as obs_events
+from triton_distributed_tpu.obs import metrics as obs_metrics
+from triton_distributed_tpu.serving.replica import (
+    DEAD,
+    FLEET_TOTAL_KEYS,
+    HEALTHY,
+    EngineReplica,
+    Ticket,
+)
+
+
+class Router:
+    """Front tier over N :class:`EngineReplica`\\ s.
+
+    ``engines`` entries may be ContinuousEngines (wrapped into
+    replicas named ``r0..rN-1``) or pre-built replicas. ``policy`` is
+    ``"affinity"`` (longest-prefix match, least-loaded fallback) or
+    ``"round_robin"`` (the scale-out baseline the bench compares
+    against). ``drain_grace_s`` mirrors the server's connection-drain
+    knob: how long :meth:`drain_replica`/:meth:`shutdown` wait for a
+    replica's in-flight work before giving up on a clean drain.
+    """
+
+    # The model server dispatches generation payloads to a
+    # concurrent-safe engine without its engine lock (ticket routing
+    # and per-replica queues do the serialization).
+    concurrent_safe = True
+
+    def __init__(
+        self,
+        engines,
+        *,
+        policy: str = "affinity",
+        drain_grace_s: float = 2.0,
+        max_reroutes: int = 2,
+        request_timeout_s: float | None = None,
+        replica_max_pending: int = 8,
+    ):
+        if policy not in ("affinity", "round_robin"):
+            raise ValueError(
+                f"policy must be 'affinity' or 'round_robin', got {policy!r}"
+            )
+        self.replicas: list[EngineReplica] = [
+            e if isinstance(e, EngineReplica)
+            else EngineReplica(e, name=f"r{i}", max_pending=replica_max_pending)
+            for i, e in enumerate(engines)
+        ]
+        if not self.replicas:
+            raise ValueError("Router needs at least one replica")
+        names = [r.name for r in self.replicas]
+        if len(set(names)) != len(names):
+            raise ValueError(f"replica names must be unique, got {names}")
+        self.policy = policy
+        self.drain_grace_s = float(drain_grace_s)
+        self.max_reroutes = int(max_reroutes)
+        self.request_timeout_s = request_timeout_s
+        self._rr = 0  # round-robin cursor
+        self._lock = threading.Lock()  # router counters + rr cursor
+        self.stats = {
+            "routed": 0,
+            "affinity_hits": 0,
+            "affinity_hit_tokens": 0,
+            "least_loaded": 0,
+            "round_robin": 0,
+            "shed_skips": 0,
+            "reroutes": 0,
+            "failed_no_replica": 0,
+        }
+        for r in self.replicas:
+            r.on_failure = self._on_replica_failure
+        # Metric handles resolved ONCE (engine convention): routing is
+        # on every request's path and must not pay registry
+        # get-or-create lookups.
+        self._m_routed = obs_metrics.counter(
+            "tdt_router_requests_total",
+            "Requests routed, by replica and decision kind.",
+            labels=("replica", "decision"),
+        )
+        self._m_affinity = obs_metrics.counter(
+            "tdt_router_affinity_hit_tokens_total",
+            "Prompt tokens routed onto a replica already caching them.",
+        )
+        self._m_reroutes = obs_metrics.counter(
+            "tdt_router_reroutes_total",
+            "Tickets re-routed off a dead or timed-out replica.",
+        )
+        self._m_shed_skips = obs_metrics.counter(
+            "tdt_router_shed_skips_total",
+            "Routing decisions that skipped an overloaded replica.",
+        )
+        self._g_healthy = obs_metrics.gauge(
+            "tdt_router_healthy_replicas",
+            "Replicas currently accepting new work.",
+        )
+        self._g_healthy.set(len(self.replicas))
+
+    # -- engine-compatible surface ----------------------------------------
+
+    def run(self, requests, *, results: bool = False):
+        """Serve ``requests`` across the replica fleet; same contract
+        as ``ContinuousEngine.run`` (the model server calls this with
+        ``results=True``). Requests are routed individually; results
+        come back in submission order."""
+        tickets = [Ticket.of(r) for r in requests]
+        for t in tickets:
+            self._dispatch(t)
+        outs = [self._await(t) for t in tickets]
+        if results:
+            return outs
+        failures = []
+        for i, (t, r) in enumerate(zip(tickets, outs)):
+            if r.status == "ok":
+                continue
+            # RequestFailedError documents ``failures`` as (index,
+            # Request) — callers read .prompt/.out off the entries, so
+            # hand them a real Request carrying the failed attempt's
+            # outcome, not a bare RequestResult.
+            req = t.make_request()
+            req.status, req.reason = r.status, r.reason
+            req.out = [int(x) for x in r.tokens]
+            failures.append((i, req))
+        if failures:
+            raise RequestFailedError(failures)
+        return [np.asarray(r.tokens, np.int32) for r in outs]
+
+    @property
+    def last_stats(self) -> dict:
+        """Aggregated serving counters: the core stats keys summed
+        CUMULATIVELY across every batch each replica ever ran (the
+        engines zero their own stats per run; mixing "last batch"
+        snapshots from replicas that ran at different times would
+        double-count), plus the router's own ledger under
+        ``router``."""
+        agg: dict = {k: 0 for k in FLEET_TOTAL_KEYS}
+        reps = []
+        kv_bpt, kv_dtype = None, None
+        for r in self.replicas:
+            st = r.engine.last_stats
+            for k in agg:
+                agg[k] += r.totals.get(k, 0)
+            if kv_bpt is None:
+                kv_bpt = st.get("kv_bytes_per_token")
+                kv_dtype = st.get("kv_dtype")
+            snap = r.snapshot()
+            snap["prefix_hit_rate"] = st.get("prefix_hit_rate")
+            snap["tree_pages"] = st.get("tree_pages")
+            reps.append(snap)
+        agg["kv_bytes_per_token"] = kv_bpt
+        agg["kv_dtype"] = kv_dtype
+        with self._lock:
+            router = dict(self.stats)
+        router["policy"] = self.policy
+        router["replicas"] = reps
+        router["healthy_replicas"] = self._refresh_healthy()
+        router["affinity_hit_rate"] = (
+            router["affinity_hits"] / max(router["routed"], 1)
+        )
+        agg["router"] = router
+        return agg
+
+    def audit(self, *, raise_on_violation: bool = False) -> list[str]:
+        """Every replica engine's pool/radix audit, replica-labeled.
+
+        Best run on a quiesced fleet (after :meth:`shutdown` /
+        :meth:`drain_replica`, or between batches): the audit walks
+        live engine state that a mid-batch worker is mutating, so a
+        concurrent run can report transient phantoms or trip on a
+        resizing dict — such trips are surfaced as a labeled problem
+        string (with a raced-live-work caveat), never an escape."""
+        problems: list[str] = []
+        for r in self.replicas:
+            try:
+                problems += [
+                    f"replica {r.name}: {p}" for p in r.engine.audit()
+                ]
+            except Exception as e:  # noqa: BLE001 — racing a live batch
+                problems.append(
+                    f"replica {r.name}: audit raced in-flight work "
+                    f"({type(e).__name__}: {e}); re-run quiesced"
+                )
+        if problems and raise_on_violation:
+            from triton_distributed_tpu.models.paged_kv_cache import (
+                PoolAuditError,
+            )
+
+            raise PoolAuditError("; ".join(problems))
+        return problems
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def replica(self, name: str) -> EngineReplica:
+        for r in self.replicas:
+            if r.name == name:
+                return r
+        raise KeyError(f"no replica named {name!r}")
+
+    def drain_replica(self, name: str,
+                      grace_s: float | None = None) -> bool:
+        """Gracefully take one replica out of rotation (finish queued
+        work, flush its radix tree); waits up to ``grace_s`` (default:
+        the router's ``drain_grace_s``)."""
+        grace = self.drain_grace_s if grace_s is None else grace_s
+        ok = self.replica(name).drain(grace)
+        self._refresh_healthy()
+        return ok
+
+    def shutdown(self) -> None:
+        """Drain the whole fleet against ONE shared ``drain_grace_s``
+        deadline (flip everyone to draining first, then wait — N
+        sequential full drains would cost N × grace) and join the
+        worker threads. Idempotent — the model server calls this from
+        its own shutdown path."""
+        for r in self.replicas:
+            r.begin_drain()
+        deadline = time.monotonic() + self.drain_grace_s
+        for r in self.replicas:
+            r.drain(max(deadline - time.monotonic(), 0.0))
+        # One shared join deadline too: K wedged workers must not hold
+        # shutdown K × timeout beyond the grace already spent.
+        join_by = time.monotonic() + max(self.drain_grace_s, 5.0)
+        for r in self.replicas:
+            r.join(timeout=max(join_by - time.monotonic(), 0.0))
+        self._g_healthy.set(0)
+
+    # -- routing -----------------------------------------------------------
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self.stats[key] += n
+
+    def _refresh_healthy(self) -> int:
+        """Recompute the accepting-work count and sync its gauge — the
+        ONE definition every state transition and stats read shares."""
+        n = sum(1 for r in self.replicas if r.state == HEALTHY)
+        self._g_healthy.set(n)
+        return n
+
+    def _candidates(self) -> list[EngineReplica]:
+        return [r for r in self.replicas if r.state == HEALTHY]
+
+    def _pick(self, ticket: Ticket, *, count_sheds: bool = True):
+        """One routing decision: ``(replica, matched_tokens, decision)``
+        or ``(None, 0, reason)`` when nothing can take the ticket.
+        ``count_sheds=False`` on pick-to-submit-race retries keeps the
+        shed-skip ledger one-entry-per-decision."""
+        live = self._candidates()
+        if not live:
+            return None, 0, "no healthy replica"
+        open_ = [r for r in live if not r.overloaded]
+        if len(open_) < len(live) and count_sheds:
+            skipped = len(live) - len(open_)
+            self._bump("shed_skips", skipped)
+            self._m_shed_skips.inc(skipped)
+        # All saturated: queue to the least-loaded healthy replica
+        # anyway — the router never bounces a request it could hold
+        # (the engine-side max_queue/deadline bounds still shed).
+        pool = open_ or live
+        if self.policy == "round_robin":
+            with self._lock:
+                rep = pool[self._rr % len(pool)]
+                self._rr += 1
+            return rep, 0, "round_robin"
+        best, best_len = None, 0
+        toks = ticket.prompt_tokens  # converted once, scored N times
+        for r in pool:
+            m = r.match_len(toks)
+            if m > best_len or (
+                m == best_len and best is not None and m > 0
+                and r.pending < best.pending
+            ):
+                best, best_len = r, m
+        if best is not None and best_len > 0:
+            return best, best_len, "affinity"
+        rep = min(pool, key=lambda r: (r.pending, -r.free_pages))
+        return rep, 0, "least_loaded"
+
+    def _dispatch(self, ticket: Ticket) -> None:
+        first = True
+        while True:
+            rep, matched, decision = self._pick(ticket, count_sheds=first)
+            first = False
+            if rep is None:
+                self._fail_ticket(ticket, decision)
+                return
+            if not rep.submit(ticket):
+                # Lost the race with the replica dying between pick and
+                # submit — re-pick (the state filter now excludes it).
+                continue
+            # (submit already appended rep.name to replica_history,
+            # atomically with the enqueue, under the replica's lock.)
+            self._bump("routed")
+            if decision == "affinity":
+                self._bump("affinity_hits")
+                self._bump("affinity_hit_tokens", matched)
+                self._m_affinity.inc(matched)
+            elif decision == "least_loaded":
+                self._bump("least_loaded")
+            elif decision == "round_robin":
+                self._bump("round_robin")
+            self._m_routed.inc(replica=rep.name, decision=decision)
+            obs_events.emit(
+                "route", replica=rep.name, decision=decision,
+                matched=matched, prompt_len=len(ticket.prompt),
+                reroutes=ticket.reroutes,
+            )
+            return
+
+    def _await(self, ticket: Ticket) -> RequestResult:
+        """Block until the ticket latches a result. With
+        ``request_timeout_s`` set, a replica that sits on a ticket too
+        long is marked unhealthy (its queue re-routes, the in-flight
+        batch finishes into latched-ignored results) and the ticket is
+        retried elsewhere."""
+        if self.request_timeout_s is None:
+            ticket.wait()
+            return ticket.result
+        while ticket.result is None:
+            # Per-HOP budget: the timer arms from the CURRENT hop's
+            # dispatch stamp, not from when this wait started — a
+            # ticket rerouted mid-wait (a death callback beat this
+            # timer) gives its new replica a full window, because
+            # killing a replica that has held the ticket only a
+            # fraction of the budget would cascade a healthy fleet to
+            # zero.
+            dispatched = ticket.last_dispatch_t
+            wait_s = self.request_timeout_s
+            if dispatched is not None:
+                wait_s = dispatched + wait_s - time.monotonic()
+            # Floor the wait: a stale stamp with an expired budget
+            # (e.g. a lost reroute claim whose winner hasn't
+            # re-submitted yet) must poll, not busy-spin.
+            if ticket.wait(max(wait_s, 0.05)):
+                break
+            if ticket.result is not None:
+                # Lost the race with a completion right at the timeout:
+                # the work was delivered — the replica must NOT be
+                # killed for finishing slowly but in time.
+                break
+            # Atomic hop judgment (name + stamp under the ticket
+            # lock): a reroute racing this expiry can't get the NEW
+            # replica killed for the old hop's stale stamp.
+            overdue = ticket.expired_hop(self.request_timeout_s)
+            if overdue is None:
+                continue  # re-dispatched/completed during the wait
+            rep = self.replica(overdue)
+            if rep.state != DEAD:
+                orphans = rep.mark_unhealthy(
+                    f"router-observed timeout: a ticket waited "
+                    f">{self.request_timeout_s}s"
+                )
+                self._refresh_healthy()
+                for t in orphans:
+                    if t is not ticket:
+                        self._reroute(t, "replica timeout (queued)",
+                                      source=rep)
+            if ticket.result is None:
+                self._reroute(ticket, "replica timeout", source=rep)
+        return ticket.result
+
+    # -- failure handling --------------------------------------------------
+
+    def _on_replica_failure(self, replica: EngineReplica,
+                            tickets: list[Ticket]) -> None:
+        """A replica died mid-batch (engine raise / injected kill):
+        re-route every orphaned ticket. Runs on the dead replica's
+        worker thread."""
+        self._refresh_healthy()
+        for t in tickets:
+            self._reroute(
+                t, f"replica {replica.name} died: {replica.last_error}",
+                source=replica,
+            )
+
+    def _reroute(self, ticket: Ticket, reason: str,
+                 source: EngineReplica | None = None) -> None:
+        # Atomic per-hop claim (Ticket.claim_reroute): a latched
+        # result, a ticket already re-dispatched off this replica, or
+        # a concurrent claim for the same hop (the timeout path racing
+        # the death callback) all skip — a ticket is never
+        # double-dispatched, and never guard-skipped into a hang.
+        if not ticket.claim_reroute(source.name if source else None):
+            return
+        if ticket.reroutes > self.max_reroutes:
+            self._fail_ticket(
+                ticket,
+                f"re-route budget exhausted ({self.max_reroutes}) after: "
+                f"{reason}",
+            )
+            return
+        self._bump("reroutes")
+        self._m_reroutes.inc()
+        obs_events.emit(
+            "reroute", attempt=ticket.reroutes, reason=str(reason)[:200],
+            prompt_len=len(ticket.prompt),
+        )
+        self._dispatch(ticket)
+
+    def _fail_ticket(self, ticket: Ticket, reason: str) -> None:
+        """Terminal routing failure: a structured PR 3-taxonomy result,
+        never a silent drop. Counted only when the failure actually
+        latches — a late completion winning the race delivered real
+        tokens, and the ledger must not report a failure no client
+        saw."""
+        if ticket.complete(RequestResult(
+            np.zeros(0, np.int32), "failed", f"routing failed: {reason}"
+        )):
+            self._bump("failed_no_replica")
+            obs_events.emit(
+                "route_failed", reason=str(reason)[:200],
+                reroutes=ticket.reroutes,
+            )

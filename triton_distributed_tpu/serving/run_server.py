@@ -2,10 +2,17 @@
 
 Parity: the reference's server launch path
 (``mega_triton_kernel/test/models/model_server.py`` ``__main__``).
+Beyond parity, ``--replicas N`` stands the multi-engine serving tier
+up behind the same socket: N ``ContinuousEngine`` replicas behind the
+prefix-affinity router (docs/scale-out.md), served by the same wire
+protocol (``requests`` payloads only — the router speaks continuous
+batching).
 
 Usage:
     python -m triton_distributed_tpu.serving.run_server \
         --model tiny --tp 1 --port 8765
+    python -m triton_distributed_tpu.serving.run_server \
+        --model tiny --replicas 2 --policy affinity
 """
 
 from __future__ import annotations
@@ -24,6 +31,25 @@ def main(argv=None) -> int:
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--mode", default="xla",
                    choices=["xla", "pallas", "mega"])
+    p.add_argument("--replicas", type=int, default=0,
+                   help="serve N ContinuousEngine replicas behind the "
+                   "prefix-affinity router (0 = single fixed-batch "
+                   "Engine, the legacy path); docs/scale-out.md")
+    p.add_argument("--policy", default="affinity",
+                   choices=["affinity", "round_robin"],
+                   help="router policy with --replicas")
+    p.add_argument("--max-batch", type=int, default=4,
+                   help="decode slots per replica with --replicas")
+    p.add_argument("--drain-grace", type=float, default=2.0,
+                   help="drain grace (seconds) for server connections "
+                   "AND router replica drains")
+    p.add_argument("--request-timeout", type=float, default=0.0,
+                   help="with --replicas: router-observed replica "
+                   "timeout in seconds — a replica sitting on a "
+                   "request this long is marked dead and the request "
+                   "re-routed (0 = off, the default: a cold first "
+                   "request compiles for minutes and must not read as "
+                   "a hang)")
     args = p.parse_args(argv)
 
     from triton_distributed_tpu.models import AutoLLM
@@ -33,11 +59,39 @@ def main(argv=None) -> int:
 
     ctx = initialize_distributed(tp=args.tp, devices=jax.devices()[: args.tp])
     model = AutoLLM.from_pretrained(args.model, ctx=ctx)
-    engine = Engine(
-        model, temperature=args.temperature, mode=args.mode, verbose=True
+    if args.replicas > 0:
+        from triton_distributed_tpu.models.continuous import ContinuousEngine
+        from triton_distributed_tpu.serving.router import Router
+
+        mode = args.mode
+        if mode == "mega":
+            # Same coercion as perf/serve_demo.py: the replicated tier
+            # is validated on the xla/pallas engines.
+            print("--replicas: coercing --mode mega to xla")
+            mode = "xla"
+        engines = [
+            ContinuousEngine(
+                model, max_batch=args.max_batch, mode=mode,
+                temperature=args.temperature, prefix_cache=True,
+            )
+            for _ in range(args.replicas)
+        ]
+        engine = Router(
+            engines, policy=args.policy, drain_grace_s=args.drain_grace,
+            request_timeout_s=args.request_timeout or None,
+        )
+        what = f"{args.model} x{args.replicas} ({args.policy} router)"
+    else:
+        engine = Engine(
+            model, temperature=args.temperature, mode=args.mode,
+            verbose=True,
+        )
+        what = f"{args.model} (tp={args.tp})"
+    server = ModelServer(
+        engine, host=args.host, port=args.port,
+        drain_grace_s=args.drain_grace,
     )
-    server = ModelServer(engine, host=args.host, port=args.port)
-    print(f"serving {args.model} (tp={args.tp}) on {server.host}:{server.port}")
+    print(f"serving {what} on {server.host}:{server.port}")
     server.serve_forever()
     return 0
 

@@ -51,12 +51,22 @@ server finishes in-flight work, answers pings, refuses new generation),
 ``internal``. Per-request failures inside a ``requests`` payload do NOT
 fail the payload — the response carries per-request statuses.
 
+The ``overloaded`` shed reply carries a load-proportional
+``retry_after_s`` hint; the :func:`request` retry loop honors it over
+its local exponential backoff. ``drain_grace_s`` bounds the
+oversized-line connection drain (was a hardcoded 2.0) and is surfaced
+in ``server_stats``.
+
 A ``requests`` payload routes to a
 :class:`~triton_distributed_tpu.models.continuous.ContinuousEngine`'s
 admission/eviction loop (mixed prompt/gen lengths, paged pool, prefix
 cache when the engine enables it); ``input_ids`` routes to
 ``Engine.serve`` fixed-batch serving. A server constructed over a
-ContinuousEngine only speaks the former, over an Engine only the latter.
+ContinuousEngine only speaks the former, over an Engine only the
+latter. A server over a ``Router`` (``serving/router.py``,
+docs/scale-out.md) speaks the continuous form, dispatches generation
+payloads WITHOUT the engine lock (the router's per-replica queues
+serialize), and drains the replica fleet on shutdown.
 """
 
 from __future__ import annotations
@@ -107,9 +117,20 @@ class ModelServer:
         port: int = 0,
         *,
         max_pending: int = 8,
+        drain_grace_s: float = 2.0,
     ):
         self.engine = engine
         self.max_pending = max_pending
+        # Connection-drain budget (was a hardcoded 2.0): bounds how
+        # long an oversized-line tail is drained before the conn
+        # closes, and rides into the router's replica-drain grace when
+        # this server fronts a Router (docs/scale-out.md). Surfaced in
+        # ``server_stats`` so a scraper can see the deployed value.
+        self.drain_grace_s = float(drain_grace_s)
+        # Routers serialize internally (per-replica queues): dispatch
+        # their generation payloads WITHOUT the engine lock so
+        # payloads from many connections fan out across replicas.
+        self._concurrent = bool(getattr(engine, "concurrent_safe", False))
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -164,6 +185,7 @@ class ModelServer:
         with self._pending_lock:
             stats["pending"] = self._pending
         stats["draining"] = self._shutdown.is_set()
+        stats["drain_grace_s"] = self.drain_grace_s
         # ``snapshot_at`` is the same monotonic clock the per-request
         # timelines use, so a scraper can order stats snapshots against
         # event-ring timestamps without wall-clock skew.
@@ -309,11 +331,19 @@ class ModelServer:
         with self._pending_lock:
             if self._pending >= self.max_pending:
                 self._count("shed")
+                # Load-proportional backoff hint: clients that honor
+                # ``retry_after_s`` (see :func:`request`) spread their
+                # retries with the depth of the queue they bounced
+                # off, instead of hammering a shedding server in
+                # lockstep.
                 return self._error(
                     "overloaded",
                     f"{self._pending} generation payloads already "
                     f"pending (bound {self.max_pending}); retry with "
                     "backoff",
+                    retry_after_s=round(
+                        min(max(0.1 * self._pending, 0.05), 2.0), 3
+                    ),
                 )
             self._pending += 1
         # Enqueue stamp BEFORE the engine lock: a request's queue-wait
@@ -321,6 +351,9 @@ class ModelServer:
         # generations, not just the engine's admission queue.
         enqueue_t = time.monotonic()
         try:
+            if self._concurrent:
+                self._count("requests")
+                return self._generate(req, enqueue_t)
             with self._engine_lock:
                 self._count("requests")
                 return self._generate(req, enqueue_t)
@@ -444,8 +477,8 @@ class ModelServer:
                     # thread (each drip resetting the 10 s idle
                     # timeout). A timeout here raises and is counted
                     # as a conn error, which a hostile client is.
-                    conn.settimeout(2.0)
-                    drain_deadline = time.monotonic() + 2.0
+                    conn.settimeout(self.drain_grace_s)
+                    drain_deadline = time.monotonic() + self.drain_grace_s
                     while time.monotonic() < drain_deadline:
                         rest = f.readline(self.MAX_LINE_BYTES)
                         if not rest or rest.endswith(b"\n"):
@@ -518,6 +551,12 @@ class ModelServer:
             # let callers (and the test-suite audit fixture) observe
             # the engine mid-mutation.
             self._thread.join(timeout=self.DRAIN_TIMEOUT_S + 5)
+        # A Router engine owns replica worker threads: drain them too
+        # (bounded by its drain_grace_s per replica) so a server
+        # shutdown quiesces the whole tier, not just the socket.
+        engine_shutdown = getattr(self.engine, "shutdown", None)
+        if callable(engine_shutdown):
+            engine_shutdown()
 
 
 def request(
@@ -534,7 +573,11 @@ def request(
     With ``retries > 0`` transient failures — connection refused/reset,
     the server vanishing mid-response, and structured ``overloaded``
     shedding — are retried with exponential backoff
-    (``backoff_s * 2**attempt``). Non-transient server errors raise
+    (``backoff_s * 2**attempt``). A shed reply carrying a
+    ``retry_after_s`` hint overrides the local backoff for that
+    attempt: the server knows its own queue depth, so router- or
+    script-driven retries spread out instead of hammering a shedding
+    replica in lockstep. Non-transient server errors raise
     ``RuntimeError`` immediately.
     """
     attempt = 0
@@ -563,7 +606,16 @@ def request(
         if err is not None:
             status = err.get("status") if isinstance(err, dict) else None
             if status == "overloaded" and attempt < retries:
-                time.sleep(backoff_s * (2 ** attempt))
+                hint = err.get("retry_after_s")
+                # hint > 0 only (zero/absent/bogus must not collapse
+                # the retry loop into back-to-back hammering), and
+                # clamped: the client trusts ANY peer speaking the
+                # protocol, and an arbitrary server value must not be
+                # able to stall it for hours.
+                if isinstance(hint, (int, float)) and hint > 0:
+                    time.sleep(min(float(hint), 30.0))
+                else:
+                    time.sleep(backoff_s * (2 ** attempt))
                 attempt += 1
                 continue
             raise RuntimeError(f"server error: {err}")
