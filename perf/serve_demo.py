@@ -35,9 +35,14 @@ def main(argv=None) -> int:
     p.add_argument("--gen-len", type=int, default=32)
     p.add_argument("--cpu", action="store_true")
     p.add_argument("--kv-dtype", default=None, choices=["int8"],
-                   help="int8-quantized paged KV pool (forces the paged "
-                   "xla/pallas engine; stats payload then carries "
-                   "kv_bytes_per_token/kv_dtype through the wire)")
+                   help="int8-quantized paged KV pool (composes with "
+                   "every --mode including mega — in-kernel dequant; "
+                   "stats payload then carries kv_bytes_per_token/"
+                   "kv_dtype through the wire)")
+    p.add_argument("--speculative", type=int, default=0, metavar="K",
+                   help="self-drafting speculative decoding, up to K "
+                   "draft tokens per row (docs/serving.md); excluded "
+                   "with --mode mega")
     p.add_argument("--stats", action="store_true",
                    help="after generating, fetch {'cmd':'stats'} and "
                    "{'cmd':'metrics'} through the wire and pretty-print "
@@ -52,6 +57,17 @@ def main(argv=None) -> int:
                    "timeout (seconds; 0 = off — a cold compile must "
                    "not read as a hang)")
     args = p.parse_args(argv)
+    # kv_dtype×mega and replicas×mega compose since PR 7 (the megakernel
+    # is the general serving fast path — docs/megakernel.md); the ONE
+    # remaining conflict is speculative×mega, refused loudly BY FLAG
+    # NAME before any model loads, instead of silently downgrading the
+    # mode. (--cpu coerces mega→xla below, which does compose.)
+    if args.speculative and args.mode == "mega" and not args.cpu:
+        p.error(
+            "--speculative and --mode mega do not compose (the NS-step "
+            "fused launch already amortizes per-step dispatch); drop "
+            "--speculative or use --mode xla/pallas"
+        )
 
     import jax
 
@@ -72,8 +88,6 @@ def main(argv=None) -> int:
     )
     jax.block_until_ready(model.params)
     mode = args.mode if not (args.cpu and args.mode == "mega") else "xla"
-    if (args.kv_dtype or args.replicas) and mode == "mega":
-        mode = "xla"  # quantized pool / router compose with xla/pallas
     if args.replicas > 0:
         from triton_distributed_tpu.models.continuous import ContinuousEngine
         from triton_distributed_tpu.serving.router import Router
@@ -82,13 +96,15 @@ def main(argv=None) -> int:
             ContinuousEngine(
                 model, max_batch=2, max_length=1024, mode=mode,
                 temperature=0.0, prefix_cache=True,
-                kv_dtype=args.kv_dtype,
+                kv_dtype=args.kv_dtype, speculative=args.speculative,
             )
             for _ in range(args.replicas)
         ], request_timeout_s=args.request_timeout or None)
     else:
         eng = Engine(model, temperature=0.0, mode=mode,
-                     paged=bool(args.kv_dtype), kv_dtype=args.kv_dtype)
+                     paged=bool(args.kv_dtype or args.speculative),
+                     kv_dtype=args.kv_dtype,
+                     speculative=args.speculative)
     server = ModelServer(eng).start()
     print(json.dumps({"serving": args.model, "mode": mode,
                       "replicas": args.replicas, "port": server.port,

@@ -118,7 +118,37 @@ def pytest_configure(config):
     )
 
 
+# Tier-1 runs under a hard wall-clock budget (ROADMAP.md: 870 s), and
+# the FULL fast suite no longer fits it on this one-core interpret
+# host — so spend the window highest-yield-first: cheap/high-signal
+# suites up front, the multi-minute interpret-heavy suites (and the
+# families that cannot execute under this container's 0.4.x interpret
+# gaps — collectives/overlap/stress, see runtime/jax_compat.py) at the
+# back. Within-file order is preserved (stable sort), every test still
+# runs when the clock allows, and the order is deterministic. Ordered
+# by measured ascending cost-per-verified-test on this host. Files NOT
+# in the list sort FIRST (rank -1): a new test file must never be
+# silently starved behind the multi-minute tail — if it turns out
+# expensive, add it here explicitly.
+_FILE_ORDER = [
+    "test_tools.py", "test_bench_tuning.py", "test_onchip_queue.py",
+    "test_runtime.py", "test_sampling.py", "test_language.py",
+    "test_layers.py", "test_native.py", "test_obs.py", "test_router.py",
+    "test_attention.py", "test_p2p.py", "test_kv_quant.py",
+    "test_speculative.py", "test_megakernel.py", "test_tpu_lowering.py",
+    "test_prefix_cache.py", "test_faults.py", "test_serving.py",
+    "test_model.py", "test_collectives.py", "test_sp_attention.py",
+    "test_moe.py", "test_stress.py", "test_overlap.py",
+]
+_FILE_RANK = {name: i for i, name in enumerate(_FILE_ORDER)}
+
+
 def pytest_collection_modifyitems(config, items):
+    items.sort(
+        key=lambda item: _FILE_RANK.get(
+            os.path.basename(str(item.fspath)), -1
+        )
+    )
     if config.option.markexpr or os.environ.get("TDT_RUN_SLOW") == "1":
         return
     skip = pytest.mark.skip(

@@ -240,6 +240,92 @@ def test_quantized_row_scatter_reset_and_grow(rng):
     assert np.all(np.abs(deq - want) <= sc2[:, None, None] * 1.0 + 1e-6)
 
 
+def test_append_n_sequential_scale_protocol(rng):
+    """``append_n`` on an int8 pool must leave the pool BIT-IDENTICAL
+    to NS single-row ``append`` calls over the same rows: the megakernel
+    NS-launch retires pages into the radix tree that unfused serving
+    also produces, so the scale grow/requant EVENT ORDER — not just the
+    values — must match (append_n sequences its per-step scatters for
+    exactly this)."""
+    from triton_distributed_tpu.models.paged_kv_cache import append
+
+    L, B, H, NS, page, hd, P_ = 2, 2, 2, 5, 4, 8, 6
+    cache = PagedKVCache(
+        k_pages=jnp.zeros((L, P_, H, page, hd), jnp.int8),
+        v_pages=jnp.zeros((L, P_, H, page, hd), jnp.int8),
+        page_table=jnp.asarray([[1, 2, 0], [3, 4, 0]], jnp.int32),
+        kv_len=jnp.asarray([2, 3], jnp.int32),
+        k_scale=jnp.zeros((L, P_, H), jnp.float32),
+        v_scale=jnp.zeros((L, P_, H), jnp.float32),
+    )
+    # Row magnitudes GROW per step so every append forces a scale grow
+    # + requant of the earlier rows — the order-sensitive case.
+    k_new = jnp.asarray(
+        rng.standard_normal((L, B, H, NS, hd))
+        * (2.0 ** np.arange(NS))[None, None, None, :, None],
+        jnp.float32,
+    )
+    v_new = jnp.asarray(rng.standard_normal((L, B, H, NS, hd)),
+                        jnp.float32)
+    batch = append_n(cache, k_new, v_new)
+    seq = cache
+    for s in range(NS):
+        seq = append(seq, k_new[:, :, :, s, :], v_new[:, :, :, s, :])
+    np.testing.assert_array_equal(
+        np.asarray(batch.k_pages), np.asarray(seq.k_pages)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(batch.k_scale), np.asarray(seq.k_scale)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(batch.v_pages), np.asarray(seq.v_pages)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(batch.kv_len), np.asarray(seq.kv_len)
+    )
+
+
+def test_append_n_trash_routes_overshoot(rng):
+    """``n_valid`` routes a finishing row's guaranteed-overshoot rows
+    to the trash page: the sequence's own pages (the ones that retire
+    into the radix tree) keep codes AND scales free of garbage-row
+    contamination."""
+    L, B, H, NS, page, hd, P_ = 1, 2, 1, 4, 4, 8, 4
+    cache = PagedKVCache(
+        k_pages=jnp.zeros((L, P_, H, page, hd), jnp.int8),
+        v_pages=jnp.zeros((L, P_, H, page, hd), jnp.int8),
+        page_table=jnp.asarray([[1, 2], [3, 0]], jnp.int32),
+        kv_len=jnp.asarray([1, 0], jnp.int32),
+        k_scale=jnp.zeros((L, P_, H), jnp.float32),
+        v_scale=jnp.zeros((L, P_, H), jnp.float32),
+    )
+    rows = jnp.asarray(rng.standard_normal((L, B, H, NS, hd)),
+                       jnp.float32)
+    # Row 0 keeps 2 of 4 rows; row 1 keeps all 4. Make row 0's
+    # overshoot HUGE: without routing it would inflate page 1's scale.
+    rows = rows.at[:, 0, :, 2:, :].multiply(100.0)
+    full = append_n(cache, rows, rows)
+    routed = append_n(
+        cache, rows, rows, n_valid=jnp.asarray([2, 4], jnp.int32)
+    )
+    # Routed: page 1 (slot 0's page) scale covers only the 2 kept rows.
+    assert float(routed.k_scale[0, 1, 0]) < float(full.k_scale[0, 1, 0])
+    # Slot 1 untouched by routing.
+    np.testing.assert_array_equal(
+        np.asarray(routed.k_pages[:, 3]), np.asarray(full.k_pages[:, 3])
+    )
+    # Overshoot landed on the trash page (page 0), nowhere else; the
+    # kept rows dequantize the same values as an un-routed append of
+    # just those rows would.
+    clean = append_n(
+        cache, rows[:, :, :, :2, :], rows[:, :, :, :2, :],
+        n_valid=jnp.asarray([2, 2], jnp.int32),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(routed.k_pages[:, 1]), np.asarray(clean.k_pages[:, 1])
+    )
+
+
 def _tiny_model(ctx, max_length=128):
     from triton_distributed_tpu.models import AutoLLM
 
@@ -484,10 +570,14 @@ def test_bf16_bit_identical_when_unset_and_validation(ctx4):
         init_paged_cache(model.cfg, 1, ctx4, "tp", kv_dtype="fp8")
     with pytest.raises(ValueError, match="paged"):
         Engine(model, kv_dtype="int8")
-    with pytest.raises(ValueError, match="megakernel"):
-        Engine(model, paged=True, mode="mega", kv_dtype="int8")
-    with pytest.raises(ValueError, match="megakernel"):
-        ContinuousEngine(model, mode="mega", kv_dtype="int8")
+    # PR 7: kv_dtype COMPOSES with mode="mega" (the fused decode
+    # dequantizes the int8 pool in-kernel) — construction must succeed;
+    # the one remaining mega exclusion is speculative.
+    Engine(model, paged=True, mode="mega", kv_dtype="int8")
+    ContinuousEngine(model, mode="mega", kv_dtype="int8")
+    with pytest.raises(ValueError, match="speculative"):
+        ContinuousEngine(model, mode="mega", kv_dtype="int8",
+                         speculative=4)
     # cfg-level default plumbs through without the explicit knob.
     cfg = dataclasses.replace(model.cfg, kv_dtype="int8")
     qcache, _ = init_paged_cache(cfg, 1, ctx4, "tp", max_length=128,

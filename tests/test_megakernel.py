@@ -299,8 +299,8 @@ def test_lm_head_remainder_tile(ctx4):
     )
 
 
-@pytest.mark.parametrize("fused", [True, pytest.param(False,
-                                                      marks=pytest.mark.slow)])
+@pytest.mark.slow
+@pytest.mark.parametrize("fused", [True, False])
 def test_cross_prefetch_parity(ctx4, fused):
     """cross_prefetch (the previous task starts the next task's first
     weight-tile DMA; the stream consumes the SMEM flag and skips its
@@ -340,14 +340,12 @@ def test_cross_prefetch_parity(ctx4, fused):
     assert [int(x) for x in np.asarray(toks3)[:, 0]] == gold_chain
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("extras", [
     {},
     # The full tuned q8 stack the on-chip sweep runs (deep staging +
     # fused norms + cross-task prefetch over int8 streams).
-    pytest.param(
-        {"nbuf": 3, "fuse_norms": True, "cross_prefetch": True},
-        marks=pytest.mark.slow,
-    ),
+    {"nbuf": 3, "fuse_norms": True, "cross_prefetch": True},
 ])
 def test_wq8_parity_vs_dequant_gold(ctx4, extras):
     """Weight-only int8 decode (MegaConfig.wq8): the megakernel fed
@@ -465,6 +463,7 @@ def test_fused_norms_parity(ctx4):
     )
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize(
     "nbuf",
     [
@@ -713,6 +712,232 @@ class TestMultiStepDecode:
         np.testing.assert_array_equal(
             np.asarray(p_out.kv_len), np.asarray(p_ref.kv_len)
         )
+
+
+class TestMegaServeFastPath:
+    """PR 7: the megakernel composes with the production serving
+    configuration — int8 paged pool read in-kernel through per-page
+    scales, per-slot Gumbel sampling inside the NS launch, and split
+    send-early/wait-late TP allreduces (docs/megakernel.md "Serving
+    fast path")."""
+
+    @staticmethod
+    def _warm_pools(model, ctx, B=2, page=16, s_max=64):
+        """Dense-golden context mirrored into paged pools (one int8,
+        one full-width), plus the warmed dense cache."""
+        from triton_distributed_tpu.models.paged_kv_cache import (
+            init_paged_cache,
+            write_prefill,
+        )
+
+        cache = model.new_cache(B, max_length=s_max)
+        step_gold = model.decode_fn("xla")
+        for toks in ([3, 5], [7, 11], [13, 17]):
+            _, cache = step_gold(
+                model.params, jnp.asarray(toks, jnp.int32), cache
+            )
+
+        def mk(kv_dtype):
+            paged, _pool = init_paged_cache(
+                model.cfg, B, ctx, max_length=s_max, page_size=page,
+                kv_dtype=kv_dtype,
+            )
+            for b in range(B):
+                row = jax.tree.map(
+                    lambda x: x[:, b:b + 1], {"k": cache.k, "v": cache.v}
+                )
+                paged = write_prefill(
+                    paged, b, row["k"], row["v"], int(cache.kv_len[b])
+                )
+            return paged
+
+        return cache, mk
+
+    @pytest.mark.slow
+    def test_quant_paged_single_step_bit_parity(self, ctx4):
+        """Greedy mega(int8) vs the unfused int8 paged xla decode,
+        step-for-step: the in-kernel per-page dequant must produce the
+        SAME token chain (both paths append through the one
+        quantized_row_scatter protocol, so pools track within one code
+        unit)."""
+        from triton_distributed_tpu.models.paged_kv_cache import as_dense
+
+        model = AutoLLM.from_pretrained("tiny", ctx=ctx4)
+        _, mk = self._warm_pools(model, ctx4)
+        q_mega, q_xla = mk("int8"), mk("int8")
+        mega = MegaQwen3(model)
+        tm = tx = jnp.asarray([19, 23], jnp.int32)
+        for _ in range(6):
+            lg_m, q_mega = mega.decode_step(tm, q_mega)
+            lg_x, q_xla = model.decode_step(tx, q_xla, "xla")
+            tm = jnp.argmax(lg_m, -1).astype(jnp.int32)
+            tx = jnp.argmax(lg_x, -1).astype(jnp.int32)
+            np.testing.assert_array_equal(np.asarray(tm), np.asarray(tx))
+        km, _ = as_dense(q_mega)
+        kx, _ = as_dense(q_xla)
+        # One int8 code unit (amax/127) of slack: rows computed by
+        # different kernels may round to adjacent codes.
+        np.testing.assert_allclose(
+            np.asarray(km), np.asarray(kx), atol=0.06
+        )
+
+    @pytest.mark.slow
+    def test_quant_paged_multi_matches_chained_single(self, ctx4):
+        """NS-step launch over the int8 pool vs NS chained single-step
+        mega(int8) decodes: token-exact, pools within one quantization
+        step. (Bit-identity of the pools is NOT expected here: the
+        launch attends its own rows at full precision through the band
+        while the chained steps re-read them quantized, so the K/V
+        rows differ in low bits and may round to adjacent codes — the
+        scale grow/requant EVENT ORDER itself is proven bit-exact by
+        tests/test_kv_quant.py::test_append_n_sequential_scale_protocol
+        over identical rows.)"""
+        from triton_distributed_tpu.models.paged_kv_cache import as_dense
+
+        model = AutoLLM.from_pretrained("tiny", ctx=ctx4)
+        _, mk = self._warm_pools(model, ctx4)
+        NS, page = 4, 16
+        q_ref, q_multi = mk("int8"), mk("int8")
+        mega = MegaQwen3(model)
+        tok0 = jnp.asarray([19, 23], jnp.int32)
+        t, ref_toks = tok0, []
+        for _ in range(NS):
+            lg, q_ref = mega.decode_step(t, q_ref)
+            t = jnp.argmax(lg, -1).astype(jnp.int32)
+            ref_toks.append(np.asarray(t))
+        fn = mega.decode_multi_fn(
+            2, 64, NS, page=page, kv_quant=True,
+            num_pages=int(q_multi.k_pages.shape[1]),
+        )
+        mtoks, _, q_out = fn(model.params, tok0, q_multi)
+        np.testing.assert_array_equal(
+            np.asarray(mtoks), np.stack(ref_toks)
+        )
+        km, _ = as_dense(q_out)
+        kr, _ = as_dense(q_ref)
+        np.testing.assert_allclose(
+            np.asarray(km), np.asarray(kr), atol=0.06
+        )
+        np.testing.assert_array_equal(
+            np.asarray(q_out.kv_len), np.asarray(q_ref.kv_len)
+        )
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("kv_dtype", [None, "int8"])
+    def test_sampled_paged_multi(self, ctx4, kv_dtype):
+        """Sampled multi-step over the PAGED pool (int8 included): the
+        in-kernel argmax over logits + noise must match the host
+        chaining tokens exactly — Gumbel-max temperature sampling on
+        the serving cache layout."""
+        model = AutoLLM.from_pretrained("tiny", ctx=ctx4)
+        _, mk = self._warm_pools(model, ctx4)
+        NS, B, page = 3, 2, 16
+        V = model.cfg.vocab_size
+        v_pad = model.params.lm_head.shape[1]
+        noise = 0.7 * jax.random.gumbel(
+            jax.random.key(7), (NS, B, v_pad), jnp.float32
+        )
+        p_ref, p_s = mk(kv_dtype), mk(kv_dtype)
+        mega = MegaQwen3(model)
+        t, ref_toks = jnp.asarray([19, 23], jnp.int32), []
+        for i in range(NS):
+            lg, p_ref = mega.decode_step(t, p_ref)
+            t = jnp.argmax(lg + noise[i, :, :V], -1).astype(jnp.int32)
+            ref_toks.append(np.asarray(t))
+        fn = mega.decode_multi_fn(
+            B, 64, NS, sampled=True, page=page,
+            kv_quant=kv_dtype is not None,
+            num_pages=int(p_s.k_pages.shape[1]),
+        )
+        stoks, _, _ = fn(
+            model.params, jnp.asarray([19, 23], jnp.int32), p_s, noise
+        )
+        np.testing.assert_array_equal(
+            np.asarray(stoks), np.stack(ref_toks)
+        )
+
+    def test_overlap_ar_parity(self, ctx4):
+        """Split AR_SEND/AR_WAIT allreduces (+ fused norms + cross-task
+        prefetch — the serving default config) must match the golden
+        decode step exactly: the overlap moves WHEN the puts fly and
+        the reduction waits, never the math."""
+        from triton_distributed_tpu.megakernel.code_generator import (
+            MegaConfig,
+        )
+
+        model = AutoLLM.from_pretrained("tiny", ctx=ctx4)
+        cache = model.new_cache(1, max_length=64)
+        step_gold = model.decode_fn("xla")
+        for t in (3, 5):
+            _, cache = step_gold(
+                model.params, jnp.asarray([t], jnp.int32), cache
+            )
+        tok = jnp.asarray([7], jnp.int32)
+        logits_gold, _ = step_gold(
+            model.params, tok, jax.tree.map(jnp.copy, cache)
+        )
+        ov = MegaQwen3(model, cfg=MegaConfig(
+            fuse_norms=True, cross_prefetch=True, overlap_ar=True
+        ))
+        logits_ov, _ = ov.decode_step(tok, jax.tree.map(jnp.copy, cache))
+        np.testing.assert_allclose(
+            np.asarray(logits_ov), np.asarray(logits_gold),
+            rtol=2e-3, atol=2e-3,
+        )
+
+    @pytest.mark.slow
+    def test_overlap_ar_multi_step(self, ctx4):
+        """Multi-step launches under overlap_ar: the split exchange's
+        workspace/semaphore reuse must stay race-free across the NS
+        in-launch steps AND the LM head's cross-rank argmax exchange —
+        token-exact vs the chained overlap_ar single-step."""
+        from triton_distributed_tpu.megakernel.code_generator import (
+            MegaConfig,
+        )
+
+        model = AutoLLM.from_pretrained("tiny", ctx=ctx4)
+        _, mk = self._warm_pools(model, ctx4)
+        NS = 3
+        ov = MegaQwen3(model, cfg=MegaConfig(
+            fuse_norms=True, cross_prefetch=True, overlap_ar=True
+        ))
+        o_ref, o_m = mk(None), mk(None)
+        t, ref_toks = jnp.asarray([19, 23], jnp.int32), []
+        for _ in range(NS):
+            lg, o_ref = ov.decode_step(t, o_ref)
+            t = jnp.argmax(lg, -1).astype(jnp.int32)
+            ref_toks.append(np.asarray(t))
+        fn = ov.decode_multi_fn(2, 64, NS, page=16)
+        otoks, _, _ = fn(
+            model.params, jnp.asarray([19, 23], jnp.int32), o_m
+        )
+        np.testing.assert_array_equal(
+            np.asarray(otoks), np.stack(ref_toks)
+        )
+
+    def test_overlap_ar_task_graph(self, ctx4):
+        """overlap_ar splits every allreduce into AR_SEND + AR_WAIT
+        (one extra task per exchange), adjacently scheduled."""
+        from triton_distributed_tpu.megakernel.code_generator import (
+            MegaConfig,
+        )
+
+        model = AutoLLM.from_pretrained("tiny", ctx=ctx4)
+        base = MegaQwen3(model)
+        split = MegaQwen3(model, cfg=MegaConfig(overlap_ar=True))
+        n_base = len(base._built(1, 64)[0].order)
+        n_split = len(split._built(1, 64)[0].order)
+        L = model.cfg.num_layers
+        assert n_split - n_base == 2 * L  # 2 exchanges per layer
+        types = [t.task_type for t in split._built(1, 64)[0].order]
+        assert TaskType.ALLREDUCE not in types
+        assert types.count(TaskType.AR_SEND) == 2 * L
+        assert types.count(TaskType.AR_WAIT) == 2 * L
+        # Every AR_SEND is immediately followed by its AR_WAIT (the
+        # sequential-chain deps pin the pair together).
+        for i, tt in enumerate(types):
+            if tt == TaskType.AR_SEND:
+                assert types[i + 1] == TaskType.AR_WAIT
 
 
 class TestMultiStepWide:

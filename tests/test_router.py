@@ -314,6 +314,42 @@ def test_router_results_false_raises_on_failures(tier_model):
         router.shutdown()
 
 
+@pytest.mark.slow
+def test_router_mega_int8_fleet_bit_exact(tier_model):
+    """PR 7 compose: a fleet of ``mode="mega"`` int8 replicas behind
+    the Router serves bit-exact vs per-request unfused int8 goldens,
+    with fused launches actually happening on the replicas (the fast
+    path survives the serving tier's threading and re-dispatch)."""
+    from triton_distributed_tpu.models.continuous import ContinuousEngine
+    from triton_distributed_tpu.serving.router import Router
+
+    model = tier_model
+
+    def engine(mode):
+        return ContinuousEngine(
+            model, max_batch=2, page_size=16, max_length=64, mode=mode,
+            kv_dtype="int8", prefix_cache=True,
+        )
+
+    # Disjoint prompts: no cross-request prefix reuse, so per-request
+    # fresh-engine goldens hold regardless of where the router lands
+    # each request.
+    golds = [
+        engine("xla").run([(p, g)])[0] for p, g in zip(PROMPTS, GENS)
+    ]
+    replicas = [engine("mega") for _ in range(2)]
+    router = Router(replicas)
+    try:
+        results = router.run(list(zip(PROMPTS, GENS)), results=True)
+        for r, gold in zip(results, golds):
+            assert r.status == "ok"
+            np.testing.assert_array_equal(r.tokens, gold)
+        assert sum(e.stats["mega_launches"] for e in replicas) > 0
+        assert router.audit() == []
+    finally:
+        router.shutdown()
+
+
 # -- through the wire ----------------------------------------------------
 
 

@@ -99,3 +99,32 @@ def test_target_probs_greedy_is_one_hot():
     logits = _logits([0.0, 5.0, 1.0])
     probs = np.asarray(sampling.target_probs(logits, temperature=0.0))
     np.testing.assert_allclose(probs, [0.0, 1.0, 0.0])
+
+
+def test_gumbel_max_matches_target_distribution():
+    """The megakernel's in-kernel sampling IS ``argmax(logits + T·g)``
+    with standard-Gumbel ``g`` (the serving wrapper draws the noise,
+    the kernel argmaxes) — by the Gumbel-max trick this must draw
+    exactly the ``sampling.sample`` / ``target_probs`` distribution at
+    ``top_p=1, top_k=0``, the shared filtered-distribution definition
+    the mega fast path relies on (filtered slots fall back to host
+    sampling)."""
+    rng = np.random.default_rng(5)
+    logits = _logits(rng.normal(size=8) * 2.0)
+    t = 0.7
+    probs = np.asarray(sampling.target_probs(logits, t), np.float64)
+    n = 4000
+    keys = jax.random.split(jax.random.key(13), n)
+
+    def draw(kk):
+        noise = t * jax.random.gumbel(kk, (8,), jnp.float32)
+        return jnp.argmax(logits + noise)
+
+    draws = np.asarray(jax.vmap(draw)(keys))
+    emp = np.bincount(draws, minlength=8) / n
+    assert np.abs(emp - probs).sum() / 2 < 0.05  # total variation
+    # Per-slot temperature 0 degenerates to the greedy argmax.
+    zero = jnp.argmax(logits + 0.0 * jax.random.gumbel(
+        jax.random.key(1), (8,), jnp.float32
+    ))
+    assert int(zero) == int(sampling.greedy(logits))

@@ -381,3 +381,61 @@ def test_kv_quant_modules_compile():
     assert proc.returncode == 0, (
         f"kv-quant modules failed to compile:\n{proc.stdout}\n{proc.stderr}"
     )
+
+
+def test_mega_serve_modules_compile():
+    """The megakernel serving fast path must byte-compile: the fused
+    int8/sampling/overlap decode modules are imported by both engines
+    (a syntax error takes serving down at import time), and the
+    CPU-runnable bench that writes perf/MEGA_SERVE.json rides along
+    (repo convention: perf harnesses fail tier-1, not a relay
+    window)."""
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    targets = [
+        os.path.join(root, "triton_distributed_tpu", "megakernel"),
+        os.path.join(root, "triton_distributed_tpu", "models",
+                     "continuous.py"),
+        os.path.join(root, "triton_distributed_tpu", "runtime",
+                     "jax_compat.py"),
+        os.path.join(root, "perf", "mega_serve_bench.py"),
+    ]
+    proc = subprocess.run(
+        [sys.executable, "-m", "compileall", "-q", "-f", *targets],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, (
+        f"mega-serve modules failed to compile:\n"
+        f"{proc.stdout}\n{proc.stderr}"
+    )
+
+
+def test_serving_cli_speculative_mega_conflict():
+    """Both serving CLIs refuse --speculative with --mode mega by flag
+    name, BEFORE loading a model (argparse error → SystemExit 2), and
+    the spec-string parser round-trips the new overlap_ar field."""
+    import os
+    import sys
+
+    import pytest
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from perf import serve_demo
+    from triton_distributed_tpu.serving import run_server
+
+    for main in (serve_demo.main, run_server.main):
+        with pytest.raises(SystemExit) as ei:
+            main(["--speculative", "2", "--mode", "mega"])
+        assert ei.value.code == 2  # argparse p.error exit code
+
+    from triton_distributed_tpu.megakernel.code_generator import MegaConfig
+
+    cfg = MegaConfig(tile_n=512, nbuf=3, fuse_norms=True,
+                     cross_prefetch=True, overlap_ar=True)
+    assert MegaConfig.from_spec(cfg.spec()) == cfg
+    # Old 5-field strings (pre-overlap_ar MEGA_TUNED.json) still parse.
+    old = MegaConfig.from_spec("1024:1024:2:1:0")
+    assert old.overlap_ar is False and old.fuse_norms is True

@@ -394,6 +394,67 @@ class TestMegakernelLower:
         exp = export.export(f, platforms=["tpu"])(params, tok, cache)
         assert len(exp.mlir_module_serialized) > 0
 
+    def test_mega_serving_fast_path_lowers(self, tpu_ctx4):
+        """The PR 7 serving-config pieces must lower for TPU: int8
+        paged pool (per-page scale operands + in-register dequant in
+        the attention task) and the split AR_SEND/AR_WAIT overlapped
+        collectives with their REAL barrier/semaphore machinery — the
+        interpret path skips barriers (kctx.interpret), so only a
+        TPU-targeted trace walks them. Single-step build: the
+        multi-step (in-kernel argmax) lowering is blocked at seed by
+        this jax's Mosaic integer-reduction gap (see the xfailing
+        multi tests above), and every piece NEW in PR 7 except the
+        argmax rides the single-step program too."""
+        from triton_distributed_tpu.megakernel import MegaQwen3
+        from triton_distributed_tpu.megakernel.code_generator import (
+            MegaConfig,
+        )
+        from triton_distributed_tpu.models import AutoLLM
+        from triton_distributed_tpu.models.paged_kv_cache import (
+            PagedKVCache,
+        )
+
+        model = AutoLLM.from_pretrained("tiny", ctx=tpu_ctx4)
+        mega = MegaQwen3(model, cfg=MegaConfig(
+            fuse_norms=True, cross_prefetch=True, overlap_ar=True
+        ))
+        B, page, pps, P_ = 2, 16, 4, 9
+        _, f, _ = mega.build(
+            B, page * pps, page, kv_quant=True, num_pages=P_,
+        )
+        cfg = model.cfg
+        shape = (cfg.num_layers, P_, cfg.num_kv_heads, page,
+                 cfg.head_dim)
+        pool_sh = tpu_ctx4.sharding(None, None, "tp", None, None)
+        sc_sh = tpu_ctx4.sharding(None, None, "tp")
+        rep = tpu_ctx4.sharding()
+        cache = PagedKVCache(
+            k_pages=jax.ShapeDtypeStruct(shape, jnp.int8,
+                                         sharding=pool_sh),
+            v_pages=jax.ShapeDtypeStruct(shape, jnp.int8,
+                                         sharding=pool_sh),
+            page_table=jax.ShapeDtypeStruct((B, pps), jnp.int32,
+                                            sharding=rep),
+            kv_len=jax.ShapeDtypeStruct((B,), jnp.int32, sharding=rep),
+            k_scale=jax.ShapeDtypeStruct(
+                (cfg.num_layers, P_, cfg.num_kv_heads), jnp.float32,
+                sharding=sc_sh,
+            ),
+            v_scale=jax.ShapeDtypeStruct(
+                (cfg.num_layers, P_, cfg.num_kv_heads), jnp.float32,
+                sharding=sc_sh,
+            ),
+        )
+        tok = jax.ShapeDtypeStruct((B,), jnp.int32, sharding=rep)
+        params = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(
+                x.shape, x.dtype, sharding=x.sharding
+            ),
+            model.params,
+        )
+        exp = export.export(f, platforms=["tpu"])(params, tok, cache)
+        assert len(exp.mlir_module_serialized) > 0
+
     def test_mega_wq8_lowers(self, tpu_ctx4):
         """Weight-only int8 decode must lower for TPU (int8 staging
         tiles, VMEM scale operands, upcast-at-MXU dots)."""

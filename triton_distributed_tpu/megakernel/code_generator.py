@@ -89,6 +89,18 @@ class MegaDims:
     # a scalar-prefetch operand, and the attention block size is the
     # page size (parity: reference paged_kv_cache.py).
     page: int = 0
+    # Quantized paged pool (``kv_dtype="int8"``, PR 4's storage mode):
+    # the KV pools arrive as int8 codes and two per-page-per-head scale
+    # operands ``[L, P, 1, Hkv]`` f32 ride as VMEM-resident inputs (the
+    # [L, P, 1, H] layout is the norm-weight trick — dynamic layer/page
+    # indices stay on untiled leading dims). The attention task
+    # dequantizes each staged page block in-register, so full-width KV
+    # never materializes in HBM — the megakernel keeps the int8 pool's
+    # bytes/token. Requires ``page`` > 0 (scales live on pages).
+    kv_quant: bool = False
+    # Pool page count (0 = unknown): only feeds the scoped-VMEM limit
+    # accounting for the VMEM-resident scale operands above.
+    num_pages: int = 0
     # Prefill mode: ``batch`` is the prompt length S (rows = positions),
     # the embedded prompt arrives as an extra input (LOAD_X task), the
     # cache is not read, K/V come out as [L, hkv, S, hd], and the LM
@@ -179,20 +191,34 @@ class MegaConfig:
     # the consumer's first-DMA latency exposure) goes away. A/B'd by
     # perf/mega_tile_sweep.py before becoming default.
     fuse_norms: bool = False
+    # Overlapped TP collectives (the gemm_ar ONE_SHOT pattern adapted
+    # to the sequential megakernel grid, ops/overlap/gemm_ar.py): each
+    # layer allreduce splits into AR_SEND (remote puts start the moment
+    # the producing GEMM's partial is ready) and AR_WAIT (waits the
+    # inbound partials only AFTER starting the next weight stream's
+    # first tile DMA), so the ICI hop hides under the next task's HBM
+    # traffic — decode's actual bottleneck — instead of serializing
+    # after the GEMM. The in-window prefetch needs the cross_prefetch
+    # flag machinery (the consuming stream must skip its own tile-0
+    # start) and pairs best with fuse_norms (the task after AR_WAIT is
+    # then the weight stream itself); without cross_prefetch the split
+    # still overlaps the puts with task dispatch only. No-op at
+    # n_ranks == 1 (the builder emits the fused ALLREDUCE there).
+    overlap_ar: bool = False
 
     @classmethod
     def from_spec(cls, spec: str) -> "MegaConfig":
         """Parse the sweep/bench config-string format
-        ``tile_n:tile_k:nbuf[:fuse_norms[:cross_prefetch]]`` — the ONE
-        parser for both ``perf/mega_tile_sweep.py`` (which writes these
-        strings into ``perf/MEGA_TUNED.json``) and ``bench.py`` (which
-        reads them back); a shared definition keeps the handoff
-        format-compatible."""
+        ``tile_n:tile_k:nbuf[:fuse_norms[:cross_prefetch[:overlap_ar]]]``
+        — the ONE parser for both ``perf/mega_tile_sweep.py`` (which
+        writes these strings into ``perf/MEGA_TUNED.json``) and
+        ``bench.py`` (which reads them back); a shared definition keeps
+        the handoff format-compatible."""
         fields = [int(v) for v in spec.split(":")]
-        if len(fields) not in (3, 4, 5):
+        if len(fields) not in (3, 4, 5, 6):
             raise ValueError(
-                "want tile_n:tile_k:nbuf[:fuse_norms[:cross_prefetch]], "
-                f"got {spec!r}"
+                "want tile_n:tile_k:nbuf[:fuse_norms[:cross_prefetch"
+                f"[:overlap_ar]]], got {spec!r}"
             )
         # Validate VALUES here, not just arity: a tuned-file/env spec
         # like "0:1024:2" or a negative tile would otherwise surface as
@@ -203,18 +229,21 @@ class MegaConfig:
             )
         if any(f not in (0, 1) for f in fields[3:]):
             raise ValueError(
-                f"fuse_norms/cross_prefetch flags must be 0 or 1: {spec!r}"
+                f"fuse_norms/cross_prefetch/overlap_ar flags must be 0 "
+                f"or 1: {spec!r}"
             )
         return cls(
             tile_n=fields[0], tile_k=fields[1], nbuf=fields[2],
             fuse_norms=bool(fields[3]) if len(fields) > 3 else False,
             cross_prefetch=bool(fields[4]) if len(fields) > 4 else False,
+            overlap_ar=bool(fields[5]) if len(fields) > 5 else False,
         )
 
     def spec(self) -> str:
         """Inverse of :meth:`from_spec` (what the sweep persists)."""
         return (f"{self.tile_n}:{self.tile_k}:{self.nbuf}:"
-                f"{int(self.fuse_norms)}:{int(self.cross_prefetch)}")
+                f"{int(self.fuse_norms)}:{int(self.cross_prefetch)}:"
+                f"{int(self.overlap_ar)}")
 
     def resolve(self, dims: MegaDims) -> "ResolvedConfig":
         if self.nbuf < 1:
@@ -230,6 +259,7 @@ class MegaConfig:
             cross_prefetch=self.cross_prefetch,
             fuse_norms=self.fuse_norms,
             wq8=self.wq8,
+            overlap_ar=self.overlap_ar,
             tn_qkv=pick_tile(dims.qkv_loc, self.tile_n),
             tn_fc1=pick_tile(dims.f_loc, self.tile_n),
             # The vocab axis rarely divides by a wide tile (Qwen3:
@@ -261,6 +291,7 @@ class ResolvedConfig:
     cross_prefetch: bool
     fuse_norms: bool
     wq8: bool
+    overlap_ar: bool
     tn_qkv: int
     tn_fc1: int
     tn_lm: int
@@ -285,12 +316,20 @@ class KernelCtx:
     """
 
     def __init__(self, dims: MegaDims, cfg: ResolvedConfig, axis: str,
-                 wdtype, cdtype):
+                 wdtype, cdtype, interpret: bool = False):
         self.dims = dims
         self.cfg = cfg
         self.axis = axis
         self.wdtype = wdtype
         self.cdtype = cdtype
+        # True when this build runs under the interpret path (CPU
+        # simulator mesh): remote DMAs discharge synchronously at their
+        # program point there, so cross-rank barriers are vacuous — and
+        # 0.4.x interpret has no barrier-semaphore support at all. The
+        # bodies consult this to skip barrier_all; Mosaic builds
+        # (including TPU-targeted AOT lowering from CPU hosts, whose
+        # ctx reports on_tpu) keep every barrier.
+        self.interpret = interpret
         # traced per-step header fields, bound in the kernel body:
         self.layer: Any = None
         self.arg0: Any = None
@@ -311,6 +350,17 @@ class KernelCtx:
         self.sc_w1: Any = None
         self.sc_w2: Any = None
         self.sc_lm: Any = None
+        # int8 paged-pool dequant scales [L, P, 1, Hkv] f32 (None unless
+        # dims.kv_quant): the attention task reads scalar (layer, page,
+        # head) entries to dequantize staged page blocks in-register.
+        self.ksc: Any = None
+        self.vsc: Any = None
+        # The scalar-prefetched task table + current task index, bound
+        # per trace: the AR_WAIT body peeks its successor's header to
+        # start that weight stream's tile-0 DMA before blocking on the
+        # inbound allreduce partials (cfg.overlap_ar).
+        self.task_tab: Any = None
+        self.t: Any = None
 
 
 def make_mega_kernel(
@@ -321,9 +371,10 @@ def make_mega_kernel(
     axis: str,
     wdtype,
     cdtype,
+    interpret: bool = False,
 ):
     """Build the kernel function dispatching over ``used_types``."""
-    kctx = KernelCtx(dims, cfg, axis, wdtype, cdtype)
+    kctx = KernelCtx(dims, cfg, axis, wdtype, cdtype, interpret)
     # Build one body closure per used type, in enum order.
     bodies = [(int(t), get_body_factory(t)(kctx)) for t in sorted(used_types)]
 
@@ -355,8 +406,12 @@ def make_mega_kernel(
             noise, *rest = rest
         else:
             noise = None
+        if dims.kv_quant:  # int8 pool: cache block is (kc, vc, ksc, vsc)
+            kc, vc, ksc, vsc, *rest = rest
+        else:
+            kc, vc, *rest = rest
+            ksc = vsc = None
         (
-            kc, vc,                                        # ANY (read-only)
             logits, knew_out, vnew_out, toks_out,          # outputs
             x, h, qkv, ao, mlp, estage,                    # VMEM state
             colstage, rowstage, kstage, vstage,            # weight/KV staging
@@ -371,6 +426,9 @@ def make_mega_kernel(
         kctx.kv_len = kv_len
         kctx.tokens = tokens
         kctx.table = page_tab
+        kctx.task_tab = task_tab
+        kctx.t = t
+        kctx.ksc, kctx.vsc = ksc, vsc
         kctx.x0 = x0
         kctx.noise = noise
         kctx.toks_out = toks_out
@@ -413,33 +471,25 @@ def make_mega_kernel(
             # this task's trailing matmuls and the next stream skips
             # its own tile-0 start (flag consumed there). Copies must
             # BYTE-MATCH the stream's own copy(0) — same refs, widths,
-            # and semaphore — or the wait accounting breaks. The last
-            # task of a step prefetches nothing (the next grid
-            # iteration is the next step's EMBED).
-            T = pl.num_programs(1)
-
+            # and semaphore — guaranteed by sharing fire_next_tile0
+            # with the AR_WAIT body. The last task of a step prefetches
+            # nothing (the next grid iteration is the next step's
+            # EMBED).
             from triton_distributed_tpu.megakernel.kernels import (
-                stream_tile0_table,
+                fire_next_tile0,
             )
 
-            @pl.when(t + 1 < T)
-            def _prefetch_next():
-                nt = task_tab[t + 1, 0]
-                nl = task_tab[t + 1, 1]
-                col_tab, row_tab = stream_tile0_table(kctx)
-
-                for tt, make in col_tab:
-                    def fire(make=make):
-                        make(nl).start()
-                        pre_col[0] = 1
-
-                    pl.when(nt == int(tt))(fire)
-                for tt, make in row_tab:
-                    def fire(make=make):
-                        make(nl).start()
-                        pre_row[0] = 1
-
-                    pl.when(nt == int(tt))(fire)
+            if TaskType.AR_WAIT in used_types:
+                # An AR_WAIT task already fired its successor's tile-0
+                # copy BEFORE blocking on the allreduce partials (that
+                # early start is the whole overlap); firing it again
+                # here would double-start the same DMA descriptor and
+                # corrupt the semaphore accounting.
+                pl.when(ttype != int(TaskType.AR_WAIT))(
+                    lambda: fire_next_tile0(kctx)
+                )
+            else:
+                fire_next_tile0(kctx)
 
     return kernel
 
@@ -465,8 +515,10 @@ def build_mega_call(
     """
     cfg = mcfg.resolve(dims)
     used = tuple({t.task_type for t in tasks})
+    interpret = interpret_mode(ctx)
     kernel = make_mega_kernel(
-        dims, cfg, used, axis=axis, wdtype=wdtype, cdtype=cdtype
+        dims, cfg, used, axis=axis, wdtype=wdtype, cdtype=cdtype,
+        interpret=bool(interpret),
     )
     B, d = dims.batch, dims.d
     n = dims.n_ranks
@@ -494,7 +546,12 @@ def build_mega_call(
             )]
             if dims.sampled else []
         )
-        + [pl.BlockSpec(memory_space=pl.ANY)] * 2,
+        + [pl.BlockSpec(memory_space=pl.ANY)] * 2
+        # int8 pool scales [L, P, 1, Hkv] f32: VMEM-resident like the
+        # norm weights — per-(layer, page, head) scalar reads inside
+        # the attention block loop (~L·P·H·4 bytes; counted below).
+        + ([pl.BlockSpec(memory_space=pltpu.VMEM)] * 2
+           if dims.kv_quant else []),
         out_specs=[
             pl.BlockSpec(memory_space=pltpu.VMEM),  # logits
             pl.BlockSpec(memory_space=pltpu.VMEM),  # new K rows
@@ -517,13 +574,17 @@ def build_mega_call(
                        jnp.int8 if cfg.wq8 else wdtype),       # colstage
             pltpu.VMEM((cfg.nbuf, cfg.tk_max, d),
                        jnp.int8 if cfg.wq8 else wdtype),       # rowstage
+            # int8 pools stage their codes as int8 (dequant happens
+            # in-register per block) — half the staging VMEM too.
             pltpu.VMEM(
                 (1,) * 5 if dims.prefill
-                else (2, B, hkv, cfg.s_blk, hd), cdtype
+                else (2, B, hkv, cfg.s_blk, hd),
+                jnp.int8 if dims.kv_quant else cdtype
             ),                                                 # kstage
             pltpu.VMEM(
                 (1,) * 5 if dims.prefill
-                else (2, B, hkv, cfg.s_blk, hd), cdtype
+                else (2, B, hkv, cfg.s_blk, hd),
+                jnp.int8 if dims.kv_quant else cdtype
             ),                                                 # vstage
             pltpu.VMEM((B, d), jnp.float32),                   # arsrc
             pltpu.VMEM((n, B, d), jnp.float32),                # cbuf
@@ -563,6 +624,11 @@ def build_mega_call(
         in_vmem += itw * B * d
     if dims.sampled:
         in_vmem += 2 * 4 * B * dims.v_loc
+    if dims.kv_quant:
+        # Per-page-per-head f32 scale planes for K and V (num_pages may
+        # be 0 = unknown for shape-polymorphic builds; the 1.5× headroom
+        # below absorbs small pools, and engine builds pass the count).
+        in_vmem += 2 * 4 * dims.num_layers * dims.num_pages * hkv
 
     # FLOPs/bytes annotation (parity: the reference's launch_metadata on
     # its megakernel): decode is one pass over every weight shard plus
@@ -628,22 +694,32 @@ def build_mega_call(
                 scratch, out_shapes, in_vmem
             ),
         ),
-        interpret=interpret_mode(ctx),
+        interpret=interpret,
     )
 
     if dims.page and dims.prefill:
         raise NotImplementedError("paged prefill: prefill then scatter")
-    if dims.sampled and (dims.page or dims.prefill):
-        raise NotImplementedError("sampled multi-step: dense decode only")
+    if dims.sampled and dims.prefill:
+        raise NotImplementedError("sampled multi-step: decode only")
+    if dims.kv_quant and not dims.page:
+        raise ValueError("kv_quant requires the paged cache (scales "
+                         "live on pool pages)")
     # ``wargs`` = the kernel-args block (weights + norms [+ wq8
-    # scales]) followed by the two cache operands — variadic so the
-    # wq8 path's extra scale operands flow through without per-mode
-    # signature edits. x0/noise/page_table are re-sited into the
-    # kernel's canonical operand order here.
-    if dims.sampled:
+    # scales]) followed by the cache operands (kc, vc[, ksc, vsc]) —
+    # variadic so the wq8/kv_quant paths' extra scale operands flow
+    # through without per-mode signature edits. x0/noise/page_table are
+    # re-sited into the kernel's canonical operand order here.
+    nc = 4 if dims.kv_quant else 2  # trailing cache-block operand count
+    if dims.sampled and dims.page:
+        def run(kv_len, tokens, page_table, noise, *wargs):
+            return call(
+                table, kv_len, tokens, page_table, *wargs[:-nc], noise,
+                *wargs[-nc:]
+            )
+    elif dims.sampled:
         def run(kv_len, tokens, noise, *wargs):
             return call(
-                table, kv_len, tokens, *wargs[:-2], noise, *wargs[-2:]
+                table, kv_len, tokens, *wargs[:-nc], noise, *wargs[-nc:]
             )
     elif dims.prefill:
         def run(kv_len, tokens, x0, *wargs):

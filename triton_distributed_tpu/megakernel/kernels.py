@@ -114,6 +114,38 @@ def stream_tile0_table(kctx):
     return col, row
 
 
+def fire_next_tile0(kctx):
+    """Start the NEXT task's first weight-tile DMA and set the
+    cross_prefetch handshake flag — THE one implementation of the
+    prefetch fire, shared by the generated per-task epilogue
+    (``code_generator.py``) and the AR_WAIT body (which fires it BEFORE
+    blocking on the inbound allreduce partials, so the ICI hop hides
+    under the next weight stream's tile-0 HBM traffic). Both sites must
+    byte-match the stream's own ``copy(0)``; sharing the fire keeps
+    that a structural guarantee."""
+    T = pl.num_programs(1)
+    t = kctx.t
+
+    @pl.when(t + 1 < T)
+    def _fire():
+        nt = kctx.task_tab[t + 1, 0]
+        nl = kctx.task_tab[t + 1, 1]
+        col_tab, row_tab = stream_tile0_table(kctx)
+
+        for tt, make in col_tab:
+            def fire(make=make):
+                make(nl).start()
+                kctx.pre_col[0] = 1
+
+            pl.when(nt == int(tt))(fire)
+        for tt, make in row_tab:
+            def fire(make=make):
+                make(nl).start()
+                kctx.pre_row[0] = 1
+
+            pl.when(nt == int(tt))(fire)
+
+
 def _stream_cols(kctx, x_f32, w_hbm, n: int, tn: int, consume,
                  col0: int = 0, tail: int = 0, carry=None):
     """Column-streamed GEMM: ``x [B, K] @ w_hbm [K, col0:col0+n*tn]``
@@ -287,20 +319,28 @@ def _stream_rows(kctx, x_ref, w_hbm, out_ref, n: int, tk: int,
     jax.lax.fori_loop(0, n, body, 0, unroll=False)
 
 
-def _workspace_bcast(kctx, payload):
-    """One-shot broadcast through the allreduce workspace: every rank
-    writes ``payload`` ([B, d] f32) to peer slot ``cbuf[me]`` and waits
-    for all ``nr`` candidates to land. Returns nothing — read
-    ``kctx.cbuf[r]`` afterwards. The caller owns quiescence: traffic
-    into cbuf must be fenced (barrier) before the slots are reused.
+def _barrier(kctx):
+    """Cross-rank barrier, skipped under the interpret path: discharge-
+    based interpret executes every remote DMA synchronously at its
+    program point, so the barrier's temporal ordering is vacuous there
+    (and 0.4.x interpret has no barrier-semaphore support). Mosaic
+    builds — including TPU-targeted AOT lowering traced on a CPU host —
+    keep every barrier (``kctx.interpret`` comes from the build ctx,
+    not the process backend)."""
+    if not kctx.interpret:
+        dl.barrier_all(kctx.axis)
 
-    Shared by the ALLREDUCE task and the LM head's cross-rank argmax.
-    """
+
+def _ar_put_dmas(kctx):
+    """The allreduce-workspace put descriptors (this rank's ``arsrc``
+    into every peer's ``cbuf[me]`` slot) — ONE definition, because the
+    split allreduce starts them in AR_SEND and send-waits them in
+    AR_WAIT (a later grid iteration): reconstructed descriptors must
+    byte-match or the semaphore accounting breaks (the col_tile_copy
+    sharing contract, applied to remote copies)."""
     axis = kctx.axis
     nr = kctx.dims.n_ranks
     me = jax.lax.axis_index(axis)
-    kctx.arsrc[...] = payload
-    kctx.cbuf[me] = payload
 
     def put(p):
         dst = jax.lax.rem(me + p, nr)
@@ -313,14 +353,41 @@ def _workspace_bcast(kctx, payload):
             device_id_type=pltpu.DeviceIdType.MESH,
         )
 
-    puts = [put(p) for p in range(1, nr)]
-    for dma in puts:
-        dma.start()
+    return [put(p) for p in range(1, nr)]
+
+
+def _ar_wait_recvs(kctx):
+    """Wait every peer's inbound partial (the receive half of
+    :func:`_ar_put_dmas`); afterwards all ``nr`` candidate slots of
+    ``cbuf`` are valid."""
+    nr = kctx.dims.n_ranks
+    me = jax.lax.axis_index(kctx.axis)
     for p in range(1, nr):
         src = jax.lax.rem(me + p, nr)
         pltpu.make_async_copy(
             kctx.cbuf.at[src], kctx.arsrc, kctx.arrecv.at[src]
         ).wait()
+
+
+def _workspace_bcast(kctx, payload):
+    """One-shot broadcast through the allreduce workspace: every rank
+    writes ``payload`` ([B, d] f32) to peer slot ``cbuf[me]`` and waits
+    for all ``nr`` candidates to land. Returns nothing — read
+    ``kctx.cbuf[r]`` afterwards. The caller owns quiescence: traffic
+    into cbuf must be fenced (barrier) before the slots are reused.
+
+    Shared by the ALLREDUCE task and the LM head's cross-rank argmax;
+    the split AR_SEND/AR_WAIT pair is this same exchange pulled apart
+    so independent work can run between the two halves.
+    """
+    me = jax.lax.axis_index(kctx.axis)
+    kctx.arsrc[...] = payload
+    kctx.cbuf[me] = payload
+
+    puts = _ar_put_dmas(kctx)
+    for dma in puts:
+        dma.start()
+    _ar_wait_recvs(kctx)
     for dma in puts:
         dma.wait_send()
 
@@ -610,6 +677,17 @@ def attn_body(kctx):
                     m, l, acc = carry[b * hkv + h]
                     kb = kctx.kstage[slot, b, h].astype(jnp.float32)
                     vb = kctx.vstage[slot, b, h].astype(jnp.float32)
+                    if dims.kv_quant:
+                        # int8 pool: dequantize the staged page block
+                        # in-register under its (layer, page, head)
+                        # scale — scalar reads off the VMEM-resident
+                        # [L, P, 1, Hkv] planes ([L, P, 1, H] keeps the
+                        # dynamic layer/page indices on untiled leading
+                        # dims, the norm-weight trick). Full-width KV
+                        # never exists in HBM.
+                        pid = kctx.table[b, j]
+                        kb = kb * kctx.ksc[layer, pid, 0, h]
+                        vb = vb * kctx.vsc[layer, pid, 0, h]
                     s = jax.lax.dot_general(
                         qg[b][h], kb, nt,
                         preferred_element_type=jnp.float32,
@@ -877,7 +955,58 @@ def allreduce_body(kctx):
         for r in range(n):
             acc = acc + kctx.cbuf[r]
         kctx.x[...] = acc
-        dl.barrier_all(axis)
+        _barrier(kctx)
+
+    return body
+
+
+@register_task(TaskType.AR_SEND)
+def ar_send_body(kctx):
+    """First half of the split allreduce (``MegaConfig.overlap_ar``):
+    stage this rank's GEMM partial into the workspace and START the
+    remote puts — non-blocking, so the ICI transfer proceeds while the
+    following grid iterations run. Parity: the gemm_ar ONE_SHOT
+    producer's per-tile notify pipelining
+    (``ops/overlap/gemm_ar.py::_gemm_ar_one_shot_kernel`` ``_produce``),
+    adapted to the sequential megakernel grid — the payload here is the
+    whole [B, d] partial (decode batches are tiny; the overlap lever is
+    WHEN the put starts, not tiling it)."""
+
+    def body():
+        me = jax.lax.axis_index(kctx.axis)
+        h = kctx.h[...]
+        kctx.arsrc[...] = h
+        kctx.cbuf[me] = h
+        for dma in _ar_put_dmas(kctx):
+            dma.start()
+
+    return body
+
+
+@register_task(TaskType.AR_WAIT)
+def ar_wait_body(kctx):
+    """Second half of the split allreduce: fire the NEXT weight
+    stream's tile-0 DMA (the overlap window — the ICI hop from AR_SEND
+    hides under that HBM traffic), then wait the inbound partials,
+    fold ``x += sum(partials)``, drain the sends, and barrier so the
+    workspace slots are reusable by the next exchange (the gemm_ar
+    ONE_SHOT ``_reduce``/``_drain`` phases)."""
+
+    def body():
+        nr = kctx.dims.n_ranks
+        if kctx.cfg.cross_prefetch:
+            # Needs the cross_prefetch handshake (the consuming stream
+            # must skip its own tile-0 start); without it the split
+            # still moves the puts off the critical path.
+            fire_next_tile0(kctx)
+        _ar_wait_recvs(kctx)
+        acc = kctx.x[...]
+        for r in range(nr):
+            acc = acc + kctx.cbuf[r]
+        kctx.x[...] = acc
+        for dma in _ar_put_dmas(kctx):
+            dma.wait_send()
+        _barrier(kctx)
 
     return body
 
@@ -988,7 +1117,7 @@ def lm_head_body(kctx):
                 # Slot reuse fence: the next step's exchange (or
                 # allreduce) must not land before every rank has read
                 # this round's candidates.
-                dl.barrier_all(kctx.axis)
+                _barrier(kctx)
 
             row = jnp.concatenate(
                 [besti[b:b + 1, :] for b in range(B)], axis=1
@@ -1013,6 +1142,6 @@ def lm_head_body(kctx):
 @register_task(TaskType.BARRIER)
 def barrier_body(kctx):
     def body():
-        dl.barrier_all(kctx.axis)
+        _barrier(kctx)
 
     return body

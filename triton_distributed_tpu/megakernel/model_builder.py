@@ -118,6 +118,16 @@ class ModelBuilder:
     def make_allreduce(self, layer: int = 0, **kw) -> int:
         # Kept even for n_ranks == 1: the body also folds the residual
         # (x += h), degenerating to a plain add with zero remote puts.
+        # Under ``cfg.overlap_ar`` (and only with real peers) the
+        # exchange splits into AR_SEND (remote puts start the moment
+        # the producing GEMM finished) + AR_WAIT (reduction waits only
+        # after firing the next weight stream's tile-0 DMA) — the
+        # gemm_ar ONE_SHOT overlap adapted to the sequential grid; the
+        # n_ranks guard lives HERE so no graph builder pays two task
+        # iterations for a single-rank exchange with nothing to hide.
+        if self.cfg.overlap_ar and self.dims.n_ranks > 1:
+            self._add(TaskType.AR_SEND, layer, **kw)
+            return self._add(TaskType.AR_WAIT, layer)
         return self._add(TaskType.ALLREDUCE, layer, **kw)
 
     def make_lm_head(self, **kw) -> int:

@@ -117,7 +117,10 @@ class MegaQwen3:
         self.policy = policy
         self._jit: dict = {}
 
-    def _dims(self, batch: int, s_max: int, page: int = 0) -> MegaDims:
+    def _dims(
+        self, batch: int, s_max: int, page: int = 0,
+        kv_quant: bool = False, num_pages: int = 0,
+    ) -> MegaDims:
         m = self.model
         c = m.cfg
         n = m.ctx.axis_size(m.axis)
@@ -143,15 +146,35 @@ class MegaQwen3:
             rms_eps=c.rms_eps,
             rope_theta=c.rope_theta,
             page=page,
+            kv_quant=kv_quant,
+            num_pages=num_pages,
         )
 
-    def build(self, batch: int, s_max: int, page: int = 0):
+    @staticmethod
+    def _scale_args(cache: PagedKVCache, kv_quant: bool):
+        """The trailing scale operands of a quantized pool call:
+        ``[L, P, H]`` scale planes reshaped to ``[L, P, 1, H]`` so the
+        kernel's dynamic layer/page indices stay on untiled leading
+        dims (the norm-weight layout trick)."""
+        if not kv_quant:
+            return ()
+        return (
+            cache.k_scale[:, :, None, :], cache.v_scale[:, :, None, :]
+        )
+
+    def build(
+        self, batch: int, s_max: int, page: int = 0,
+        kv_quant: bool = False, num_pages: int = 0,
+    ):
         """Build + schedule the task graph and jit the SPMD step
         (parity: ``Qwen3Model.build_fwd`` + ``compile``). ``page`` > 0
         builds the paged-cache variant (KV read through the page table,
-        attention block size = page size)."""
+        attention block size = page size); ``kv_quant`` reads an int8
+        pool through its per-page scales (dequant in-kernel, appends
+        through the quantized_row_scatter protocol — full-width KV
+        never materializes)."""
         m = self.model
-        dims = self._dims(batch, s_max, page)
+        dims = self._dims(batch, s_max, page, kv_quant, num_pages)
         # (s_blk == page is enforced by MegaConfig.resolve when
         # dims.page is set — single owner of that invariant.)
         mb = ModelBuilder(
@@ -172,14 +195,17 @@ class MegaQwen3:
                 logits, k_rows, v_rows, _toks = per_shard(
                     cache.kv_len, tokens, cache.page_table,
                     *kernel_args(params), cache.k_pages, cache.v_pages,
+                    *self._scale_args(cache, kv_quant),
                 )
                 # Page-table append of the new rows [L, B, hkv, hd]
                 # (the kernel never writes the pool — same reasoning as
                 # the dense path below; [0] drops the step dim of the
-                # single-step build).
+                # single-step build). On a quantized pool, append runs
+                # the ONE scale-protocol implementation
+                # (quantized_row_scatter: offset-0 reset, grow+requant).
                 return logits, _paged.append(cache, k_rows[0], v_rows[0])
 
-            specs = paged_cache_specs(ax)
+            specs = paged_cache_specs(ax, quantized=kv_quant)
         else:
             def shard_fn(params: Qwen3Params, tokens, cache: KVCache):
                 logits, k_rows, v_rows, _toks = per_shard(
@@ -354,8 +380,9 @@ class MegaQwen3:
             lp.attn.q_norm[:, None, :], lp.attn.k_norm[:, None, :],
         )
 
-    def _built(self, batch: int, s_max: int, page: int = 0):
-        key = (batch, s_max, page)
+    def _built(self, batch: int, s_max: int, page: int = 0,
+               kv_quant: bool = False, num_pages: int = 0):
+        key = (batch, s_max, page, kv_quant, num_pages)
         if key not in self._jit:
             self._jit[key] = self.build(*key)
         return self._jit[key]
@@ -364,12 +391,17 @@ class MegaQwen3:
         """One decode step for the whole batch: ``tokens [B] int32 →
         (logits [B, V] f32, cache)`` — the megakernel rung of the decode
         ladder. Accepts a dense :class:`KVCache` or a
-        :class:`PagedKVCache` (pool read through the page table)."""
+        :class:`PagedKVCache` (pool read through the page table —
+        int8-quantized pools dequantize in-kernel via their per-page
+        scales)."""
         b = int(tokens.shape[0])
         if isinstance(cache, PagedKVCache):
             page = int(cache.k_pages.shape[3])
             s_max = int(cache.page_table.shape[1]) * page
-            step = self._built(b, s_max, page)[1]
+            step = self._built(
+                b, s_max, page, cache.quantized,
+                int(cache.k_pages.shape[1]),
+            )[1]
         else:
             step = self._built(b, int(cache.k.shape[3]))[1]
         return step(self._step_params(), tokens, cache)
@@ -381,17 +413,20 @@ class MegaQwen3:
             return self.quantized_params()
         return self.model.params
 
-    def decode_fn(self, batch: int, s_max: int, page: int = 0):
+    def decode_fn(self, batch: int, s_max: int, page: int = 0,
+                  kv_quant: bool = False, num_pages: int = 0):
         """The raw (unjitted) step ``f(params, tokens, cache) →
         (logits, cache)`` — same contract as ``Qwen3.decode_fn``, so
         callers can chain steps inside one jit (``lax.fori_loop`` greedy
         decode) instead of dispatching per step."""
-        return self._built(batch, s_max, page)[2]
+        return self._built(batch, s_max, page, kv_quant, num_pages)[2]
 
     # -- multi-step greedy decode ----------------------------------------
     def build_multi(
         self, batch: int, s_max: int, nsteps: int, sampled: bool = False,
         page: int = 0, straggler_rank: int | None = None,
+        kv_quant: bool = False, num_pages: int = 0,
+        valid_arg: bool = False,
     ):
         """``nsteps`` greedy decode steps in ONE kernel launch.
 
@@ -413,8 +448,13 @@ class MegaQwen3:
 
         ``page`` > 0 builds the paged-cache variant (pool reads through
         the page table; all ``nsteps`` new rows land with ONE scatter
-        via :func:`paged_kv_cache.append_n`). Sampled+paged is not
-        combined.
+        via :func:`paged_kv_cache.append_n`). ``sampled`` composes with
+        ``page`` (the serving fast path: Gumbel-noise sampling over the
+        paged pool), and ``kv_quant`` reads an int8 pool through its
+        per-page scales — the in-launch attention band keeps the
+        launch's own rows at full precision (they are quantized once,
+        by the trailing ``append_n`` scatter; docs/megakernel.md
+        "Serving fast path").
 
         Caller contract: ``kv_len[b] + nsteps <= s_max`` for every row
         — the dense append is a ``dynamic_update_slice``, whose clamped
@@ -423,7 +463,7 @@ class MegaQwen3:
         """
         m = self.model
         V = m.cfg.vocab_size
-        base = self._dims(batch, s_max, page)
+        base = self._dims(batch, s_max, page, kv_quant, num_pages)
         dims = dataclasses.replace(
             base, nsteps=nsteps, v_real=V, sampled=sampled,
             straggler_rank=straggler_rank,
@@ -441,21 +481,30 @@ class MegaQwen3:
 
         if page:
             def shard_fn(params: Qwen3Params, tokens,
-                         cache: PagedKVCache, *noise):
+                         cache: PagedKVCache, *extra):
+                if valid_arg:  # serving: per-slot kept-row counts first
+                    n_valid, *noise = extra
+                else:
+                    n_valid, noise = None, extra
                 logits, k_rows, v_rows, toks = per_shard(
                     cache.kv_len, tokens, cache.page_table, *noise,
                     *kernel_args(params), cache.k_pages, cache.v_pages,
+                    *self._scale_args(cache, kv_quant),
                 )
                 # k_rows [NS, L, B, hkv, hd] → [L, B, hkv, NS, hd]:
-                # one scatter lands all nsteps rows in the pool.
+                # one scatter lands all nsteps rows in the pool (int8
+                # pools quantize them here, through append_n's
+                # quantized_row_scatter protocol; guaranteed-overshoot
+                # rows of finishing slots route to the trash page so
+                # retiring pages' scales never cover garbage).
                 k_rows = jnp.transpose(k_rows, (1, 2, 3, 0, 4))
                 v_rows = jnp.transpose(v_rows, (1, 2, 3, 0, 4))
                 return (
                     toks[:, 0, :], logits,
-                    _paged.append_n(cache, k_rows, v_rows),
+                    _paged.append_n(cache, k_rows, v_rows, n_valid),
                 )
 
-            specs = paged_cache_specs(ax)
+            specs = paged_cache_specs(ax, quantized=kv_quant)
         else:
             def shard_fn(params: Qwen3Params, tokens, cache: KVCache,
                          *noise):
@@ -484,15 +533,18 @@ class MegaQwen3:
 
             specs = cache_specs(ax)
 
-        noise_specs = (P(None, None, ax),) if sampled else ()
+        if valid_arg and not page:
+            raise ValueError("valid_arg rides the paged append only")
+        extra_specs = (P(),) if valid_arg else ()
+        extra_specs += (P(None, None, ax),) if sampled else ()
         g = m.ctx.shard_map(
             shard_fn,
-            in_specs=(pspecs, P(), specs, *noise_specs),
+            in_specs=(pspecs, P(), specs, *extra_specs),
             out_specs=(P(), P(None, ax), specs),
         )
 
-        def f(params, tokens, cache, *noise):
-            toks, logits, cache = g(params, tokens, cache, *noise)
+        def f(params, tokens, cache, *extra):
+            toks, logits, cache = g(params, tokens, cache, *extra)
             # toks [nsteps, B]; logits are the LAST step's (pad cols
             # dropped as in the single-step path).
             return toks, logits[:, :V], cache
@@ -504,19 +556,27 @@ class MegaQwen3:
 
     def decode_multi_fn(
         self, batch: int, s_max: int, nsteps: int, sampled: bool = False,
-        page: int = 0,
+        page: int = 0, kv_quant: bool = False, num_pages: int = 0,
+        valid_arg: bool = False,
     ):
-        """Jitted multi-step fn ``f(params, tokens, cache[, noise]) →
-        (tokens [nsteps, B], last_logits [B, V], cache advanced
-        nsteps)``; the cache argument is DONATED. With ``sampled``,
-        ``noise [nsteps, B, V_pad]`` f32 perturbs the in-kernel argmax
-        (Gumbel-max sampling); ``page`` > 0 takes a
-        :class:`PagedKVCache`. Cached per (batch, s_max, nsteps,
-        sampled, page)."""
-        key = ("multi", batch, s_max, nsteps, sampled, page)
+        """Jitted multi-step fn ``f(params, tokens, cache[, n_valid]
+        [, noise]) → (tokens [nsteps, B], last_logits [B, V], cache
+        advanced nsteps)``; the cache argument is DONATED. With
+        ``sampled``, ``noise [nsteps, B, V_pad]`` f32 perturbs the
+        in-kernel argmax (Gumbel-max sampling — per-slot temperatures
+        ride in the noise magnitudes); ``page`` > 0 takes a
+        :class:`PagedKVCache`, and ``kv_quant`` an int8 pool (both
+        compose with ``sampled``). ``valid_arg`` adds the serving
+        loop's ``n_valid [B]`` kept-row counts (guaranteed-overshoot
+        rows route to the trash page — see ``append_n``). Cached per
+        the full option tuple."""
+        key = ("multi", batch, s_max, nsteps, sampled, page, kv_quant,
+               num_pages, valid_arg)
         if key not in self._jit:
             self._jit[key] = self.build_multi(
-                batch, s_max, nsteps, sampled, page
+                batch, s_max, nsteps, sampled, page,
+                kv_quant=kv_quant, num_pages=num_pages,
+                valid_arg=valid_arg,
             )
         return self._jit[key]
 

@@ -48,12 +48,25 @@ class TaskType(enum.IntEnum):
     BARRIER = 9      # standalone cross-chip barrier (stress/test fixture)
     ATTN_PREFILL = 10  # causal self-attn over the S token rows + K/V out
     LOAD_X = 11      # x ← x0 input (prefill: embedding arrives via XLA)
+    # Split allreduce (``MegaConfig.overlap_ar``): the producing GEMM's
+    # partial is pushed to every peer's workspace slot the moment it is
+    # ready (AR_SEND — non-blocking remote puts), and the reduction
+    # waits for the inbound partials only AFTER starting the NEXT weight
+    # stream's first tile DMA (AR_WAIT) — the megakernel adaptation of
+    # the gemm_ar ONE_SHOT overlap (ops/overlap/gemm_ar.py): comm flies
+    # under the next task's HBM traffic instead of serializing after
+    # the GEMM.
+    AR_SEND = 12     # start remote puts of h into peers' cbuf slots
+    AR_WAIT = 13     # prefetch next tile-0, wait partials, x += sum
 
 
 # Resource class used by the zig-zag scheduler: tasks whose cost is
 # dominated by the MXU vs by DMA/ICI traffic (parity role: the
 # reference's compute/comm SM partitioning heuristics).
-COMM_TASKS = frozenset({TaskType.ALLREDUCE, TaskType.BARRIER, TaskType.EMBED})
+COMM_TASKS = frozenset({
+    TaskType.ALLREDUCE, TaskType.BARRIER, TaskType.EMBED,
+    TaskType.AR_SEND, TaskType.AR_WAIT,
+})
 
 
 @dataclasses.dataclass(frozen=True)

@@ -250,11 +250,21 @@ class ContinuousEngine(MegaDispatch):
         self.top_k = top_k
         # Speculative decoding (docs/serving.md): per-slot n-gram
         # drafts verified through the chunk-prefill path; rounds with
-        # no draft anywhere fall back to the batched decode step.
+        # no draft anywhere fall back to the batched decode step. The
+        # ONE remaining mega exclusion (docs/megakernel.md "Serving
+        # fast path" composition matrix): speculation's chunked
+        # verify+rollback steps slots at different paces, while a mega
+        # launch advances every slot NS tokens in lockstep — and the
+        # NS-amortized launch already buys the dispatch saving
+        # speculation would chase.
         if speculative and mode == "mega":
             raise ValueError(
-                "speculative=K composes with mode='xla'/'pallas', not "
-                "the megakernel"
+                "speculative=K does not compose with mode='mega': the "
+                "NS-step fused launch advances all slots in lockstep "
+                "and already amortizes per-step dispatch; run "
+                "speculative with mode='xla'/'pallas', or drop "
+                "speculative to serve through the megakernel "
+                "(docs/megakernel.md)"
             )
         self.speculative = int(speculative)
         # Quantized KV storage (docs/serving.md "Quantized KV cache"):
@@ -262,15 +272,12 @@ class ContinuousEngine(MegaDispatch):
         # decode step streams AND doubles how many tokens the same pool
         # HBM holds, so the radix tree retains more prefixes and more
         # slots admit before shedding. Explicit knob wins over
-        # ``cfg.kv_dtype``.
+        # ``cfg.kv_dtype``. Composes with mode='mega': the fused decode
+        # dequantizes the int8 pool in-kernel through the per-page
+        # scales (PR 7 lifted the old full-width exclusion).
         self.kv_dtype = kv_dtype if kv_dtype is not None else (
             model.cfg.kv_dtype
         )
-        if self.kv_dtype is not None and mode == "mega":
-            raise ValueError(
-                "kv_dtype composes with mode='xla'/'pallas', not the "
-                "megakernel (its fused decode reads the pool full-width)"
-            )
         self.eos_id = eos_id
         self.key = jax.random.key(seed)
         self.max_batch = max_batch
@@ -302,7 +309,10 @@ class ContinuousEngine(MegaDispatch):
         self._dense1 = None if prefix_cache else model.new_cache(
             1, self.max_length
         )
-        self._multi_fn = None  # lazy megakernel multi-step program
+        # Lazy megakernel multi-step programs, keyed by whether the
+        # launch samples (greedy rounds must not consume PRNG keys, or
+        # temperature=0 runs would lose their seeded determinism).
+        self._multi_fns: dict = {}
         self.stats = self._zero_stats()
         # Metric handles resolved ONCE: the hot decode loop pays a dict
         # lookup + inc per _bump, not a registry get-or-create.
@@ -320,6 +330,18 @@ class ContinuousEngine(MegaDispatch):
         # into the never-GC'd process registry (docs/observability.md).
         self._free_pages_gauge = obs_metrics.gauge(
             "tdt_engine_free_pages", "Pool pages on the free list."
+        )
+        # NS-amortization gauge: decode steps emitted per mega launch
+        # over the current run — NS while every round launches fused,
+        # sagging toward 1 as tail/filter fallbacks mix in.
+        # Last-write-wins and UNLABELED like _free_pages_gauge above
+        # (same rationale): one engine per serving process is the
+        # deployment shape; an in-process replica fleet's scrape shows
+        # the last replica to launch, and the per-replica truth rides
+        # the stats verb's per-replica `mega_launches` counters.
+        self._ns_gauge = obs_metrics.gauge(
+            "tdt_mega_ns_amortization",
+            "Decode steps per megakernel launch (current run).",
         )
         ContinuousEngine._live.add(self)
 
@@ -344,6 +366,10 @@ class ContinuousEngine(MegaDispatch):
             "deadline_expired": 0,
             "nonfinite_logits": 0,
             "decode_faults": 0,
+            # Megakernel fast-path ledger (mode="mega" only): fused
+            # NS-step launches vs single-step fallback rounds.
+            "mega_launches": 0,
+            "mega_fallback_steps": 0,
         }
 
     @property
@@ -982,30 +1008,107 @@ class ContinuousEngine(MegaDispatch):
             if not ok or len(drafted) < n_active:
                 changed = self._decode_once() or changed
             return changed
-        # Megakernel greedy serving decodes in NS-step chunks: one
-        # launch emits NS tokens per slot (in-kernel argmax), then the
-        # host checks eos/gen_len. A finished row's overshoot tokens
-        # are discarded; its overshoot KV rows land beyond its
-        # allocated pages, where the zeroed table entries route them to
-        # the trash page. Rows near max_length fall back to single
-        # steps for the tail.
-        if (self.mode == "mega" and self.temperature <= 0.0
-                and kv_high + self.NS <= self.max_length):
-            if self._multi_fn is None:
-                self._multi_fn = self._mega_model().decode_multi_fn(
-                    self.max_batch, self.max_length, self.NS,
-                    page=self.page_size,
-                )
-            toks, _logits, self.cache = self._multi_fn(
-                # Q8Params under MegaConfig(wq8=True), else params.
-                self._mega_model()._step_params(),
-                jnp.asarray(self._tok), self.cache,
-            )
-            self._kv_len += self.NS * active
-            self._bump("decode_steps", self.NS)
-            toks_np = np.asarray(toks)  # [NS, max_batch]
-            return self._process(lambda slot: toks_np[:, slot])
+        # Megakernel serving decodes in NS-step chunks: one launch
+        # emits NS tokens per slot (in-kernel argmax — Gumbel-perturbed
+        # per slot when sampling), then the host checks eos/gen_len. A
+        # finished row's overshoot tokens are discarded; its overshoot
+        # KV rows land beyond its allocated pages, where the zeroed
+        # table entries route them to the trash page. Rounds that don't
+        # compose (rows near max_length, slots needing top-k/top-p
+        # filtering) fall back to single steps.
+        if self.mode == "mega":
+            changed = self._mega_round(active, kv_high)
+            if changed is not None:
+                return changed
+            self._bump("mega_fallback_steps")
         return self._decode_once()
+
+    def _mega_round(self, active: np.ndarray, kv_high: int):
+        """One NS-step megakernel launch, or None when this round must
+        use the single-step fallback: a row within NS of ``max_length``
+        (the append would overwrite cached rows past capacity), or an
+        active slot sampling with top-k/top-p (the in-kernel Gumbel
+        argmax draws the unfiltered temperature distribution; filtered
+        slots sample host-side from full logits). Mixed greedy/sampled
+        batches launch fused: per-slot temperatures scale the noise, a
+        zero temperature zeroes it — exactly the greedy argmax."""
+        if kv_high + self.NS > self.max_length:
+            return None
+        temps = np.zeros(self.max_batch, np.float32)
+        # Kept-row counts: a slot finishing mid-launch (gen_len bound,
+        # known NOW) emits guaranteed-overshoot rows — routed to the
+        # trash page by the append so a retiring page's int8 scale
+        # never covers garbage (append_n docstring).
+        n_valid = np.zeros(self.max_batch, np.int32)
+        for slot, req in enumerate(self._slots):
+            if req is None:
+                continue
+            t, p, k = self._request_sampling(req)
+            if t > 0.0 and (k > 0 or p < 1.0):
+                return None
+            temps[slot] = max(t, 0.0)
+            n_valid[slot] = min(req.gen_len - len(req.out), self.NS)
+        sampled = bool((temps > 0.0).any())
+        fn = self._mega_multi_fn(sampled)
+        params = self._mega_model()._step_params()  # Q8Params under wq8
+        args = (params, jnp.asarray(self._tok), self.cache,
+                jnp.asarray(n_valid))
+        if sampled:
+            self.key, sub = jax.random.split(self.key)
+            toks, _logits, self.cache = fn(*args, sub, jnp.asarray(temps))
+        else:
+            toks, _logits, self.cache = fn(*args)
+        self._kv_len += self.NS * active
+        self._bump("decode_steps", self.NS)
+        self._bump("mega_launches")
+        self._ns_gauge.set(
+            self.stats["decode_steps"] / max(self.stats["mega_launches"], 1)
+        )
+        obs_events.emit(
+            "mega:launch", ns=self.NS, active=int(active.sum()),
+            sampled=int(sampled),
+        )
+        toks_np = np.asarray(toks)  # [NS, max_batch]
+        return self._process(lambda slot: toks_np[:, slot])
+
+    def _mega_multi_fn(self, sampled: bool):
+        """The NS-step launch program (built lazily, cached per
+        ``sampled``). The sampled wrapper draws the Gumbel noise INSIDE
+        the jit — per-sub-step key splits, per-slot temperature scaling
+        — so each rank materializes only its vocab shard and the
+        kernel's argmax over ``logits + T_b·gumbel`` IS per-slot
+        temperature sampling (the Gumbel-max trick, distribution-equal
+        to ``sampling.sample`` at ``top_p=1, top_k=0``)."""
+        fn = self._multi_fns.get(sampled)
+        if fn is not None:
+            return fn
+        mega = self._mega_model()
+        base = mega.decode_multi_fn(
+            self.max_batch, self.max_length, self.NS, sampled=sampled,
+            page=self.page_size, kv_quant=self.kv_dtype is not None,
+            num_pages=int(self.cache.k_pages.shape[1]),
+            valid_arg=True,
+        )
+        if sampled:
+            NS, B = self.NS, self.max_batch
+            n = self.model.ctx.axis_size(self.model.axis)
+            v_pad = mega._dims(B, self.max_length).v_loc * n
+
+            def wrapped(params, tok, cache, n_valid, key, temps):
+                keys = jax.random.split(key, NS)
+                noise = jax.vmap(
+                    lambda k: jax.random.gumbel(
+                        k, (B, v_pad), jnp.float32
+                    )
+                )(keys)
+                return base(params, tok, cache, n_valid,
+                            noise * temps[None, :, None])
+
+            fn = jax.jit(wrapped, donate_argnums=(2,))
+        else:
+            fn = base
+        self._multi_fns[sampled] = fn
+        return fn
 
     def run(self, requests, *, results: bool = False):
         """Serve requests to completion with per-request error

@@ -124,8 +124,23 @@ class MegaDispatch:
     def _mega_model(self):
         if self._mega is None:
             from triton_distributed_tpu.megakernel import MegaQwen3
+            from triton_distributed_tpu.megakernel.code_generator import (
+                MegaConfig,
+            )
 
-            self._mega = MegaQwen3(self.model, cfg=self.mega_cfg)
+            cfg = self.mega_cfg
+            if cfg is None:
+                # Serving default (docs/megakernel.md "Serving fast
+                # path"): fused norms + cross-task tile-0 prefetch +
+                # split send-early/wait-late allreduces. All three are
+                # token-exact vs the plain build (tested individually
+                # and composed); overlap_ar only pays with
+                # cross_prefetch on and the weight stream directly
+                # after AR_WAIT, which is what fuse_norms arranges.
+                cfg = MegaConfig(
+                    fuse_norms=True, cross_prefetch=True, overlap_ar=True
+                )
+            self._mega = MegaQwen3(self.model, cfg=cfg)
         return self._mega
 
     def _decode_step(self, tok, cache):
@@ -180,18 +195,14 @@ class Engine(MegaDispatch):
         self.kv_dtype = kv_dtype if kv_dtype is not None else (
             model.cfg.kv_dtype
         )
-        if self.kv_dtype is not None:
-            if not paged:
-                raise ValueError(
-                    "kv_dtype requires paged=True (scales live on the "
-                    "page pool; the dense cache has no pages)"
-                )
-            if mode == "mega":
-                raise ValueError(
-                    "kv_dtype composes with mode='xla'/'pallas', not "
-                    "the megakernel (its fused decode reads the pool "
-                    "full-width)"
-                )
+        # kv_dtype composes with every mode incl. 'mega' (the fused
+        # decode dequantizes the int8 pool in-kernel via its per-page
+        # scales — docs/megakernel.md "Serving fast path").
+        if self.kv_dtype is not None and not paged:
+            raise ValueError(
+                "kv_dtype requires paged=True (scales live on the "
+                "page pool; the dense cache has no pages)"
+            )
         # Prefix-cache mode (requires paged): pool + cache + radix tree
         # persist ACROSS serve() calls, finished rows retire their pages
         # into the tree, and later calls prefill only uncached suffixes
@@ -423,17 +434,14 @@ class Engine(MegaDispatch):
         kv_high = int(true_lens.max())
         # Sampling composes with multi-step via the Gumbel-max trick
         # (argmax over logits + T*gumbel == categorical(logits/T)) as
-        # long as no top-p/top-k filter truncates the distribution.
-        # Sampled+paged is the one uncovered combination.
+        # long as no top-p/top-k filter truncates the distribution —
+        # paged and int8 pools included (the serving fast path).
         sampled = self.temperature > 0.0
         multi_launches = 0
         if (
             self.mode == "mega"
             and not self.speculative
-            and (
-                not sampled
-                or (self.top_p >= 1.0 and self.top_k == 0 and not self.paged)
-            )
+            and (not sampled or (self.top_p >= 1.0 and self.top_k == 0))
         ):
             multi_launches = min(
                 (gen_len - 1) // NS, max(s_max - kv_high, 0) // NS
@@ -455,9 +463,14 @@ class Engine(MegaDispatch):
                 # through the single-step kernel rather than paying a
                 # full extra megakernel build per distinct tail length.
                 v_pad = self.model.params.lm_head.shape[1]
+                quant = self.paged and self.kv_dtype is not None
                 base_fn = self._mega_model().decode_multi_fn(
                     b, s_max, NS, sampled=sampled,
                     page=self.page_size if self.paged else 0,
+                    kv_quant=quant,
+                    num_pages=(
+                        int(cache.k_pages.shape[1]) if self.paged else 0
+                    ),
                 )
                 if sampled:
                     # Draw the Gumbel noise INSIDE the jit so each rank
@@ -466,7 +479,7 @@ class Engine(MegaDispatch):
                     # array to one device and reshard it every launch.
                     # Cached per shape: a fresh closure per serve()
                     # would retrace + recompile the megakernel program.
-                    wkey = (b, s_max, NS)
+                    wkey = (b, s_max, NS, self.paged, quant)
                     fn = self._sampled_multi.get(wkey)
                     if fn is None:
                         def fn(params, tok, cache, key, temp):

@@ -587,6 +587,7 @@ def append_n(
     cache: PagedKVCache,
     k_new: jax.Array,  # [L, B, Hkv_loc, NS, hd] — NS tokens per sequence
     v_new: jax.Array,
+    n_valid: jax.Array | None = None,  # [B] i32 — rows kept per sequence
 ) -> PagedKVCache:
     """Append ``NS`` tokens per sequence at ``kv_len`` in ONE scatter.
 
@@ -596,6 +597,18 @@ def append_n(
     per pool handles all (b, step) rows — page-boundary crossings fall
     out of the per-row (page_id, offset) computation.
 
+    ``n_valid[b]`` (None → NS) routes row's ``>= n_valid[b]`` to the
+    trash page instead of the sequence's own pages: a serving launch
+    whose row finishes mid-launch emits guaranteed-overshoot rows
+    (``gen_len`` bound, known at launch time), and on an int8 pool
+    those rows would otherwise GROW the final page's scale before the
+    page retires into the radix tree — quantization noise paid by
+    every later request reusing that prefix. Trash-routed rows are
+    discarded either way; this keeps retired pages' scales covering
+    real rows only. (eos can still finish earlier than ``gen_len``;
+    those rare rows land in owned pages as ordinary
+    garbage-beyond-kv_len, same as the single-step path.)
+
     Caller contract: ``kv_len[b] + NS`` stays within the page table's
     capacity for every row.
     """
@@ -603,6 +616,9 @@ def append_n(
     L, B, H, NS, hd = k_new.shape
     pos = cache.kv_len[:, None] + jnp.arange(NS, dtype=jnp.int32)[None]
     pids = jnp.take_along_axis(cache.page_table, pos // page, axis=1)
+    if n_valid is not None:
+        step = jnp.arange(NS, dtype=jnp.int32)[None]
+        pids = jnp.where(step < n_valid[:, None], pids, 0)
     flat_p = pids.reshape(-1)        # [B*NS]
     flat_o = (pos % page).reshape(-1)
 
@@ -615,17 +631,39 @@ def append_n(
         upd = new.transpose(1, 3, 0, 2, 4).reshape(B * NS, L, H, hd)
         return pages.at[:, flat_p, :, flat_o, :].set(upd.astype(pages.dtype))
 
-    def write_q(pages, scales, new):
+    if cache.quantized:
         # Quantized append: ONE scale-protocol implementation
         # (:func:`quantized_row_scatter` — reset at offset 0, grow +
-        # requant otherwise), vmapped over the layer axis with the
-        # (page, offset) routing shared across layers.
-        rows = new.transpose(0, 1, 3, 2, 4).reshape(L, B * NS, H, hd)
-        return _row_scatter_layers(pages, scales, rows, flat_p, flat_o)
+        # requant otherwise), vmapped over the layer axis and applied
+        # STEP BY STEP (lax.fori over NS, one C=B scatter per step)
+        # rather than as one B·NS-row batch: a batch scatter would grow
+        # each touched page's scale ONCE to cover all NS rows, while
+        # the single-step serving path grows it row-by-row with a
+        # requant at each growth — a different rounding-event order
+        # that leaves different codes behind. Serving shares retired
+        # pages across requests through the radix tree, so the fused
+        # NS-launch must leave the pool BIT-IDENTICAL to NS unfused
+        # steps over the same tokens; sequencing the scatters inside
+        # the one program keeps that while still dispatching once.
+        pids_q = pids  # [B, NS] (trash-routed where n_valid caps)
+        offs_q = pos % page
 
-    if cache.quantized:
-        k_pages, k_scale = write_q(cache.k_pages, cache.k_scale, k_new)
-        v_pages, v_scale = write_q(cache.v_pages, cache.v_scale, v_new)
+        def step_scatter(s, carry):
+            kp, ks, vp, vs = carry
+            rows_k = jax.lax.dynamic_index_in_dim(k_new, s, axis=3)[
+                :, :, :, 0, :]  # [L, B, H, hd]
+            rows_v = jax.lax.dynamic_index_in_dim(v_new, s, axis=3)[
+                :, :, :, 0, :]
+            p_s = jax.lax.dynamic_index_in_dim(pids_q, s, axis=1)[:, 0]
+            o_s = jax.lax.dynamic_index_in_dim(offs_q, s, axis=1)[:, 0]
+            kp, ks = _row_scatter_layers(kp, ks, rows_k, p_s, o_s)
+            vp, vs = _row_scatter_layers(vp, vs, rows_v, p_s, o_s)
+            return kp, ks, vp, vs
+
+        k_pages, k_scale, v_pages, v_scale = jax.lax.fori_loop(
+            0, NS, step_scatter,
+            (cache.k_pages, cache.k_scale, cache.v_pages, cache.v_scale),
+        )
         return PagedKVCache(
             k_pages=k_pages, v_pages=v_pages,
             page_table=cache.page_table, kv_len=cache.kv_len + NS,

@@ -80,4 +80,12 @@ STAT_METRICS = {
     "decode_faults": ("tdt_engine_decode_faults_total",
                       "Exceptions isolated by the decode-phase step "
                       "guard."),
+    # Megakernel serving fast path (docs/megakernel.md "Serving fast
+    # path"): NS-step fused launches vs the rounds that had to fall
+    # back to single-step decode (max_length tail, top-k/top-p slots).
+    "mega_launches": ("tdt_mega_launches_total",
+                      "Megakernel NS-step decode launches."),
+    "mega_fallback_steps": ("tdt_mega_single_step_fallbacks_total",
+                            "Mega-mode rounds served by the single-step "
+                            "fallback (tail or filtered sampling)."),
 }

@@ -72,5 +72,59 @@ def _install() -> None:
 
         pltpu.InterpretParams = _interpret_params
 
+    _install_dma_discharge_shim()
+
+
+def _install_dma_discharge_shim() -> None:
+    """0.4.x interpret-mode fix: remote-DMA discharge with a mesh-dict
+    ``device_id``.
+
+    Every remote copy in this package names its target as
+    ``device_id={axis: dst}`` with ``DeviceIdType.MESH`` — the form
+    Mosaic lowers on real TPU. The 0.4.x interpret path discharges
+    ``dma_start`` by all-gathering the target ids and comparing against
+    the local axis index (``dma_start_discharge_rule``), but it feeds
+    the DICT straight into ``all_gather(...) == my_axis`` and dies with
+    ``tracer == dict`` — so every kernel with an in-kernel collective
+    (the megakernel allreduce, put_signal rings) fails under the CPU
+    simulator mesh. For a single-axis mesh the dict carries exactly one
+    scalar; unwrapping it to that scalar before the stock rule runs is
+    semantically identical (the rule's own ``jax.Array`` branch) and
+    leaf-count-preserving, so the returned new-values line up with the
+    eqn invars unchanged. Newer JAX (which replaced this rule) keeps
+    its own behavior — the wrap only installs when the stock rule both
+    exists and exhibits the bug (probed structurally by version)."""
+    if not jax.__version__.startswith("0.4."):
+        return
+    try:
+        from jax import tree_util as _tu
+        from jax._src.pallas.mosaic import primitives as _pmp
+        from jax._src.state import discharge as _sd
+    except ImportError:  # pragma: no cover - layout differs → leave be
+        return
+    orig = _sd._discharge_rules.get(_pmp.dma_start_p)
+    if orig is None or getattr(orig, "_tdt_dict_device_id_shim", False):
+        return
+
+    def rule(in_avals, out_avals, *args, tree, device_id_type):
+        vals = list(_tu.tree_unflatten(tree, args))
+        dev = vals[-1]
+        if isinstance(dev, dict) and len(dev) == 1:
+            vals[-1] = next(iter(dev.values()))
+            new_args, new_tree = _tu.tree_flatten(tuple(vals))
+            avals = list(_tu.tree_unflatten(tree, in_avals))
+            avals[-1] = next(iter(avals[-1].values()))
+            return orig(
+                _tu.tree_leaves(tuple(avals)), out_avals, *new_args,
+                tree=new_tree, device_id_type=device_id_type,
+            )
+        return orig(
+            in_avals, out_avals, *args, tree=tree,
+            device_id_type=device_id_type,
+        )
+
+    rule._tdt_dict_device_id_shim = True
+    _sd._discharge_rules[_pmp.dma_start_p] = rule
+
 
 _install()

@@ -31,6 +31,16 @@ def main(argv=None) -> int:
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--mode", default="xla",
                    choices=["xla", "pallas", "mega"])
+    p.add_argument("--kv-dtype", default=None, choices=["int8"],
+                   help="int8-quantized paged KV pool (docs/serving.md "
+                   "'Quantized KV cache'); composes with every --mode "
+                   "including mega (in-kernel dequant). The single-"
+                   "Engine path then serves paged.")
+    p.add_argument("--speculative", type=int, default=0, metavar="K",
+                   help="self-drafting speculative decoding, up to K "
+                   "draft tokens per row (docs/serving.md 'Speculative "
+                   "decoding'); excluded with --mode mega — the NS-step "
+                   "fused launch already amortizes dispatch")
     p.add_argument("--replicas", type=int, default=0,
                    help="serve N ContinuousEngine replicas behind the "
                    "prefix-affinity router (0 = single fixed-batch "
@@ -51,6 +61,15 @@ def main(argv=None) -> int:
                    "request compiles for minutes and must not read as "
                    "a hang)")
     args = p.parse_args(argv)
+    if args.speculative and args.mode == "mega":
+        # Explicit, named-knob refusal (the engines raise the same
+        # conflict; failing at the CLI names the flags to change).
+        p.error(
+            "--speculative and --mode mega do not compose: the "
+            "megakernel's NS-step fused launch already amortizes "
+            "per-step dispatch (docs/megakernel.md 'Serving fast "
+            "path'). Drop --speculative or use --mode xla/pallas."
+        )
 
     from triton_distributed_tpu.models import AutoLLM
     from triton_distributed_tpu.models.engine import Engine
@@ -63,16 +82,11 @@ def main(argv=None) -> int:
         from triton_distributed_tpu.models.continuous import ContinuousEngine
         from triton_distributed_tpu.serving.router import Router
 
-        mode = args.mode
-        if mode == "mega":
-            # Same coercion as perf/serve_demo.py: the replicated tier
-            # is validated on the xla/pallas engines.
-            print("--replicas: coercing --mode mega to xla")
-            mode = "xla"
         engines = [
             ContinuousEngine(
-                model, max_batch=args.max_batch, mode=mode,
+                model, max_batch=args.max_batch, mode=args.mode,
                 temperature=args.temperature, prefix_cache=True,
+                kv_dtype=args.kv_dtype, speculative=args.speculative,
             )
             for _ in range(args.replicas)
         ]
@@ -85,6 +99,10 @@ def main(argv=None) -> int:
         engine = Engine(
             model, temperature=args.temperature, mode=args.mode,
             verbose=True,
+            # Both knobs ride the paged engine (scales/verify chunks
+            # live on the page pool).
+            paged=bool(args.kv_dtype or args.speculative),
+            kv_dtype=args.kv_dtype, speculative=args.speculative,
         )
         what = f"{args.model} (tp={args.tp})"
     server = ModelServer(

@@ -186,6 +186,16 @@ class ModelServer:
             stats["pending"] = self._pending
         stats["draining"] = self._shutdown.is_set()
         stats["drain_grace_s"] = self.drain_grace_s
+        # Deployed engine knobs (docs/serving.md): scrapers see what
+        # configuration is actually serving without shelling into the
+        # host. Routers surface per-replica details in the stats verb's
+        # ``router`` ledger instead; these getattrs then report the
+        # fleet-level defaults (None/0).
+        stats["engine"] = {
+            "mode": getattr(self.engine, "mode", None),
+            "kv_dtype": getattr(self.engine, "kv_dtype", None),
+            "speculative": getattr(self.engine, "speculative", 0),
+        }
         # ``snapshot_at`` is the same monotonic clock the per-request
         # timelines use, so a scraper can order stats snapshots against
         # event-ring timestamps without wall-clock skew.
