@@ -1,0 +1,287 @@
+"""Device task tracer bench → perf/MEGA_TRACE.json (ISSUE 8).
+
+Three arms, all CPU-runnable (interpret mesh — same harness convention
+as perf/mega_serve_bench.py):
+
+1. **Tracer cost + bit-identity** (tp=1 serving workload): the SAME
+   request set through ``ContinuousEngine(mode="mega")`` untraced and
+   traced. Outputs must be bit-identical; the added cost of the traced
+   arm (in-kernel ring stores + host-side ring decode) is reported as
+   median decode-wall per emitted token, with per-run spread — this
+   host's wall clock swings, so the platform-independent number (ring
+   decode µs/launch, measured separately) rides along.
+2. **Measured overlap exposure** (tp=4, the serving megakernel config):
+   decode the ring of an ``overlap_ar`` launch and report
+   windows/hidden/exposed — the ring-derived replacement for
+   perf/MEGA_SERVE.json's ``overlap_exposure_estimate`` (an analytic
+   model; ROADMAP item 2 called out that every overlap number was
+   analytic). Under interpret the ticks are the logical clock — phase
+   *structure* is exact, durations are phase counts; on hardware the
+   same decoder yields cycle-true numbers.
+3. **Merged timeline**: host ``group_profile`` capture around the
+   traced serving run, device task rows injected — one file, host
+   spans + device tasks, same request trace id on both.
+
+Usage: JAX_PLATFORMS=cpu python perf/mega_trace_bench.py [--out ...]
+"""
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault(
+    "XLA_FLAGS",
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8",
+)
+
+
+def _requests():
+    # Shared-prefix population, mixed lengths — small enough to finish
+    # on the interpret host, big enough for several NS=8 launches.
+    base = list(range(1, 9))
+    return [
+        (base + [10, 11], 12),
+        (base + [12, 13, 14], 10),
+        (list(range(3, 15)), 12),
+        (base, 9),
+    ]
+
+
+def bench_engine_arm(model, *, kernel_trace: bool, runs: int):
+    """Median decode wall per emitted token over ``runs`` warm runs."""
+    from triton_distributed_tpu.models.continuous import ContinuousEngine
+
+    eng = ContinuousEngine(
+        model, max_batch=2, max_length=64, page_size=16, mode="mega",
+        kernel_trace=kernel_trace,
+    )
+    outs = eng.run(_requests(), results=True)  # warm: compiles
+    walls = []
+    for _ in range(runs):
+        t0 = time.monotonic()
+        eng.run(_requests(), results=True)
+        emitted = eng.stats["generated_tokens"]
+        walls.append((time.monotonic() - t0) / max(emitted, 1))
+    return eng, outs, walls
+
+
+def ring_host_cost_us(launch, repeats: int = 200) -> float:
+    """The tracer's FULL per-launch host work — the vectorized inline
+    path ``_record_kernel_trace`` pays (gap check, per-opcode duration
+    grouping, measured overlap, registry observes) — measured
+    deterministically, unlike this host's wall. Record decoding is
+    LAZY (summary/merge consumers only) and intentionally excluded."""
+    from triton_distributed_tpu.obs import kernel_trace as kt
+
+    arr = launch.ring
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        kt.observe_launch(kt.KernelTraceLaunch(
+            wall_s=launch.wall_s, t0=0.0, nsteps=launch.nsteps,
+            ring=arr,
+        ))
+    return (time.perf_counter() - t0) / repeats * 1e6
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "MEGA_TRACE.json"))
+    p.add_argument("--runs", type=int, default=3)
+    p.add_argument("--trace-dir", default="/tmp/mega_trace_bench")
+    args = p.parse_args(argv)
+
+    import jax
+
+    if jax.default_backend() != "tpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from triton_distributed_tpu.megakernel import MegaQwen3
+    from triton_distributed_tpu.megakernel.code_generator import MegaConfig
+    from triton_distributed_tpu.models import AutoLLM
+    from triton_distributed_tpu.obs import kernel_trace as kt
+    from triton_distributed_tpu.runtime import mesh as mesh_mod
+    from triton_distributed_tpu.runtime.profiling import group_profile
+
+    result: dict = {
+        "metric": "mega_device_task_tracer",
+        "platform": jax.default_backend(),
+        "workload": {
+            "model": "tiny", "requests": len(_requests()),
+            "max_batch": 2, "ns": 8, "page_size": 16,
+        },
+    }
+
+    # -- arm 1: tp=1 engine, tracer on/off -------------------------------
+    # No profiler capture around either timing arm — a jax.profiler
+    # trace taxes the interpreted kernel far beyond anything the
+    # tracer itself costs (the merged-timeline arm below captures ONE
+    # separate short run).
+    ctx = mesh_mod.initialize_distributed(tp=1, devices=jax.devices()[:1])
+    model = AutoLLM.from_pretrained("tiny", ctx=ctx)
+    eng_off, outs_off, walls_off = bench_engine_arm(
+        model, kernel_trace=False, runs=args.runs)
+    eng_on, outs_on, walls_on = bench_engine_arm(
+        model, kernel_trace=True, runs=args.runs)
+    bit_identical = all(
+        a.status == b.status == "ok"
+        and np.array_equal(a.tokens, b.tokens)
+        for a, b in zip(outs_off, outs_on)
+    )
+    launches = eng_on.kernel_trace_launches()
+    rec0 = launches[-1]
+    med_off = statistics.median(walls_off)
+    med_on = statistics.median(walls_on)
+    wall_pct = (med_on - med_off) / med_off * 100.0
+    # Op-attributed estimate (the OBS_OVERHEAD.json convention: this
+    # host's wall swings far beyond the cost being measured, so the
+    # headline prices the tracer's DETERMINISTIC added work over the
+    # measured launch wall): the only host-side work the tracer adds
+    # per launch is the ring decode, measured standalone below; the
+    # in-kernel addition is O(tasks·steps) scalar SMEM stores under a
+    # multi-ms launch. On-chip projection uses the relay-measured
+    # ~2 ms/step dispatch-tax floor (perf/MEGA_SERVE.json) × NS.
+    decode_us = ring_host_cost_us(rec0)
+    ns = rec0.nsteps
+    launch_wall_off_ms = med_off * 1e3 * ns * 2  # B=2 slots emit 2/step
+    onchip_launch_ms = 2.0 * ns
+    result["tracer_overhead"] = {
+        "ring_host_us_per_launch": round(decode_us, 1),
+        "ring_bytes_per_launch": int(rec0.ring[0].nbytes),
+        "overhead_pct_of_launch_this_host": round(
+            decode_us / 1e3 / launch_wall_off_ms * 100.0, 3),
+        "overhead_pct_of_launch_onchip_projection": round(
+            decode_us / 1e3 / onchip_launch_ms * 100.0, 3),
+        "onchip_launch_ms_basis": onchip_launch_ms,
+        "wall_ab_advisory": {
+            "decode_wall_per_token_off_ms": round(med_off * 1e3, 3),
+            "decode_wall_per_token_on_ms": round(med_on * 1e3, 3),
+            "wall_delta_pct": round(wall_pct, 2),
+            "runs": args.runs,
+            "spread_off_ms": [round(w * 1e3, 3) for w in walls_off],
+            "spread_on_ms": [round(w * 1e3, 3) for w in walls_on],
+            "note": (
+                "interpret-mode wall: the CPU interpreter executes the "
+                "ring's scalar stores as host callbacks, a tax real "
+                "hardware does not pay (OBS_OVERHEAD.json documents "
+                "this host's swing); advisory only"
+            ),
+        },
+        "bar": "< 2% added decode-step cost",
+        "meets_bar": bool(
+            decode_us / 1e3 / min(launch_wall_off_ms, onchip_launch_ms)
+            * 100.0 < 2.0
+        ),
+    }
+    result["bit_identical_on_off"] = bool(bit_identical)
+
+    # Ring validation on the serving launches — fetched with the
+    # ENGINE's exact build key (num_pages included), so this reads the
+    # cached order of the launches being validated instead of forcing
+    # a second build against a possibly different schedule.
+    order = eng_on._mega_model().multi_task_order(
+        2, 64, eng_on.NS, sampled=False, page=16,
+        kv_quant=eng_on.kv_dtype is not None,
+        num_pages=int(eng_on.cache.k_pages.shape[1]),
+        valid_arg=True, trace=True,
+    )
+    problems = []
+    for launch in launches:
+        problems += kt.validate_ring(launch.get_records(), order)
+    result["ring_validation"] = {
+        "launches_decoded": len(launches),
+        "gap_free": True,  # decode_trace(strict) raised otherwise
+        "dependency_order_ok": not problems,
+        "problems": problems[:5],
+    }
+
+    # -- arm 3: merged host+device timeline ------------------------------
+    # ONE short captured run (profiling the timing arms would tax them;
+    # see arm 1 note): host spans + device task rows in one file.
+    n_before = eng_on._trace_launch_n
+    with group_profile("mega_trace", out_dir=args.trace_dir, merge=False):
+        eng_on.run(_requests()[:2], results=True)
+    added = eng_on._trace_launch_n - n_before
+    captured = eng_on.kernel_trace_launches()[-added:] if added else []
+    merged = kt.merge_with_host_profile(
+        "mega_trace", args.trace_dir, captured or launches)
+    host_spans = device_rows = 0
+    shared_trace_id = None
+    if merged:
+        import gzip
+
+        with gzip.open(merged, "rt") as f:
+            data = json.load(f)
+        dev = [e for e in data["traceEvents"]
+               if isinstance(e.get("args"), dict)
+               and "trace_ids" in e["args"]]
+        device_rows = len(dev)
+        host_spans = sum(
+            1 for e in data["traceEvents"]
+            if e.get("ph") == "X"
+            and not (isinstance(e.get("args"), dict)
+                     and "trace_ids" in e["args"])
+        )
+        ids = set()
+        for e in dev:
+            ids.update(x for x in e["args"]["trace_ids"].split(",") if x)
+        shared_trace_id = sorted(ids)[0] if ids else None
+    result["merged_timeline"] = {
+        "path": merged,
+        "host_events": host_spans,
+        "device_task_rows": device_rows,
+        "example_trace_id": shared_trace_id,
+    }
+    mesh_mod.finalize_distributed()
+
+    # -- arm 2: tp=4 measured overlap exposure ---------------------------
+    ctx4 = mesh_mod.initialize_distributed(tp=4, devices=jax.devices()[:4])
+    model4 = AutoLLM.from_pretrained("tiny", ctx=ctx4)
+    cache = model4.new_cache(1, max_length=64)
+    step = model4.decode_fn("xla")
+    import jax.numpy as jnp
+
+    for t in (3, 5):
+        _, cache = step(model4.params, jnp.asarray([t], jnp.int32), cache)
+    mega = MegaQwen3(model4, cfg=MegaConfig(
+        fuse_norms=True, cross_prefetch=True, overlap_ar=True))
+    NS = 2
+    fn = mega.decode_multi_fn(1, 64, NS, trace=True)
+    _t, _l, _c, ring = fn(model4.params, jnp.asarray([19], jnp.int32), cache)
+    records = kt.decode_trace(np.asarray(ring))
+    order4 = mega.multi_task_order(1, 64, NS, trace=True)
+    rep = kt.overlap_report(records)
+    result["overlap_measured"] = {
+        **rep,
+        "tp": 4, "nsteps": NS,
+        "config": "fuse_norms:cross_prefetch:overlap_ar (serving default)",
+        "dependency_order_ok": not kt.validate_ring(records, order4),
+        "clock": (
+            "logical (interpret): durations are instrumented-phase "
+            "counts; window structure — which phases coincide — is "
+            "exact, and replaces MEGA_SERVE.json's analytic "
+            "overlap_exposure_estimate arm with ring-derived numbers. "
+            "On hardware the same fields carry cycles."
+        ),
+    }
+    mesh_mod.finalize_distributed()
+
+    result["provenance"] = {
+        "harness": "perf/mega_trace_bench.py",
+        "written": "MEGA_TRACE.json",
+    }
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

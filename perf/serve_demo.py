@@ -56,6 +56,12 @@ def main(argv=None) -> int:
                    help="with --replicas: router-observed replica "
                    "timeout (seconds; 0 = off — a cold compile must "
                    "not read as a hang)")
+    p.add_argument("--trace", default=None, metavar="DIR",
+                   help="wrap the generation in group_profile(DIR) and "
+                   "print the merged one-file timeline path — host "
+                   "trace_spans plus (with --mode mega) the device "
+                   "task tracer's per-task rows and their measured "
+                   "overlap (docs/profiling.md 'Device task tracer')")
     args = p.parse_args(argv)
     # kv_dtype×mega and replicas×mega compose since PR 7 (the megakernel
     # is the general serving fast path — docs/megakernel.md); the ONE
@@ -88,6 +94,7 @@ def main(argv=None) -> int:
     )
     jax.block_until_ready(model.params)
     mode = args.mode if not (args.cpu and args.mode == "mega") else "xla"
+    kernel_trace = bool(args.trace) and mode == "mega"
     if args.replicas > 0:
         from triton_distributed_tpu.models.continuous import ContinuousEngine
         from triton_distributed_tpu.serving.router import Router
@@ -97,6 +104,7 @@ def main(argv=None) -> int:
                 model, max_batch=2, max_length=1024, mode=mode,
                 temperature=0.0, prefix_cache=True,
                 kv_dtype=args.kv_dtype, speculative=args.speculative,
+                kernel_trace=kernel_trace,
             )
             for _ in range(args.replicas)
         ], request_timeout_s=args.request_timeout or None)
@@ -104,24 +112,52 @@ def main(argv=None) -> int:
         eng = Engine(model, temperature=0.0, mode=mode,
                      paged=bool(args.kv_dtype or args.speculative),
                      kv_dtype=args.kv_dtype,
-                     speculative=args.speculative)
-    server = ModelServer(eng).start()
+                     speculative=args.speculative,
+                     kernel_trace=kernel_trace)
+    server = ModelServer(eng, trace_dir=args.trace).start()
     print(json.dumps({"serving": args.model, "mode": mode,
                       "replicas": args.replicas, "port": server.port,
                       "startup_s": round(time.time() - t0, 1)}), flush=True)
     try:
+        import contextlib as _ctxlib
+
         assert request(server.host, server.port, {"cmd": "ping"})["ok"]
         prompt = list(range(1, 33))
         if args.replicas > 0:
             payload = {"requests": [prompt], "gen_lens": [args.gen_len]}
         else:
             payload = {"input_ids": [prompt], "gen_len": args.gen_len}
-        t1 = time.time()
-        r1 = request(server.host, server.port, payload, timeout=1200)
-        cold_s = time.time() - t1
-        t2 = time.time()
-        r2 = request(server.host, server.port, payload, timeout=1200)
-        warm_s = time.time() - t2
+        with _ctxlib.ExitStack() as stack:
+            if args.trace:
+                from triton_distributed_tpu.runtime.profiling import (
+                    group_profile,
+                )
+
+                stack.enter_context(group_profile(
+                    "serve_demo", out_dir=args.trace, merge=False
+                ))
+            t1 = time.time()
+            r1 = request(server.host, server.port, payload, timeout=1200)
+            cold_s = time.time() - t1
+            t2 = time.time()
+            r2 = request(server.host, server.port, payload, timeout=1200)
+            warm_s = time.time() - t2
+        if args.trace:
+            # ONE merged timeline: host trace_spans + (mega) the device
+            # task tracer's per-task rows, tagged with request trace
+            # ids (docs/profiling.md "Device task tracer").
+            from triton_distributed_tpu.obs import kernel_trace as kt
+
+            launches = getattr(
+                eng, "kernel_trace_launches", lambda: []
+            )()
+            merged = kt.merge_with_host_profile(
+                "serve_demo", args.trace, launches
+            )
+            print(json.dumps({
+                "merged_trace": merged,
+                "traced_mega_launches": len(launches),
+            }), flush=True)
         if args.replicas > 0:
             gen1 = np.asarray(r1["outputs"][0])
             gen2 = np.asarray(r2["outputs"][0])

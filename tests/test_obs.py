@@ -243,6 +243,47 @@ def test_ring_timestamps_monotonic():
     assert evts[0].t <= evts[1].t
 
 
+def test_ring_tail_kind_filter():
+    """ISSUE 8 satellite: ``tail(kind=...)`` pulls one event stream
+    server-side. The filter applies after the drop count (overwritten
+    events' kinds are unknowable) and before ``limit`` (a page is
+    ``limit`` MATCHING events)."""
+    ring = obs_events.EventRing(capacity=32, enabled=True)
+    for i in range(10):
+        ring.emit("span", i=i)
+        ring.emit("fault", i=i)
+    spans, dropped = ring.tail(0, kind="span")
+    assert dropped == 0 and len(spans) == 10
+    assert all(e.kind == "span" for e in spans)
+    assert [e.fields["i"] for e in spans] == list(range(10))
+    # limit counts MATCHING events, not scanned events.
+    page, _ = ring.tail(0, limit=3, kind="fault")
+    assert [e.fields["i"] for e in page] == [0, 1, 2]
+    assert all(e.kind == "fault" for e in page)
+    # Paging by the returned seq walks the filtered stream completely.
+    got = list(page)
+    while True:
+        page, d = ring.tail(got[-1].seq, limit=3, kind="fault")
+        assert d == 0
+        if not page:
+            break
+        got.extend(page)
+    assert [e.fields["i"] for e in got] == list(range(10))
+    # No matches at all: empty page, drop count still exact.
+    none, d = ring.tail(0, kind="nope")
+    assert none == [] and d == 0
+    # Drop accounting is unchanged by the filter: overflow the ring.
+    ring2 = obs_events.EventRing(capacity=8, enabled=True)
+    for i in range(20):
+        ring2.emit("a" if i % 2 else "b", i=i)
+    filt, dropped2 = ring2.tail(0, kind="a")
+    allv, dropped_all = ring2.tail(0)
+    assert dropped2 == dropped_all == 12
+    assert [e.fields["i"] for e in filt] == [
+        e.fields["i"] for e in allv if e.kind == "a"
+    ]
+
+
 # -- trace_span → event ring -------------------------------------------------
 
 
@@ -308,6 +349,7 @@ def test_trace_span_float_probe_cached(monkeypatch):
         profiling.jax.profiler, "TraceAnnotation", RejectsFloats
     )
     monkeypatch.setattr(profiling, "_FLOAT_META_OK", None)
+    monkeypatch.setattr(profiling, "_STR_META_ONLY", False)
     with profiling.trace_span("t:probe1", rate=0.5):
         pass
     # First float span: failed float probe + stringified retry.
@@ -324,9 +366,10 @@ def test_trace_span_float_probe_cached(monkeypatch):
           and e.fields.get("name") == "t:probe2"]
     assert len(p2) == 1 and p2[0].fields["rate"] == 0.25
 
-    # A WHOLLY broken profiler (every construction raises) also
-    # settles the probe: float spans then pay one failed construction
-    # like every other span, never two forever.
+    # A WHOLLY broken profiler (every construction raises) settles the
+    # FLOAT probe (later float spans skip the native-float rung) but
+    # NOT the stringify ladder position — a total failure may be
+    # transient and must not downgrade future spans' metadata.
     class AlwaysRaises:
         def __init__(self, name, **kwargs):
             attempts.append(kwargs)
@@ -336,14 +379,68 @@ def test_trace_span_float_probe_cached(monkeypatch):
         profiling.jax.profiler, "TraceAnnotation", AlwaysRaises
     )
     monkeypatch.setattr(profiling, "_FLOAT_META_OK", None)
+    monkeypatch.setattr(profiling, "_STR_META_ONLY", False)
     n0 = len(attempts)
     with profiling.trace_span("t:broken1", rate=0.5):
         pass
-    assert len(attempts) == n0 + 2  # probe + stringified retry
+    # Unsettled ladder: float probe + int retry + uniform stringify.
+    assert len(attempts) == n0 + 3
     assert profiling._FLOAT_META_OK is False
+    assert profiling._STR_META_ONLY is False
     with profiling.trace_span("t:broken2", rate=0.5):
         pass
-    assert len(attempts) == n0 + 3  # settled: one attempt only
+    # Float probe settled: the float rung is skipped, the rest of the
+    # ladder still runs (the failure could have been transient).
+    assert len(attempts) == n0 + 5
+
+
+def test_trace_span_uniform_stringify_fallback(monkeypatch):
+    """Regression (ISSUE 8): a profiler that rejects a NON-float arg
+    type too (here: any non-str metadata) used to lose the span — and
+    its args — on the retry path. The uniform stringify rung must keep
+    the span alive with all-string args, remember the ladder position,
+    and leave the ring mirror's numerics native."""
+    from triton_distributed_tpu.runtime import profiling
+
+    entered = []
+
+    class StrOnly:
+        def __init__(self, name, **kwargs):
+            if any(not isinstance(v, str) for v in kwargs.values()):
+                raise TypeError("string metadata only")
+            self.kwargs = kwargs
+
+        def __enter__(self):
+            entered.append(self.kwargs)
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+    monkeypatch.setattr(
+        profiling.jax.profiler, "TraceAnnotation", StrOnly
+    )
+    monkeypatch.setattr(profiling, "_FLOAT_META_OK", None)
+    monkeypatch.setattr(profiling, "_STR_META_ONLY", False)
+    # Mixed arg types INCLUDING a non-float the old retry path lost:
+    # floats stringified on rung 2 still left the int native, so rung
+    # 2 failed too and the span vanished.
+    with profiling.trace_span("t:mixed", rate=0.5, slot=3, tag="x"):
+        pass
+    assert len(entered) == 1  # the span survived
+    assert entered[0] == {"rate": "0.5", "slot": "3", "tag": "x"}
+    assert profiling._STR_META_ONLY is True
+    # Settled: the next span goes straight to the stringify rung.
+    with profiling.trace_span("t:mixed2", slot=4):
+        pass
+    assert len(entered) == 2
+    assert entered[1] == {"slot": "4"}
+    # Ring mirror keeps numerics native regardless of profiler mode.
+    evts, _ = obs_events.default_ring().tail(0)
+    mine = [e for e in evts if e.kind == "span"
+            and e.fields.get("name") == "t:mixed"]
+    assert len(mine) == 1
+    assert mine[0].fields["rate"] == 0.5 and mine[0].fields["slot"] == 3
 
 
 # -- timelines ---------------------------------------------------------------

@@ -315,6 +315,91 @@ class TestGroupProfileMerge:
         assert any("different capture sessions" in str(w.message)
                    for w in caught)
 
+    def test_pid_remap_collision_bounds(self, tmp_path):
+        """ISSUE 8 satellite: rank pid namespacing must be collision-
+        free up to the stride — a pid just under ``_PID_STRIDE`` on
+        rank r must stay strictly below rank r+1's namespace, and the
+        device-task pid offset (obs/kernel_trace.DEVICE_TASK_PID) must
+        sit inside the stride too."""
+        import gzip
+        import json
+
+        from triton_distributed_tpu.obs.kernel_trace import (
+            DEVICE_TASK_PID,
+        )
+        from triton_distributed_tpu.runtime.profiling import (
+            _PID_STRIDE,
+            merge_group_profile,
+        )
+
+        assert 0 < DEVICE_TASK_PID < _PID_STRIDE
+        root = tmp_path / "prof" / "run"
+        # Rank 0 with the largest in-stride pid, rank 1 with pid 0.
+        self._write_rank_trace(root, 0, _PID_STRIDE - 1, "hi")
+        self._write_rank_trace(root, 1, 0, "lo")
+        out = merge_group_profile("run", str(tmp_path / "prof"))
+        with gzip.open(out, "rt") as f:
+            merged = json.load(f)
+        pids = {e["pid"] for e in merged["traceEvents"]}
+        assert pids == {_PID_STRIDE - 1, _PID_STRIDE}
+        # Distinct namespaces: every rank-0 pid < every rank-1 pid.
+        assert max(p for p in pids if p < _PID_STRIDE) < _PID_STRIDE
+
+    def test_missing_and_malformed_rank_dirs_tolerated(self, tmp_path):
+        """ISSUE 8 satellite: a rank dir with no usable trace, a
+        non-numeric ``rankX`` dir, and a GAP in rank numbering must all
+        be skipped — the merge still emits the ranks it can read."""
+        import gzip
+        import json
+
+        from triton_distributed_tpu.runtime.profiling import (
+            merge_group_profile,
+        )
+
+        root = tmp_path / "prof" / "run"
+        self._write_rank_trace(root, 0, 1, "good0")
+        # Rank 1 missing entirely (gap); rank 2 present.
+        self._write_rank_trace(root, 2, 1, "good2")
+        # A rank dir with an empty session (no exported trace).
+        self._write_rank_trace(root, 3, 1, "broken", empty=True)
+        # A dir that parses as no rank at all.
+        (root / "rank_bogus").mkdir()
+        (root / "rankX7").mkdir()
+        out = merge_group_profile("run", str(tmp_path / "prof"))
+        with gzip.open(out, "rt") as f:
+            merged = json.load(f)
+        names = {e["args"]["name"] for e in merged["traceEvents"]
+                 if e.get("ph") == "M"}
+        assert names == {"rank0: good0", "rank2: good2"}
+
+    def test_merged_gzip_round_trip(self, tmp_path):
+        """ISSUE 8 satellite: the merged file must be a REAL gzip that
+        round-trips through a fresh load — including a re-merge over
+        the directory that now contains the merged file itself (the
+        merged output must not be picked up as a rank trace)."""
+        import gzip
+        import json
+
+        from triton_distributed_tpu.runtime.profiling import (
+            merge_group_profile,
+        )
+
+        root = tmp_path / "prof" / "run"
+        self._write_rank_trace(root, 0, 5, "p")
+        self._write_rank_trace(root, 1, 5, "p")
+        out = merge_group_profile("run", str(tmp_path / "prof"))
+        with open(out, "rb") as f:
+            assert f.read(2) == b"\x1f\x8b"  # gzip magic
+        with gzip.open(out, "rt") as f:
+            first = json.load(f)
+        # Re-merge with the merged.trace.json.gz already on disk:
+        # event set must be identical (no self-ingestion).
+        out2 = merge_group_profile("run", str(tmp_path / "prof"))
+        with gzip.open(out2, "rt") as f:
+            second = json.load(f)
+        assert first["traceEvents"] == second["traceEvents"]
+        assert len(first["traceEvents"]) == 4  # 2 ranks × (M + X)
+
     def test_group_profile_end_to_end_merge(self, tmp_path):
         """A real single-process capture must leave ONE merged file next
         to the per-rank dir."""

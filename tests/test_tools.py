@@ -325,6 +325,79 @@ def test_obs_modules_compile():
     )
 
 
+def test_kernel_trace_modules_compile():
+    """ISSUE 8: the device task tracer's host half must byte-compile —
+    obs/kernel_trace.py is imported lazily from the decode hot path
+    (a traced launch decodes its ring inline), and the CPU-runnable
+    bench that writes perf/MEGA_TRACE.json rides along (repo
+    convention: perf harnesses fail tier-1, not a relay window)."""
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    targets = [
+        os.path.join(root, "triton_distributed_tpu", "obs",
+                     "kernel_trace.py"),
+        os.path.join(root, "triton_distributed_tpu", "megakernel",
+                     "task.py"),
+        os.path.join(root, "perf", "mega_trace_bench.py"),
+    ]
+    proc = subprocess.run(
+        [sys.executable, "-m", "compileall", "-q", "-f", *targets],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, (
+        f"kernel-trace modules failed to compile:\n"
+        f"{proc.stdout}\n{proc.stderr}"
+    )
+
+
+def test_tier1_marker_audit():
+    """ISSUE 8 satellite: the tier-1 window is spent by conftest's
+    ``_FILE_ORDER`` schedule — audit it against reality so new trace
+    tests actually run inside the wall clock: every listed file must
+    exist (a stale entry silently reorders nothing), and the device-
+    tracer suite must both be scheduled ahead of the multi-minute tail
+    AND carry runnable (non-slow) tests."""
+    import ast
+    import os
+
+    import conftest
+
+    tests_dir = os.path.dirname(__file__)
+    actual = {f for f in os.listdir(tests_dir)
+              if f.startswith("test_") and f.endswith(".py")}
+    stale = [f for f in conftest._FILE_ORDER if f not in actual]
+    assert not stale, f"conftest._FILE_ORDER lists missing files: {stale}"
+    # The trace suite is explicitly scheduled (not just rank -1) and
+    # sits before the interpret-heavy tail.
+    order = conftest._FILE_ORDER
+    assert "test_kernel_trace.py" in order
+    assert (order.index("test_kernel_trace.py")
+            < order.index("test_serving.py"))
+    # And it contains non-slow tests, so tier-1 (which skips `slow`)
+    # actually exercises the tracer.
+    src = open(os.path.join(tests_dir, "test_kernel_trace.py")).read()
+    tree = ast.parse(src)
+
+    def is_slow(node):
+        for dec in node.decorator_list:
+            if "slow" in ast.dump(dec):
+                return True
+        return False
+
+    fast_tests = [
+        n.name for n in ast.walk(tree)
+        if isinstance(n, ast.FunctionDef) and n.name.startswith("test_")
+        and not is_slow(n)
+    ]
+    assert len(fast_tests) >= 5, (
+        f"device-tracer suite has too few tier-1-runnable tests: "
+        f"{fast_tests}"
+    )
+
+
 def test_serving_tier_modules_compile():
     """The multi-engine serving tier must byte-compile: the router and
     replica modules are imported by the serving package (so a syntax

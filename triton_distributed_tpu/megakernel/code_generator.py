@@ -24,7 +24,19 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from triton_distributed_tpu.megakernel.registry import get_body_factory
-from triton_distributed_tpu.megakernel.task import Task, TaskType
+from triton_distributed_tpu.megakernel.task import (
+    TR_BEGIN,
+    TR_END,
+    TR_FLAG,
+    TR_LAYER,
+    TR_MID,
+    TR_OPCODE,
+    TR_SLOT,
+    TR_TASK_ID,
+    TRACE_INTS,
+    Task,
+    TaskType,
+)
 from triton_distributed_tpu.ops.common import interpret_mode, pick_tile
 from triton_distributed_tpu.runtime.mesh import DistContext
 
@@ -133,6 +145,16 @@ class MegaDims:
     # None = fixture off (straggle_if_rank's own no-op convention).
     straggler_rank: int | None = None
     straggler_nanos: int = 500_000
+    # Device task tracer (docs/observability.md "Device task tracer"):
+    # the kernel gains an SMEM trace-ring output [nsteps, T, TRACE_INTS]
+    # int32 and every grid iteration records its task's
+    # (task_id, opcode, layer, slot, begin, end[, mid]) — TPU cycle
+    # counter where the toolchain exposes one, a monotonic SMEM logical
+    # clock otherwise (always under interpret, so the feature is
+    # deterministic in tests). Off (the default) the operand list,
+    # scratch, and traced program are bit-identical to the untraced
+    # build — the tracer costs literally nothing when disabled.
+    trace: bool = False
 
     @property
     def qkv_loc(self) -> int:
@@ -361,6 +383,10 @@ class KernelCtx:
         # inbound allreduce partials (cfg.overlap_ar).
         self.task_tab: Any = None
         self.t: Any = None
+        # Device task tracer refs (None unless dims.trace): the SMEM
+        # trace-ring output and the logical-clock SMEM counter.
+        self.trace_out: Any = None
+        self.clk: Any = None
 
 
 def make_mega_kernel(
@@ -411,6 +437,15 @@ def make_mega_kernel(
         else:
             kc, vc, *rest = rest
             ksc = vsc = None
+        rest = list(rest)
+        if dims.trace:
+            # Trace builds append the SMEM ring after the outputs and
+            # the logical-clock counter after the scratch; popping them
+            # here keeps the canonical unpack below mode-free.
+            trace_out = rest.pop(4)
+            clk = rest.pop()
+        else:
+            trace_out = clk = None
         (
             logits, knew_out, vnew_out, toks_out,          # outputs
             x, h, qkv, ao, mlp, estage,                    # VMEM state
@@ -450,6 +485,7 @@ def make_mega_kernel(
         kctx.ksem, kctx.vsem = ksem, vsem
         kctx.arsend, kctx.arrecv = arsend, arrecv
         kctx.tsem = tsem
+        kctx.trace_out, kctx.clk = trace_out, clk
 
         ttype = task_tab[t, 0]
         kctx.layer = task_tab[t, 1]
@@ -461,6 +497,22 @@ def make_mega_kernel(
             def _init_flags():
                 pre_col[0] = 0
                 pre_row[0] = 0
+
+        if dims.trace:
+            from triton_distributed_tpu.megakernel.kernels import trace_tick
+
+            @pl.when(jnp.logical_and(kctx.step == 0, t == 0))
+            def _init_clk():
+                clk[0] = 0
+
+            # Record header fields + begin BEFORE dispatch; mid stays 0
+            # unless a body stamps a phase mark (the AR bodies do).
+            trace_out[kctx.step, t, TR_TASK_ID] = task_tab[t, 4]
+            trace_out[kctx.step, t, TR_OPCODE] = ttype
+            trace_out[kctx.step, t, TR_LAYER] = kctx.layer
+            trace_out[kctx.step, t, TR_SLOT] = kctx.arg0
+            trace_out[kctx.step, t, TR_MID] = 0
+            trace_out[kctx.step, t, TR_BEGIN] = trace_tick(kctx)
 
         for value, body in bodies:
             pl.when(ttype == value)(body)
@@ -490,6 +542,14 @@ def make_mega_kernel(
                 )
             else:
                 fire_next_tile0(kctx)
+
+        if dims.trace:
+            # End AFTER the cross_prefetch epilogue: the prefetch fire
+            # is part of this task's grid iteration, and the decoder's
+            # dependency check needs end[producer] <= begin[consumer]
+            # to hold for everything the iteration did.
+            trace_out[kctx.step, t, TR_END] = trace_tick(kctx)
+            trace_out[kctx.step, t, TR_FLAG] = 1
 
     return kernel
 
@@ -557,7 +617,12 @@ def build_mega_call(
             pl.BlockSpec(memory_space=pltpu.VMEM),  # new K rows
             pl.BlockSpec(memory_space=pltpu.VMEM),  # new V rows
             pl.BlockSpec(memory_space=pltpu.VMEM),  # greedy tokens
-        ],
+        ]
+        # Trace ring: SMEM, because records are scalar stores at
+        # dynamic (step, task) indices — natural on the scalar core,
+        # while a VMEM row write at a dynamic sublane offset is exactly
+        # the unaligned-slice shape Mosaic rejects. ~NS·T·32 bytes.
+        + ([pl.BlockSpec(memory_space=pltpu.SMEM)] if dims.trace else []),
         scratch_shapes=(scratch := [
             pltpu.VMEM((B, d), jnp.float32),                   # x
             pltpu.VMEM((B, d), jnp.float32),                   # h
@@ -603,7 +668,10 @@ def build_mega_call(
             pltpu.SemaphoreType.DMA,                           # arsend
             pltpu.SemaphoreType.DMA((n,)),                     # arrecv
             pltpu.SemaphoreType.DMA,                           # tsem
-        ]),
+        ] + (
+            # Logical trace clock (SMEM counter; see kernels.trace_tick).
+            [pltpu.SMEM((1,), jnp.int32)] if dims.trace else []
+        )),
     )
 
     # VMEM-resident in_specs are footprint too (ADVICE r4 — the 1.5×
@@ -675,7 +743,17 @@ def build_mega_call(
             # Greedy tokens per step (multi-step; garbage when the LM
             # head runs in single-step mode and the caller ignores it).
             jax.ShapeDtypeStruct((dims.nsteps, 1, max(B, 1)), jnp.int32),
-        ]),
+        ] + (
+            # Device trace ring: one TRACE_INTS-int record per
+            # (step, task) grid iteration — dense by construction, so
+            # the decoder's gap-free check is exact (every flag must
+            # read 1). ``len(tasks)`` is the scheduled order's length;
+            # obs/kernel_trace.py maps rows back through it.
+            [jax.ShapeDtypeStruct(
+                (dims.nsteps, len(tasks), TRACE_INTS), jnp.int32
+            )]
+            if dims.trace else []
+        )),
         compiler_params=pltpu.CompilerParams(
             has_side_effects=True,
             dimension_semantics=("arbitrary", "arbitrary"),

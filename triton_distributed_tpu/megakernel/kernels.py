@@ -27,7 +27,45 @@ from jax.experimental.pallas import tpu as pltpu
 
 from triton_distributed_tpu import language as dl
 from triton_distributed_tpu.megakernel.registry import register_task
-from triton_distributed_tpu.megakernel.task import TaskType
+from triton_distributed_tpu.megakernel.task import TR_MID, TaskType
+
+
+# -- device task tracer (docs/observability.md "Device task tracer") ---------
+#
+# Candidate cycle-counter primitives, probed in order: jaxlib 0.4.x
+# exposes none publicly, so the tracer's default clock is a LOGICAL
+# one — an SMEM counter bumped once per read. The Pallas grid is
+# sequential on a TPU core, so the logical clock is monotonic and
+# race-free by construction; under interpret it is fully deterministic.
+# On a jaxlib that grows a cycle counter the same records carry real
+# cycle timestamps with no decoder change (the decoder treats clock
+# values as opaque monotonic ticks either way).
+_CYCLE_PRIMS = ("get_cycle_count", "cycle_count", "get_timestamp")
+
+
+def trace_tick(kctx):
+    """One monotonic device-clock read for a trace-ring record: the
+    TPU cycle counter when the installed Pallas exposes one (Mosaic
+    builds only — interpret always uses the logical clock so tests are
+    deterministic), else the SMEM logical clock."""
+    if not kctx.interpret:
+        for name in _CYCLE_PRIMS:
+            prim = getattr(pltpu, name, None)
+            if prim is not None:
+                return prim().astype(jnp.int32)
+    c = kctx.clk[0] + 1
+    kctx.clk[0] = c
+    return c
+
+
+def trace_mid(kctx):
+    """Stamp the CURRENT task's optional intra-task phase mark (the
+    record's ``mid`` field) — the AR bodies call it where their comm
+    phase hands off, so the decoder can split issue-time from blocked
+    wait. A Python-level no-op when the build is untraced (the traced
+    kernel carries zero extra ops with the tracer off)."""
+    if getattr(kctx.dims, "trace", False) and kctx.trace_out is not None:
+        kctx.trace_out[kctx.step, kctx.t, TR_MID] = trace_tick(kctx)
 
 
 def _rms(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
@@ -951,6 +989,9 @@ def allreduce_body(kctx):
         n = kctx.dims.n_ranks
         h = kctx.h[...]
         _workspace_bcast(kctx, h)
+        # Tracer phase mark: partials landed — [begin, mid] is the
+        # fused exchange's comm phase, [mid, end] the local fold.
+        trace_mid(kctx)
         acc = kctx.x[...]
         for r in range(n):
             acc = acc + kctx.cbuf[r]
@@ -979,6 +1020,9 @@ def ar_send_body(kctx):
         kctx.cbuf[me] = h
         for dma in _ar_put_dmas(kctx):
             dma.start()
+        # Tracer phase mark: every remote put is in flight — the comm
+        # window the decoder's overlap-exposure measure opens here.
+        trace_mid(kctx)
 
     return body
 
@@ -999,6 +1043,10 @@ def ar_wait_body(kctx):
             # must skip its own tile-0 start); without it the split
             # still moves the puts off the critical path.
             fire_next_tile0(kctx)
+        # Tracer phase mark: the next stream's tile-0 DMA is issued
+        # (the work hidden under the open comm window); [mid, end] is
+        # the blocked wait + fold + drain the overlap exists to shrink.
+        trace_mid(kctx)
         _ar_wait_recvs(kctx)
         acc = kctx.x[...]
         for r in range(nr):

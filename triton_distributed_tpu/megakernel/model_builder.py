@@ -197,7 +197,7 @@ class ModelBuilder:
         """Schedule + generate the single-kernel program
         (parity: ``ModelBuilder.compile``:372)."""
         order = schedule(self.tasks, policy)
-        table = pack_table(order)
+        table = pack_table(order, trace=self.dims.trace)
         run = build_mega_call(
             self.dims,
             self.cfg,

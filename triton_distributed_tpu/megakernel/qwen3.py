@@ -116,10 +116,15 @@ class MegaQwen3:
         self.cfg = cfg or MegaConfig()
         self.policy = policy
         self._jit: dict = {}
+        # Scheduled orders by decode_multi_fn cache key (trace
+        # consumers read them back via multi_task_order).
+        self._orders: dict = {}
+        self._last_multi_order = None
 
     def _dims(
         self, batch: int, s_max: int, page: int = 0,
         kv_quant: bool = False, num_pages: int = 0,
+        trace: bool = False,
     ) -> MegaDims:
         m = self.model
         c = m.cfg
@@ -148,6 +153,7 @@ class MegaQwen3:
             page=page,
             kv_quant=kv_quant,
             num_pages=num_pages,
+            trace=trace,
         )
 
     @staticmethod
@@ -165,6 +171,7 @@ class MegaQwen3:
     def build(
         self, batch: int, s_max: int, page: int = 0,
         kv_quant: bool = False, num_pages: int = 0,
+        trace: bool = False,
     ):
         """Build + schedule the task graph and jit the SPMD step
         (parity: ``Qwen3Model.build_fwd`` + ``compile``). ``page`` > 0
@@ -172,9 +179,12 @@ class MegaQwen3:
         attention block size = page size); ``kv_quant`` reads an int8
         pool through its per-page scales (dequant in-kernel, appends
         through the quantized_row_scatter protocol — full-width KV
-        never materializes)."""
+        never materializes). ``trace`` adds the device task tracer's
+        ring output (docs/observability.md "Device task tracer"): the
+        step then returns ``(logits, cache, trace [tp, 1, T, 8])``;
+        untraced builds keep the exact PR 7 operand list and contract."""
         m = self.model
-        dims = self._dims(batch, s_max, page, kv_quant, num_pages)
+        dims = self._dims(batch, s_max, page, kv_quant, num_pages, trace)
         # (s_blk == page is enforced by MegaConfig.resolve when
         # dims.page is set — single owner of that invariant.)
         mb = ModelBuilder(
@@ -192,26 +202,31 @@ class MegaQwen3:
 
         if page:
             def shard_fn(params: Qwen3Params, tokens, cache: PagedKVCache):
-                logits, k_rows, v_rows, _toks = per_shard(
+                outs = per_shard(
                     cache.kv_len, tokens, cache.page_table,
                     *kernel_args(params), cache.k_pages, cache.v_pages,
                     *self._scale_args(cache, kv_quant),
                 )
+                logits, k_rows, v_rows, _toks = outs[:4]
                 # Page-table append of the new rows [L, B, hkv, hd]
                 # (the kernel never writes the pool — same reasoning as
                 # the dense path below; [0] drops the step dim of the
                 # single-step build). On a quantized pool, append runs
                 # the ONE scale-protocol implementation
                 # (quantized_row_scatter: offset-0 reset, grow+requant).
-                return logits, _paged.append(cache, k_rows[0], v_rows[0])
+                new_cache = _paged.append(cache, k_rows[0], v_rows[0])
+                if trace:  # per-rank ring, stacked on a tp leading dim
+                    return logits, new_cache, outs[4][None]
+                return logits, new_cache
 
             specs = paged_cache_specs(ax, quantized=kv_quant)
         else:
             def shard_fn(params: Qwen3Params, tokens, cache: KVCache):
-                logits, k_rows, v_rows, _toks = per_shard(
+                outs = per_shard(
                     cache.kv_len, tokens,
                     *kernel_args(params), cache.k, cache.v,
                 )
+                logits, k_rows, v_rows, _toks = outs[:4]
                 k_rows, v_rows = k_rows[0], v_rows[0]  # single-step build
                 # Append the new rows [L, B, hkv, hd] at each row's
                 # position — one dynamic_update_slice per batch row; XLA
@@ -228,24 +243,30 @@ class MegaQwen3:
                     v_new = jax.lax.dynamic_update_slice(
                         v_new, v_rows[:, b, :, None, :][:, None], at
                     )
-                return logits, KVCache(
+                new_cache = KVCache(
                     k=k_new, v=v_new, kv_len=cache.kv_len + 1
                 )
+                if trace:
+                    return logits, new_cache, outs[4][None]
+                return logits, new_cache
 
             specs = cache_specs(ax)
 
+        out_specs = (P(None, ax), specs)
+        if trace:
+            out_specs += (P(ax),)
         g = m.ctx.shard_map(
             shard_fn,
             in_specs=(pspecs, P(), specs),
-            out_specs=(P(None, ax), specs),
+            out_specs=out_specs,
         )
         V = m.cfg.vocab_size
 
         def f(params, tokens, cache):
-            logits, cache = g(params, tokens, cache)
+            outs = g(params, tokens, cache)
             # Drop vocab-pad logits (zero-weight columns score 0 and
             # could beat real logits under greedy sampling).
-            return logits[:, :V], cache
+            return (outs[0][:, :V], *outs[1:])
 
         step = jax.jit(f, donate_argnums=(2,))
         return compiled, step, f
@@ -381,8 +402,9 @@ class MegaQwen3:
         )
 
     def _built(self, batch: int, s_max: int, page: int = 0,
-               kv_quant: bool = False, num_pages: int = 0):
-        key = (batch, s_max, page, kv_quant, num_pages)
+               kv_quant: bool = False, num_pages: int = 0,
+               trace: bool = False):
+        key = (batch, s_max, page, kv_quant, num_pages, trace)
         if key not in self._jit:
             self._jit[key] = self.build(*key)
         return self._jit[key]
@@ -414,19 +436,22 @@ class MegaQwen3:
         return self.model.params
 
     def decode_fn(self, batch: int, s_max: int, page: int = 0,
-                  kv_quant: bool = False, num_pages: int = 0):
+                  kv_quant: bool = False, num_pages: int = 0,
+                  trace: bool = False):
         """The raw (unjitted) step ``f(params, tokens, cache) →
         (logits, cache)`` — same contract as ``Qwen3.decode_fn``, so
         callers can chain steps inside one jit (``lax.fori_loop`` greedy
-        decode) instead of dispatching per step."""
-        return self._built(batch, s_max, page, kv_quant, num_pages)[2]
+        decode) instead of dispatching per step. ``trace`` appends the
+        device trace ring to the returns (docs/observability.md)."""
+        return self._built(batch, s_max, page, kv_quant, num_pages,
+                           trace)[2]
 
     # -- multi-step greedy decode ----------------------------------------
     def build_multi(
         self, batch: int, s_max: int, nsteps: int, sampled: bool = False,
         page: int = 0, straggler_rank: int | None = None,
         kv_quant: bool = False, num_pages: int = 0,
-        valid_arg: bool = False,
+        valid_arg: bool = False, trace: bool = False,
     ):
         """``nsteps`` greedy decode steps in ONE kernel launch.
 
@@ -463,7 +488,7 @@ class MegaQwen3:
         """
         m = self.model
         V = m.cfg.vocab_size
-        base = self._dims(batch, s_max, page, kv_quant, num_pages)
+        base = self._dims(batch, s_max, page, kv_quant, num_pages, trace)
         dims = dataclasses.replace(
             base, nsteps=nsteps, v_real=V, sampled=sampled,
             straggler_rank=straggler_rank,
@@ -473,7 +498,12 @@ class MegaQwen3:
             wdtype=m.cfg.dtype, cdtype=m.cfg.dtype,
         )
         mb.build_decoder_graph()
-        per_shard = mb.compile(self.policy).per_shard
+        compiled = mb.compile(self.policy)
+        per_shard = compiled.per_shard
+        # Scheduled order, retrievable by trace consumers: the ring
+        # decoder's dependency check (obs/kernel_trace.validate_ring)
+        # needs the scoreboard edges of THIS build.
+        self._last_multi_order = compiled.order
         ax = m.axis
         wq8 = self.cfg.wq8
         kernel_args = self._kernel_args_q8 if wq8 else self._kernel_args
@@ -486,11 +516,12 @@ class MegaQwen3:
                     n_valid, *noise = extra
                 else:
                     n_valid, noise = None, extra
-                logits, k_rows, v_rows, toks = per_shard(
+                outs = per_shard(
                     cache.kv_len, tokens, cache.page_table, *noise,
                     *kernel_args(params), cache.k_pages, cache.v_pages,
                     *self._scale_args(cache, kv_quant),
                 )
+                logits, k_rows, v_rows, toks = outs[:4]
                 # k_rows [NS, L, B, hkv, hd] → [L, B, hkv, NS, hd]:
                 # one scatter lands all nsteps rows in the pool (int8
                 # pools quantize them here, through append_n's
@@ -499,19 +530,23 @@ class MegaQwen3:
                 # retiring pages' scales never cover garbage).
                 k_rows = jnp.transpose(k_rows, (1, 2, 3, 0, 4))
                 v_rows = jnp.transpose(v_rows, (1, 2, 3, 0, 4))
-                return (
+                ret = (
                     toks[:, 0, :], logits,
                     _paged.append_n(cache, k_rows, v_rows, n_valid),
                 )
+                if trace:  # per-rank ring, stacked on a tp leading dim
+                    ret += (outs[4][None],)
+                return ret
 
             specs = paged_cache_specs(ax, quantized=kv_quant)
         else:
             def shard_fn(params: Qwen3Params, tokens, cache: KVCache,
                          *noise):
-                logits, k_rows, v_rows, toks = per_shard(
+                outs = per_shard(
                     cache.kv_len, tokens, *noise,
                     *kernel_args(params), cache.k, cache.v,
                 )
+                logits, k_rows, v_rows, toks = outs[:4]
                 # k_rows [NS, L, B, hkv, hd] → [L, B, hkv, NS, hd]: all
                 # nsteps rows land with ONE contiguous update per batch
                 # row.
@@ -527,9 +562,12 @@ class MegaQwen3:
                     v_new = jax.lax.dynamic_update_slice(
                         v_new, v_rows[:, b:b + 1], at
                     )
-                return toks[:, 0, :], logits, KVCache(
+                ret = (toks[:, 0, :], logits, KVCache(
                     k=k_new, v=v_new, kv_len=cache.kv_len + nsteps
-                )
+                ))
+                if trace:
+                    ret += (outs[4][None],)
+                return ret
 
             specs = cache_specs(ax)
 
@@ -537,17 +575,21 @@ class MegaQwen3:
             raise ValueError("valid_arg rides the paged append only")
         extra_specs = (P(),) if valid_arg else ()
         extra_specs += (P(None, None, ax),) if sampled else ()
+        out_specs = (P(), P(None, ax), specs)
+        if trace:
+            out_specs += (P(ax),)
         g = m.ctx.shard_map(
             shard_fn,
             in_specs=(pspecs, P(), specs, *extra_specs),
-            out_specs=(P(), P(None, ax), specs),
+            out_specs=out_specs,
         )
 
         def f(params, tokens, cache, *extra):
-            toks, logits, cache = g(params, tokens, cache, *extra)
+            toks, logits, *rest = g(params, tokens, cache, *extra)
             # toks [nsteps, B]; logits are the LAST step's (pad cols
-            # dropped as in the single-step path).
-            return toks, logits[:, :V], cache
+            # dropped as in the single-step path). Trace builds append
+            # the device ring [tp, NS, T, 8] as a fourth return.
+            return (toks, logits[:, :V], *rest)
 
         # Donated cache: the nsteps-row dynamic_update_slice aliases in
         # place instead of copying the whole KV cache per launch (same
@@ -557,7 +599,7 @@ class MegaQwen3:
     def decode_multi_fn(
         self, batch: int, s_max: int, nsteps: int, sampled: bool = False,
         page: int = 0, kv_quant: bool = False, num_pages: int = 0,
-        valid_arg: bool = False,
+        valid_arg: bool = False, trace: bool = False,
     ):
         """Jitted multi-step fn ``f(params, tokens, cache[, n_valid]
         [, noise]) → (tokens [nsteps, B], last_logits [B, V], cache
@@ -568,17 +610,40 @@ class MegaQwen3:
         :class:`PagedKVCache`, and ``kv_quant`` an int8 pool (both
         compose with ``sampled``). ``valid_arg`` adds the serving
         loop's ``n_valid [B]`` kept-row counts (guaranteed-overshoot
-        rows route to the trash page — see ``append_n``). Cached per
-        the full option tuple."""
-        key = ("multi", batch, s_max, nsteps, sampled, page, kv_quant,
-               num_pages, valid_arg)
+        rows route to the trash page — see ``append_n``). ``trace``
+        appends the device task ring ``[tp, NS, T, 8]`` to the returns
+        (docs/observability.md "Device task tracer"). Cached per the
+        full option tuple."""
+        key = self._multi_key(batch, s_max, nsteps, sampled, page,
+                              kv_quant, num_pages, valid_arg, trace)
         if key not in self._jit:
             self._jit[key] = self.build_multi(
                 batch, s_max, nsteps, sampled, page,
                 kv_quant=kv_quant, num_pages=num_pages,
-                valid_arg=valid_arg,
+                valid_arg=valid_arg, trace=trace,
             )
+            # Scheduled order for this build, for trace consumers
+            # (obs/kernel_trace.validate_ring's dependency check).
+            self._orders[key] = self._last_multi_order
         return self._jit[key]
+
+    @staticmethod
+    def _multi_key(batch, s_max, nsteps, sampled=False, page=0,
+                   kv_quant=False, num_pages=0, valid_arg=False,
+                   trace=False):
+        """The ONE multi-build cache key — shared by
+        :meth:`decode_multi_fn` and :meth:`multi_task_order` so the
+        two can never disagree on what identifies a build."""
+        return ("multi", batch, s_max, nsteps, sampled, page, kv_quant,
+                num_pages, valid_arg, trace)
+
+    def multi_task_order(self, *args, **kw):
+        """The scheduled task order of a multi-step build — same
+        signature as :meth:`decode_multi_fn` (builds on first use).
+        Ring consumers pass it to ``validate_ring`` so the decoder can
+        check every scoreboard edge against the device clock."""
+        self.decode_multi_fn(*args, **kw)
+        return self._orders[self._multi_key(*args, **kw)]
 
     # -- prefill ---------------------------------------------------------
     def _build_prefill(self, s: int):

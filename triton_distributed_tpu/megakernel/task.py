@@ -24,8 +24,29 @@ import enum
 import numpy as np
 
 # Device-side header layout: HDR_INTS int32 per task.
-# [0] task_type  [1] layer_id  [2] arg0  [3] arg1  (rest reserved)
+# [0] task_type  [1] layer_id  [2] arg0  [3] arg1  [4] task_id
+# (rest reserved). task_id rides in the header so the device task
+# tracer (docs/observability.md "Device task tracer") can stamp ring
+# records with the BUILDER's id, not the schedule position — the two
+# differ whenever the scheduler legally reorders independent tasks.
 HDR_INTS = 8
+
+# Device trace-ring record layout (obs/kernel_trace.py decodes it):
+# TRACE_INTS int32 per (step, task) record, same 8-int width as the
+# task headers. ``mid`` is an optional intra-task phase stamp (the AR
+# bodies mark when their comm phase hands off); ``flag`` is the
+# written marker (the logical clock starts at 1, but a cycle-counter
+# clock may legitimately read 0) — a zero flag means the record was
+# never written, which is what the decoder's gap-free check keys on.
+TRACE_INTS = 8
+TR_TASK_ID = 0   # builder task id (header slot 4)
+TR_OPCODE = 1    # TaskType value
+TR_LAYER = 2     # layer_id
+TR_SLOT = 3      # arg0 (e.g. the allreduce parity slot)
+TR_BEGIN = 4     # clock at task entry
+TR_END = 5       # clock at task exit (epilogue included)
+TR_MID = 6       # optional intra-task phase stamp (0 = none)
+TR_FLAG = 7      # 1 = record written
 
 
 class TaskType(enum.IntEnum):
@@ -90,8 +111,13 @@ class Task:
     arg1: int = 0
     deps: tuple[TaskDependency, ...] = ()
 
-    def header(self) -> list[int]:
-        h = [int(self.task_type), self.layer_id, self.arg0, self.arg1]
+    def header(self, trace: bool = False) -> list[int]:
+        # The id column (slot 4) is a tracer-only operand extension:
+        # untraced tables stay byte-identical to the pre-tracer layout
+        # (nothing untraced reads past slot 3, and launch params must
+        # not change when the tracer is off).
+        h = [int(self.task_type), self.layer_id, self.arg0, self.arg1,
+             self.task_id if trace else 0]
         return h + [0] * (HDR_INTS - len(h))
 
 
@@ -112,11 +138,13 @@ class TaskIDManager:
         return self._next
 
 
-def pack_table(tasks: list[Task]) -> np.ndarray:
+def pack_table(tasks: list[Task], trace: bool = False) -> np.ndarray:
     """Flatten scheduled tasks into the int32 device table the kernel
     scalar-prefetches (parity: the per-SM int32 work queues,
     ``core/scheduler.py:40-63`` — collapsed to one queue for the
-    sequential TPU grid)."""
+    sequential TPU grid). ``trace`` stamps each header's id column
+    (slot 4) so the device task tracer can record builder ids; off,
+    the table is byte-identical to the pre-tracer layout."""
     if not tasks:
         raise ValueError("empty task list")
-    return np.asarray([t.header() for t in tasks], np.int32)
+    return np.asarray([t.header(trace) for t in tasks], np.int32)

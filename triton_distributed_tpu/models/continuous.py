@@ -42,6 +42,7 @@ leak is caught at the batch that caused it, not three batches later.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import time
 import weakref
 from collections import Counter, deque
@@ -111,6 +112,13 @@ class RequestResult:
     @property
     def error(self) -> RequestError | None:
         return None if self.ok else RequestError(self.status, self.reason)
+
+
+# Process-wide trace-id allocator: ids must stay unique across engines
+# and replicas (the router fans one batch over several), or the
+# device-task tagging would alias two requests into one thread of the
+# merged timeline.
+_TRACE_IDS = itertools.count(1)
 
 
 class RequestFailedError(RuntimeError):
@@ -190,6 +198,11 @@ class Request:
     # payload decode; ``run()`` backfills for direct callers.
     timeline: Timeline | None = dataclasses.field(default=None, repr=False)
     deadline_at: float | None = dataclasses.field(default=None, repr=False)
+    # Request trace id (docs/observability.md "Device task tracer"):
+    # follows the request server → router → replica → engine →
+    # individual device tasks. Clients may supply one in the payload
+    # (``trace_ids``); ``run()`` assigns ``req-<n>`` when absent.
+    trace_id: str | None = None
 
     @property
     def done(self) -> bool:
@@ -241,10 +254,19 @@ class ContinuousEngine(MegaDispatch):
         speculative: int = 0,
         max_queue: int | None = None,
         kv_dtype: str | None = None,
+        kernel_trace: bool = False,
     ):
         self.model = model
         self.mode = mode
         self.mega_cfg = mega_cfg
+        # Device task tracer (docs/observability.md "Device task
+        # tracer"): mega launches carry an in-kernel trace ring; every
+        # launch's ring is folded into tdt_mega_task_seconds /
+        # tdt_mega_overlap_exposure and kept (bounded) for the
+        # server's {"cmd": "kernel_trace"} verb and the merged chrome
+        # timeline (plumbing shared with Engine via MegaDispatch). Off
+        # by default: the untraced build is bit-identical to PR 7's.
+        self._init_kernel_trace(kernel_trace, mode)
         self.temperature = temperature
         self.top_p = top_p
         self.top_k = top_k
@@ -370,6 +392,8 @@ class ContinuousEngine(MegaDispatch):
             # NS-step launches vs single-step fallback rounds.
             "mega_launches": 0,
             "mega_fallback_steps": 0,
+            # Device task tracer: launches whose ring was decoded.
+            "mega_trace_launches": 0,
         }
 
     @property
@@ -432,10 +456,19 @@ class ContinuousEngine(MegaDispatch):
 
     def _sync_tables(self) -> None:
         self._free_pages_gauge.set(len(self.pool.free))
+        # COPIES, not views: ``jnp.asarray`` on the CPU backend may
+        # zero-copy an aligned numpy array, so the device "buffer"
+        # aliases the live host array — and this engine mutates
+        # ``_table``/``_kv_len`` in place while async-dispatched
+        # launches still read them (the decode then races host
+        # bookkeeping; observed as run-to-run token flips whose
+        # probability scaled with host work between dispatch and the
+        # first output fetch). Explicit copies give the device arrays
+        # their own storage.
         self.cache = dataclasses.replace(
             self.cache,
-            page_table=jnp.asarray(self._table),
-            kv_len=jnp.asarray(self._kv_len),
+            page_table=jnp.asarray(self._table.copy()),
+            kv_len=jnp.asarray(self._kv_len.copy()),
         )
 
     def _admit(
@@ -473,7 +506,8 @@ class ContinuousEngine(MegaDispatch):
         # Emitted HERE, aligned with the `admitted` counter — a failed
         # allocation/prefill must not leave a phantom admit event for
         # consumers correlating admits against counters or evicts.
-        obs_events.emit("admit", slot=slot, prompt_len=s, matched=0)
+        obs_events.emit("admit", slot=slot, prompt_len=s, matched=0,
+                        trace_id=req.trace_id)
         self._slots[slot] = req
         return self._sample_req(req, logits[0])
 
@@ -517,7 +551,8 @@ class ContinuousEngine(MegaDispatch):
         # count (the same contract the non-prefix path states above).
         self._bump("admitted")
         self._bump("prefix_hit_tokens", matched)
-        obs_events.emit("admit", slot=slot, prompt_len=s, matched=matched)
+        obs_events.emit("admit", slot=slot, prompt_len=s, matched=matched,
+                        trace_id=req.trace_id)
         self._slots[slot] = req
         return self._sample_req(req, logits)
 
@@ -564,7 +599,10 @@ class ContinuousEngine(MegaDispatch):
         logits = mutate_point(
             "engine.logits", logits, step=self.stats["decode_steps"]
         )
-        self._kv_len += active
+        # Rebind, never ``+=`` — the zero-copy-alias discipline of
+        # ``_sync_tables`` (an in-place add between an async dispatch
+        # and its first fetch raced the device's kv_len read).
+        self._kv_len = self._kv_len + active
         self._bump("decode_steps")
         # One device program computes the finite mask AND the greedy
         # base tokens, so the NaN guard adds no extra host-sync round
@@ -1053,21 +1091,48 @@ class ContinuousEngine(MegaDispatch):
         params = self._mega_model()._step_params()  # Q8Params under wq8
         args = (params, jnp.asarray(self._tok), self.cache,
                 jnp.asarray(n_valid))
+        t_launch = time.monotonic()
         if sampled:
             self.key, sub = jax.random.split(self.key)
-            toks, _logits, self.cache = fn(*args, sub, jnp.asarray(temps))
+            outs = fn(*args, sub, jnp.asarray(temps))
         else:
-            toks, _logits, self.cache = fn(*args)
-        self._kv_len += self.NS * active
+            outs = fn(*args)
+        if self.kernel_trace:
+            toks, _logits, self.cache, ring = outs
+            jax.block_until_ready(toks)  # wall must cover the launch
+        else:
+            toks, _logits, self.cache = outs
+            ring = None
+        wall_s = time.monotonic() - t_launch
+        # Rebind, never ``+=``: the in-place add mutated the numpy
+        # array a zero-copy ``jnp.asarray`` may have aliased into the
+        # STILL-RUNNING launch's cache.kv_len (see _sync_tables).
+        self._kv_len = self._kv_len + self.NS * active
         self._bump("decode_steps", self.NS)
         self._bump("mega_launches")
         self._ns_gauge.set(
             self.stats["decode_steps"] / max(self.stats["mega_launches"], 1)
         )
+        # Active slots' request trace ids ride the launch event (and
+        # the decoded ring's launch metadata), so one request can be
+        # followed server → router → replica → engine → device tasks.
+        trace_ids = {
+            slot: req.trace_id
+            for slot, req in enumerate(self._slots)
+            if req is not None and req.trace_id
+        }
         obs_events.emit(
             "mega:launch", ns=self.NS, active=int(active.sum()),
             sampled=int(sampled),
+            trace_ids=",".join(trace_ids[k] for k in sorted(trace_ids)),
         )
+        if ring is not None:
+            # Shared MegaDispatch plumbing records the launch; the
+            # per-run stats ledger + registry mirror ride _bump.
+            self._record_kernel_trace(
+                ring, t_launch, wall_s, self.NS, trace_ids
+            )
+            self._bump("mega_trace_launches")
         toks_np = np.asarray(toks)  # [NS, max_batch]
         return self._process(lambda slot: toks_np[:, slot])
 
@@ -1087,7 +1152,7 @@ class ContinuousEngine(MegaDispatch):
             self.max_batch, self.max_length, self.NS, sampled=sampled,
             page=self.page_size, kv_quant=self.kv_dtype is not None,
             num_pages=int(self.cache.k_pages.shape[1]),
-            valid_arg=True,
+            valid_arg=True, trace=self.kernel_trace,
         )
         if sampled:
             NS, B = self.NS, self.max_batch
@@ -1145,6 +1210,10 @@ class ContinuousEngine(MegaDispatch):
             if r.timeline is None:
                 r.timeline = Timeline()
             r.timeline.stamp_enqueue()
+            # Trace id: client-supplied or assigned here; tags admit
+            # events, mega:launch events, and device-task ring records.
+            if r.trace_id is None:
+                r.trace_id = f"req-{next(_TRACE_IDS)}"
         # Load shedding: the admission queue is bounded — excess
         # requests get a structured `overloaded` error immediately
         # instead of wedging the batch (clients retry with backoff).

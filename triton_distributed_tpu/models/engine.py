@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import time
 import weakref
+from collections import deque
 from typing import Literal
 
 import jax
@@ -106,14 +107,70 @@ def prefill_suffix_chunks(
 
 class MegaDispatch:
     """Shared megakernel-mode dispatch (Engine + ContinuousEngine):
-    lazy MegaQwen3 construction, xla prefill fallback, and mega-vs-model
-    decode routing. Expects ``self.model`` and ``self.mode``;
-    ``self.mega_cfg`` (an optional ``MegaConfig``, e.g. a sweep-tuned
-    one — ``MegaConfig.from_spec(...)`` parses the
-    ``perf/MEGA_TUNED.json`` config strings) customizes the kernel."""
+    lazy MegaQwen3 construction, xla prefill fallback, mega-vs-model
+    decode routing, and the device task tracer's host plumbing.
+    Expects ``self.model`` and ``self.mode``; ``self.mega_cfg`` (an
+    optional ``MegaConfig``, e.g. a sweep-tuned one —
+    ``MegaConfig.from_spec(...)`` parses the ``perf/MEGA_TUNED.json``
+    config strings) customizes the kernel."""
 
     _mega = None
     mega_cfg = None
+
+    # -- device task tracer (docs/observability.md) ----------------------
+
+    def _init_kernel_trace(self, kernel_trace: bool, mode: str) -> None:
+        """Ctor-time tracer state, shared by both engines: validates
+        the knob (the tracer rides the megakernel's trace-ring
+        operand; xla/pallas decode paths have no device ring) and sets
+        up the bounded launch ledger."""
+        if kernel_trace and mode != "mega":
+            raise ValueError(
+                "kernel_trace=True requires mode='mega' (the tracer "
+                "rides the megakernel's trace-ring operand; the "
+                "xla/pallas decode paths have no device ring)"
+            )
+        self.kernel_trace = bool(kernel_trace)
+        self._kernel_traces: "deque" = deque(maxlen=8)
+        self._trace_launch_n = 0
+
+    def _record_kernel_trace(
+        self, ring, t0: float, wall_s: float, nsteps: int,
+        trace_ids: dict | None = None,
+    ) -> None:
+        """Fold one launch's device ring into telemetry: the inline
+        work is vectorized over the raw ring (gap check, per-opcode
+        durations, measured overlap → registry); the launch is kept
+        (bounded deque) with the ring attached, records decoding
+        lazily for ``kernel_trace_summary`` and the merged timeline."""
+        from triton_distributed_tpu.obs import kernel_trace as _kt
+
+        self._trace_launch_n += 1
+        launch = _kt.KernelTraceLaunch(
+            wall_s=wall_s, t0=t0, trace_ids=trace_ids or {},
+            nsteps=nsteps, launch=self._trace_launch_n,
+            ring=np.asarray(ring),
+        )
+        self._kernel_traces.append(launch)
+        _kt.observe_launch(launch)
+
+    def kernel_trace_launches(self) -> list:
+        """Recent traced launches (``KernelTraceLaunch``), oldest
+        first — what ``obs.kernel_trace.merge_with_host_profile``
+        takes to add device task rows to the merged chrome timeline."""
+        return list(self._kernel_traces)
+
+    def kernel_trace_summary(self) -> dict:
+        """JSON-ready device-tracer state for the server's
+        ``{"cmd": "kernel_trace"}`` verb: knob, launch count (process
+        lifetime), and the recent launches' per-opcode tick totals +
+        measured overlap + request trace ids."""
+        return {
+            "enabled": self.kernel_trace,
+            "mode": self.mode,
+            "launches": self._trace_launch_n,
+            "recent": [ln.summary() for ln in self._kernel_traces],
+        }
 
     @property
     def _prefill_mode(self) -> Mode:
@@ -173,6 +230,7 @@ class Engine(MegaDispatch):
         prefill_chunk: int = 0,
         speculative: int = 0,
         kv_dtype: str | None = None,
+        kernel_trace: bool = False,
     ):
         self.model = model
         self.temperature = temperature
@@ -233,6 +291,12 @@ class Engine(MegaDispatch):
                     "not the megakernel"
                 )
         self.speculative = int(speculative)
+        # Device task tracer (docs/observability.md "Device task
+        # tracer"): multi-step mega launches in serve() carry the
+        # in-kernel trace ring; decoded launches feed
+        # tdt_mega_task_seconds/_overlap_exposure and are kept
+        # (bounded) for kernel_trace_summary / the merged timeline.
+        self._init_kernel_trace(kernel_trace, mode)
         self._prefix_state: _PrefixState | None = None
         # Page-pool free list, populated by the first paged serve();
         # continuous-batching admission/eviction draws from it.
@@ -471,6 +535,7 @@ class Engine(MegaDispatch):
                     num_pages=(
                         int(cache.k_pages.shape[1]) if self.paged else 0
                     ),
+                    trace=self.kernel_trace,
                 )
                 if sampled:
                     # Draw the Gumbel noise INSIDE the jit so each rank
@@ -498,13 +563,23 @@ class Engine(MegaDispatch):
                         extra = (sub, jnp.float32(self.temperature))
                     else:
                         extra = ()
-                    toks, logits, cache = fn(
+                    t_launch = time.monotonic()
+                    launch_outs = fn(
                         # _step_params: the Q8Params pytree under
                         # MegaConfig(wq8=True), model.params otherwise.
                         self._mega_model()._step_params(), tok, cache,
                         *extra,
                     )
-                    toks = np.asarray(toks)  # [NS, b]
+                    if self.kernel_trace:
+                        toks, logits, cache, ring = launch_outs
+                        toks = np.asarray(toks)  # also fences the wall
+                        self._record_kernel_trace(
+                            ring, t_launch,
+                            time.monotonic() - t_launch, NS,
+                        )
+                    else:
+                        toks, logits, cache = launch_outs
+                        toks = np.asarray(toks)  # [NS, b]
                     out.append(toks.T)
                     tok = jnp.asarray(toks[-1])
                     left -= NS
@@ -540,6 +615,8 @@ class Engine(MegaDispatch):
             "prefill_tokens": prefill_toks,
             "generated_tokens": int(b * gen_len),
         }
+        if self.kernel_trace:
+            self.last_stats["mega_trace_launches"] = self._trace_launch_n
         if obs_metrics.default_registry().enabled:
             h = self._metric_handles
             h["decode_steps"].inc(steps)

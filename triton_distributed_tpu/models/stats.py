@@ -88,4 +88,8 @@ STAT_METRICS = {
     "mega_fallback_steps": ("tdt_mega_single_step_fallbacks_total",
                             "Mega-mode rounds served by the single-step "
                             "fallback (tail or filtered sampling)."),
+    # Device task tracer (docs/observability.md "Device task tracer").
+    "mega_trace_launches": ("tdt_mega_trace_launches_total",
+                            "Megakernel launches whose device trace "
+                            "ring was decoded."),
 }

@@ -16,6 +16,10 @@ drops every mutation to an attribute check.
 - :mod:`~triton_distributed_tpu.obs.events` — bounded structured-event
   ring with gap-free seq numbers for drop-aware tailing
   (``{"cmd": "events"}``).
+- :mod:`~triton_distributed_tpu.obs.kernel_trace` — decoder for the
+  megakernel's device task-tracer ring (docs/observability.md "Device
+  task tracer"). NOT imported here: it pulls the megakernel package
+  (and therefore jax), while this top-level import stays host-only.
 """
 
 from triton_distributed_tpu.obs.events import (  # noqa: F401

@@ -93,8 +93,8 @@ class EventRing:
     def next_seq(self) -> int:
         return self._next_seq
 
-    def tail(self, since: int = 0,
-             limit: int | None = None) -> tuple[list[Event], int]:
+    def tail(self, since: int = 0, limit: int | None = None,
+             kind: str | None = None) -> tuple[list[Event], int]:
         """Events with ``seq > since``, oldest first, plus how many such
         events are GONE (overwritten by the ring). ``limit`` is a page
         size: it keeps the OLDEST ``limit`` so ``since=<last seq seen>``
@@ -103,7 +103,14 @@ class EventRing:
         on later pages) everything since its last call. A negative
         ``since`` clamps to 0 (the before-everything cursor) — it must
         not read as phantom drops to a drop-summing consumer (the
-        server additionally rejects it wire-side as ``bad_request``)."""
+        server additionally rejects it wire-side as ``bad_request``).
+
+        ``kind`` filters to one event stream (``span`` /
+        ``mega:launch`` / ``fault`` / ...) server-side, so stream
+        consumers stop re-filtering the full firehose client-side.
+        The filter applies AFTER the drop count (the ring cannot know
+        an overwritten event's kind) and BEFORE ``limit`` (a page is
+        ``limit`` MATCHING events, not ``limit`` scanned)."""
         since = max(since, 0)
         with self._lock:
             newest = self._next_seq - 1
@@ -115,6 +122,8 @@ class EventRing:
             dropped = events[0].seq - since - 1
         else:
             dropped = max(0, newest - since)
+        if kind is not None:
+            events = [e for e in events if e.kind == kind]
         if limit is not None and limit >= 0:
             events = events[:limit]
         return events, dropped

@@ -186,8 +186,17 @@ class Histogram(_Metric):
             raise ValueError(f"{name}: bucket edges must strictly increase")
 
     def observe(self, v: float, **labels) -> None:
+        self.observe_n(v, 1, **labels)
+
+    def observe_n(self, v: float, n: int = 1, **labels) -> None:
+        """``n`` observations of the same value in one bucket
+        increment — the bulk path for high-rate emitters that can
+        pre-group identical samples (the device task tracer folds a
+        whole launch's per-task durations grouped by (opcode, ticks),
+        so a launch costs O(distinct durations) registry ops, not
+        O(records))."""
         reg = self._registry
-        if not reg.enabled:
+        if not reg.enabled or n <= 0:
             return
         key = self._key(labels)
         i = bisect.bisect_left(self.edges, v)
@@ -197,8 +206,8 @@ class Histogram(_Metric):
                 series = self._series[key] = [
                     [0] * (len(self.edges) + 1), 0.0
                 ]
-            series[0][i] += 1
-            series[1] += v
+            series[0][i] += n
+            series[1] += v * n
 
     def count(self, **labels) -> int:
         s = self._series.get(self._key(labels))

@@ -86,23 +86,51 @@ def _gemm_ar_one_shot_kernel(
     b_ref,      # [k_loc, tile_n] VMEM — B tile min(s, num_j-1)
     o_ref,      # [M, tile_n] VMEM — reduced output tile max(s-1, 0)
     ws,         # [n, M, N] ANY/HBM output — slot p holds peer p's partial
-    sbuf,       # [M, tile_n] VMEM — partial tile staging
-    vbuf,       # [n, M, tile_n] VMEM — reduce staging
-    stage_sem,  # DMA ()
-    send_sems,  # DMA (n-1,)
-    recv_sems,  # DMA (n, num_j) — arrival of (src rank, tile)
-    *,
+    *rest,      # [tr (SMEM ring, trace only)], sbuf, vbuf, sems, [clk]
     axis: str,
     acc_dtype,
+    trace: bool = False,
 ):
+    if trace:
+        tr, sbuf, vbuf, stage_sem, send_sems, recv_sems, clk = rest
+    else:
+        tr = clk = None
+        sbuf, vbuf, stage_sem, send_sems, recv_sems = rest
     me = dl.rank(axis)
     n = dl.num_ranks(axis)
     s = pl.program_id(0)
     num_j = pl.num_programs(0) - 1
 
+    # Device task-tracer seam (docs/observability.md "Device task
+    # tracer"): the standalone overlap kernel records the SAME ring
+    # format as the megakernel — produce phases as AR_SEND rows (mid =
+    # puts in flight), reduce phases as AR_WAIT rows (mid = partials
+    # landed), the drain as a BARRIER row — decoded by the one
+    # obs/kernel_trace.py decoder (strict=False: iterations only run
+    # the phases their grid position owns). Phase rows sit in
+    # EXECUTION order (0 produce, 1 reduce, 2 drain — the order the
+    # pl.when blocks run within an iteration), so the decoder's
+    # per-step clock-monotonicity check holds on real rings.
+    def tick():
+        c = clk[0] + 1
+        clk[0] = c
+        return c
+
+    def record(phase, opcode, slot, begin, end, mid):
+        tr[s, phase, 0] = s          # task_id = grid iteration
+        tr[s, phase, 1] = opcode
+        tr[s, phase, 2] = 0          # layer
+        tr[s, phase, 3] = slot       # tile index
+        tr[s, phase, 4] = begin
+        tr[s, phase, 5] = end
+        tr[s, phase, 6] = mid
+        tr[s, phase, 7] = 1
+
     @pl.when(s == 0)
     def _entry():
         # Peers' ws slots must exist before the first remote put lands.
+        if trace:
+            clk[0] = 0
         dl.barrier_all(axis)
 
     @pl.when(s < num_j)
@@ -112,6 +140,7 @@ def _gemm_ar_one_shot_kernel(
         # tile s-1 is being reduced and tile s+1 is on the MXU (per-tile
         # notify pipelining, as the reference's producer GEMM does with
         # its tile barriers).
+        begin = tick() if trace else None
         tile_n = b_ref.shape[1]
         jsl = pl.ds(s * tile_n, tile_n)
         sbuf[:] = jnp.dot(
@@ -126,18 +155,24 @@ def _gemm_ar_one_shot_kernel(
                 ws.at[me].at[:, jsl], ws.at[me].at[:, jsl], peer,
                 send_sems.at[i - 1], recv_sems.at[me, s], axis=axis,
             )
+        if trace:
+            mid = tick()  # puts in flight
+            record(0, 12, s, begin, tick(), mid)  # TaskType.AR_SEND
 
     @pl.when(s > 0)
     def _reduce():
         # Reduce tile s-1: wait its n-1 inbound partials (per-(src, tile)
         # semaphores — the analog of the reference consumer's per-tile
         # ``dl.wait`` + ``consume_token``), stage, sum locally.
+        begin = tick() if trace else None
         tile_n = o_ref.shape[1]
         j = s - 1
         jsl = pl.ds(j * tile_n, tile_n)
         for i in range(1, n):
             src = jax.lax.rem(me + i, n)
             dl.wait_recv(recv_sems.at[src, j], ws.at[src].at[:, jsl])
+        if trace:
+            mid = tick()  # partials landed; the rest is the local fold
         dma = dl.local_copy(ws.at[:, :, jsl], vbuf, stage_sem)
         dma.start()
         dma.wait()
@@ -145,14 +180,22 @@ def _gemm_ar_one_shot_kernel(
         for i in range(1, n):
             acc = acc + vbuf[i].astype(acc_dtype)
         o_ref[:] = acc.astype(o_ref.dtype)
+        if trace:
+            record(1, 13, j, begin, tick(), mid)  # TaskType.AR_WAIT
 
     @pl.when(s == num_j)
     def _drain():
         # All num_j tiles were sent to each peer: [M, N] bytes per peer.
+        begin = tick() if trace else None
         for i in range(1, n):
             pltpu.make_async_copy(
                 ws.at[me], ws.at[me], send_sems.at[i - 1]
             ).wait()
+        if trace:
+            # Phase row 2: the drain runs AFTER this iteration's
+            # reduce — its row index must follow reduce's or the
+            # decoder's monotonicity check would misfire.
+            record(2, 9, 0, begin, tick(), 0)  # TaskType.BARRIER
 
 
 def gemm_ar(
@@ -162,20 +205,49 @@ def gemm_ar(
     method: GemmARMethod = GemmARMethod.AUTO,
     config: GemmARConfig | None = None,
     ctx: DistContext | None = None,
+    trace: bool = False,
 ) -> jax.Array:
     """Overlapped ``psum(a @ b)`` inside ``shard_map``.
 
     ``a``: ``[M, k_loc]`` column shard; ``b``: ``[k_loc, N]`` row shard.
     Every device returns the full reduced ``[M, N]`` — same contract as
     reference ``gemm_allreduce_op`` (``gemm_allreduce.py:509``).
+
+    ``trace=True`` (ONE_SHOT only) additionally returns this shard's
+    device task ring ``[num_j+1, 3, 8]`` int32 — produce/reduce/drain
+    phase rows IN EXECUTION ORDER per grid iteration (produce < reduce
+    < drain, so ``validate_ring``'s per-step monotonicity holds), in
+    the megakernel tracer's format, decoded by
+    ``obs.kernel_trace.decode_trace(..., strict=False)`` — iterations
+    only write the phases their grid position owns
+    (docs/observability.md "Device task tracer"). Note the decoder's
+    ``overlap_report`` windows pair AR_SEND/AR_WAIT within one step:
+    this kernel's send (tile j, iteration j) and its wait (iteration
+    j+1) land in different steps — reshape the ring to one step
+    (``ring.reshape(ranks, 1, -1, 8)``) to pair them.
     """
     n = jax.lax.axis_size(axis)
     m, k_loc = a.shape
     _, n_out = b.shape
     config = config or create_gemm_ar_context(m, n_out, k_loc, a.dtype)
+    if trace and method is not GemmARMethod.ONE_SHOT:
+        raise ValueError(
+            "trace=True requires method=ONE_SHOT (the ring rides the "
+            "fused kernel; XLA/TWO_SHOT paths have no device ring)"
+        )
 
     if n == 1:
-        return jnp.dot(a, b, preferred_element_type=config.acc_dtype).astype(a.dtype)
+        out = jnp.dot(
+            a, b, preferred_element_type=config.acc_dtype
+        ).astype(a.dtype)
+        if trace:
+            # No fused kernel ran (single rank: nothing to overlap) —
+            # keep the documented (out, ring) arity with an all-zero
+            # (= all-unwritten) ring so strict=False decodes to [].
+            tile_n = min(config.tile_n, n_out)
+            num_j = n_out // max(tile_n, 1)
+            return out, jnp.zeros((num_j + 1, 3, 8), jnp.int32)
+        return out
 
     out_bytes = m * n_out * a.dtype.itemsize
     if method == GemmARMethod.AUTO:
@@ -212,14 +284,21 @@ def gemm_ar(
         raise ValueError(f"n_out={n_out} not divisible by tile_n={tile_n}")
     num_j = n_out // tile_n
 
-    out, _ws = comm_pallas_call(
+    outs = comm_pallas_call(
         functools.partial(
-            _gemm_ar_one_shot_kernel, axis=axis, acc_dtype=config.acc_dtype
+            _gemm_ar_one_shot_kernel, axis=axis,
+            acc_dtype=config.acc_dtype, trace=trace,
         ),
         (
             jax.ShapeDtypeStruct((m, n_out), a.dtype),
             jax.ShapeDtypeStruct((n, m, n_out), a.dtype),
-        ),
+        ) + ((
+            # Device task ring: [grid, phase, TRACE_INTS] — phases in
+            # execution order (0 produce, 1 reduce, 2 drain); not every
+            # iteration runs every phase, so the decoder skips
+            # unwritten rows with strict=False.
+            jax.ShapeDtypeStruct((num_j + 1, 3, 8), jnp.int32),
+        ) if trace else ()),
         grid=(num_j + 1,),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.VMEM),
@@ -236,14 +315,14 @@ def gemm_ar(
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(memory_space=pl.ANY),
-        ),
+        ) + ((pl.BlockSpec(memory_space=pltpu.SMEM),) if trace else ()),
         scratch_shapes=[
             pltpu.VMEM((m, tile_n), a.dtype),
             pltpu.VMEM((n, m, tile_n), a.dtype),
             pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA((n - 1,)),
             pltpu.SemaphoreType.DMA((n, num_j)),
-        ],
+        ] + ([pltpu.SMEM((1,), jnp.int32)] if trace else []),
         collective_id=_GEMM_AR_COLLECTIVE_ID,
         # Mosaic double-buffers the BlockSpec-pipelined operands; at
         # north-star shapes that exceeds the 16 MB default scoped-VMEM
@@ -260,7 +339,9 @@ def gemm_ar(
         ),
         ctx=ctx,
     )(a, b)
-    return out
+    if trace:
+        return outs[0], outs[2]
+    return outs[0]
 
 
 def gemm_ar_op(
@@ -270,11 +351,28 @@ def gemm_ar_op(
     method: GemmARMethod = GemmARMethod.AUTO,
     config: GemmARConfig | None = None,
     ctx: DistContext | None = None,
+    trace: bool = False,
 ) -> jax.Array:
     """Host-level wrapper: ``a [M, K]`` column-sharded over ``axis``,
     ``b [K, N]`` row-sharded; returns the full ``[M, N]`` (replicated) —
-    the summed GEMM on every device."""
+    the summed GEMM on every device. ``trace=True`` (ONE_SHOT only)
+    returns ``(out, ring [n_ranks, num_j+1, 3, 8])`` — the per-rank
+    device task rings (docs/observability.md "Device task tracer")."""
     ctx = ctx or current_context()
+    if trace:
+        def shard(a_, b_):
+            out, ring = gemm_ar(
+                a_, b_, axis=axis, method=method, config=config,
+                ctx=ctx, trace=True,
+            )
+            return out, ring[None]
+
+        f = ctx.shard_map(
+            shard,
+            in_specs=(P(None, axis), P(axis, None)),
+            out_specs=(P(None, None), P(axis)),
+        )
+        return f(a, b)
     f = ctx.shard_map(
         functools.partial(gemm_ar, axis=axis, method=method, config=config, ctx=ctx),
         in_specs=(P(None, axis), P(axis, None)),

@@ -37,6 +37,14 @@ _PID_STRIDE = 10_000_000
 # the probe.
 _FLOAT_META_OK: bool | None = None
 
+# Whether the profiler demands ALL-string metadata: set only when a
+# span SUCCEEDED on the uniform-stringify rung after a lower rung was
+# rejected — a proven, deterministic type restriction. A span on which
+# every rung failed settles nothing beyond the float probe: that
+# failure may be transient (capture teardown race), and one transient
+# error must not downgrade every future span's metadata to strings.
+_STR_META_ONLY: bool = False
+
 @contextlib.contextmanager
 def trace_span(name: str, **args):
     """Named host-side span on the jax.profiler timeline AND the
@@ -52,7 +60,10 @@ def trace_span(name: str, **args):
     them, so a float-metadata mismatch costs precision, never the
     span — and the rejection is remembered process-wide
     (``_FLOAT_META_OK``), so later float spans go straight to the
-    stringified form. Outside an active capture the annotation is free; a profiler
+    stringified form. The final rung stringifies EVERY arg uniformly,
+    so a profiler that rejects some other type too (an out-of-range
+    int, say) still gets the span with all-string args instead of
+    losing it. Outside an active capture the annotation is free; a profiler
     API mismatch must never sink serving, so entry failures degrade to
     a plain yield (body exceptions still propagate).
 
@@ -63,17 +74,28 @@ def trace_span(name: str, **args):
     already emits a dedicated, richer ring event (e.g. ``spec_verify``)
     passes ``_ring=False`` to skip the duplicate ``span`` entry —
     bounded ring space shouldn't hold the same moment twice."""
-    global _FLOAT_META_OK
+    global _FLOAT_META_OK, _STR_META_ONLY
     ring_emit = args.pop("_ring", True)
     span = None
     has_float = any(
         isinstance(v, float) and not isinstance(v, bool)
         for v in args.values()
     )
-    if has_float and _FLOAT_META_OK is not False:
-        variants = ((int, str, float), (int, str))
+    # Fallback ladder: floats native → ints native → EVERYTHING
+    # stringified. The last rung is the uniform stringify fallback: a
+    # profiler that also rejects some non-float type (an int out of
+    # its range, say) used to lose the span entirely on the retry
+    # path — now such a span survives with all-string args, which is
+    # the documented degradation (precision, never the span). Both
+    # ladder positions are remembered (_FLOAT_META_OK /
+    # _STR_META_ONLY), so a persistently strict profiler costs one
+    # construction per span, not the ladder.
+    if _STR_META_ONLY:
+        variants = ((str,),)
+    elif has_float and _FLOAT_META_OK is not False:
+        variants = ((int, str, float), (int, str), (str,))
     else:
-        variants = ((int, str),)
+        variants = ((int, str), (str,))
     for num_ok in variants:
         try:
             prof_args = {
@@ -83,18 +105,24 @@ def trace_span(name: str, **args):
             span = jax.profiler.TraceAnnotation(name, **prof_args)
             span.__enter__()
             if has_float:
-                # Probe settled: either floats passed natively, or the
+                # Probe settled: either floats passed natively, or a
                 # stringified retry succeeded where the float attempt
                 # failed (so the floats were the rejection's cause —
                 # a wholly broken profiler never reaches here).
                 _FLOAT_META_OK = float in num_ok
+            if num_ok == (str,) and len(variants) > 1:
+                # A lower rung rejected native numerics beyond floats:
+                # later spans skip straight to uniform stringify.
+                _STR_META_ONLY = True
             break
         except Exception:
             span = None
     if span is None and has_float and _FLOAT_META_OK is None:
-        # Both variants failed (profiler wholly broken, not a float
-        # rejection): settle the probe anyway so future float spans
-        # pay ONE failed construction like every other span, not two.
+        # Every rung failed (profiler wholly broken, not a float
+        # rejection): settle the float probe so later float spans
+        # skip the native-float rung. _STR_META_ONLY is NOT set here
+        # — a wholly-failed span proves nothing about accepted types,
+        # and the failure may be transient.
         _FLOAT_META_OK = False
     # Honor the disabled-mode contract (attribute check + return):
     # skip the clock reads and the kwargs coercion entirely when the

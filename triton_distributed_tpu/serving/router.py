@@ -209,6 +209,33 @@ class Router:
         agg["router"] = router
         return agg
 
+    def kernel_trace_summary(self) -> dict:
+        """Fleet device-tracer state for the server's
+        ``{"cmd": "kernel_trace"}`` verb (docs/observability.md
+        "Device task tracer"): one per-replica summary per replica
+        whose engine exposes a tracer — the router itself has no
+        device ring, it only fans the question out."""
+        out: dict = {"replicas": {}}
+        for r in self.replicas:
+            summary = getattr(r.engine, "kernel_trace_summary", None)
+            if summary is not None:
+                out["replicas"][r.name] = summary()
+        out["enabled"] = any(
+            s.get("enabled") for s in out["replicas"].values()
+        )
+        return out
+
+    def kernel_trace_launches(self) -> list:
+        """Every replica's recent traced launches, flattened (oldest
+        first by launch wall start) — what
+        ``obs.kernel_trace.merge_with_host_profile`` consumes."""
+        launches: list = []
+        for r in self.replicas:
+            get = getattr(r.engine, "kernel_trace_launches", None)
+            if get is not None:
+                launches.extend(get())
+        return sorted(launches, key=lambda ln: ln.t0)
+
     def audit(self, *, raise_on_violation: bool = False) -> list[str]:
         """Every replica engine's pool/radix audit, replica-labeled.
 

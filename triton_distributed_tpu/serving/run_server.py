@@ -60,6 +60,14 @@ def main(argv=None) -> int:
                    "re-routed (0 = off, the default: a cold first "
                    "request compiles for minutes and must not read as "
                    "a hang)")
+    p.add_argument("--trace", default=None, metavar="DIR",
+                   help="wrap the whole run in group_profile(DIR) and "
+                   "merge ONE chrome timeline on exit — host "
+                   "trace_spans plus, with --mode mega, the device "
+                   "task tracer's per-task rows (docs/profiling.md "
+                   "'Device task tracer'); prints the merged path. "
+                   "Also turns the engines' kernel_trace knob on and "
+                   "surfaces both in server_stats.")
     args = p.parse_args(argv)
     if args.speculative and args.mode == "mega":
         # Explicit, named-knob refusal (the engines raise the same
@@ -78,6 +86,10 @@ def main(argv=None) -> int:
 
     ctx = initialize_distributed(tp=args.tp, devices=jax.devices()[: args.tp])
     model = AutoLLM.from_pretrained(args.model, ctx=ctx)
+    # --trace: device-side kernel tracing rides the mega engines only
+    # (the xla/pallas paths have no device ring); host profiling wraps
+    # the run regardless of mode.
+    kernel_trace = bool(args.trace) and args.mode == "mega"
     if args.replicas > 0:
         from triton_distributed_tpu.models.continuous import ContinuousEngine
         from triton_distributed_tpu.serving.router import Router
@@ -87,6 +99,7 @@ def main(argv=None) -> int:
                 model, max_batch=args.max_batch, mode=args.mode,
                 temperature=args.temperature, prefix_cache=True,
                 kv_dtype=args.kv_dtype, speculative=args.speculative,
+                kernel_trace=kernel_trace,
             )
             for _ in range(args.replicas)
         ]
@@ -103,14 +116,29 @@ def main(argv=None) -> int:
             # live on the page pool).
             paged=bool(args.kv_dtype or args.speculative),
             kv_dtype=args.kv_dtype, speculative=args.speculative,
+            kernel_trace=kernel_trace,
         )
         what = f"{args.model} (tp={args.tp})"
     server = ModelServer(
         engine, host=args.host, port=args.port,
-        drain_grace_s=args.drain_grace,
+        drain_grace_s=args.drain_grace, trace_dir=args.trace,
     )
     print(f"serving {what} on {server.host}:{server.port}")
-    server.serve_forever()
+    if args.trace:
+        # Host capture wraps the whole serving run; on exit the ranks'
+        # chrome traces AND every traced mega launch's device task rows
+        # merge into ONE timeline (docs/profiling.md).
+        from triton_distributed_tpu.obs import kernel_trace as _kt
+        from triton_distributed_tpu.runtime.profiling import group_profile
+
+        with group_profile("serve", out_dir=args.trace, merge=False):
+            server.serve_forever()
+        launches = getattr(engine, "kernel_trace_launches", lambda: [])()
+        merged = _kt.merge_with_host_profile("serve", args.trace, launches)
+        print(f"merged trace: {merged} "
+              f"({len(launches)} traced mega launches)")
+    else:
+        server.serve_forever()
     return 0
 
 

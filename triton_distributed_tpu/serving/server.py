@@ -15,9 +15,11 @@ framed protocol. Here the protocol is newline-delimited JSON over TCP:
        "stats": {...}}
     → {"cmd": "stats"}           ← {"stats": {..., "server": {...}}}
     → {"cmd": "metrics"}         ← {"prometheus": "...", "metrics": {...}}
-    → {"cmd": "events", "since": 0, "limit": 100}
+    → {"cmd": "events", "since": 0, "limit": 100, "kind": "span"}
                                  ← {"events": [...], "dropped": 0,
                                     "next_since": 17}
+    → {"cmd": "kernel_trace"}    ← {"kernel_trace": {"launches": ...,
+                                    "recent": [...]}}
     → {"cmd": "ping"}            ← {"ok": true, "draining": false}
     → {"cmd": "shutdown"}        ← {"ok": true}   (server then drains)
 
@@ -31,9 +33,14 @@ cache"), so a client can read the storage mode through the wire.
 **Telemetry** (docs/observability.md): ``{"cmd": "metrics"}`` returns
 the process metrics registry as a Prometheus-text-format string AND a
 JSON snapshot with derived p50/p90/p99; ``{"cmd": "events"}`` tails
-the bounded structured-event ring drop-aware by seq number. Both are
-probe verbs: they never touch the engine lock, so scraping works
-mid-generation. Every payload is also counted/timed per verb
+the bounded structured-event ring drop-aware by seq number (``kind=``
+pulls one stream — ``span``/``mega:launch``/``fault``/… — server-side);
+``{"cmd": "kernel_trace"}`` returns the device task tracer's recent
+decoded launches (mode='mega' engines; docs/observability.md "Device
+task tracer"). A ``requests`` payload may carry per-request
+``trace_ids`` that follow each request through admit events, launch
+events, and device task rows. All are probe verbs: they never touch
+the engine lock, so scraping works mid-generation. Every payload is also counted/timed per verb
 (``tdt_server_requests_total``, ``tdt_server_request_seconds``,
 ``tdt_server_errors_total``).
 
@@ -89,7 +96,8 @@ from triton_distributed_tpu.runtime.faults import fault_point
 # The probe verbs _dispatch_inner answers. ONE tuple: the metrics
 # label in _verb_of and the `accepted payloads` help both derive from
 # it, so a new verb can't silently label its traffic `unknown`.
-PROBE_CMDS = ("ping", "stats", "metrics", "events", "shutdown")
+PROBE_CMDS = ("ping", "stats", "metrics", "events", "kernel_trace",
+              "shutdown")
 
 
 class _BadRequest(ValueError):
@@ -118,9 +126,15 @@ class ModelServer:
         *,
         max_pending: int = 8,
         drain_grace_s: float = 2.0,
+        trace_dir: str | None = None,
     ):
         self.engine = engine
         self.max_pending = max_pending
+        # Informational: where a --trace run merges its host+device
+        # timeline (run_server owns the actual group_profile capture;
+        # the server only surfaces the knob in server_stats so a
+        # scraper can see tracing is deployed).
+        self.trace_dir = trace_dir
         # Connection-drain budget (was a hardcoded 2.0): bounds how
         # long an oversized-line tail is drained before the conn
         # closes, and rides into the router's replica-drain grace when
@@ -195,7 +209,11 @@ class ModelServer:
             "mode": getattr(self.engine, "mode", None),
             "kv_dtype": getattr(self.engine, "kv_dtype", None),
             "speculative": getattr(self.engine, "speculative", 0),
+            "kernel_trace": getattr(self.engine, "kernel_trace", False),
         }
+        # --trace DIR deployments (run_server) surface where the
+        # merged host+device timeline will land.
+        stats["trace_dir"] = self.trace_dir
         # ``snapshot_at`` is the same monotonic clock the per-request
         # timelines use, so a scraper can order stats snapshots against
         # event-ring timestamps without wall-clock skew.
@@ -285,23 +303,58 @@ class ModelServer:
                     raise _BadRequest(
                         "events since/limit must be >= 0"
                     )
+                # kind= pulls one stream (span / mega:launch / fault /
+                # admit / ...) server-side instead of every consumer
+                # re-filtering the full firehose client-side.
+                kind = req.get("kind")
+                if kind is not None and not isinstance(kind, str):
+                    raise _BadRequest("events kind must be a string")
                 ring = obs_events.default_ring()
-                evts, dropped = ring.tail(since, limit)
+                # Snapshot the newest seq BEFORE tailing: a
+                # kind-filtered empty page may safely skip everything
+                # scanned (all non-matching), but not events emitted
+                # after the scan.
+                newest_pre = ring.next_seq - 1
+                evts, dropped = ring.tail(since, limit, kind=kind)
                 # Empty tail still advances the cursor past anything
                 # the ring dropped (e.g. a clear()), or a drop-summing
                 # consumer would re-count the same loss every poll —
                 # but never past events a `limit` deferred to the next
                 # page (tail keeps the oldest, so since+dropped is
                 # always the seq just before the first undelivered
-                # event).
-                next_since = (
-                    evts[-1].seq if evts else since + dropped
-                )
+                # event). A kind-filtered empty page additionally
+                # skips the scanned non-matching events.
+                if evts:
+                    next_since = evts[-1].seq
+                elif kind is not None and limit != 0:
+                    # Zero matches in the WHOLE scanned range (a
+                    # nonzero limit can only truncate matches, and
+                    # there were none): safe to skip the scanned
+                    # non-matching events. limit == 0 returns an empty
+                    # page regardless of matches, so it must NOT skip
+                    # — matching events may sit in (since, newest].
+                    next_since = max(since, newest_pre)
+                else:
+                    next_since = since + dropped
                 return {
                     "events": [e.as_dict() for e in evts],
                     "dropped": dropped,
                     "next_since": next_since,
                 }
+            if cmd == "kernel_trace":
+                # Probe verb (engine-lock-free): the engines keep the
+                # decoded launches under their own bounded deque, so a
+                # scrape mid-generation reads a recent snapshot.
+                summary = getattr(
+                    self.engine, "kernel_trace_summary", None
+                )
+                if summary is None:
+                    raise _BadRequest(
+                        "this engine has no device kernel tracer "
+                        "(mode='mega' engines expose it; see "
+                        "docs/observability.md 'Device task tracer')"
+                    )
+                return {"kernel_trace": summary()}
             if "requests" in req or "input_ids" in req:
                 return self._generate_guarded(req)
             accepted = [
@@ -405,6 +458,24 @@ class ModelServer:
             top_ps = knob("top_ps", float)
             top_ks = knob("top_ks", int)
             deadlines = knob("deadline_s", float)
+            # Client-supplied trace ids (docs/observability.md "Device
+            # task tracer"): follow each request through admit events,
+            # mega:launch events, and device-task ring records. Always
+            # a list (no scalar broadcast — ids must stay per-request
+            # unique); omitted/null entries get engine-assigned ids.
+            trace_ids = req.get("trace_ids")
+            if trace_ids is None:
+                trace_ids = [None] * len(prompts)
+            elif (not isinstance(trace_ids, list)
+                  or len(trace_ids) != len(prompts)):
+                raise ValueError(
+                    f"{len(prompts)} requests but trace_ids is "
+                    f"{trace_ids!r} (want a {len(prompts)}-entry list)"
+                )
+            else:
+                trace_ids = [
+                    None if x is None else str(x) for x in trace_ids
+                ]
             from triton_distributed_tpu.models.continuous import Request
 
             def _timeline() -> Timeline:
@@ -417,9 +488,11 @@ class ModelServer:
                     Request(
                         p, int(g), temperature=t, top_p=tp, top_k=tk,
                         deadline_s=dl, timeline=_timeline(),
+                        trace_id=tid,
                     )
-                    for p, g, t, tp, tk, dl in zip(
-                        prompts, gen_lens, temps, top_ps, top_ks, deadlines
+                    for p, g, t, tp, tk, dl, tid in zip(
+                        prompts, gen_lens, temps, top_ps, top_ks,
+                        deadlines, trace_ids,
                     )
                 ],
                 results=True,
