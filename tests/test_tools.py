@@ -376,6 +376,14 @@ def test_tier1_marker_audit():
     assert "test_kernel_trace.py" in order
     assert (order.index("test_kernel_trace.py")
             < order.index("test_serving.py"))
+    # ISSUE-9: the process-fleet chaos suite spawns child interpreters
+    # (~seconds per fleet) — it must be explicitly scheduled (not
+    # rank -1 ahead of everything) AND sit before the multi-minute
+    # interpret tail so the wall clock actually reaches it.
+    assert "test_fleet.py" in order
+    assert (order.index("test_router.py")
+            < order.index("test_fleet.py")
+            < order.index("test_serving.py"))
     # And it contains non-slow tests, so tier-1 (which skips `slow`)
     # actually exercises the tracer.
     src = open(os.path.join(tests_dir, "test_kernel_trace.py")).read()
@@ -399,11 +407,12 @@ def test_tier1_marker_audit():
 
 
 def test_serving_tier_modules_compile():
-    """The multi-engine serving tier must byte-compile: the router and
-    replica modules are imported by the serving package (so a syntax
-    error takes the whole server down at import time), and the
-    CPU-runnable bench that writes perf/ROUTER.json rides along (repo
-    convention: perf harnesses fail tier-1, not a relay window)."""
+    """The multi-engine serving tier must byte-compile: the router,
+    replica, and process-fleet modules are imported by the serving
+    package (so a syntax error takes the whole server down at import
+    time), and the CPU-runnable benches that write perf/ROUTER.json
+    and perf/FLEET.json ride along (repo convention: perf harnesses
+    fail tier-1, not a relay window)."""
     import os
     import subprocess
     import sys
@@ -415,8 +424,15 @@ def test_serving_tier_modules_compile():
         os.path.join(root, "triton_distributed_tpu", "serving",
                      "replica.py"),
         os.path.join(root, "triton_distributed_tpu", "serving",
+                     "remote.py"),
+        os.path.join(root, "triton_distributed_tpu", "serving",
+                     "supervisor.py"),
+        os.path.join(root, "triton_distributed_tpu", "serving",
                      "run_server.py"),
+        os.path.join(root, "triton_distributed_tpu", "models",
+                     "stub.py"),
         os.path.join(root, "perf", "router_bench.py"),
+        os.path.join(root, "perf", "fleet_bench.py"),
     ]
     proc = subprocess.run(
         [sys.executable, "-m", "compileall", "-q", "-f", *targets],

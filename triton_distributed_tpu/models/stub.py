@@ -1,0 +1,192 @@
+"""Deterministic stub engine: the serving control plane with no model.
+
+The process-fleet supervisor (``serving/supervisor.py``) spawns one
+child process per replica, and the chaos suite SIGKILLs them mid-batch
+— which makes child startup cost part of every tier-1 fleet test. A
+real ``ContinuousEngine`` child pays model load + first-compile per
+spawn; this stub pays neither, while keeping everything the fleet
+actually exercises REAL:
+
+- the radix prefix cache (``models/prefix_cache.py``) and page pool
+  are the production classes — admission matches, COW-counts, inserts,
+  retires, and evicts through the exact protocol ``ContinuousEngine``
+  uses, so prefix digests, affinity routing, hit rates, and pool/tree
+  audits over the wire are the real thing;
+- outputs are a pure function of the token context (an FNV-1a rolling
+  hash picks each next token), so a re-routed request reproduces
+  BIT-EXACTLY on any replica — the chaos suite's survivor-equality
+  checks mean what they mean on the real model;
+- ``last_stats`` carries the fleet-total keys
+  (``serving/replica.py::FLEET_TOTAL_KEYS``) with the same semantics,
+  so router aggregation and the supervisor bench read one schema.
+
+What it does NOT model: logits, KV bytes, sampling temperature (all
+requests decode greedily under the hash), or wall-clock realism —
+``delay_s`` exists only to hold a batch in flight long enough for a
+mid-batch SIGKILL to land deterministically.
+
+``run_server --model stub`` serves one of these behind the production
+``ModelServer``, which is how the supervisor's tests and
+``perf/fleet_bench.py`` spawn whole fleets in seconds.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from triton_distributed_tpu.models.continuous import RequestResult
+from triton_distributed_tpu.models.paged_kv_cache import PagePool
+from triton_distributed_tpu.models.prefix_cache import PrefixCache
+
+_FNV_OFFSET = 2166136261
+_FNV_PRIME = 16777619
+_MASK = (1 << 32) - 1
+
+
+def stub_next_token(context, vocab: int) -> int:
+    """The stub's whole "model": FNV-1a over the token context. Pure,
+    stateless, identical in every process — the property the fleet's
+    bit-exact-reroute guarantee is tested against."""
+    h = _FNV_OFFSET
+    for t in context:
+        h = ((h ^ int(t)) * _FNV_PRIME) & _MASK
+    return h % vocab
+
+
+def stub_generate(prompt, gen_len: int, vocab: int = 211) -> list[int]:
+    """Reference continuation for ``prompt`` — what any replica must
+    produce. Tests compute goldens with this, no engine needed."""
+    toks = [int(t) for t in prompt]
+    out = []
+    for _ in range(int(gen_len)):
+        nxt = stub_next_token(toks, vocab)
+        out.append(nxt)
+        toks.append(nxt)
+    return out
+
+
+class StubEngine:
+    """Continuous-engine-shaped stub over a real radix prefix cache.
+
+    Duck-types the surface ``ModelServer`` and the replica tier speak:
+    ``run(reqs, results=True)``, ``last_stats``, ``prefix_digest``,
+    ``drain``, ``audit``. One instance per child process; the server's
+    engine lock serializes access exactly as for a real engine.
+    """
+
+    def __init__(self, *, num_pages: int = 128, page_size: int = 16,
+                 vocab: int = 211, delay_s: float = 0.0):
+        self.pool = PagePool(num_pages)
+        self.page_size = int(page_size)
+        self.prefix = PrefixCache(self.pool, self.page_size)
+        self.vocab = int(vocab)
+        # Per-batch wall-time floor: keeps a batch in flight long
+        # enough for the chaos suite's mid-batch kill seams.
+        self.delay_s = float(delay_s)
+        self.last_stats: dict = self._zero_stats()
+
+    def _zero_stats(self) -> dict:
+        return {
+            "decode_steps": 0,
+            "prefill_tokens": 0,
+            "generated_tokens": 0,
+            "prefix_hit_tokens": 0,
+            "kv_bytes_per_token": 0.0,
+            "kv_dtype": "stub",
+        }
+
+    def _pages_for(self, n_tokens: int) -> int:
+        return -(-n_tokens // self.page_size)
+
+    def run(self, requests, *, results: bool = False):
+        """Serve a batch; same contract as ``ContinuousEngine.run``.
+        Accepts engine ``Request`` objects or ``(prompt, gen_len)``
+        tuples. ``decode_steps`` counts emitted tokens (the stub has no
+        batched decode, so steps == tokens)."""
+        stats = self._zero_stats()
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        outs: list[RequestResult] = []
+        for req in requests:
+            prompt = getattr(req, "prompt", None)
+            if prompt is None:
+                prompt, gen_len = req
+            else:
+                gen_len = req.gen_len
+            outs.append(self._serve_one(prompt, int(gen_len), stats))
+        self.last_stats = stats
+        stats["prefix_cache"] = dict(self.prefix.stats)
+        stats["prefix_hit_rate"] = self.prefix.hit_rate
+        stats["tree_pages"] = self.prefix.node_count
+        stats["free_pages"] = len(self.pool.free)
+        if results:
+            return outs
+        return [np.asarray(r.tokens, np.int32) for r in outs]
+
+    def _serve_one(self, prompt, gen_len: int,
+                   stats: dict) -> RequestResult:
+        toks = [int(t) for t in prompt]
+        s = len(toks)
+        if s == 0 or gen_len <= 0:
+            return RequestResult(
+                np.zeros(0, np.int32), "unservable",
+                "stub needs a non-empty prompt and gen_len >= 1",
+            )
+        total = self._pages_for(s + gen_len)
+        # The production admission protocol: match (pins + hit
+        # accounting), allocate the uncovered pages (LRU-evicting the
+        # tree when the free list runs short), COW-finish, retire.
+        m = self.prefix.match(toks)
+        new = self.prefix.allocate(total - len(m.nodes))
+        if new is None:
+            self.prefix.release_match(m)
+            return RequestResult(
+                np.zeros(0, np.int32), "overloaded",
+                f"stub pool cannot cover {total} pages",
+            )
+        matched = m.matched_len
+        shared = list(m.nodes)
+        self.prefix.finish_cow(m)
+        pages = m.pages + new
+        out = stub_generate(toks, gen_len, self.vocab)
+        stats["prefill_tokens"] += s - matched
+        stats["prefix_hit_tokens"] += matched
+        stats["generated_tokens"] += gen_len
+        stats["decode_steps"] += gen_len
+        # Cache prompt + fed-back generations, positions [0, s+gen-1)
+        # — the same chain a real engine retires.
+        chain = (toks + out)[: s + gen_len - 1]
+        nchain = self._pages_for(len(chain))
+        self.prefix.retire_sequence(chain, pages[:nchain], shared)
+        self.pool.release(pages[nchain:])
+        return RequestResult(np.asarray(out, np.int32))
+
+    # -- replica/server surface -------------------------------------------
+
+    def prefix_digest(self) -> list:
+        return self.prefix.prefix_digest()
+
+    def drain(self) -> int:
+        """Flush the radix tree back to the pool (replica drain)."""
+        return self.prefix.flush()
+
+    def audit(self, *, raise_on_violation: bool = False) -> list[str]:
+        """Tree invariants + exact pool partition (no in-flight state
+        survives a synchronous ``run``, so free ∪ tree must cover the
+        pool whenever the engine lock is held)."""
+        problems = list(self.prefix.audit())
+        held = len(self.pool.free) + self.prefix.node_count
+        if held != self.pool.num_pages:
+            problems.append(
+                f"pool partition broken: {len(self.pool.free)} free + "
+                f"{self.prefix.node_count} tree != {self.pool.num_pages}"
+            )
+        if problems and raise_on_violation:
+            from triton_distributed_tpu.models.paged_kv_cache import (
+                PoolAuditError,
+            )
+
+            raise PoolAuditError("; ".join(problems))
+        return problems

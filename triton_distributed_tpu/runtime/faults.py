@@ -25,7 +25,24 @@ Seams currently instrumented (grep for ``fault_point``/``mutate_point``):
 ``replica.run``    ``EngineReplica._run_batch`` — replica-kill /
                    replica-hang for the multi-engine router tier
                    (``replica=`` narrows to one replica by name)
+``wire.connect``   ``serving/remote.py`` client connect — refused /
+                   partitioned replica processes (raise-style)
+``wire.send``      remote batch payload bytes (mutate-style: drop via
+                   a raising rule, garble via corruption)
+``wire.recv``      remote response line bytes (mutate-style, same
+                   drop/garble rules as ``wire.send``)
+``proc.kill``      the replica child's pid, offered mid-batch — a
+                   ``kill_proc`` rule SIGKILLs the process while its
+                   batch is in flight (``serving/supervisor.py``)
+``proc.hang``      same offer point — a ``hang_proc`` rule SIGSTOPs
+                   the child so heartbeats wedge without the process
+                   exiting (resume with ``os.kill(pid, SIGCONT)``)
 =================  =====================================================
+
+The ``wire.*``/``proc.*`` seams live on the *router-process* side of
+the socket (``RemoteReplica``'s send/recv path): a ``FaultPlan`` is
+process-global, so arming the parent is what makes cross-process chaos
+deterministic — the child never needs a plan.
 
 Usage::
 
@@ -219,6 +236,123 @@ class FaultPlan:
         match = {} if replica is None else {"replica": replica}
         return self.on("replica.run", every=1, times=times, delay=delay,
                        **match)
+
+    # Wire/process seams for the cross-process fleet (docs/scale-out.md
+    # "Process fleet"). ``replica=`` narrows every one of these to one
+    # RemoteReplica by name; ``side`` picks the wire direction. The
+    # wire seams fire for BOTH generation batches and probes
+    # (heartbeats, remote audits) and share one hit counter — so the
+    # conveniences match ``what="batch"`` by default: with a
+    # supervisor's timer-driven heartbeats in the same process, a
+    # what-unnarrowed times=1 rule would nondeterministically land on
+    # a probe instead of the intended mid-batch fault. Pass
+    # ``what="probe"`` to target heartbeats, ``what=None`` for either.
+
+    def refuse_connect(self, replica: str | None = None, at: int = 0,
+                       times: int = 1,
+                       what: str | None = "batch") -> "FaultPlan":
+        """A RemoteReplica's connect raises as if the child's listener
+        were gone (partition / process death between batches)."""
+        match = {} if replica is None else {"replica": replica}
+        if what is not None:
+            match["what"] = what
+        kw = {"at": at} if at else {"every": 1}
+        return self.on(
+            "wire.connect", times=times,
+            exc=ConnectionRefusedError("connection refused (injected)"),
+            **kw, **match,
+        )
+
+    def drop_wire(self, side: str = "recv", replica: str | None = None,
+                  at: int = 0, times: int = 1,
+                  what: str | None = "batch") -> "FaultPlan":
+        """The wire dies mid-batch: the Nth matching send/recv raises
+        ``ConnectionResetError`` (the RST a killed or partitioned child
+        produces). Implemented as a raising mutate rule so drop and
+        garble share one seam and one hit counter per direction."""
+        if side not in ("send", "recv"):
+            raise ValueError(f"side must be 'send' or 'recv', got {side!r}")
+
+        def _raise(_value, _ctx):
+            raise ConnectionResetError(
+                f"wire.{side} reset (injected)"
+            )
+
+        match = {} if replica is None else {"replica": replica}
+        if what is not None:
+            match["what"] = what
+        kw = {"at": at} if at else {"every": 1}
+        return self.on(f"wire.{side}", times=times, mutate=_raise,
+                       **kw, **match)
+
+    def garble_wire(self, side: str = "recv",
+                    replica: str | None = None, at: int = 0,
+                    times: int = 1,
+                    what: str | None = "batch") -> "FaultPlan":
+        """The Nth matching wire payload is corrupted in flight (bytes
+        reversed — valid UTF-8 JSON never survives it), exercising the
+        protocol-error detection path rather than the clean-close one."""
+        if side not in ("send", "recv"):
+            raise ValueError(f"side must be 'send' or 'recv', got {side!r}")
+
+        def _garble(value, _ctx):
+            return bytes(reversed(bytes(value)))
+
+        match = {} if replica is None else {"replica": replica}
+        if what is not None:
+            match["what"] = what
+        kw = {"at": at} if at else {"every": 1}
+        return self.on(f"wire.{side}", times=times, mutate=_garble,
+                       **kw, **match)
+
+    def kill_proc(self, replica: str | None = None, at: int = 0,
+                  times: int = 1) -> "FaultPlan":
+        """SIGKILL the replica child process mid-batch: the seam offers
+        the child's pid right after the batch payload went out, so the
+        kill lands while the batch is in flight — the OS then closes
+        the socket and the parent's recv sees the crash exactly as a
+        real OOM-kill would read."""
+        import os
+        import signal
+
+        def _kill(pid, _ctx):
+            if pid:
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass  # already gone — the failure is still real
+            return pid
+
+        match = {} if replica is None else {"replica": replica}
+        kw = {"at": at} if at else {"every": 1}
+        return self.on("proc.kill", times=times, mutate=_kill,
+                       **kw, **match)
+
+    def hang_proc(self, replica: str | None = None, at: int = 0,
+                  times: int = 1) -> "FaultPlan":
+        """SIGSTOP the replica child mid-batch: the process stays alive
+        (no exit code, no RST) but stops answering heartbeats — the
+        wedged-process scenario only a heartbeat deadline can detect.
+        Tests resume the child with ``os.kill(pid, SIGCONT)`` to drive
+        the late-result latch race."""
+        import os
+        import signal
+
+        if not hasattr(signal, "SIGSTOP"):  # pragma: no cover
+            raise RuntimeError("platform has no SIGSTOP")
+
+        def _stop(pid, _ctx):
+            if pid:
+                try:
+                    os.kill(pid, signal.SIGSTOP)
+                except ProcessLookupError:
+                    pass
+            return pid
+
+        match = {} if replica is None else {"replica": replica}
+        kw = {"at": at} if at else {"every": 1}
+        return self.on("proc.hang", times=times, mutate=_stop,
+                       **kw, **match)
 
     # -- firing ----------------------------------------------------------
 

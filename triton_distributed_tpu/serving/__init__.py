@@ -7,11 +7,30 @@ demo/deployment surface on top of the Engine. Beyond parity, the
 replicated serving tier (docs/scale-out.md): ``Router`` fans requests
 across N ``EngineReplica``\\ s by prefix affinity with replica
 health/drain and shed-aware balancing; ``ModelServer(Router(...))``
-keeps the wire server as the transport.
+keeps the wire server as the transport. The process fleet
+(docs/scale-out.md "Process fleet") crosses the process boundary:
+``RemoteReplica`` speaks the wire protocol to a child-process
+``ModelServer`` and ``FleetSupervisor`` owns spawn/heartbeat/respawn.
 """
 
+from triton_distributed_tpu.serving.remote import (
+    RemoteEngine,
+    RemoteReplica,
+)
 from triton_distributed_tpu.serving.replica import EngineReplica, Ticket
 from triton_distributed_tpu.serving.router import Router
 from triton_distributed_tpu.serving.server import ModelServer, request
+from triton_distributed_tpu.serving.supervisor import (
+    FleetSupervisor,
+    ReplicaSpec,
+    SpawnError,
+    model_spec,
+    spawn_replica,
+    stub_spec,
+)
 
-__all__ = ["EngineReplica", "ModelServer", "Router", "Ticket", "request"]
+__all__ = [
+    "EngineReplica", "FleetSupervisor", "ModelServer", "RemoteEngine",
+    "RemoteReplica", "ReplicaSpec", "Router", "SpawnError", "Ticket",
+    "model_spec", "request", "spawn_replica", "stub_spec",
+]

@@ -1,0 +1,306 @@
+"""A router replica behind a socket: the cross-process scale-out unit.
+
+PR 6's fleet replicates engines on *threads*; this module crosses the
+process boundary (ROADMAP item 1, docs/scale-out.md "Process fleet"):
+:class:`RemoteReplica` duck-types :class:`EngineReplica`'s
+router-facing surface — ``submit``/``pending``/``snapshot``/
+``match_len``/``begin_drain``/``drain``/``state``/``mark_unhealthy`` —
+but its batches travel the existing line-JSON wire protocol to a
+``ModelServer`` in a child process. ``Router`` composes UNCHANGED: the
+same latch-first :class:`Ticket` machinery that re-routes a dead
+thread replica's work is the recovery path for an OOM-killed process.
+
+The pieces that make the process boundary safe:
+
+- **Ticket ids on the wire.** Every generation payload carries
+  ``ticket_ids``; the server echoes them; results latch BY ID, never
+  by position. A re-dispatched request whose "dead" replica actually
+  finished produces a second completion for the same id — whichever
+  arrives first latches, the loser is recognized and discarded. No
+  double-emit, no misattribution across a garbled wire.
+- **Connection-per-batch.** The worker opens one connection per engine
+  batch (and per probe), so an idle replica never trips the server's
+  idle timeout into a phantom death, and a late response arrives on
+  the exact connection the (possibly already-rerouted) batch still
+  owns.
+- **Digest piggyback.** The batch response carries ``prefix_digest``
+  (the ``want_digest`` payload key), mirroring the in-process rule —
+  replicas publish their radix population at batch boundaries — with
+  zero extra round trips. A respawned replica naturally rejoins with a
+  fresh (empty) digest.
+- **Deterministic chaos.** The wire seams (``wire.connect`` /
+  ``wire.send`` / ``wire.recv``) and the mid-batch process seams
+  (``proc.kill`` / ``proc.hang``) live HERE, on the router-process
+  side, because a :class:`~triton_distributed_tpu.runtime.faults.FaultPlan`
+  is process-global — arming the parent is what makes killing a child
+  mid-batch reproducible (tests/test_fleet.py).
+
+Liveness, crash classification, and respawn belong to
+``serving/supervisor.py`` — this class only detects what the wire
+shows it (EOF, RST, refused, garbage) and dies through the same
+``_die`` → ``on_failure`` path a thread replica uses.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+
+import numpy as np
+
+from triton_distributed_tpu.models.continuous import RequestResult
+from triton_distributed_tpu.runtime.faults import fault_point, mutate_point
+from triton_distributed_tpu.serving.replica import (
+    DEAD,
+    EngineReplica,
+    Ticket,
+)
+
+
+class RemoteEngine:
+    """Client-side proxy for the engine living in a replica process.
+
+    Duck-types the fragments of the engine surface the router actually
+    touches through ``replica.engine``: ``last_stats`` (refreshed from
+    every batch response), ``audit()`` (the server's ``audit`` verb),
+    and ``prefix_digest()`` (the digest piggybacked on the last batch).
+    Generation itself goes through :meth:`generate`, called only by
+    the owning :class:`RemoteReplica` worker.
+    """
+
+    def __init__(self, host: str, port: int, *, name: str,
+                 pid: int | None = None,
+                 connect_timeout_s: float = 10.0,
+                 probe_timeout_s: float = 10.0,
+                 recv_timeout_s: float | None = None):
+        self.host, self.port = host, int(port)
+        self.name = name
+        self.pid = pid
+        self.connect_timeout_s = float(connect_timeout_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        # Batch recv: None blocks until the child answers or its socket
+        # dies — a wedged child is the router timeout's (and the
+        # supervisor heartbeat's) job to detect, exactly like a wedged
+        # in-process worker.
+        self.recv_timeout_s = recv_timeout_s
+        self.last_stats: dict = {}
+        self._digest = None
+
+    # -- wire --------------------------------------------------------------
+
+    def call(self, payload: dict, *, timeout: float | None = None,
+             generation: bool = False) -> dict:
+        """One request/response round trip on a fresh connection, with
+        every fault seam on the path. ``generation=True`` additionally
+        offers the child's pid to the mid-batch ``proc.*`` seams right
+        after the payload goes out — the instant a real OOM-kill would
+        land. The seams carry ``what`` ("batch"/"probe") so chaos
+        plans can target generation traffic without a supervisor
+        heartbeat racing them for the hit (the fault conveniences
+        match ``what="batch"`` by default)."""
+        what = "batch" if generation else "probe"
+        # A caller deadline bounds the WHOLE round trip, connect
+        # included: the supervisor's heartbeat deadline must not
+        # stretch to the (longer) default connect timeout against a
+        # SYN-black-holed child.
+        conn_to = self.connect_timeout_s
+        if timeout is not None:
+            conn_to = min(conn_to, timeout)
+        fault_point("wire.connect", replica=self.name, what=what)
+        with socket.create_connection(
+            (self.host, self.port), timeout=conn_to
+        ) as s:
+            s.settimeout(timeout)
+            with s.makefile("rwb") as f:
+                data = json.dumps(payload).encode() + b"\n"
+                data = mutate_point("wire.send", data, replica=self.name,
+                                    what=what)
+                f.write(data)
+                f.flush()
+                if generation:
+                    mutate_point("proc.kill", self.pid, replica=self.name)
+                    mutate_point("proc.hang", self.pid, replica=self.name)
+                line = f.readline()
+        if not line:
+            raise ConnectionError(
+                f"replica {self.name} closed the connection mid-request"
+            )
+        line = mutate_point("wire.recv", line, replica=self.name,
+                            what=what)
+        try:
+            return json.loads(line)
+        except ValueError as e:
+            raise ConnectionError(
+                f"replica {self.name} sent a garbled response: {e}"
+            ) from e
+
+    def generate(self, payload: dict) -> dict:
+        return self.call(payload, timeout=self.recv_timeout_s,
+                         generation=True)
+
+    # -- engine surface the router touches ---------------------------------
+
+    def run(self, requests, *, results: bool = False):  # pragma: no cover
+        raise RuntimeError(
+            "RemoteEngine.run is never called directly — "
+            "RemoteReplica._run_batch speaks the wire"
+        )
+
+    def audit(self, *, raise_on_violation: bool = False) -> list[str]:
+        resp = self.call({"cmd": "audit"}, timeout=self.probe_timeout_s)
+        err = resp.get("error")
+        if err is not None:
+            raise RuntimeError(f"remote audit failed: {err}")
+        problems = [str(p) for p in resp.get("problems", [])]
+        if problems and raise_on_violation:
+            from triton_distributed_tpu.models.paged_kv_cache import (
+                PoolAuditError,
+            )
+
+            raise PoolAuditError("; ".join(problems))
+        return problems
+
+    def healthz(self, timeout: float | None = None) -> dict:
+        return self.call({"cmd": "healthz"},
+                         timeout=timeout or self.probe_timeout_s)
+
+    def prefix_digest(self):
+        return self._digest
+
+    def set_digest(self, digest) -> None:
+        self._digest = digest
+
+    def drain(self) -> int:
+        """Replica drain, remote form: ask the child to shut down (its
+        server refuses new work, finishes in flight, exits). A wire
+        error here means the child is already gone — which is drained
+        enough; the supervisor reaps the process either way."""
+        try:
+            self.call({"cmd": "shutdown"}, timeout=self.probe_timeout_s)
+        except (OSError, ConnectionError):
+            pass
+        self._digest = []
+        return 0
+
+
+class RemoteReplica(EngineReplica):
+    """One replica process behind the thread-replica surface.
+
+    The queue/worker/ticket lifecycle is inherited verbatim from
+    :class:`EngineReplica` — same states, same drain semantics, same
+    ``on_failure`` re-route hand-off — only the batch execution
+    crosses the wire. ``proc`` (a ``subprocess.Popen``, optional) is
+    carried for the supervisor; an unmanaged RemoteReplica over an
+    already-running server works too (that is what makes the fleet
+    host-agnostic: nothing below the supervisor assumes the process is
+    local).
+    """
+
+    def __init__(self, host: str, port: int, *, name: str,
+                 proc=None, max_pending: int = 8,
+                 connect_timeout_s: float = 10.0,
+                 recv_timeout_s: float | None = None):
+        self.proc = proc
+        remote = RemoteEngine(
+            host, port, name=name,
+            pid=proc.pid if proc is not None else None,
+            connect_timeout_s=connect_timeout_s,
+            recv_timeout_s=recv_timeout_s,
+        )
+        self._remote = remote
+        super().__init__(remote, name=name, max_pending=max_pending)
+
+    @property
+    def pid(self) -> int | None:
+        return self._remote.pid
+
+    def healthz(self, timeout: float | None = None) -> dict:
+        """The supervisor's heartbeat probe (lock-free on the child)."""
+        return self._remote.healthz(timeout)
+
+    @property
+    def free_pages(self) -> int:
+        # Best-effort load tiebreak from the last stats the wire
+        # carried (the in-process replica reads the live pool instead).
+        return int(self._remote.last_stats.get("free_pages", 0) or 0)
+
+    def _run_batch(self, tickets: list[Ticket]) -> None:
+        payload = {
+            "requests": [t.prompt_tokens for t in tickets],
+            "gen_lens": [t.gen_len for t in tickets],
+            "ticket_ids": [t.tid for t in tickets],
+            "want_digest": True,
+        }
+        # Sampling/deadline knobs ride as per-request lists; None
+        # entries fall back to the child engine's defaults (the
+        # server's knob() contract).
+        for key, attr in (("temperatures", "temperature"),
+                          ("top_ps", "top_p"), ("top_ks", "top_k"),
+                          ("deadline_s", "deadline_s")):
+            vals = [getattr(t, attr) for t in tickets]
+            if any(v is not None for v in vals):
+                payload[key] = vals
+        try:
+            resp = self._remote.generate(payload)
+        except Exception as e:  # noqa: BLE001 — the wire is the boundary
+            self._die(f"wire failure: {type(e).__name__}: {e}")
+            return
+        err = resp.get("error")
+        if err is not None:
+            # Structured refusal (shutting_down mid-drain-race,
+            # overloaded, internal): the whole batch re-routes; the
+            # child may still be healthy but this replica's slot in
+            # the rotation is not.
+            self._die(f"remote replica refused batch: {err}")
+            return
+        try:
+            ids = resp.get("ticket_ids")
+            if ids is None:
+                ids = [t.tid for t in tickets]  # pre-echo server
+            by_id = {
+                tid: RequestResult(
+                    np.asarray(out, np.int32),
+                    str(res.get("status", "ok")),
+                    str(res.get("reason", "")),
+                )
+                for tid, out, res in zip(
+                    ids, resp["outputs"], resp["results"]
+                )
+            }
+        except (KeyError, TypeError, ValueError) as e:
+            self._die(f"malformed remote response: {type(e).__name__}: {e}")
+            return
+        if self._state == DEAD:
+            # Late batch on a replica the router already gave up on:
+            # latch what we can (latch-first dedup by ticket id makes
+            # this harmless), fold NOTHING into fleet accounting — the
+            # same duplicate-batch rule as the thread replica.
+            for t in tickets:
+                r = by_id.get(t.tid)
+                if r is not None:
+                    t.complete(r)
+            return
+        stats = resp.get("stats") or {}
+        self._remote.last_stats = stats
+        self._remote.set_digest(resp.get("prefix_digest"))
+        self.runs += 1
+        self.served += len(by_id)
+        for k in self.totals:
+            self.totals[k] += stats.get(k, 0)
+        missing = 0
+        for t in tickets:
+            r = by_id.get(t.tid)
+            if r is not None:
+                t.complete(r)
+            else:
+                missing += 1
+        self._publish_digest()
+        if missing:
+            # The response named ids we never sent (or dropped some):
+            # protocol corruption. Kill the replica; _take_dead hands
+            # the unlatched tickets back for re-routing — latched ones
+            # lose their claim harmlessly. Never strand a ticket.
+            self._die(
+                f"remote response missing {missing} of "
+                f"{len(tickets)} ticket ids"
+            )

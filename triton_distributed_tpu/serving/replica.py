@@ -32,6 +32,8 @@ router never reads live tree state across threads.
 
 from __future__ import annotations
 
+import itertools
+import os
 import threading
 import time
 
@@ -56,6 +58,16 @@ FLEET_TOTAL_KEYS = (
     "prefix_hit_tokens",
 )
 
+# Process-unique ticket ids. They ride the wire (`ticket_ids` payload
+# key, echoed by the server) so a RemoteReplica matches results to
+# tickets BY ID, never by position — and a re-dispatched ticket keeps
+# its id across hops, which is what makes the at-least-once recovery
+# path dedup-safe: whichever attempt finishes first latches, the loser
+# is recognized by id and discarded (docs/scale-out.md "Process
+# fleet"). The pid suffix keeps ids unique even across routers talking
+# to one shared replica.
+_TICKET_IDS = itertools.count(1)
+
 
 class Ticket:
     """One routed request and its latched outcome.
@@ -74,10 +86,11 @@ class Ticket:
     __slots__ = ("prompt", "gen_len", "temperature", "top_p", "top_k",
                  "deadline_s", "enqueue_t", "reroutes", "replica_history",
                  "result", "_event", "_lock", "_rerouted_from",
-                 "last_dispatch_t", "_prompt_list")
+                 "last_dispatch_t", "_prompt_list", "tid")
 
     def __init__(self, prompt, gen_len: int, *, temperature=None,
                  top_p=None, top_k=None, deadline_s=None, enqueue_t=None):
+        self.tid = f"t{next(_TICKET_IDS)}p{os.getpid()}"
         self.prompt = np.asarray(prompt, np.int32)
         self.gen_len = int(gen_len)
         self.temperature = temperature

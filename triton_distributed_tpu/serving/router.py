@@ -53,6 +53,7 @@ from triton_distributed_tpu.obs import events as obs_events
 from triton_distributed_tpu.obs import metrics as obs_metrics
 from triton_distributed_tpu.serving.replica import (
     DEAD,
+    DRAINED,
     FLEET_TOTAL_KEYS,
     HEALTHY,
     EngineReplica,
@@ -105,6 +106,12 @@ class Router:
         self.drain_grace_s = float(drain_grace_s)
         self.max_reroutes = int(max_reroutes)
         self.request_timeout_s = request_timeout_s
+        # Replicas swapped out by the supervisor's respawn path: kept
+        # for name lookups (a timed-out ticket may still hold a stamp
+        # naming one) and for fleet-total aggregation (their counters
+        # must stay in the cumulative stats — monotone, never
+        # vanishing on a respawn).
+        self._retired: list[EngineReplica] = []
         self._rr = 0  # round-robin cursor
         self._lock = threading.Lock()  # router counters + rr cursor
         self.stats = {
@@ -183,6 +190,10 @@ class Router:
         double-count), plus the router's own ledger under
         ``router``."""
         agg: dict = {k: 0 for k in FLEET_TOTAL_KEYS}
+        # Work served by since-replaced replicas stays counted.
+        for r in self._retired:
+            for k in agg:
+                agg[k] += r.totals.get(k, 0)
         reps = []
         kv_bpt, kv_dtype = None, None
         for r in self.replicas:
@@ -202,6 +213,7 @@ class Router:
             router = dict(self.stats)
         router["policy"] = self.policy
         router["replicas"] = reps
+        router["retired_replicas"] = len(self._retired)
         router["healthy_replicas"] = self._refresh_healthy()
         router["affinity_hit_rate"] = (
             router["affinity_hits"] / max(router["routed"], 1)
@@ -252,6 +264,15 @@ class Router:
                     f"replica {r.name}: {p}" for p in r.engine.audit()
                 ]
             except Exception as e:  # noqa: BLE001 — racing a live batch
+                if r.state in (DEAD, DRAINED):
+                    # A dead or drained replica that cannot be REACHED
+                    # (a killed replica process, or a drained one whose
+                    # child exited on the shutdown verb) has nothing
+                    # left to audit; the live survivors' verdicts are
+                    # what "clean" means. Dead/drained IN-process
+                    # replicas still audit above — their engines
+                    # outlive the worker.
+                    continue
                 problems.append(
                     f"replica {r.name}: audit raced in-flight work "
                     f"({type(e).__name__}: {e}); re-run quiesced"
@@ -270,7 +291,43 @@ class Router:
         for r in self.replicas:
             if r.name == name:
                 return r
+        # A ticket's hop stamp can outlive a respawn swap: resolve
+        # retired names too (newest first), so the timeout path never
+        # KeyErrors judging a hop on a since-replaced replica.
+        for r in reversed(self._retired):
+            if r.name == name:
+                return r
         raise KeyError(f"no replica named {name!r}")
+
+    def add_replica(self, replica: EngineReplica) -> None:
+        """Grow the rotation (a supervisor bringing a replica up after
+        its initial spawn failed). The replica joins routing as soon as
+        its state reads healthy."""
+        if any(r.name == replica.name for r in self.replicas):
+            raise ValueError(f"replica name {replica.name!r} already live")
+        replica.on_failure = self._on_replica_failure
+        self.replicas.append(replica)
+        self._refresh_healthy()
+
+    def replace_replica(self, old_name: str,
+                        replica: EngineReplica) -> EngineReplica:
+        """Swap a dead replica for its respawned successor (the
+        supervisor's rejoin path, docs/scale-out.md "Process fleet").
+        The old replica is retired, not forgotten: its totals stay in
+        the fleet stats and its name keeps resolving for late hop
+        judgments. The successor must carry a FRESH name — reusing the
+        dead name would let a stale reroute claim against the old hop
+        block the new replica's own failure handling."""
+        if any(r.name == replica.name for r in self.replicas):
+            raise ValueError(f"replica name {replica.name!r} already live")
+        for i, r in enumerate(self.replicas):
+            if r.name == old_name:
+                self._retired.append(r)
+                replica.on_failure = self._on_replica_failure
+                self.replicas[i] = replica
+                self._refresh_healthy()
+                return r
+        raise KeyError(f"no replica named {old_name!r}")
 
     def drain_replica(self, name: str,
                       grace_s: float | None = None) -> bool:

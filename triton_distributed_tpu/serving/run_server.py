@@ -8,18 +8,42 @@ prefix-affinity router (docs/scale-out.md), served by the same wire
 protocol (``requests`` payloads only — the router speaks continuous
 batching).
 
+It is also the process-fleet replica entry (docs/scale-out.md
+"Process fleet"): ``serving/supervisor.py`` spawns one of these per
+replica with ``--port-file`` (the child binds port 0 and writes the
+address it got, atomically, for the supervisor to pick up) and — in
+tests and the fleet bench — ``--model stub``, which serves the
+deterministic :class:`~triton_distributed_tpu.models.stub.StubEngine`
+(real radix control plane, hash-function "model", no JAX model load)
+behind the production wire server.
+
 Usage:
     python -m triton_distributed_tpu.serving.run_server \
         --model tiny --tp 1 --port 8765
     python -m triton_distributed_tpu.serving.run_server \
         --model tiny --replicas 2 --policy affinity
+    python -m triton_distributed_tpu.serving.run_server \
+        --model stub --port-file /tmp/r0.port --stub-delay 0.2
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 
 import jax
+
+
+def _write_port_file(path: str | None, host: str, port: int) -> None:
+    """Atomic port handshake: the supervisor polls for PATH, so the
+    write must never be observable half-done — write a sibling temp
+    file, then rename (atomic on POSIX)."""
+    if not path:
+        return
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(f"{host}:{port}\n")
+    os.replace(tmp, path)
 
 
 def main(argv=None) -> int:
@@ -60,6 +84,19 @@ def main(argv=None) -> int:
                    "re-routed (0 = off, the default: a cold first "
                    "request compiles for minutes and must not read as "
                    "a hang)")
+    p.add_argument("--port-file", default=None, metavar="PATH",
+                   help="after binding, atomically write 'host:port' "
+                   "to PATH — the supervisor's port-discovery "
+                   "handshake for children launched with --port 0 "
+                   "(docs/scale-out.md 'Process fleet')")
+    p.add_argument("--stub-delay", type=float, default=0.0,
+                   help="with --model stub: per-batch wall-time floor "
+                   "in seconds (holds a batch in flight so chaos "
+                   "tests can kill the process mid-batch)")
+    p.add_argument("--stub-pages", type=int, default=256,
+                   help="with --model stub: page-pool size")
+    p.add_argument("--stub-page-size", type=int, default=16,
+                   help="with --model stub: tokens per page")
     p.add_argument("--trace", default=None, metavar="DIR",
                    help="wrap the whole run in group_profile(DIR) and "
                    "merge ONE chrome timeline on exit — host "
@@ -79,10 +116,30 @@ def main(argv=None) -> int:
             "path'). Drop --speculative or use --mode xla/pallas."
         )
 
+    from triton_distributed_tpu.serving.server import ModelServer
+
+    if args.model == "stub":
+        # Process-fleet replica stub: the full wire server over the
+        # deterministic control-plane engine — no mesh, no model load,
+        # ~import-cost startup (models/stub.py).
+        from triton_distributed_tpu.models.stub import StubEngine
+
+        engine = StubEngine(
+            num_pages=args.stub_pages, page_size=args.stub_page_size,
+            delay_s=args.stub_delay,
+        )
+        server = ModelServer(
+            engine, host=args.host, port=args.port,
+            drain_grace_s=args.drain_grace,
+        )
+        print(f"serving stub on {server.host}:{server.port}")
+        _write_port_file(args.port_file, server.host, server.port)
+        server.serve_forever()
+        return 0
+
     from triton_distributed_tpu.models import AutoLLM
     from triton_distributed_tpu.models.engine import Engine
     from triton_distributed_tpu.runtime.mesh import initialize_distributed
-    from triton_distributed_tpu.serving.server import ModelServer
 
     ctx = initialize_distributed(tp=args.tp, devices=jax.devices()[: args.tp])
     model = AutoLLM.from_pretrained(args.model, ctx=ctx)
@@ -124,6 +181,7 @@ def main(argv=None) -> int:
         drain_grace_s=args.drain_grace, trace_dir=args.trace,
     )
     print(f"serving {what} on {server.host}:{server.port}")
+    _write_port_file(args.port_file, server.host, server.port)
     if args.trace:
         # Host capture wraps the whole serving run; on exit the ranks'
         # chrome traces AND every traced mega launch's device task rows

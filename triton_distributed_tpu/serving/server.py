@@ -9,9 +9,11 @@ framed protocol. Here the protocol is newline-delimited JSON over TCP:
     ← {"output_ids": [[...]], "stats": {...}}
     → {"requests": [[...], ...], "gen_lens": [4, ...],   (continuous
        "temperatures": [0.8, ...], "top_ps": [...],       batching;
-       "top_ks": [...], "deadline_s": [5.0, ...]}         knobs optional)
+       "top_ks": [...], "deadline_s": [5.0, ...],         knobs optional)
+       "ticket_ids": ["t1p9", ...], "want_digest": true}
     ← {"outputs": [[...], ...],                 (partial on failure)
        "results": [{"status": "ok"|..., "reason": ...}, ...],
+       "ticket_ids": [...],  "prefix_digest": [...],   (when requested)
        "stats": {...}}
     → {"cmd": "stats"}           ← {"stats": {..., "server": {...}}}
     → {"cmd": "metrics"}         ← {"prometheus": "...", "metrics": {...}}
@@ -21,6 +23,8 @@ framed protocol. Here the protocol is newline-delimited JSON over TCP:
     → {"cmd": "kernel_trace"}    ← {"kernel_trace": {"launches": ...,
                                     "recent": [...]}}
     → {"cmd": "ping"}            ← {"ok": true, "draining": false}
+    → {"cmd": "healthz"}         ← {"ok": true, "state": "serving"}
+    → {"cmd": "audit"}           ← {"problems": []}   (engine lock held)
     → {"cmd": "shutdown"}        ← {"ok": true}   (server then drains)
 
 The per-request sampling/deadline keys are scalars (applied to every
@@ -79,6 +83,7 @@ serialize), and drains the replica fleet on shutdown.
 from __future__ import annotations
 
 import json
+import random
 import socket
 import threading
 import time
@@ -95,9 +100,11 @@ from triton_distributed_tpu.runtime.faults import fault_point
 
 # The probe verbs _dispatch_inner answers. ONE tuple: the metrics
 # label in _verb_of and the `accepted payloads` help both derive from
-# it, so a new verb can't silently label its traffic `unknown`.
-PROBE_CMDS = ("ping", "stats", "metrics", "events", "kernel_trace",
-              "shutdown")
+# it, so a new verb can't silently label its traffic `unknown`. All
+# are engine-lock-free EXCEPT `audit` (it walks live engine state, so
+# it serializes behind generation — run it quiesced).
+PROBE_CMDS = ("ping", "healthz", "stats", "metrics", "events",
+              "kernel_trace", "audit", "shutdown")
 
 
 class _BadRequest(ValueError):
@@ -266,6 +273,30 @@ class ModelServer:
             cmd = req.get("cmd")
             if cmd == "ping":
                 return {"ok": True, "draining": self._shutdown.is_set()}
+            if cmd == "healthz":
+                # The heartbeat target (docs/scale-out.md "Process
+                # fleet"): liveness ONLY. No engine lock, no
+                # server_stats construction — it must answer fast
+                # mid-generation, because a missed deadline here is
+                # what the supervisor reads as a wedged process.
+                # `state` lets it tell a draining replica from a dead
+                # one before classifying an exit as a crash.
+                return {
+                    "ok": True,
+                    "state": ("shutting_down" if self._shutdown.is_set()
+                              else "serving"),
+                }
+            if cmd == "audit":
+                # Fleet-audit verb: the router's `Router.audit` reaches
+                # remote replicas' pool/radix invariants through this.
+                # NOT engine-lock-free — the audit walks live slot and
+                # tree state, so it queues behind in-flight generation
+                # instead of racing it.
+                auditor = getattr(self.engine, "audit", None)
+                if auditor is None:
+                    raise _BadRequest("this engine has no audit()")
+                with self._engine_lock:
+                    return {"problems": [str(p) for p in auditor()]}
             if cmd == "shutdown":
                 self._shutdown.set()
                 return {"ok": True}
@@ -360,7 +391,8 @@ class ModelServer:
             accepted = [
                 f"cmd ({'|'.join(PROBE_CMDS)})",
                 "requests + gen_lens/temperatures/top_ps/top_ks/"
-                "deadline_s (continuous batching)",
+                "deadline_s/trace_ids/ticket_ids/want_digest "
+                "(continuous batching)",
                 "input_ids + gen_len/prompt_start (fixed batch)",
             ]
             raise _BadRequest(
@@ -476,6 +508,21 @@ class ModelServer:
                 trace_ids = [
                     None if x is None else str(x) for x in trace_ids
                 ]
+            # Ticket ids (docs/scale-out.md "Process fleet"): opaque
+            # per-request tokens a RemoteReplica uses to latch results
+            # by identity instead of position. The engine never sees
+            # them — they are echoed verbatim in the response, which is
+            # the whole contract: a response carrying an id the caller
+            # no longer waits on is recognized and discarded, so an
+            # at-least-once redispatch can never double-emit.
+            ticket_ids = req.get("ticket_ids")
+            if ticket_ids is not None and (
+                    not isinstance(ticket_ids, list)
+                    or len(ticket_ids) != len(prompts)):
+                raise ValueError(
+                    f"{len(prompts)} requests but ticket_ids is "
+                    f"{ticket_ids!r} (want a {len(prompts)}-entry list)"
+                )
             from triton_distributed_tpu.models.continuous import Request
 
             def _timeline() -> Timeline:
@@ -497,7 +544,7 @@ class ModelServer:
                 ],
                 results=True,
             )
-            return {
+            resp = {
                 "outputs": [r.tokens.tolist() for r in results],
                 "results": [
                     {"status": r.status, "reason": r.reason}
@@ -505,6 +552,20 @@ class ModelServer:
                 ],
                 "stats": self.engine.last_stats,
             }
+            if ticket_ids is not None:
+                resp["ticket_ids"] = ticket_ids
+            if req.get("want_digest"):
+                # Batch-boundary digest publication over the wire: the
+                # RemoteReplica mirrors the in-process replica's
+                # protocol (re-publish after every batch) without a
+                # second round trip or an extra lock — the engine is
+                # already quiesced here, under the same dispatch that
+                # ran the batch.
+                digest = getattr(self.engine, "prefix_digest", None)
+                resp["prefix_digest"] = (
+                    digest() if digest is not None else None
+                )
+            return resp
         input_ids = np.asarray(req["input_ids"], np.int32)
         gen_len = int(req.get("gen_len", 16))
         out = self.engine.serve(
@@ -642,6 +703,18 @@ class ModelServer:
             engine_shutdown()
 
 
+def _retry_backoff(attempt: int, backoff_s: float,
+                   max_backoff_s: float) -> float:
+    """One retry delay: exponential from ``backoff_s``, CAPPED at
+    ``max_backoff_s``, with ±20% jitter. The cap keeps a long retry
+    loop from sleeping for minutes once ``2**attempt`` runs away; the
+    jitter keeps a fleet of clients that all bounced off the same
+    respawning replica from re-arriving in lockstep and re-shedding
+    each other forever (docs/scale-out.md "Process fleet")."""
+    base = min(backoff_s * (2 ** attempt), max_backoff_s)
+    return base * random.uniform(0.8, 1.2)
+
+
 def request(
     host: str,
     port: int,
@@ -650,13 +723,15 @@ def request(
     *,
     retries: int = 0,
     backoff_s: float = 0.25,
+    max_backoff_s: float = 5.0,
 ) -> dict:
     """One JSON request/response round trip (client side).
 
     With ``retries > 0`` transient failures — connection refused/reset,
     the server vanishing mid-response, and structured ``overloaded``
     shedding — are retried with exponential backoff
-    (``backoff_s * 2**attempt``). A shed reply carrying a
+    (``backoff_s * 2**attempt``, capped at ``max_backoff_s``, ±20%
+    jitter — see :func:`_retry_backoff`). A shed reply carrying a
     ``retry_after_s`` hint overrides the local backoff for that
     attempt: the server knows its own queue depth, so router- or
     script-driven retries spread out instead of hammering a shedding
@@ -682,7 +757,7 @@ def request(
             # truncated line is as transient as no line at all.
             if attempt >= retries:
                 raise
-            time.sleep(backoff_s * (2 ** attempt))
+            time.sleep(_retry_backoff(attempt, backoff_s, max_backoff_s))
             attempt += 1
             continue
         err = resp.get("error")
@@ -698,7 +773,9 @@ def request(
                 if isinstance(hint, (int, float)) and hint > 0:
                     time.sleep(min(float(hint), 30.0))
                 else:
-                    time.sleep(backoff_s * (2 ** attempt))
+                    time.sleep(
+                        _retry_backoff(attempt, backoff_s, max_backoff_s)
+                    )
                 attempt += 1
                 continue
             raise RuntimeError(f"server error: {err}")

@@ -1,0 +1,576 @@
+"""Process-fleet supervision: spawn, heartbeat, classify, respawn.
+
+The cross-process serving tier (docs/scale-out.md "Process fleet") is
+``Router`` over :class:`~triton_distributed_tpu.serving.remote.RemoteReplica`\\ s;
+this module owns the part neither of them can see — the *processes*.
+:class:`FleetSupervisor` spawns one replica child per
+:class:`ReplicaSpec` (reusing the ``run_server`` entry with its
+``--port-file`` handshake), then drives a monitor loop that:
+
+- **detects** failures via a cheap ``{"cmd": "healthz"}`` heartbeat on
+  a deadline, plus process exit codes, plus the router's own
+  observations (a wire ``_die`` or a router request-timeout both leave
+  the replica ``dead`` for the monitor to find);
+- **classifies** every failure into a small taxonomy — ``conn``
+  (refused/RST while the process looked alive), ``exit`` (the process
+  is gone; rc attached), ``heartbeat_timeout`` (alive but not
+  answering — the SIGSTOP/wedged case), ``hung_request`` (the router
+  timed out a batch on a live process), ``spawn`` (never came up);
+- **recovers** in-flight work by marking the replica dead and handing
+  its orphaned tickets to the router's existing
+  ``_on_replica_failure`` path — the same latch-first re-route that
+  serves thread-replica deaths, which the ticket-id wire dedup makes
+  safe across processes (survivors stay bit-exact; a finished-but-
+  unreported batch can only latch-lose);
+- **respawns** the slot with exponential backoff (capped) under a
+  crash-loop circuit breaker: ``crash_limit`` failures inside
+  ``crash_window_s`` PARKS the slot — an event and a counter fire, the
+  fleet keeps serving degraded on the survivors — instead of burning
+  the host on a doomed spawn loop. A respawned replica joins under a
+  fresh generation-suffixed name (``r0#2``) via
+  ``Router.replace_replica`` and rejoins routing with a fresh prefix
+  digest.
+
+Everything observable lands in the PR 5 telemetry:
+``tdt_supervisor_failures_total{replica,kind}``,
+``tdt_supervisor_respawns_total{replica}``,
+``tdt_supervisor_parked_replicas``, and the per-slot
+``tdt_replica_heartbeat_age_seconds{replica}`` gauge, plus
+``replica_proc_failed`` / ``replica_respawn`` / ``replica_parked``
+events — all scrapeable through the front server's existing
+``metrics``/``events`` verbs (docs/observability.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+from triton_distributed_tpu.obs import events as obs_events
+from triton_distributed_tpu.obs import metrics as obs_metrics
+from triton_distributed_tpu.serving.remote import RemoteReplica
+from triton_distributed_tpu.serving.replica import (
+    DEAD,
+    DRAINED,
+    DRAINING,
+    HEALTHY,
+)
+from triton_distributed_tpu.serving.router import Router
+
+
+class SpawnError(RuntimeError):
+    """A replica child never reached its port handshake."""
+
+
+@dataclasses.dataclass
+class ReplicaSpec:
+    """How to launch one replica slot. ``argv`` is the full child
+    command; the supervisor appends ``--port-file <path>`` per spawn.
+    ``name`` is the SLOT name: generation 0 serves as ``name``, every
+    respawn as ``name#<generation>`` (router identities must be unique
+    across a slot's lifetime — see ``Router.replace_replica``), while
+    metrics stay labeled by the slot so respawns don't grow label
+    cardinality."""
+
+    name: str
+    argv: list[str]
+    env: dict | None = None
+
+
+def stub_spec(name: str, *, delay_s: float = 0.0, num_pages: int = 256,
+              page_size: int = 16, extra: tuple = ()) -> ReplicaSpec:
+    """A deterministic stub-engine replica (models/stub.py) — what the
+    chaos suite and ``perf/fleet_bench.py`` spawn: full wire server,
+    real radix control plane, no model load."""
+    return ReplicaSpec(name, [
+        sys.executable, "-m", "triton_distributed_tpu.serving.run_server",
+        "--model", "stub", "--port", "0",
+        "--stub-delay", str(delay_s),
+        "--stub-pages", str(num_pages),
+        "--stub-page-size", str(page_size),
+        *extra,
+    ])
+
+
+def model_spec(name: str, model: str = "tiny", *,
+               extra: tuple = ()) -> ReplicaSpec:
+    """A real-model replica child (the production shape)."""
+    return ReplicaSpec(name, [
+        sys.executable, "-m", "triton_distributed_tpu.serving.run_server",
+        "--model", model, "--port", "0", *extra,
+    ])
+
+
+def spawn_replica(spec: ReplicaSpec, *, generation: int = 0,
+                  spawn_timeout_s: float = 120.0, max_pending: int = 8,
+                  log_dir: str | None = None) -> RemoteReplica:
+    """Launch one replica child and wait for its port handshake.
+    Returns a connected :class:`RemoteReplica` (``.proc`` holds the
+    ``Popen``); raises :class:`SpawnError` — with the child's log tail
+    attached — when the child dies or stalls before binding."""
+    name = spec.name if generation == 0 else f"{spec.name}#{generation}"
+    if log_dir is None:
+        log_dir = tempfile.mkdtemp(prefix="tdt-fleet-")
+    os.makedirs(log_dir, exist_ok=True)
+    port_file = os.path.join(log_dir, f"{name.replace('#', '_')}.port")
+    log_path = os.path.join(log_dir, f"{name.replace('#', '_')}.log")
+    if os.path.exists(port_file):
+        os.unlink(port_file)
+    env = dict(os.environ)
+    if spec.env:
+        env.update(spec.env)
+    with open(log_path, "ab") as log_f:
+        proc = subprocess.Popen(
+            spec.argv + ["--port-file", port_file],
+            stdout=log_f, stderr=subprocess.STDOUT, env=env,
+            start_new_session=True,
+        )
+    deadline = time.monotonic() + spawn_timeout_s
+    addr = None
+    while time.monotonic() < deadline:
+        if os.path.exists(port_file):
+            with open(port_file) as f:
+                text = f.read().strip()
+            if text:  # the rename made this atomic; non-empty == done
+                addr = text
+                break
+        if proc.poll() is not None:
+            break
+        time.sleep(0.02)
+    if addr is None:
+        tail = ""
+        try:
+            with open(log_path, "rb") as f:
+                tail = f.read()[-800:].decode(errors="replace")
+        except OSError:
+            pass
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait(timeout=10)
+        raise SpawnError(
+            f"replica {name} never bound within {spawn_timeout_s}s "
+            f"(rc={proc.returncode}); log tail:\n{tail}"
+        )
+    host, _, port = addr.rpartition(":")
+    return RemoteReplica(host, int(port), name=name, proc=proc,
+                         max_pending=max_pending)
+
+
+@dataclasses.dataclass
+class _Slot:
+    """Supervisor-internal state for one replica slot."""
+
+    spec: ReplicaSpec
+    generation: int = 0
+    replica: RemoteReplica | None = None
+    parked: bool = False
+    # The name this slot's replica last joined the router under (set
+    # on every successful spawn; survives _fail clearing `replica`) —
+    # the respawn path retires EXACTLY this entry instead of
+    # re-deriving the generation-suffix naming rule.
+    last_name: str | None = None
+    crash_times: list = dataclasses.field(default_factory=list)
+    fails_in_a_row: int = 0
+    missed_beats: int = 0
+    next_respawn_t: float | None = None
+    last_beat_t: float | None = None
+    last_failure: str | None = None
+    respawns: int = 0
+
+
+class FleetSupervisor:
+    """Own a fleet of replica processes behind one :class:`Router`.
+
+    ``start()`` spawns every spec, builds the router, and starts the
+    monitor thread; ``shutdown()`` drains the fleet and reaps the
+    children. The monitor is a single loop ticking every
+    ``heartbeat_s`` — with a handful of replica processes, one thread
+    beating them in sequence keeps detection latency ≈ the interval
+    without a thread per child.
+    """
+
+    def __init__(
+        self,
+        specs: list[ReplicaSpec],
+        *,
+        policy: str = "affinity",
+        heartbeat_s: float = 0.25,
+        heartbeat_timeout_s: float = 2.0,
+        heartbeat_misses: int = 2,
+        spawn_timeout_s: float = 120.0,
+        respawn_backoff_s: float = 0.25,
+        max_backoff_s: float = 4.0,
+        crash_limit: int = 3,
+        crash_window_s: float = 30.0,
+        replica_max_pending: int = 8,
+        log_dir: str | None = None,
+        router_kw: dict | None = None,
+    ):
+        if not specs:
+            raise ValueError("FleetSupervisor needs at least one spec")
+        names = [s.name for s in specs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"spec names must be unique, got {names}")
+        self._slots = [_Slot(spec=s) for s in specs]
+        self.policy = policy
+        self.heartbeat_s = float(heartbeat_s)
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        # Deadline tolerance: a wedged process is declared after this
+        # many CONSECUTIVE missed beats (a single slow accept on a
+        # loaded host is not a verdict); refused/reset classify on the
+        # first, they are definitive.
+        self.heartbeat_misses = max(int(heartbeat_misses), 1)
+        self.spawn_timeout_s = float(spawn_timeout_s)
+        self.respawn_backoff_s = float(respawn_backoff_s)
+        self.max_backoff_s = float(max_backoff_s)
+        self.crash_limit = int(crash_limit)
+        self.crash_window_s = float(crash_window_s)
+        self.replica_max_pending = int(replica_max_pending)
+        self.log_dir = log_dir or tempfile.mkdtemp(prefix="tdt-fleet-")
+        self._router_kw = dict(router_kw or {})
+        self._router_kw.setdefault("policy", policy)
+        self.router: Router | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        # Monitor-vs-shutdown exclusion: a tick must not respawn into a
+        # fleet that is draining.
+        self._lock = threading.Lock()
+        self._m_failures = obs_metrics.counter(
+            "tdt_supervisor_failures_total",
+            "Replica process failures, by slot and classified kind.",
+            labels=("replica", "kind"),
+        )
+        self._m_respawns = obs_metrics.counter(
+            "tdt_supervisor_respawns_total",
+            "Replica processes respawned, by slot.",
+            labels=("replica",),
+        )
+        self._g_parked = obs_metrics.gauge(
+            "tdt_supervisor_parked_replicas",
+            "Slots taken out of service by the crash-loop breaker.",
+        )
+        self._g_beat_age = obs_metrics.gauge(
+            "tdt_replica_heartbeat_age_seconds",
+            "Seconds since the last successful heartbeat, by slot.",
+            labels=("replica",),
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> Router:
+        """Spawn the fleet, build the router, start monitoring. A slot
+        whose INITIAL spawn fails is scheduled for retry through the
+        normal backoff/park path; at least one replica must come up."""
+        # Spawn concurrently: child startup is import-bound, and N
+        # sequential spawns would cost N × the interpreter cold start.
+        outcomes: dict[str, object] = {}
+
+        def boot(slot: _Slot) -> None:
+            try:
+                outcomes[slot.spec.name] = self._spawn(slot)
+            except SpawnError as e:
+                outcomes[slot.spec.name] = e
+
+        threads = [
+            threading.Thread(target=boot, args=(s,), daemon=True)
+            for s in self._slots
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        replicas = []
+        for slot in self._slots:
+            got = outcomes.get(slot.spec.name)
+            if isinstance(got, RemoteReplica):
+                slot.replica = got
+                slot.last_name = got.name
+                replicas.append(got)
+            else:
+                self._record_failure(slot, "spawn", str(got))
+        if not replicas:
+            raise SpawnError(
+                "no replica in the fleet reached its port handshake; "
+                f"logs under {self.log_dir}"
+            )
+        self.router = Router(
+            replicas, replica_max_pending=self.replica_max_pending,
+            **self._router_kw,
+        )
+        self._thread = threading.Thread(
+            target=self._monitor, daemon=True, name="fleet-supervisor",
+        )
+        self._thread.start()
+        return self.router
+
+    def shutdown(self) -> None:
+        """Stop monitoring, drain the router (remote drains ask each
+        child to shut down), then reap every child — SIGKILLing any
+        that outlive the drain grace. Idempotent."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+        with self._lock:
+            if self.router is not None:
+                self.router.shutdown()
+            for slot in self._slots:
+                rep = slot.replica
+                proc = rep.proc if rep is not None else None
+                if proc is None:
+                    continue
+                try:
+                    proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait(timeout=10)
+
+    # -- sync hooks (tests, bench) -----------------------------------------
+
+    def wait_for(self, predicate, timeout_s: float = 30.0,
+                 poll_s: float = 0.02) -> bool:
+        """Deadline-poll ``predicate()`` — the chaos suite's
+        synchronization primitive (condition waits, not sleeps)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if predicate():
+                return True
+            time.sleep(poll_s)
+        return bool(predicate())
+
+    def wait_healthy(self, n: int | None = None,
+                     timeout_s: float = 60.0) -> bool:
+        """Block until ``n`` (default: every non-parked slot) replicas
+        are healthy in the router's rotation."""
+
+        def healthy() -> int:
+            return sum(
+                1 for s in self._slots
+                if s.replica is not None and s.replica.state == HEALTHY
+            )
+
+        want = n if n is not None else sum(
+            1 for s in self._slots if not s.parked
+        )
+        return self.wait_for(lambda: healthy() >= want, timeout_s)
+
+    def slot(self, name: str) -> _Slot:
+        for s in self._slots:
+            if s.spec.name == name:
+                return s
+        raise KeyError(f"no slot named {name!r}")
+
+    def stats(self) -> dict:
+        """The supervisor ledger (per-slot generation/parked/failure
+        state) — surfaced by the fleet bench and debuggable from a
+        REPL; the scrape path is the tdt_supervisor_* series."""
+        return {
+            "slots": [
+                {
+                    "name": s.spec.name,
+                    "generation": s.generation,
+                    "respawns": s.respawns,
+                    "parked": s.parked,
+                    "state": (s.replica.state if s.replica is not None
+                              else "down"),
+                    "pid": (s.replica.pid if s.replica is not None
+                            else None),
+                    "last_failure": s.last_failure,
+                }
+                for s in self._slots
+            ],
+            "log_dir": self.log_dir,
+        }
+
+    # -- monitor -----------------------------------------------------------
+
+    def _monitor(self) -> None:
+        while not self._stop.is_set():
+            with self._lock:
+                if self._stop.is_set():
+                    break
+                self._tick()
+            self._stop.wait(self.heartbeat_s)
+
+    def _tick(self) -> None:
+        now = time.monotonic()
+        for slot in self._slots:
+            if slot.parked:
+                continue
+            rep = slot.replica
+            if rep is None:
+                if (slot.next_respawn_t is not None
+                        and now >= slot.next_respawn_t):
+                    self._respawn(slot)
+                continue
+            if rep.state in (DRAINING, DRAINED):
+                continue  # an operator drain is not a failure
+            rc = rep.proc.poll() if rep.proc is not None else None
+            if rep.state == DEAD:
+                # The router/wire path saw it first (recv EOF, RST,
+                # garble, or a router-observed request timeout): the
+                # orphans are already re-routed; classify for the
+                # ledger and move to respawn. A socket-level batch
+                # failure on a live process is a `conn` (the wire
+                # broke), not a `hung_request` (only a router timeout
+                # earns that).
+                err = rep.last_error or "router marked dead"
+                if rc is not None:
+                    kind = "exit"
+                elif err.startswith(("wire failure",
+                                     "malformed remote response",
+                                     "remote")):
+                    kind = "conn"
+                else:
+                    kind = "hung_request"
+                self._fail(slot, kind, err)
+            elif rc is not None:
+                self._fail(slot, "exit", f"process exited rc={rc}")
+            else:
+                self._heartbeat(slot, now)
+
+    def _heartbeat(self, slot: _Slot, now: float) -> None:
+        rep = slot.replica
+        try:
+            resp = rep.healthz(timeout=self.heartbeat_timeout_s)
+            if not resp.get("ok"):
+                raise ConnectionError(f"healthz answered {resp!r}")
+            slot.last_beat_t = time.monotonic()
+            slot.missed_beats = 0
+            self._g_beat_age.set(0.0, replica=slot.spec.name)
+            if (resp.get("state") == "shutting_down"
+                    and rep.state == HEALTHY):
+                # An externally-initiated drain (an operator sent the
+                # child {"cmd": "shutdown"} directly): take the
+                # replica out of rotation as a DRAIN, not a crash —
+                # routing another batch into it would be refused and
+                # misread as a failure, burning crash-loop budget on
+                # a voluntary exit.
+                rep.begin_drain()
+                if self.router is not None:
+                    self.router._refresh_healthy()
+                obs_events.emit(
+                    "replica_drain", replica=rep.name,
+                    slot=slot.spec.name, external=True,
+                )
+        except Exception as e:  # noqa: BLE001 — every flavor classifies
+            age = (time.monotonic() - slot.last_beat_t
+                   if slot.last_beat_t is not None else float("inf"))
+            self._g_beat_age.set(
+                min(age, 9e6), replica=slot.spec.name
+            )
+            # `socket.timeout` is `TimeoutError` on modern Pythons;
+            # keep both spellings for makefile-surfaced reads.
+            timeout_like = isinstance(e, (socket.timeout, TimeoutError))
+            rc = rep.proc.poll() if rep.proc is not None else None
+            if rc is not None:
+                kind, why = "exit", f"process exited rc={rc}"
+            elif timeout_like:
+                slot.missed_beats += 1
+                if slot.missed_beats < self.heartbeat_misses:
+                    return  # not yet a verdict — next tick retries
+                kind, why = "heartbeat_timeout", (
+                    f"{slot.missed_beats} consecutive beats missed "
+                    f"(deadline {self.heartbeat_timeout_s}s, "
+                    f"age {age:.2f}s)"
+                )
+            else:
+                kind, why = "conn", f"{type(e).__name__}: {e}"
+            self._fail(slot, kind, why)
+
+    def _fail(self, slot: _Slot, kind: str, reason: str) -> None:
+        """One replica failure, end to end: mark dead through the
+        router's re-route path, make sure the process is gone, then
+        schedule (or refuse) the respawn."""
+        rep = slot.replica
+        if rep.state != DEAD:
+            orphans = rep.mark_unhealthy(f"supervisor: {kind}: {reason}")
+            if self.router is not None:
+                # The existing thread-replica failure path: every
+                # orphaned ticket re-routes latch-first; the wire
+                # ticket-id dedup makes the overlap with any
+                # still-in-flight remote batch harmless.
+                self.router._on_replica_failure(rep, orphans)
+        if rep.proc is not None and rep.proc.poll() is None:
+            rep.proc.kill()
+            try:
+                rep.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:  # pragma: no cover
+                pass
+        obs_events.emit(
+            "replica_proc_failed", replica=rep.name,
+            slot=slot.spec.name, failure=kind, reason=str(reason)[:200],
+        )
+        slot.replica = None  # the router retires it on replace
+        slot.last_beat_t = None
+        self._record_failure(slot, kind, reason)
+
+    def _record_failure(self, slot: _Slot, kind: str,
+                        reason: str) -> None:
+        """Crash bookkeeping shared by monitor failures and failed
+        spawns: counter, crash-loop window, park-or-backoff."""
+        self._m_failures.inc(replica=slot.spec.name, kind=kind)
+        slot.last_failure = f"{kind}: {str(reason)[:200]}"
+        now = time.monotonic()
+        slot.crash_times = [
+            t for t in slot.crash_times if now - t <= self.crash_window_s
+        ] + [now]
+        slot.fails_in_a_row += 1
+        if len(slot.crash_times) >= self.crash_limit:
+            slot.parked = True
+            slot.next_respawn_t = None
+            self._g_parked.set(
+                sum(1 for s in self._slots if s.parked)
+            )
+            obs_events.emit(
+                "replica_parked", slot=slot.spec.name,
+                crashes=len(slot.crash_times),
+                window_s=self.crash_window_s, last=slot.last_failure,
+            )
+            return
+        backoff = min(
+            self.respawn_backoff_s * (2 ** (slot.fails_in_a_row - 1)),
+            self.max_backoff_s,
+        )
+        slot.next_respawn_t = now + backoff
+
+    def _respawn(self, slot: _Slot) -> None:
+        slot.generation += 1
+        try:
+            rep = self._spawn(slot)
+        except SpawnError as e:
+            slot.generation -= 1
+            self._record_failure(slot, "spawn", str(e))
+            return
+        slot.replica = rep
+        slot.respawns += 1
+        slot.fails_in_a_row = 0  # a successful bind resets the backoff
+        slot.missed_beats = 0
+        slot.next_respawn_t = None
+        if self.router is not None:
+            if slot.last_name is not None:
+                # Retire the predecessor this slot actually joined as.
+                self.router.replace_replica(slot.last_name, rep)
+            else:
+                # The slot never came up (initial spawn failed): grow
+                # the rotation instead.
+                self.router.add_replica(rep)
+        slot.last_name = rep.name
+        self._m_respawns.inc(replica=slot.spec.name)
+        obs_events.emit(
+            "replica_respawn", replica=rep.name, slot=slot.spec.name,
+            generation=slot.generation, pid=rep.pid,
+        )
+
+    def _spawn(self, slot: _Slot) -> RemoteReplica:
+        return spawn_replica(
+            slot.spec, generation=slot.generation,
+            spawn_timeout_s=self.spawn_timeout_s,
+            max_pending=self.replica_max_pending,
+            log_dir=self.log_dir,
+        )
