@@ -25,6 +25,95 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _fleet_demo(args) -> int:
+    """--fleet N: a supervised process fleet (docs/scale-out.md
+    "Process fleet") driven through the wire like --replicas — the
+    parent loads NO model; children are run_server processes under the
+    FleetSupervisor (heartbeats, respawn, snapshot recovery)."""
+    from triton_distributed_tpu.serving.server import ModelServer, request
+    from triton_distributed_tpu.serving.supervisor import (
+        FleetSupervisor,
+        ReplicaSpec,
+        stub_spec,
+    )
+
+    t0 = time.time()
+    mode = args.mode if not (args.cpu and args.mode == "mega") else "xla"
+    if args.model == "stub":
+        specs = [
+            stub_spec(f"r{i}", delay_s=0.05) for i in range(args.fleet)
+        ]
+    else:
+        child = [
+            sys.executable, "-m",
+            "triton_distributed_tpu.serving.run_server",
+            "--model", args.model, "--port", "0", "--continuous",
+            "--mode", mode,
+        ]
+        if args.kv_dtype:
+            child += ["--kv-dtype", args.kv_dtype]
+        if args.speculative:
+            child += ["--speculative", str(args.speculative)]
+        env = {"JAX_PLATFORMS": "cpu"} if args.cpu else None
+        specs = [
+            ReplicaSpec(f"r{i}", list(child), env=env)
+            for i in range(args.fleet)
+        ]
+    sup = FleetSupervisor(
+        specs,
+        router_kw={
+            "request_timeout_s": args.request_timeout or None,
+        },
+    )
+    router = sup.start()
+    server = ModelServer(router).start()
+    print(json.dumps({
+        "serving": args.model, "mode": mode, "fleet": args.fleet,
+        "port": server.port, "logs": sup.log_dir,
+        "startup_s": round(time.time() - t0, 1),
+    }), flush=True)
+    try:
+        assert request(server.host, server.port, {"cmd": "ping"})["ok"]
+        prompt = list(range(1, 33))
+        payload = {"requests": [prompt], "gen_lens": [args.gen_len]}
+        t1 = time.time()
+        r1 = request(server.host, server.port, payload, timeout=1200)
+        cold_s = time.time() - t1
+        t2 = time.time()
+        r2 = request(server.host, server.port, payload, timeout=1200)
+        warm_s = time.time() - t2
+        gen1 = np.asarray(r1["outputs"][0])
+        gen2 = np.asarray(r2["outputs"][0])
+        router_stats = r2["stats"].get("router", {})
+        print(json.dumps({
+            "transcript_tokens": gen1.tolist(),
+            "deterministic": bool(
+                gen1.shape == gen2.shape and (gen1 == gen2).all()
+            ),
+            "cold_wall_s": round(cold_s, 2),
+            "warm_wall_s": round(warm_s, 2),
+            "wire_tok_s": round(args.gen_len / warm_s, 2),
+            "statuses": [x["status"] for x in r2["results"]],
+            "affinity_hits": router_stats.get("affinity_hits"),
+            "routed": router_stats.get("routed"),
+            "supervisor": sup.stats()["slots"],
+        }, default=str), flush=True)
+        if args.stats:
+            stats = request(server.host, server.port, {"cmd": "stats"})
+            print("== stats ==", flush=True)
+            print(json.dumps(stats["stats"], indent=2, default=str),
+                  flush=True)
+    finally:
+        import contextlib
+
+        with contextlib.suppress(Exception):
+            request(server.host, server.port, {"cmd": "shutdown"},
+                    timeout=10.0)
+        server.shutdown()
+        sup.shutdown()
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--model", default="Qwen/Qwen3-0.6B")
@@ -52,6 +141,15 @@ def main(argv=None) -> int:
                    "prefix-affinity router (docs/scale-out.md); the "
                    "demo then drives 'requests' payloads and the "
                    "repeat doubles as the affinity-hit check")
+    p.add_argument("--fleet", type=int, default=0,
+                   help="boot a SUPERVISED PROCESS fleet of N "
+                   "run_server children (FleetSupervisor — "
+                   "docs/scale-out.md 'Process fleet'; no model loads "
+                   "in this process) and drive the router through the "
+                   "wire exactly like --replicas; children inherit "
+                   "--model/--mode/--kv-dtype/--speculative (note: "
+                   "children load the NAMED preset — the demo's "
+                   "depth-8 trim applies only in-process)")
     p.add_argument("--request-timeout", type=float, default=0.0,
                    help="with --replicas: router-observed replica "
                    "timeout (seconds; 0 = off — a cold compile must "
@@ -83,6 +181,9 @@ def main(argv=None) -> int:
     from triton_distributed_tpu.models import AutoLLM, Engine
     from triton_distributed_tpu.runtime.mesh import initialize_distributed
     from triton_distributed_tpu.serving.server import ModelServer, request
+
+    if args.fleet > 0:
+        return _fleet_demo(args)
 
     t0 = time.time()
     ctx = initialize_distributed(tp=1, devices=jax.devices()[:1])

@@ -384,6 +384,24 @@ def test_tier1_marker_audit():
     assert (order.index("test_router.py")
             < order.index("test_fleet.py")
             < order.index("test_serving.py"))
+    # ISSUE-10: the slot-migration suite (tiny-model bit-exactness +
+    # stub fleets) rides right behind the fleet suite, still ahead of
+    # the interpret tail, and must carry tier-1-runnable tests.
+    assert "test_migration.py" in order
+    assert (order.index("test_fleet.py")
+            < order.index("test_migration.py")
+            < order.index("test_serving.py"))
+    mig_src = open(os.path.join(tests_dir, "test_migration.py")).read()
+    mig_tree = ast.parse(mig_src)
+    mig_fast = [
+        n.name for n in ast.walk(mig_tree)
+        if isinstance(n, ast.FunctionDef) and n.name.startswith("test_")
+        and not any("slow" in ast.dump(d) for d in n.decorator_list)
+    ]
+    assert len(mig_fast) >= 5, (
+        f"slot-migration suite has too few tier-1-runnable tests: "
+        f"{mig_fast}"
+    )
     # And it contains non-slow tests, so tier-1 (which skips `slow`)
     # actually exercises the tracer.
     src = open(os.path.join(tests_dir, "test_kernel_trace.py")).read()
@@ -440,6 +458,37 @@ def test_serving_tier_modules_compile():
     )
     assert proc.returncode == 0, (
         f"serving-tier modules failed to compile:\n"
+        f"{proc.stdout}\n{proc.stderr}"
+    )
+
+
+def test_migration_modules_compile():
+    """ISSUE-10: the slot-migration stack must byte-compile — the
+    portable-slot-state module is imported by the continuous engine's
+    admission path (a syntax error takes serving down at import time),
+    and the CPU-runnable bench that writes perf/MIGRATION.json rides
+    along (repo convention: perf harnesses fail tier-1, not a relay
+    window)."""
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    targets = [
+        os.path.join(root, "triton_distributed_tpu", "models",
+                     "slot_state.py"),
+        os.path.join(root, "triton_distributed_tpu", "models",
+                     "continuous.py"),
+        os.path.join(root, "triton_distributed_tpu", "models",
+                     "stub.py"),
+        os.path.join(root, "perf", "migration_bench.py"),
+    ]
+    proc = subprocess.run(
+        [sys.executable, "-m", "compileall", "-q", "-f", *targets],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, (
+        f"slot-migration modules failed to compile:\n"
         f"{proc.stdout}\n{proc.stderr}"
     )
 

@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import threading
 import time
 import weakref
 from collections import Counter, deque
@@ -99,11 +100,17 @@ class RequestError:
 class RequestResult:
     """One request's outcome: generated tokens (PARTIAL when the
     request failed mid-decode — everything emitted before the failure)
-    and its status."""
+    and its status. ``status == "migrated"`` means the slot was
+    exported instead of finished (handoff drain, prefill→decode
+    handoff): ``snapshot`` then carries the portable slot state
+    (``models/slot_state.py`` wire form) a different engine resumes
+    from — the serving tier re-dispatches it, so a migrated result
+    never reaches a client."""
 
     tokens: np.ndarray
     status: str = "ok"
     reason: str = ""
+    snapshot: dict | None = None
 
     @property
     def ok(self) -> bool:
@@ -203,6 +210,25 @@ class Request:
     # individual device tasks. Clients may supply one in the payload
     # (``trace_ids``); ``run()`` assigns ``req-<n>`` when absent.
     trace_id: str | None = None
+    # Slot migration (docs/scale-out.md "Slot migration & handoff"):
+    # ``snapshot`` holds portable slot state (slot_state.py wire form)
+    # — set on INPUT to resume a migrated request (admission imports it
+    # instead of prefilling), and set on OUTPUT by a handoff export
+    # (``status`` flips to "migrated" and ``result()`` ships it).
+    # ``prefill_only`` makes the engine export the slot right after
+    # admission — the prefill→decode handoff's first half.
+    # ``ticket_id`` keys the engine's incremental snapshot buffer so
+    # the supervisor's crash recovery can match snapshots to tickets.
+    snapshot: dict | None = dataclasses.field(default=None, repr=False)
+    prefill_only: bool = False
+    ticket_id: str | None = None
+    # Per-request PRNG state: sampled requests draw from their OWN key
+    # via fold_in(key, key_step) — never the engine-global key — so a
+    # migrated slot's seeded-sampled continuation replays the exact
+    # draws the un-migrated run would have made. Assigned lazily from
+    # the engine key on first sampled draw (greedy requests never pay).
+    key: object | None = dataclasses.field(default=None, repr=False)
+    key_step: int = 0
 
     @property
     def done(self) -> bool:
@@ -210,7 +236,8 @@ class Request:
 
     def result(self) -> RequestResult:
         return RequestResult(
-            np.asarray(self.out, np.int32), self.status, self.reason
+            np.asarray(self.out, np.int32), self.status, self.reason,
+            self.snapshot if self.status == "migrated" else None,
         )
 
 
@@ -255,6 +282,7 @@ class ContinuousEngine(MegaDispatch):
         max_queue: int | None = None,
         kv_dtype: str | None = None,
         kernel_trace: bool = False,
+        snapshot_every: int = 0,
     ):
         self.model = model
         self.mode = mode
@@ -365,6 +393,40 @@ class ContinuousEngine(MegaDispatch):
             "tdt_mega_ns_amortization",
             "Decode steps per megakernel launch (current run).",
         )
+        # Slot migration (docs/scale-out.md "Slot migration & handoff"):
+        # ``snapshot_every=N`` (rounds, 0 = off) keeps an incremental
+        # per-ticket snapshot buffer the server's ``export_slots`` verb
+        # reads — the supervisor's crash-recovery feed. ``_handoff_at``
+        # arms the lossless-drain sweep: at the first scheduling round
+        # >= it, every active slot exports instead of finishing here.
+        self.snapshot_every = int(snapshot_every)
+        self._handoff_at: int | None = None
+        self._round = 0
+        self._snap_lock = threading.Lock()
+        self._snapshots: dict[str, dict] = {}
+        self._m_migrations = obs_metrics.counter(
+            "tdt_migrations_total",
+            "Slots exported for migration, by reason.",
+            labels=("reason",),
+        )
+        self._m_mig_bytes = obs_metrics.histogram(
+            "tdt_migration_bytes",
+            "KV payload bytes shipped per exported slot.",
+            buckets=obs_metrics.SIZE_BUCKETS,
+        )
+        self._m_mig_handoff = obs_metrics.histogram(
+            "tdt_migration_handoff_seconds",
+            "Wall time from slot export to its import elsewhere.",
+        )
+        self._m_mig_saved = obs_metrics.counter(
+            "tdt_migration_tokens_saved_total",
+            "Generated tokens restored from a snapshot instead of "
+            "re-generated (work a replay recovery would repeat).",
+        )
+        self._m_mig_fallbacks = obs_metrics.counter(
+            "tdt_migration_fallbacks_total",
+            "Snapshot imports that fell back to replay-from-prompt.",
+        )
         ContinuousEngine._live.add(self)
 
     @staticmethod
@@ -394,6 +456,14 @@ class ContinuousEngine(MegaDispatch):
             "mega_fallback_steps": 0,
             # Device task tracer: launches whose ring was decoded.
             "mega_trace_launches": 0,
+            # Slot-migration ledger (docs/scale-out.md "Slot migration
+            # & handoff"): exports, imports, generated tokens restored
+            # without re-generation, and imports that fell back to a
+            # full replay from the prompt.
+            "migrated_out": 0,
+            "migrated_in": 0,
+            "migrated_in_tokens": 0,
+            "migration_fallbacks": 0,
         }
 
     @property
@@ -473,11 +543,16 @@ class ContinuousEngine(MegaDispatch):
 
     def _admit(
         self, req: Request, slot: int, m: PrefixMatch | None = None
-    ) -> jax.Array:
-        """Prefill ``req`` into ``slot``; returns the first sampled token."""
+    ):
+        """Prefill ``req`` into ``slot``; returns the first sampled
+        token — or None when ``req`` carried a migration snapshot and
+        was RESUMED instead (its pending token is already in ``out``;
+        the next scheduling round continues decoding it)."""
         fault_point("engine.admit", slot=slot)
         if req.timeline is not None:
             req.timeline.stamp_admit()
+        if req.snapshot is not None:
+            return self._admit_import(req, slot)
         if self.prefix is not None:
             return self._admit_prefix(req, slot, m)
         s = len(req.prompt)
@@ -555,6 +630,76 @@ class ContinuousEngine(MegaDispatch):
                         trace_id=req.trace_id)
         self._slots[slot] = req
         return self._sample_req(req, logits)
+
+    def _admit_import(self, req: Request, slot: int):
+        """Resume a migrated request from its snapshot: import the
+        portable slot state (``models/slot_state.py``) into ``slot``
+        instead of re-prefilling. Returns None (the pending token is
+        already the tail of ``out``). Import failures — geometry or
+        dtype mismatch, a stale prefix delta, an injected
+        ``migrate.import`` fault — fall back to a FULL REPLAY from the
+        prompt: correct (the engine is deterministic), just without the
+        saved work. A failure after pages were claimed unwinds through
+        the standard crash-safe teardown first."""
+        from triton_distributed_tpu.models import slot_state
+
+        snap_wire = req.snapshot
+        snap = None
+        try:
+            snap = (
+                slot_state.SlotSnapshot.from_wire(snap_wire)
+                if isinstance(snap_wire, dict) else snap_wire
+            )
+            slot_state.import_slot(self, req, snap, slot)
+        except Exception as e:  # noqa: BLE001 — fallback boundary
+            if req.slot is not None:
+                # Pages/pins were claimed before the failure: release
+                # them the same way any crashed slot does.
+                self._teardown_slot(req)
+            req.snapshot = None
+            req.out = []
+            # Replay under the snapshot's OWN key from draw 0: the
+            # replay then makes exactly the draws the original run
+            # made, keeping even the fallback bit-exact for seeded
+            # sampling (a fresh engine-split key would not).
+            if snap is not None and snap.key_data is not None:
+                req.key = jax.random.wrap_key_data(
+                    jnp.asarray(snap.key_data)
+                )
+            req.key_step = 0
+            self.stats["migration_fallbacks"] += 1
+            self._m_mig_fallbacks.inc()
+            obs_events.emit(
+                "migrate_fallback", slot=slot,
+                reason=f"{type(e).__name__}: {str(e)[:160]}",
+                trace_id=req.trace_id,
+            )
+            m = self.prefix.match(req.prompt) if self.prefix else None
+            try:
+                return self._admit(req, slot, m)
+            except Exception as e2:  # noqa: BLE001 — isolation boundary
+                # The replay admission failed too: release the match
+                # pins and fail ONLY this request, exactly as a direct
+                # admission failure would (the caller reads the status).
+                self._admit_failure(req, m, e2)
+                return None
+        req.snapshot = None
+        self._sync_tables()
+        self._bump("admitted")
+        self.stats["migrated_in"] += 1
+        self.stats["migrated_in_tokens"] += len(req.out)
+        self._m_mig_saved.inc(len(req.out))
+        if snap.exported_at:
+            self._m_mig_handoff.observe(
+                max(time.time() - snap.exported_at, 0.0)
+            )
+        obs_events.emit(
+            "migrate_in", slot=slot, tokens_out=len(req.out),
+            kv_tokens=int(self._kv_len[slot]),
+            from_prefix_pages=int(snap.from_prefix_pages),
+            trace_id=req.trace_id,
+        )
+        return None
 
     def _prefill_suffix(self, slot: int, prompt: np.ndarray, start: int):
         """Chunk-prefill ``prompt[start:]`` into ``slot``'s pages,
@@ -799,6 +944,20 @@ class ContinuousEngine(MegaDispatch):
         k = self.top_k if req.top_k is None else req.top_k
         return float(t), float(p), int(k)
 
+    def _req_key(self, req: Request) -> jax.Array:
+        """One sampling subkey for ``req`` — the per-request PRNG
+        protocol slot migration relies on: every draw is
+        ``fold_in(request key, draw counter)``, so a migrated slot
+        (which carries key + counter in its snapshot) replays the exact
+        draw sequence the un-migrated run would have made, independent
+        of what other slots share its batch. The request key itself is
+        split off the engine key lazily on the first sampled draw."""
+        if req.key is None:
+            self.key, req.key = jax.random.split(self.key)
+        sub = jax.random.fold_in(req.key, req.key_step)
+        req.key_step += 1
+        return sub
+
     def _sample_req(self, req: Request, logits: jax.Array) -> int:
         """Sample one token for ``req`` from ``logits [V]`` under its
         effective knobs."""
@@ -810,8 +969,7 @@ class ContinuousEngine(MegaDispatch):
         t, p, k = self._request_sampling(req)
         if t <= 0.0:
             return int(sampling.greedy(logits))
-        self.key, sub = jax.random.split(self.key)
-        return int(sampling.sample(logits, sub, t, p, k))
+        return int(sampling.sample(logits, self._req_key(req), t, p, k))
 
     def _sample_slots(
         self, logits: jax.Array, toks: np.ndarray | None = None
@@ -819,8 +977,8 @@ class ContinuousEngine(MegaDispatch):
         """Per-slot sampling of a batched ``[max_batch, V]`` decode
         output. All-greedy batches stay one batched argmax (``toks``,
         when given, is that argmax already fetched by the caller);
-        slots with ``temperature > 0`` each draw under their own
-        knobs."""
+        slots with ``temperature > 0`` each draw under their own knobs
+        and their own per-request key (see :meth:`_req_key`)."""
         if toks is None:
             toks = np.array(sampling.greedy(logits))
         for slot, req in enumerate(self._slots):
@@ -829,8 +987,9 @@ class ContinuousEngine(MegaDispatch):
             t, p, k = self._request_sampling(req)
             if t <= 0.0:
                 continue
-            self.key, sub = jax.random.split(self.key)
-            toks[slot] = int(sampling.sample(logits[slot], sub, t, p, k))
+            toks[slot] = int(
+                sampling.sample(logits[slot], self._req_key(req), t, p, k)
+            )
         return toks
 
     def _needed_pages(self, prompt_len: int, gen_len: int) -> int:
@@ -882,10 +1041,14 @@ class ContinuousEngine(MegaDispatch):
             kv = int(self._kv_len[slot])
             draft = drafts[slot]
             t, p, k = self._request_sampling(req)
+            # One per-request subkey per verify (the internal
+            # accept/resample splits derive from it) — the draw
+            # sequence stays the request's own across a migration.
+            sub = self._req_key(req) if t > 0.0 else None
             try:
-                emitted, self.cache, a, self.key = spec_verify_slot(
+                emitted, self.cache, a, _ = spec_verify_slot(
                     self.model, self.cache, slot, int(self._tok[slot]),
-                    draft, kv, self._prefill_mode, key=self.key,
+                    draft, kv, self._prefill_mode, key=sub,
                     temperature=t, top_p=p, top_k=k,
                 )
             except FaultError as e:
@@ -974,7 +1137,19 @@ class ContinuousEngine(MegaDispatch):
                     break
                 need = self._needed_pages(len(head.prompt), head.gen_len)
                 m = None
-                if self.prefix is not None:
+                if head.snapshot is not None:
+                    # Migration import does its own (prefix-delta)
+                    # matching; here only a conservative availability
+                    # check — full need against free + reclaimable.
+                    avail = len(self.pool.free) + (
+                        self.prefix.reclaimable_pages()
+                        if self.prefix is not None else 0
+                    )
+                    if need > avail:
+                        self._bump("admission_stalls")
+                        progress = False
+                        break
+                elif self.prefix is not None:
                     m = self.prefix.match(head.prompt)
                     avail = (
                         len(self.pool.free)
@@ -995,9 +1170,20 @@ class ContinuousEngine(MegaDispatch):
                     self._admit_failure(req, m, e)
                     progress = True
                     break
+                if first is None:
+                    # Snapshot path: either resumed mid-generation (its
+                    # pending token is already out[-1]) or failed inside
+                    # the import fallback — the status tells which.
+                    if req.status != "ok":
+                        progress = True
+                        break
+                    if req.timeline is not None:
+                        req.timeline.stamp_first_token()
+                    admitted = progress = True
+                    continue
                 if req.timeline is not None:
                     req.timeline.stamp_first_token()
-                if self.speculative:
+                if self.speculative and req.spec is None:
                     from triton_distributed_tpu.models.speculative import (  # noqa: E501
                         SpecState,
                     )
@@ -1013,8 +1199,14 @@ class ContinuousEngine(MegaDispatch):
                 self._tok[slot] = int(first)
                 admitted = progress = True
                 # The admission token itself can finish the request
-                # (gen_len=1, or eos as first token).
-                self._maybe_finish(req, int(first))
+                # (gen_len=1, or eos as first token)...
+                if not self._maybe_finish(req, int(first)) \
+                        and req.prefill_only:
+                    # ...and an unfinished prefill_only request exports
+                    # HERE — the prefill→decode handoff's first half:
+                    # the slot (prefill KV + the admission token) ships
+                    # to a decode replica instead of occupying this one.
+                    self._migrate_out(req, "prefill_handoff")
         if admitted:
             # A trailing first-token eviction leaves the device table
             # pointing at released pages until synced — and every exit
@@ -1203,6 +1395,11 @@ class ContinuousEngine(MegaDispatch):
         ]
         self.stats = self._zero_stats()
         t0 = time.monotonic()
+        self._round = 0
+        # A fresh batch invalidates the previous one's crash-recovery
+        # snapshots (their tickets latched when run() returned).
+        with self._snap_lock:
+            self._snapshots = {}
         # Telemetry: every request gets a lifecycle timeline; the
         # server stamps enqueue at payload decode, direct callers get
         # it backfilled here (docs/observability.md).
@@ -1212,8 +1409,14 @@ class ContinuousEngine(MegaDispatch):
             r.timeline.stamp_enqueue()
             # Trace id: client-supplied or assigned here; tags admit
             # events, mega:launch events, and device-task ring records.
+            # A migrated request keeps its snapshot's id, so one id
+            # follows the request across engines.
             if r.trace_id is None:
-                r.trace_id = f"req-{next(_TRACE_IDS)}"
+                snap_tid = (
+                    r.snapshot.get("trace_id")
+                    if isinstance(r.snapshot, dict) else None
+                )
+                r.trace_id = snap_tid or f"req-{next(_TRACE_IDS)}"
         # Load shedding: the admission queue is bounded — excess
         # requests get a structured `overloaded` error immediately
         # instead of wedging the batch (clients retry with backoff).
@@ -1254,6 +1457,13 @@ class ContinuousEngine(MegaDispatch):
         try:
             self._try_admit(queue)
             while True:
+                self._round += 1
+                if (self._handoff_at is not None
+                        and self._round > self._handoff_at):
+                    # Lossless drain: export the active slots, hand the
+                    # queue back; slots whose export failed keep
+                    # decoding and are retried next round.
+                    self._handoff_sweep(queue)
                 if self._expire_deadlines():
                     # An expiry freed a slot AND its pages: admit from
                     # the queue NOW — waiting for the next slot-state
@@ -1285,7 +1495,14 @@ class ContinuousEngine(MegaDispatch):
                     # pages, but table + kv_len are host-authoritative.
                     self._try_admit(queue)
                     self._sync_tables()
+                if (self.snapshot_every
+                        and self._round % self.snapshot_every == 0):
+                    # Incremental crash-recovery snapshots at round
+                    # boundaries — host state is consistent here.
+                    self._update_snapshot_buffer()
         finally:
+            self._handoff_at = None
+            self._round = 0
             # Crash-safe teardown: NO exit path — injected fault,
             # engine bug, KeyboardInterrupt — leaves a slot holding
             # pages, a dangling tree pin, or a stale device table; the
@@ -1331,6 +1548,111 @@ class ContinuousEngine(MegaDispatch):
         if self.prefix is None:
             return 0
         return self.prefix.flush()
+
+    # -- slot migration (docs/scale-out.md "Slot migration & handoff") ----
+
+    def request_handoff(self, after_rounds: int = 0) -> None:
+        """Arm the lossless-drain sweep: at the first scheduling round
+        past ``after_rounds`` more rounds, every active slot is
+        EXPORTED (status ``migrated`` + portable snapshot) instead of
+        finishing here, and queued requests return un-run for
+        re-dispatch. Thread-safe (an int write); the replica tier calls
+        this from ``begin_drain(handoff=True)`` while a batch is in
+        flight. Tests arm it before ``run()`` for a deterministic
+        mid-generation export point."""
+        self._handoff_at = self._round + int(after_rounds)
+
+    def export_slot(self, slot: int, *, target_digest=None):
+        """Snapshot one active slot (``models/slot_state.py``) — a pure
+        read; the slot keeps decoding. ``target_digest`` enables the
+        prefix delta: payload for pages the target's radix digest
+        already covers is omitted. Call between runs or from the
+        engine's own thread at a round boundary."""
+        from triton_distributed_tpu.models import slot_state
+
+        return slot_state.export_slot(
+            self, slot, target_digest=target_digest
+        )
+
+    def export_slots(self) -> dict:
+        """The incremental snapshot buffer (``snapshot_every`` rounds),
+        keyed by ticket id — what the server's ``export_slots`` verb
+        returns and the supervisor's crash recovery resumes from.
+        Lock-guarded and engine-lock-free: safe to read mid-batch."""
+        with self._snap_lock:
+            return dict(self._snapshots)
+
+    def _update_snapshot_buffer(self) -> None:
+        """Refresh the per-ticket snapshot buffer from every active
+        slot that carries a ticket id. Wholesale replacement IS the
+        pruning: finished tickets drop out on the next refresh, and a
+        resumed stale snapshot can only latch-lose."""
+        from triton_distributed_tpu.models import slot_state
+
+        snaps: dict[str, dict] = {}
+        for slot, req in enumerate(self._slots):
+            if req is None or req.ticket_id is None:
+                continue
+            try:
+                snaps[req.ticket_id] = slot_state.export_slot(
+                    self, slot
+                ).to_wire()
+            except Exception:  # noqa: BLE001 — snapshotting is best-effort
+                continue
+        with self._snap_lock:
+            self._snapshots = snaps
+
+    def _migrate_out(self, req: Request, reason: str) -> bool:
+        """Export ``req``'s slot and tear it down with status
+        ``migrated`` (the serving tier re-dispatches the snapshot
+        elsewhere). Returns False — and leaves the request RUNNING —
+        when the export itself fails (e.g. an injected
+        ``migrate.export`` fault): the slot then simply finishes here,
+        which keeps a handoff drain lossless either way."""
+        from triton_distributed_tpu.models import slot_state
+
+        slot = req.slot
+        try:
+            snap = slot_state.export_slot(self, slot)
+            req.snapshot = snap.to_wire()
+        except Exception as e:  # noqa: BLE001 — export is best-effort
+            obs_events.emit(
+                "migrate_failed", slot=slot,
+                reason=f"{type(e).__name__}: {str(e)[:160]}",
+                trace_id=req.trace_id,
+            )
+            return False
+        req.status, req.reason = "migrated", f"slot exported ({reason})"
+        self.stats["migrated_out"] += 1
+        self._m_migrations.inc(reason=reason)
+        self._m_mig_bytes.observe(float(snap.payload_bytes()))
+        self._teardown_slot(req)
+        self._finish_obs(req)
+        obs_events.emit(
+            "migrate_out", slot=slot, tokens_out=len(req.out),
+            reason=reason, bytes=snap.payload_bytes(),
+            trace_id=req.trace_id,
+        )
+        return True
+
+    def _handoff_sweep(self, queue: deque) -> None:
+        """The armed handoff fires: export every active slot (a slot
+        whose export fails keeps decoding — retried next round) and
+        mark everything still queued ``migrated`` with no snapshot
+        (nothing computed yet; it re-dispatches as a plain request)."""
+        changed = False
+        for slot in range(self.max_batch):
+            req = self._slots[slot]
+            if req is not None and self._migrate_out(req, "drain"):
+                changed = True
+        while queue:
+            r = queue.popleft()
+            if r.status == "ok":
+                r.status = "migrated"
+                r.reason = "handoff drain before admission"
+                self._finish_obs(r)
+        if changed:
+            self._sync_tables()
 
     # -- auditing ---------------------------------------------------------
 

@@ -677,6 +677,72 @@ def append_n(
     )
 
 
+def gather_pages(cache: PagedKVCache, page_ids: list[int]):
+    """Gather the listed pool pages to HOST arrays — the slot-migration
+    export path (``models/slot_state.py``): one ``take`` per pool on the
+    page axis, fetched as-is (int8 codes stay codes, scales ride along),
+    so the snapshot is byte-exact with respect to the pool it came from.
+    Returns ``(k_pages, v_pages, k_scale, v_scale)`` numpy arrays —
+    ``[L, n, Hkv, page, hd]`` pools and ``[L, n, Hkv]`` scales (scales
+    are None on an unquantized pool)."""
+    ids = jnp.asarray([int(p) for p in page_ids], jnp.int32)
+    k = np.asarray(_gather_pages_jit(cache.k_pages, ids))
+    v = np.asarray(_gather_pages_jit(cache.v_pages, ids))
+    if cache.quantized:
+        ks = np.asarray(_gather_pages_jit(cache.k_scale, ids))
+        vs = np.asarray(_gather_pages_jit(cache.v_scale, ids))
+    else:
+        ks = vs = None
+    return k, v, ks, vs
+
+
+# NOT donated (unlike every writer above): the gather is a pure read —
+# the pool stays live for the decode loop that owns it. jit
+# re-specializes per (pool shape, id count); migration exports reuse a
+# handful of shapes per engine.
+_gather_pages_jit = jax.jit(lambda pages, ids: jnp.take(pages, ids, axis=1))
+
+
+def write_page(cache: PagedKVCache, pid: int, k_page, v_page,
+               k_scale=None, v_scale=None) -> PagedKVCache:
+    """Write one page's full content (both pools, all layers) into pool
+    page ``pid`` — the slot-migration import path: the payload arrays
+    come from :func:`gather_pages` on another engine and are written
+    VERBATIM (int8 codes + their scale as a pair), so a migrated slot's
+    dequantized KV is bit-identical to the source's. ``k_page``/
+    ``v_page`` are ``[L, Hkv, page, hd]``; scales ``[L, Hkv]`` (required
+    iff the pool is quantized)."""
+    if cache.quantized != (k_scale is not None):
+        raise ValueError(
+            "page payload and pool disagree on quantization "
+            f"(pool quantized={cache.quantized}, scales "
+            f"{'present' if k_scale is not None else 'absent'})"
+        )
+    p = jnp.asarray(pid, jnp.int32)
+    kp = _write_page_jit(cache.k_pages, p,
+                         jnp.asarray(k_page, cache.k_pages.dtype))
+    vp = _write_page_jit(cache.v_pages, p,
+                         jnp.asarray(v_page, cache.v_pages.dtype))
+    ks, vs = cache.k_scale, cache.v_scale
+    if cache.quantized:
+        ks = _write_page_jit(ks, p, jnp.asarray(k_scale, jnp.float32))
+        vs = _write_page_jit(vs, p, jnp.asarray(v_scale, jnp.float32))
+    return dataclasses.replace(
+        cache, k_pages=kp, v_pages=vp, k_scale=ks, v_scale=vs
+    )
+
+
+# Donated like _scatter_jit (an eager update would copy the pool to
+# move one page); shape-polymorphic over trailing dims so the same body
+# serves pools and their [L, P, H] scale arrays.
+_write_page_jit = jax.jit(
+    lambda pages, pid, data: jax.lax.dynamic_update_slice_in_dim(
+        pages, data[:, None], pid, axis=1
+    ),
+    donate_argnums=(0,),
+)
+
+
 def as_dense(cache: PagedKVCache, layer=None):
     """Materialize contiguous ``[L?, B, Hkv_loc, S_max, hd]`` views by
     gathering pages through the table (decode feeds this to

@@ -32,6 +32,7 @@ mid-batch SIGKILL to land deterministically.
 
 from __future__ import annotations
 
+import threading
 import time
 
 import numpy as np
@@ -39,6 +40,7 @@ import numpy as np
 from triton_distributed_tpu.models.continuous import RequestResult
 from triton_distributed_tpu.models.paged_kv_cache import PagePool
 from triton_distributed_tpu.models.prefix_cache import PrefixCache
+from triton_distributed_tpu.obs import metrics as obs_metrics
 
 _FNV_OFFSET = 2166136261
 _FNV_PRIME = 16777619
@@ -83,9 +85,30 @@ class StubEngine:
         self.prefix = PrefixCache(self.pool, self.page_size)
         self.vocab = int(vocab)
         # Per-batch wall-time floor: keeps a batch in flight long
-        # enough for the chaos suite's mid-batch kill seams.
+        # enough for the chaos suite's mid-batch kill seams. Spread
+        # over the batch's tokens (not slept up front), so the
+        # incremental snapshot buffer below has real partial progress
+        # for a mid-batch SIGKILL to leave behind.
         self.delay_s = float(delay_s)
         self.last_stats: dict = self._zero_stats()
+        # Slot migration (docs/scale-out.md "Slot migration & handoff"):
+        # the stub keeps a per-ticket snapshot of each in-flight
+        # request's progress — the control-plane half of the protocol,
+        # token-cheap (no KV payload; the hash "model" regenerates KV
+        # for free, so prompt+out IS the full portable state).
+        self._snap_lock = threading.Lock()
+        self._snapshots: dict[str, dict] = {}
+        self._handoff = threading.Event()
+        self._m_mig_saved = obs_metrics.counter(
+            "tdt_migration_tokens_saved_total",
+            "Generated tokens restored from a snapshot instead of "
+            "re-generated (work a replay recovery would repeat).",
+        )
+        self._m_migrations = obs_metrics.counter(
+            "tdt_migrations_total",
+            "Slots exported for migration, by reason.",
+            labels=("reason",),
+        )
 
     def _zero_stats(self) -> dict:
         return {
@@ -95,6 +118,10 @@ class StubEngine:
             "prefix_hit_tokens": 0,
             "kv_bytes_per_token": 0.0,
             "kv_dtype": "stub",
+            "migrated_out": 0,
+            "migrated_in": 0,
+            "migrated_in_tokens": 0,
+            "migration_fallbacks": 0,
         }
 
     def _pages_for(self, n_tokens: int) -> int:
@@ -104,18 +131,40 @@ class StubEngine:
         """Serve a batch; same contract as ``ContinuousEngine.run``.
         Accepts engine ``Request`` objects or ``(prompt, gen_len)``
         tuples. ``decode_steps`` counts emitted tokens (the stub has no
-        batched decode, so steps == tokens)."""
+        batched decode, so steps == tokens). The batch delay is spread
+        over its tokens, and each token updates the per-ticket snapshot
+        buffer — so a mid-batch SIGKILL leaves resumable progress and a
+        handoff request (:meth:`request_handoff`) exports mid-request."""
         stats = self._zero_stats()
-        if self.delay_s:
-            time.sleep(self.delay_s)
-        outs: list[RequestResult] = []
+        total_toks = 0
+        parsed = []
         for req in requests:
             prompt = getattr(req, "prompt", None)
             if prompt is None:
                 prompt, gen_len = req
+                req = None
             else:
                 gen_len = req.gen_len
-            outs.append(self._serve_one(prompt, int(gen_len), stats))
+            parsed.append((req, prompt, int(gen_len)))
+            total_toks += max(int(gen_len), 1)
+        sleep = self.delay_s / max(total_toks, 1)
+        outs: list[RequestResult] = []
+        for req, prompt, gen_len in parsed:
+            if self._handoff.is_set():
+                # Not-yet-started requests hand back un-run. NOT
+                # counted as migrated_out — nothing was exported; the
+                # real engine's sweep makes the same distinction, so
+                # stub and ContinuousEngine fleets report one schema.
+                outs.append(RequestResult(
+                    np.zeros(0, np.int32), "migrated",
+                    "handoff drain before admission",
+                    getattr(req, "snapshot", None),
+                ))
+                continue
+            outs.append(self._serve_one(req, prompt, gen_len, stats, sleep))
+        with self._snap_lock:
+            self._snapshots = {}
+        self._handoff.clear()  # one-shot, like the engine's _handoff_at
         self.last_stats = stats
         stats["prefix_cache"] = dict(self.prefix.stats)
         stats["prefix_hit_rate"] = self.prefix.hit_rate
@@ -125,8 +174,8 @@ class StubEngine:
             return outs
         return [np.asarray(r.tokens, np.int32) for r in outs]
 
-    def _serve_one(self, prompt, gen_len: int,
-                   stats: dict) -> RequestResult:
+    def _serve_one(self, req, prompt, gen_len: int, stats: dict,
+                   sleep: float) -> RequestResult:
         toks = [int(t) for t in prompt]
         s = len(toks)
         if s == 0 or gen_len <= 0:
@@ -134,6 +183,22 @@ class StubEngine:
                 np.zeros(0, np.int32), "unservable",
                 "stub needs a non-empty prompt and gen_len >= 1",
             )
+        # Snapshot resume (docs/scale-out.md "Slot migration &
+        # handoff"): a valid snapshot's generated tokens are restored,
+        # not re-generated; anything malformed/stale falls back to a
+        # full replay from the prompt — the same contract as the real
+        # engine's import path.
+        out: list[int] = []
+        snap = getattr(req, "snapshot", None)
+        if snap is not None:
+            restored = self._resume_tokens(snap, toks, gen_len)
+            if restored is None:
+                stats["migration_fallbacks"] += 1
+            else:
+                out = restored
+                stats["migrated_in"] += 1
+                stats["migrated_in_tokens"] += len(out)
+                self._m_mig_saved.inc(len(out))
         total = self._pages_for(s + gen_len)
         # The production admission protocol: match (pins + hit
         # accounting), allocate the uncovered pages (LRU-evicting the
@@ -150,20 +215,99 @@ class StubEngine:
         shared = list(m.nodes)
         self.prefix.finish_cow(m)
         pages = m.pages + new
-        out = stub_generate(toks, gen_len, self.vocab)
-        stats["prefill_tokens"] += s - matched
+        tid = getattr(req, "ticket_id", None)
+        # A resumed request's KV is "shipped" (the hash model carries
+        # none) — only a cold start pays the prefill.
+        stats["prefill_tokens"] += 0 if out else s - matched
         stats["prefix_hit_tokens"] += matched
-        stats["generated_tokens"] += gen_len
-        stats["decode_steps"] += gen_len
+        ctx = toks + out
+        # prefill→decode handoff: emit only the admission token, then
+        # export (the engine's prefill_only contract). Never re-armed
+        # on a resumed request — its prefill already happened.
+        prefill_only = bool(getattr(req, "prefill_only", False)) and not out
+        migrated = None
+        while len(out) < gen_len:
+            if sleep:
+                time.sleep(sleep)
+            if self._handoff.is_set():
+                migrated = "drain"
+                break
+            nxt = stub_next_token(ctx, self.vocab)
+            out.append(nxt)
+            ctx.append(nxt)
+            stats["generated_tokens"] += 1
+            stats["decode_steps"] += 1
+            if tid is not None:
+                with self._snap_lock:
+                    self._snapshots[tid] = self._snapshot_of(
+                        toks, out, gen_len, req
+                    )
+            if prefill_only and len(out) < gen_len:
+                migrated = "prefill_handoff"
+                break
+        if migrated:
+            # Mid-request handoff: export the progress, release the
+            # pages (nothing retires — the tree only caches completed
+            # chains in the stub), hand the snapshot back.
+            for node in shared:
+                self.prefix.release_node(node)
+            self.pool.release(pages[len(shared):])
+            stats["migrated_out"] += 1
+            self._m_migrations.inc(reason=migrated)
+            return RequestResult(
+                np.asarray(out, np.int32), "migrated",
+                f"slot exported ({migrated})",
+                self._snapshot_of(toks, out, gen_len, req),
+            )
         # Cache prompt + fed-back generations, positions [0, s+gen-1)
         # — the same chain a real engine retires.
-        chain = (toks + out)[: s + gen_len - 1]
+        chain = ctx[: s + gen_len - 1]
         nchain = self._pages_for(len(chain))
         self.prefix.retire_sequence(chain, pages[:nchain], shared)
         self.pool.release(pages[nchain:])
         return RequestResult(np.asarray(out, np.int32))
 
+    @staticmethod
+    def _snapshot_of(toks, out, gen_len, req) -> dict:
+        return {
+            "stub": True,
+            "prompt": list(toks),
+            "out": list(out),
+            "gen_len": int(gen_len),
+            "trace_id": getattr(req, "trace_id", None),
+            "exported_at": time.time(),
+        }
+
+    def _resume_tokens(self, snap, toks, gen_len) -> list[int] | None:
+        """Validate a snapshot against the request; None → replay."""
+        try:
+            if [int(t) for t in snap["prompt"]] != toks:
+                return None
+            out = [int(t) for t in snap["out"]]
+        except (KeyError, TypeError, ValueError):
+            return None
+        if len(out) >= int(gen_len):
+            return None
+        return out
+
     # -- replica/server surface -------------------------------------------
+
+    def request_handoff(self, after_rounds: int = 0) -> None:
+        """Arm the lossless-drain export (docs/scale-out.md "Slot
+        migration & handoff"): the in-flight batch stops at the next
+        token, exporting each request's progress as a snapshot.
+        ``after_rounds`` is accepted for engine-surface parity (the
+        stub has no scheduling rounds — it always stops at the next
+        token boundary)."""
+        del after_rounds
+        self._handoff.set()
+
+    def export_slots(self) -> dict:
+        """Per-ticket progress snapshots of the in-flight batch — what
+        the server's ``export_slots`` verb returns and the supervisor's
+        crash recovery resumes from. Lock-guarded; safe mid-batch."""
+        with self._snap_lock:
+            return dict(self._snapshots)
 
     def prefix_digest(self) -> list:
         return self.prefix.prefix_digest()

@@ -37,6 +37,12 @@ Seams currently instrumented (grep for ``fault_point``/``mutate_point``):
 ``proc.hang``      same offer point — a ``hang_proc`` rule SIGSTOPs
                    the child so heartbeats wedge without the process
                    exiting (resume with ``os.kill(pid, SIGCONT)``)
+``migrate.export`` ``models/slot_state.py::export_slot`` — a slot
+                   export dies before any state is read (the slot
+                   keeps decoding; handoff retries or finishes local)
+``migrate.import`` ``models/slot_state.py::import_slot`` — a snapshot
+                   import dies before pages are claimed (the engine
+                   falls back to replay-from-prompt)
 =================  =====================================================
 
 The ``wire.*``/``proc.*`` seams live on the *router-process* side of
@@ -237,6 +243,24 @@ class FaultPlan:
         return self.on("replica.run", every=1, times=times, delay=delay,
                        **match)
 
+    def fail_export(self, at: int = 1, times: int = 1) -> "FaultPlan":
+        """Nth slot export raises mid-migration (the source end of a
+        handoff dies): the request keeps decoding locally — a handoff
+        drain stays lossless, just slower (docs/scale-out.md 'Slot
+        migration & handoff'). ``at=0`` fires on EVERY export (up to
+        ``times``) — the export path is retried at round boundaries,
+        so killing one attempt only delays the handoff."""
+        kw = {"at": at} if at else {"every": 1}
+        return self.on("migrate.export", times=times, **kw)
+
+    def fail_import(self, at: int = 1, times: int = 1) -> "FaultPlan":
+        """Nth snapshot import raises mid-migration (the target end
+        dies before claiming pages): the engine falls back to a full
+        replay from the prompt — correct output, saved work lost.
+        ``at=0`` fires on every import up to ``times``."""
+        kw = {"at": at} if at else {"every": 1}
+        return self.on("migrate.import", times=times, **kw)
+
     # Wire/process seams for the cross-process fleet (docs/scale-out.md
     # "Process fleet"). ``replica=`` narrows every one of these to one
     # RemoteReplica by name; ``side`` picks the wire direction. The
@@ -306,16 +330,21 @@ class FaultPlan:
                        **kw, **match)
 
     def kill_proc(self, replica: str | None = None, at: int = 0,
-                  times: int = 1) -> "FaultPlan":
+                  times: int = 1, after_s: float = 0.0) -> "FaultPlan":
         """SIGKILL the replica child process mid-batch: the seam offers
         the child's pid right after the batch payload went out, so the
         kill lands while the batch is in flight — the OS then closes
         the socket and the parent's recv sees the crash exactly as a
-        real OOM-kill would read."""
+        real OOM-kill would read. ``after_s`` sleeps before the kill
+        (on the waiting worker thread, so the batch stays in flight):
+        the child makes real progress first — what the snapshot-based
+        recovery tests need a mid-generation kill for."""
         import os
         import signal
 
         def _kill(pid, _ctx):
+            if after_s:
+                time.sleep(after_s)
             if pid:
                 try:
                     os.kill(pid, signal.SIGKILL)

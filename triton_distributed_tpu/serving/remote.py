@@ -49,6 +49,7 @@ import socket
 import numpy as np
 
 from triton_distributed_tpu.models.continuous import RequestResult
+from triton_distributed_tpu.obs import events as obs_events
 from triton_distributed_tpu.runtime.faults import fault_point, mutate_point
 from triton_distributed_tpu.serving.replica import (
     DEAD,
@@ -164,6 +165,26 @@ class RemoteEngine:
         return self.call({"cmd": "healthz"},
                          timeout=timeout or self.probe_timeout_s)
 
+    def export_slots(self, timeout: float | None = None) -> dict:
+        """The child's incremental slot-snapshot buffer, by ticket id
+        (docs/scale-out.md "Slot migration & handoff") — what the
+        supervisor's snapshot-based crash recovery polls."""
+        resp = self.call({"cmd": "export_slots"},
+                         timeout=timeout or self.probe_timeout_s)
+        slots = resp.get("slots")
+        return slots if isinstance(slots, dict) else {}
+
+    def request_handoff(self, after_rounds: int = 0) -> None:
+        """Arm the child engine's lossless-drain sweep (the in-flight
+        batch returns its unfinished slots as snapshots). A wire error
+        means the child is already gone — the batch path will classify
+        that; nothing to do here."""
+        del after_rounds  # the child exports at its next boundary
+        try:
+            self.call({"cmd": "handoff"}, timeout=self.probe_timeout_s)
+        except (OSError, ConnectionError):
+            pass
+
     def prefix_digest(self):
         return self._digest
 
@@ -218,6 +239,11 @@ class RemoteReplica(EngineReplica):
         """The supervisor's heartbeat probe (lock-free on the child)."""
         return self._remote.healthz(timeout)
 
+    def export_slots(self, timeout: float | None = None) -> dict:
+        """The child's slot-snapshot buffer by ticket id — the
+        supervisor's snapshot-based crash-recovery feed."""
+        return self._remote.export_slots(timeout)
+
     @property
     def free_pages(self) -> int:
         # Best-effort load tiebreak from the last stats the wire
@@ -240,6 +266,30 @@ class RemoteReplica(EngineReplica):
             vals = [getattr(t, attr) for t in tickets]
             if any(v is not None for v in vals):
                 payload[key] = vals
+        # Slot migration: snapshots resume exported work on this
+        # child; prefill_only asks it to export right after admission
+        # (docs/scale-out.md "Slot migration & handoff").
+        if any(t.snapshot is not None for t in tickets):
+            payload["snapshots"] = [t.snapshot for t in tickets]
+            # A payload over the child's request-line bound would be
+            # refused as bad_request — which the wire path below reads
+            # as a REPLICA failure, killing a healthy target (and the
+            # still-oversized ticket would then kill the next one).
+            # Ship nothing instead: the requests replay from the
+            # prompt — PR 9 recovery, never a cascade.
+            from triton_distributed_tpu.serving.server import ModelServer
+
+            probe = len(json.dumps(payload))
+            if probe > ModelServer.MAX_LINE_BYTES - 4096:
+                payload.pop("snapshots")
+                obs_events.emit(
+                    "snapshot_dropped", replica=self.name,
+                    bytes=probe, tickets=len(tickets),
+                )
+        if any(t.prefill_only for t in tickets):
+            payload["prefill_only"] = [
+                bool(t.prefill_only) for t in tickets
+            ]
         try:
             resp = self._remote.generate(payload)
         except Exception as e:  # noqa: BLE001 — the wire is the boundary
@@ -262,6 +312,7 @@ class RemoteReplica(EngineReplica):
                     np.asarray(out, np.int32),
                     str(res.get("status", "ok")),
                     str(res.get("reason", "")),
+                    res.get("snapshot"),
                 )
                 for tid, out, res in zip(
                     ids, resp["outputs"], resp["results"]
@@ -274,27 +325,43 @@ class RemoteReplica(EngineReplica):
             # Late batch on a replica the router already gave up on:
             # latch what we can (latch-first dedup by ticket id makes
             # this harmless), fold NOTHING into fleet accounting — the
-            # same duplicate-batch rule as the thread replica.
+            # same duplicate-batch rule as the thread replica. Migrated
+            # results stay unlatched (the router already re-routed).
             for t in tickets:
                 r = by_id.get(t.tid)
-                if r is not None:
+                if r is not None and r.status != "migrated":
                     t.complete(r)
             return
         stats = resp.get("stats") or {}
         self._remote.last_stats = stats
         self._remote.set_digest(resp.get("prefix_digest"))
         self.runs += 1
-        self.served += len(by_id)
         for k in self.totals:
             self.totals[k] += stats.get(k, 0)
         missing = 0
+        done = 0
+        migrated = []
         for t in tickets:
             r = by_id.get(t.tid)
-            if r is not None:
-                t.complete(r)
-            else:
+            if r is None:
                 missing += 1
+            elif r.status == "migrated":
+                # The child exported this slot (handoff drain /
+                # prefill→decode): carry the snapshot across the wire
+                # and hand the ticket back for re-dispatch — same
+                # contract as the thread replica, never latched here
+                # (prefill_only stays set for the router's kind
+                # classification; it clears it pre-dispatch).
+                if r.snapshot is not None:
+                    t.snapshot = r.snapshot
+                migrated.append(t)
+            else:
+                done += 1
+                t.complete(r)
+        self.served += done
         self._publish_digest()
+        if migrated:
+            self._migrate_tickets(migrated)
         if missing:
             # The response named ids we never sent (or dropped some):
             # protocol corruption. Kill the replica; _take_dead hands

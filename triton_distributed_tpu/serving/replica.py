@@ -55,7 +55,7 @@ DEAD = "dead"
 # models/stats.py::CORE_STATS_KEYS lesson, applied to the tier).
 FLEET_TOTAL_KEYS = (
     "decode_steps", "prefill_tokens", "generated_tokens",
-    "prefix_hit_tokens",
+    "prefix_hit_tokens", "migrated_in_tokens",
 )
 
 # Process-unique ticket ids. They ride the wire (`ticket_ids` payload
@@ -86,7 +86,8 @@ class Ticket:
     __slots__ = ("prompt", "gen_len", "temperature", "top_p", "top_k",
                  "deadline_s", "enqueue_t", "reroutes", "replica_history",
                  "result", "_event", "_lock", "_rerouted_from",
-                 "last_dispatch_t", "_prompt_list", "tid")
+                 "last_dispatch_t", "_prompt_list", "tid", "snapshot",
+                 "prefill_only")
 
     def __init__(self, prompt, gen_len: int, *, temperature=None,
                  top_p=None, top_k=None, deadline_s=None, enqueue_t=None):
@@ -113,6 +114,16 @@ class Ticket:
         # ticket rerouted mid-wait gives its new replica a full budget.
         self.last_dispatch_t: float | None = None
         self._prompt_list: list[int] | None = None
+        # Slot migration (docs/scale-out.md "Slot migration &
+        # handoff"): a portable snapshot of this request's in-flight
+        # state, attached by a handoff drain, a prefill→decode
+        # migration, or the supervisor's crash recovery. The next
+        # dispatch RESUMES from it instead of re-prefilling.
+        # ``prefill_only`` asks the target engine to export right
+        # after admission (the migrate_after_prefill policy's first
+        # hop).
+        self.snapshot: dict | None = None
+        self.prefill_only: bool = False
 
     @property
     def prompt_tokens(self) -> list[int]:
@@ -149,7 +160,8 @@ class Ticket:
         return Request(
             self.prompt, self.gen_len, temperature=self.temperature,
             top_p=self.top_p, top_k=self.top_k, deadline_s=self.deadline_s,
-            timeline=tl,
+            timeline=tl, snapshot=self.snapshot,
+            prefill_only=self.prefill_only, ticket_id=self.tid,
         )
 
     def complete(self, result: RequestResult) -> bool:
@@ -242,6 +254,11 @@ class EngineReplica:
         self.totals = {k: 0 for k in FLEET_TOTAL_KEYS}
         # Router-installed failure callback: (replica, orphan_tickets).
         self.on_failure = None
+        # Router-installed migration callback: (replica, tickets whose
+        # batch exported them). Falls back to on_failure when unset —
+        # both re-dispatch through the latch-first ticket machinery.
+        self.on_migrate = None
+        self._handoff = False
         self._digest_lock = threading.Lock()
         self._prefix_digest = None
         self._digest_version: int | None = None
@@ -328,14 +345,32 @@ class EngineReplica:
 
     # -- lifecycle ---------------------------------------------------------
 
-    def begin_drain(self) -> None:
+    def begin_drain(self, handoff: bool = False) -> None:
         """Flip to DRAINING without waiting (the router flips the whole
         fleet first, then waits everyone against one shared deadline —
-        sequential full drains would cost N × grace)."""
+        sequential full drains would cost N × grace).
+
+        ``handoff=True`` is the LOSSLESS drain (docs/scale-out.md
+        "Slot migration & handoff"): instead of finishing queued and
+        in-flight work here, the engine exports every unfinished slot
+        at its next round boundary and the queue hands back un-run —
+        the router re-admits everything elsewhere with the existing
+        latch-first ticket dedup, so a rolling restart loses zero
+        tokens of generated work."""
         with self._cond:
             if self._state == HEALTHY:
                 self._state = DRAINING
+                self._handoff = bool(handoff)
                 self._cond.notify_all()
+            elif self._state == DRAINING and handoff:
+                self._handoff = True
+                self._cond.notify_all()
+            else:
+                return
+        if handoff:
+            rh = getattr(self.engine, "request_handoff", None)
+            if rh is not None:
+                rh()
 
     def drain(self, grace_s: float | None = None) -> bool:
         """PR 3-style graceful drain: refuse new work, let queued and
@@ -388,10 +423,19 @@ class EngineReplica:
 
     def _worker(self) -> None:
         while True:
+            handoff_orphans: list[Ticket] = []
             with self._cond:
                 while not self._queue and self._state == HEALTHY:
                     self._cond.wait(0.1)
-                if self._queue and self._state in (HEALTHY, DRAINING):
+                if self._state == DRAINING and self._handoff:
+                    # Lossless drain: NOTHING queued runs here — the
+                    # queue hands back for re-dispatch (the in-flight
+                    # batch, if any, already returned with its slots
+                    # exported before the worker got back here).
+                    handoff_orphans = self._queue
+                    self._queue = []
+                    batch = None
+                elif self._queue and self._state in (HEALTHY, DRAINING):
                     batch = self._queue[: self.MAX_RUN_BATCH]
                     del self._queue[: self.MAX_RUN_BATCH]
                     self._inflight = len(batch)
@@ -418,6 +462,10 @@ class EngineReplica:
                     batch = None  # drain finalization, outside the lock
                 else:
                     return
+            if handoff_orphans:
+                # Re-dispatch OUTSIDE the lock (the router's dispatch
+                # takes other replicas' locks).
+                self._migrate_tickets(handoff_orphans)
             if batch is None:
                 # The worker owns the engine: flush the radix tree back
                 # to the pool and publish the (now empty) digest BEFORE
@@ -476,17 +524,54 @@ class EngineReplica:
             # digest nothing routes to. (The engine-side timeline of a
             # duplicate still observes; that is the documented
             # at-least-once telemetry cost of timeout re-routing.)
+            # Migrated results stay unlatched either way — the router
+            # already re-routed the ticket when it marked us dead.
             for t, r in zip(tickets, results):
-                t.complete(r)
+                if r.status != "migrated":
+                    t.complete(r)
             return
         self.runs += 1
-        self.served += len(results)
         st = self.engine.last_stats
         for k in self.totals:
             self.totals[k] += st.get(k, 0)
+        migrated: list[Ticket] = []
+        done = 0
         for t, r in zip(tickets, results):
+            if r.status == "migrated":
+                # The slot was exported, not finished: carry the
+                # snapshot (None for a request that never admitted —
+                # it keeps any snapshot it already had) and hand the
+                # ticket back for re-dispatch. NEVER latched here, so
+                # the eventual completion elsewhere is the one and
+                # only emission. ``prefill_only`` is left as-is: the
+                # router reads it to classify the migration, then
+                # clears it before dispatching the decode hop.
+                if r.snapshot is not None:
+                    t.snapshot = r.snapshot
+                migrated.append(t)
+                continue
+            done += 1
             t.complete(r)
+        self.served += done
         self._publish_digest()
+        if migrated:
+            self._migrate_tickets(migrated)
+
+    def _migrate_tickets(self, tickets: list[Ticket]) -> None:
+        """Hand exported tickets to the router for re-dispatch (the
+        latch-first machinery dedups exactly as for failures). With no
+        router attached (unit tests), fail them in place — never a
+        silent drop."""
+        cb = self.on_migrate or self.on_failure
+        if cb is not None:
+            cb(self, tickets)
+            return
+        for t in tickets:
+            t.complete(RequestResult(
+                np.zeros(0, np.int32), "failed",
+                f"replica {self.name} exported a slot with no router "
+                "attached to resume it",
+            ))
 
     def _publish_digest(self) -> None:
         """Re-snapshot the radix population for the router — but only

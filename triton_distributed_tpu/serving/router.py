@@ -88,9 +88,11 @@ class Router:
         request_timeout_s: float | None = None,
         replica_max_pending: int = 8,
     ):
-        if policy not in ("affinity", "round_robin"):
+        if policy not in ("affinity", "round_robin",
+                          "migrate_after_prefill"):
             raise ValueError(
-                f"policy must be 'affinity' or 'round_robin', got {policy!r}"
+                "policy must be 'affinity', 'round_robin', or "
+                f"'migrate_after_prefill', got {policy!r}"
             )
         self.replicas: list[EngineReplica] = [
             e if isinstance(e, EngineReplica)
@@ -106,6 +108,12 @@ class Router:
         self.drain_grace_s = float(drain_grace_s)
         self.max_reroutes = int(max_reroutes)
         self.request_timeout_s = request_timeout_s
+        # Crash-recovery snapshot feed (docs/scale-out.md "Slot
+        # migration & handoff"): when set (the FleetSupervisor installs
+        # it), a re-routed ticket without a snapshot asks the provider
+        # for one — a ticket whose dead replica had published progress
+        # resumes from it instead of replaying from the prompt.
+        self.snapshot_provider = None
         # Replicas swapped out by the supervisor's respawn path: kept
         # for name lookups (a timed-out ticket may still hold a stamp
         # naming one) and for fleet-total aggregation (their counters
@@ -123,9 +131,15 @@ class Router:
             "shed_skips": 0,
             "reroutes": 0,
             "failed_no_replica": 0,
+            # Slot migration (docs/scale-out.md "Slot migration &
+            # handoff"): tickets re-dispatched with exported state —
+            # handoff drains and prefill→decode handoffs.
+            "migrations": 0,
+            "prefill_migrations": 0,
         }
         for r in self.replicas:
             r.on_failure = self._on_replica_failure
+            r.on_migrate = self._on_replica_migrate
         # Metric handles resolved ONCE (engine convention): routing is
         # on every request's path and must not pay registry
         # get-or-create lookups.
@@ -145,6 +159,11 @@ class Router:
         self._m_shed_skips = obs_metrics.counter(
             "tdt_router_shed_skips_total",
             "Routing decisions that skipped an overloaded replica.",
+        )
+        self._m_migrations = obs_metrics.counter(
+            "tdt_router_migrations_total",
+            "Tickets re-dispatched with exported slot state, by kind.",
+            labels=("kind",),
         )
         self._g_healthy = obs_metrics.gauge(
             "tdt_router_healthy_replicas",
@@ -306,6 +325,7 @@ class Router:
         if any(r.name == replica.name for r in self.replicas):
             raise ValueError(f"replica name {replica.name!r} already live")
         replica.on_failure = self._on_replica_failure
+        replica.on_migrate = self._on_replica_migrate
         self.replicas.append(replica)
         self._refresh_healthy()
 
@@ -324,18 +344,34 @@ class Router:
             if r.name == old_name:
                 self._retired.append(r)
                 replica.on_failure = self._on_replica_failure
+                replica.on_migrate = self._on_replica_migrate
                 self.replicas[i] = replica
                 self._refresh_healthy()
                 return r
         raise KeyError(f"no replica named {old_name!r}")
 
-    def drain_replica(self, name: str,
-                      grace_s: float | None = None) -> bool:
-        """Gracefully take one replica out of rotation (finish queued
-        work, flush its radix tree); waits up to ``grace_s`` (default:
-        the router's ``drain_grace_s``)."""
+    def drain_replica(self, name: str, grace_s: float | None = None,
+                      *, handoff: bool = False) -> bool:
+        """Gracefully take one replica out of rotation; waits up to
+        ``grace_s`` (default: the router's ``drain_grace_s``).
+
+        ``handoff=False`` finishes queued + in-flight work HERE before
+        draining. ``handoff=True`` is the lossless drain
+        (docs/scale-out.md "Slot migration & handoff"): unfinished
+        slots export and re-admit on surviving replicas — generated
+        tokens carry over, nothing is recomputed, nothing double-emits
+        (latch-first tickets)."""
         grace = self.drain_grace_s if grace_s is None else grace_s
-        ok = self.replica(name).drain(grace)
+        rep = self.replica(name)
+        if handoff and not any(
+            r.state == HEALTHY and r.name != name for r in self.replicas
+        ):
+            # Nowhere to hand off to: exporting would FAIL the work a
+            # plain drain finishes — degrade to the finishing drain,
+            # which is what "lossless either way" means here.
+            handoff = False
+        rep.begin_drain(handoff=handoff)
+        ok = rep.drain(grace)
         self._refresh_healthy()
         return ok
 
@@ -373,14 +409,20 @@ class Router:
     def _candidates(self) -> list[EngineReplica]:
         return [r for r in self.replicas if r.state == HEALTHY]
 
-    def _pick(self, ticket: Ticket, *, count_sheds: bool = True):
+    def _pick(self, ticket: Ticket, *, count_sheds: bool = True,
+              exclude: str | None = None):
         """One routing decision: ``(replica, matched_tokens, decision)``
         or ``(None, 0, reason)`` when nothing can take the ticket.
         ``count_sheds=False`` on pick-to-submit-race retries keeps the
-        shed-skip ledger one-entry-per-decision."""
+        shed-skip ledger one-entry-per-decision. ``exclude`` skips one
+        replica by name when alternatives exist — a migrated ticket
+        should land AWAY from its source (falling back to the source
+        beats failing when it is the only replica left)."""
         live = self._candidates()
         if not live:
             return None, 0, "no healthy replica"
+        if exclude is not None and len(live) > 1:
+            live = [r for r in live if r.name != exclude] or live
         open_ = [r for r in live if not r.overloaded]
         if len(open_) < len(live) and count_sheds:
             skipped = len(live) - len(open_)
@@ -409,10 +451,22 @@ class Router:
         rep = min(pool, key=lambda r: (r.pending, -r.free_pages))
         return rep, 0, "least_loaded"
 
-    def _dispatch(self, ticket: Ticket) -> None:
+    def _dispatch(self, ticket: Ticket, exclude: str | None = None) -> None:
+        # migrate_after_prefill (docs/scale-out.md "Slot migration &
+        # handoff"): a fresh ticket's first hop only PREFILLS — the
+        # engine exports the slot right after admission and the
+        # migrated snapshot re-dispatches to a decode replica. Needs
+        # somewhere else to decode; with one live replica the flag
+        # stays off and the request serves end-to-end locally.
+        if self.policy == "migrate_after_prefill":
+            ticket.prefill_only = (
+                ticket.snapshot is None and len(self._candidates()) > 1
+            )
         first = True
         while True:
-            rep, matched, decision = self._pick(ticket, count_sheds=first)
+            rep, matched, decision = self._pick(
+                ticket, count_sheds=first, exclude=exclude
+            )
             first = False
             if rep is None:
                 self._fail_ticket(ticket, decision)
@@ -506,6 +560,48 @@ class Router:
                 source=replica,
             )
 
+    def _on_replica_migrate(self, replica: EngineReplica,
+                            tickets: list[Ticket]) -> None:
+        """A replica exported tickets instead of finishing them (a
+        handoff drain or a prefill→decode handoff): re-dispatch each
+        with its snapshot. Runs on the source replica's worker
+        thread."""
+        self._refresh_healthy()
+        for t in tickets:
+            self._migrate_ticket(t, replica)
+
+    def _migrate_ticket(self, ticket: Ticket,
+                        source: EngineReplica) -> None:
+        # The same atomic per-hop claim re-routing uses: a latched
+        # result or a concurrent claim (a death callback racing the
+        # handoff) skips — the ticket is never double-dispatched. A
+        # migration consumes one hop of the re-route budget, which is
+        # what bounds a pathological migration loop.
+        if not ticket.claim_reroute(source.name):
+            return
+        if ticket.reroutes > self.max_reroutes:
+            self._fail_ticket(
+                ticket,
+                f"re-route budget exhausted ({self.max_reroutes}) "
+                f"after migration off {source.name}",
+            )
+            return
+        # Kind from the TICKET's provenance (was it dispatched as a
+        # prefill-only hop?), not the global policy — a handoff DRAIN
+        # under migrate_after_prefill is still a drain.
+        kind = "prefill_handoff" if ticket.prefill_only else "handoff"
+        ticket.prefill_only = False  # the next hop decodes
+        self._bump("migrations")
+        if kind == "prefill_handoff":
+            self._bump("prefill_migrations")
+        self._m_migrations.inc(kind=kind)
+        obs_events.emit(
+            "migrate", source=source.name, migration=kind,
+            tokens=len((ticket.snapshot or {}).get("out") or []),
+            prompt_len=len(ticket.prompt),
+        )
+        self._dispatch(ticket, exclude=source.name)
+
     def _reroute(self, ticket: Ticket, reason: str,
                  source: EngineReplica | None = None) -> None:
         # Atomic per-hop claim (Ticket.claim_reroute): a latched
@@ -522,6 +618,11 @@ class Router:
                 f"{reason}",
             )
             return
+        if ticket.snapshot is None and self.snapshot_provider is not None:
+            try:
+                ticket.snapshot = self.snapshot_provider(ticket)
+            except Exception:  # noqa: BLE001 — recovery is best-effort
+                ticket.snapshot = None
         self._bump("reroutes")
         self._m_reroutes.inc()
         obs_events.emit(

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import sys
 
 import jax
 
@@ -69,9 +70,34 @@ def main(argv=None) -> int:
                    help="serve N ContinuousEngine replicas behind the "
                    "prefix-affinity router (0 = single fixed-batch "
                    "Engine, the legacy path); docs/scale-out.md")
+    p.add_argument("--fleet", type=int, default=0,
+                   help="boot a SUPERVISED PROCESS fleet of N run_server "
+                   "children (FleetSupervisor: heartbeats, crash "
+                   "respawn, snapshot-based recovery — docs/scale-out.md "
+                   "'Process fleet') and serve the router in THIS "
+                   "process; children inherit --model/--mode/--kv-dtype/"
+                   "--speculative/--max-batch (or the --stub-* knobs "
+                   "with --model stub)")
+    p.add_argument("--continuous", action="store_true",
+                   help="serve ONE ContinuousEngine (continuous "
+                   "batching, 'requests' payloads) instead of the "
+                   "fixed-batch Engine — the process-fleet child shape")
     p.add_argument("--policy", default="affinity",
-                   choices=["affinity", "round_robin"],
-                   help="router policy with --replicas")
+                   choices=["affinity", "round_robin",
+                            "migrate_after_prefill"],
+                   help="router policy with --replicas/--fleet "
+                   "(migrate_after_prefill = prefill→decode handoff, "
+                   "docs/scale-out.md 'Slot migration & handoff')")
+    p.add_argument("--snapshot-every", type=int, default=0,
+                   help="ContinuousEngine incremental slot snapshots "
+                   "every N scheduling rounds (0 = off) — the "
+                   "export_slots verb's crash-recovery feed "
+                   "(docs/scale-out.md 'Slot migration & handoff')")
+    p.add_argument("--snapshot-s", type=float, default=0.0,
+                   help="with --fleet: supervisor snapshot-pull period "
+                   "in seconds (0 = off) — failed replicas' requests "
+                   "then resume from the last snapshot instead of "
+                   "replaying from the prompt")
     p.add_argument("--max-batch", type=int, default=4,
                    help="decode slots per replica with --replicas")
     p.add_argument("--drain-grace", type=float, default=2.0,
@@ -118,6 +144,66 @@ def main(argv=None) -> int:
 
     from triton_distributed_tpu.serving.server import ModelServer
 
+    if args.fleet > 0:
+        # Supervised process fleet (docs/scale-out.md "Process
+        # fleet"): N run_server children under the FleetSupervisor,
+        # the router served from THIS process — no model loads here.
+        from triton_distributed_tpu.serving.supervisor import (
+            FleetSupervisor,
+            ReplicaSpec,
+            stub_spec,
+        )
+
+        if args.model == "stub":
+            specs = [
+                stub_spec(
+                    f"r{i}", delay_s=args.stub_delay,
+                    num_pages=args.stub_pages,
+                    page_size=args.stub_page_size,
+                )
+                for i in range(args.fleet)
+            ]
+        else:
+            child = [
+                sys.executable, "-m",
+                "triton_distributed_tpu.serving.run_server",
+                "--model", args.model, "--port", "0", "--continuous",
+                "--mode", args.mode, "--tp", str(args.tp),
+                "--max-batch", str(args.max_batch),
+                "--temperature", str(args.temperature),
+            ]
+            if args.kv_dtype:
+                child += ["--kv-dtype", args.kv_dtype]
+            if args.speculative:
+                child += ["--speculative", str(args.speculative)]
+            if args.snapshot_every:
+                child += ["--snapshot-every", str(args.snapshot_every)]
+            specs = [
+                ReplicaSpec(f"r{i}", list(child))
+                for i in range(args.fleet)
+            ]
+        sup = FleetSupervisor(
+            specs, policy=args.policy, snapshot_s=args.snapshot_s,
+            router_kw={
+                "drain_grace_s": args.drain_grace,
+                "request_timeout_s": args.request_timeout or None,
+            },
+        )
+        router = sup.start()
+        server = ModelServer(
+            router, host=args.host, port=args.port,
+            drain_grace_s=args.drain_grace,
+        )
+        print(f"serving {args.model} fleet x{args.fleet} "
+              f"({args.policy} router, logs {sup.log_dir}) on "
+              f"{server.host}:{server.port}")
+        _write_port_file(args.port_file, server.host, server.port)
+        try:
+            server.serve_forever()
+        finally:
+            sup.shutdown()
+        return 0
+
     if args.model == "stub":
         # Process-fleet replica stub: the full wire server over the
         # deterministic control-plane engine — no mesh, no model load,
@@ -157,6 +243,7 @@ def main(argv=None) -> int:
                 temperature=args.temperature, prefix_cache=True,
                 kv_dtype=args.kv_dtype, speculative=args.speculative,
                 kernel_trace=kernel_trace,
+                snapshot_every=args.snapshot_every,
             )
             for _ in range(args.replicas)
         ]
@@ -165,6 +252,20 @@ def main(argv=None) -> int:
             request_timeout_s=args.request_timeout or None,
         )
         what = f"{args.model} x{args.replicas} ({args.policy} router)"
+    elif args.continuous:
+        # The process-fleet child shape (docs/scale-out.md): ONE
+        # ContinuousEngine speaking 'requests' payloads, with the
+        # migration surface (export_slots/handoff verbs) live.
+        from triton_distributed_tpu.models.continuous import ContinuousEngine
+
+        engine = ContinuousEngine(
+            model, max_batch=args.max_batch, mode=args.mode,
+            temperature=args.temperature, prefix_cache=True,
+            kv_dtype=args.kv_dtype, speculative=args.speculative,
+            kernel_trace=kernel_trace,
+            snapshot_every=args.snapshot_every,
+        )
+        what = f"{args.model} (continuous, tp={args.tp})"
     else:
         engine = Engine(
             model, temperature=args.temperature, mode=args.mode,

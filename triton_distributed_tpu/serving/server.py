@@ -25,7 +25,16 @@ framed protocol. Here the protocol is newline-delimited JSON over TCP:
     → {"cmd": "ping"}            ← {"ok": true, "draining": false}
     → {"cmd": "healthz"}         ← {"ok": true, "state": "serving"}
     → {"cmd": "audit"}           ← {"problems": []}   (engine lock held)
+    → {"cmd": "export_slots"}    ← {"slots": {tid: snapshot, ...}}
+    → {"cmd": "handoff"}         ← {"ok": true}  (in-flight batch then
+                                    returns its slots as snapshots)
     → {"cmd": "shutdown"}        ← {"ok": true}   (server then drains)
+
+A ``requests`` payload may also carry ``snapshots`` (per-request slot
+snapshots to RESUME from — docs/scale-out.md "Slot migration &
+handoff") and ``prefill_only`` flags (export right after admission:
+the prefill→decode handoff); a ``migrated`` result entry then carries
+its ``snapshot`` back.
 
 The per-request sampling/deadline keys are scalars (applied to every
 request) or per-request lists; omitted/null entries fall back to the
@@ -104,7 +113,8 @@ from triton_distributed_tpu.runtime.faults import fault_point
 # are engine-lock-free EXCEPT `audit` (it walks live engine state, so
 # it serializes behind generation — run it quiesced).
 PROBE_CMDS = ("ping", "healthz", "stats", "metrics", "events",
-              "kernel_trace", "audit", "shutdown")
+              "kernel_trace", "audit", "shutdown", "export_slots",
+              "handoff")
 
 
 class _BadRequest(ValueError):
@@ -297,6 +307,36 @@ class ModelServer:
                     raise _BadRequest("this engine has no audit()")
                 with self._engine_lock:
                     return {"problems": [str(p) for p in auditor()]}
+            if cmd == "export_slots":
+                # Slot-migration probe (docs/scale-out.md "Slot
+                # migration & handoff"): the engine's incremental
+                # per-ticket snapshot buffer, refreshed at scheduling-
+                # round boundaries. Engine-lock-FREE (the buffer has
+                # its own lock) — the supervisor polls this MID-batch;
+                # that is the whole point of snapshot-based crash
+                # recovery.
+                exporter = getattr(self.engine, "export_slots", None)
+                if exporter is None:
+                    raise _BadRequest(
+                        "this engine has no slot snapshots "
+                        "(ContinuousEngine/StubEngine expose them; see "
+                        "docs/scale-out.md 'Slot migration & handoff')"
+                    )
+                return {"slots": exporter()}
+            if cmd == "handoff":
+                # Lossless-drain trigger: arm the engine's handoff
+                # sweep so the in-flight batch returns its unfinished
+                # slots as exported snapshots instead of finishing
+                # them here. Engine-lock-free (an event/int write) —
+                # it must land WHILE the batch runs.
+                rh = getattr(self.engine, "request_handoff", None)
+                if rh is None:
+                    raise _BadRequest(
+                        "this engine has no handoff support "
+                        "(ContinuousEngine/StubEngine expose it)"
+                    )
+                rh()
+                return {"ok": True}
             if cmd == "shutdown":
                 self._shutdown.set()
                 return {"ok": True}
@@ -391,8 +431,8 @@ class ModelServer:
             accepted = [
                 f"cmd ({'|'.join(PROBE_CMDS)})",
                 "requests + gen_lens/temperatures/top_ps/top_ks/"
-                "deadline_s/trace_ids/ticket_ids/want_digest "
-                "(continuous batching)",
+                "deadline_s/trace_ids/ticket_ids/want_digest/"
+                "snapshots/prefill_only (continuous batching)",
                 "input_ids + gen_len/prompt_start (fixed batch)",
             ]
             raise _BadRequest(
@@ -523,6 +563,31 @@ class ModelServer:
                     f"{len(prompts)} requests but ticket_ids is "
                     f"{ticket_ids!r} (want a {len(prompts)}-entry list)"
                 )
+            # Slot migration (docs/scale-out.md "Slot migration &
+            # handoff"): per-request snapshots resume migrated work
+            # (the engine imports instead of re-prefilling);
+            # ``prefill_only`` asks the engine to export right after
+            # admission (the prefill→decode handoff's first hop).
+            snapshots = req.get("snapshots")
+            if snapshots is None:
+                snapshots = [None] * len(prompts)
+            elif (not isinstance(snapshots, list)
+                  or len(snapshots) != len(prompts)):
+                raise ValueError(
+                    f"{len(prompts)} requests but snapshots is a "
+                    f"{type(snapshots).__name__} of wrong shape "
+                    f"(want a {len(prompts)}-entry list)"
+                )
+            prefill_only = req.get("prefill_only")
+            if prefill_only is None:
+                prefill_only = [False] * len(prompts)
+            elif (not isinstance(prefill_only, list)
+                  or len(prefill_only) != len(prompts)):
+                raise ValueError(
+                    f"{len(prompts)} requests but prefill_only is "
+                    f"{prefill_only!r} (want a {len(prompts)}-entry "
+                    "list)"
+                )
             from triton_distributed_tpu.models.continuous import Request
 
             def _timeline() -> Timeline:
@@ -535,19 +600,33 @@ class ModelServer:
                     Request(
                         p, int(g), temperature=t, top_p=tp, top_k=tk,
                         deadline_s=dl, timeline=_timeline(),
-                        trace_id=tid,
+                        trace_id=tid, snapshot=sn,
+                        prefill_only=bool(po),
+                        ticket_id=(
+                            None if ticket_ids is None else ticket_ids[i]
+                        ),
                     )
-                    for p, g, t, tp, tk, dl, tid in zip(
-                        prompts, gen_lens, temps, top_ps, top_ks,
-                        deadlines, trace_ids,
+                    for i, (p, g, t, tp, tk, dl, tid, sn, po) in enumerate(
+                        zip(
+                            prompts, gen_lens, temps, top_ps, top_ks,
+                            deadlines, trace_ids, snapshots, prefill_only,
+                        )
                     )
                 ],
                 results=True,
             )
             resp = {
                 "outputs": [r.tokens.tolist() for r in results],
+                # A migrated result carries its portable snapshot —
+                # the caller (RemoteReplica) re-dispatches it; the
+                # entry shape stays {status, reason} otherwise.
                 "results": [
-                    {"status": r.status, "reason": r.reason}
+                    (
+                        {"status": r.status, "reason": r.reason,
+                         "snapshot": r.snapshot}
+                        if r.snapshot is not None
+                        else {"status": r.status, "reason": r.reason}
+                    )
                     for r in results
                 ],
                 "stats": self.engine.last_stats,

@@ -211,6 +211,7 @@ class FleetSupervisor:
         replica_max_pending: int = 8,
         log_dir: str | None = None,
         router_kw: dict | None = None,
+        snapshot_s: float = 0.0,
     ):
         if not specs:
             raise ValueError("FleetSupervisor needs at least one spec")
@@ -232,6 +233,18 @@ class FleetSupervisor:
         self.crash_limit = int(crash_limit)
         self.crash_window_s = float(crash_window_s)
         self.replica_max_pending = int(replica_max_pending)
+        # Snapshot-based crash recovery (docs/scale-out.md "Slot
+        # migration & handoff"): every ``snapshot_s`` seconds (0 =
+        # off) the monitor pulls each healthy child's incremental
+        # slot snapshots ({"cmd": "export_slots"}); when a replica
+        # fails, its orphaned tickets resume from the last snapshot
+        # instead of replaying from the prompt. A stale or garbled
+        # snapshot degrades to replay on the target — never worse
+        # than PR 9's recovery.
+        self.snapshot_s = float(snapshot_s)
+        self._snaps: dict[str, dict] = {}  # slot name → {tid: snap}
+        self._snap_lock = threading.Lock()  # monitor vs reroute threads
+        self._next_snap_t = 0.0
         self.log_dir = log_dir or tempfile.mkdtemp(prefix="tdt-fleet-")
         self._router_kw = dict(router_kw or {})
         self._router_kw.setdefault("policy", policy)
@@ -258,6 +271,12 @@ class FleetSupervisor:
         self._g_beat_age = obs_metrics.gauge(
             "tdt_replica_heartbeat_age_seconds",
             "Seconds since the last successful heartbeat, by slot.",
+            labels=("replica",),
+        )
+        self._m_resumes = obs_metrics.counter(
+            "tdt_supervisor_snapshot_resumes_total",
+            "Orphaned tickets re-dispatched WITH a crash-recovery "
+            "snapshot (vs plain replay), by slot.",
             labels=("replica",),
         )
 
@@ -303,6 +322,11 @@ class FleetSupervisor:
             replicas, replica_max_pending=self.replica_max_pending,
             **self._router_kw,
         )
+        if self.snapshot_s:
+            # Crash recovery consults the snapshot store on EVERY
+            # re-route claim — wire-detected deaths included, which
+            # never pass through this supervisor's _fail.
+            self.router.snapshot_provider = self._snapshot_for
         self._thread = threading.Thread(
             target=self._monitor, daemon=True, name="fleet-supervisor",
         )
@@ -399,6 +423,9 @@ class FleetSupervisor:
 
     def _tick(self) -> None:
         now = time.monotonic()
+        if self.snapshot_s and now >= self._next_snap_t:
+            self._next_snap_t = now + self.snapshot_s
+            self._pull_snapshots()
         for slot in self._slots:
             if slot.parked:
                 continue
@@ -433,6 +460,45 @@ class FleetSupervisor:
                 self._fail(slot, "exit", f"process exited rc={rc}")
             else:
                 self._heartbeat(slot, now)
+
+    def _pull_snapshots(self) -> None:
+        """One snapshot sweep: replace each healthy slot's snapshot
+        map with the child's current buffer. Wholesale replacement IS
+        the pruning (finished tickets drop out); a failed pull keeps
+        the PREVIOUS map — stale beats empty, and a stale resume can
+        only latch-lose or degrade to replay."""
+        for slot in self._slots:
+            rep = slot.replica
+            if rep is None or rep.state != HEALTHY:
+                continue
+            exporter = getattr(rep, "export_slots", None)
+            if exporter is None:
+                continue
+            try:
+                snaps = exporter(timeout=self.heartbeat_timeout_s)
+            except Exception:  # noqa: BLE001 — best-effort feed
+                continue
+            if isinstance(snaps, dict):
+                with self._snap_lock:
+                    self._snaps[slot.spec.name] = snaps
+
+    def _snapshot_for(self, ticket) -> dict | None:
+        """Router snapshot-provider hook (``Router.snapshot_provider``):
+        the last pulled snapshot for a re-routed ticket, from whichever
+        slot published it. Runs on router/replica worker threads."""
+        with self._snap_lock:
+            items = list(self._snaps.items())
+        for name, snaps in items:
+            snap = snaps.get(ticket.tid)
+            if snap is not None:
+                self._m_resumes.inc(replica=name)
+                obs_events.emit(
+                    "snapshot_resume", slot=name, ticket=ticket.tid,
+                    tokens=(len(snap.get("out") or [])
+                            if isinstance(snap, dict) else 0),
+                )
+                return snap
+        return None
 
     def _heartbeat(self, slot: _Slot, now: float) -> None:
         rep = slot.replica
@@ -549,6 +615,10 @@ class FleetSupervisor:
             return
         slot.replica = rep
         slot.respawns += 1
+        # The dead child's orphans were already resumed (or replayed);
+        # its snapshots must not outlive it into the fresh generation.
+        with self._snap_lock:
+            self._snaps.pop(slot.spec.name, None)
         slot.fails_in_a_row = 0  # a successful bind resets the backoff
         slot.missed_beats = 0
         slot.next_respawn_t = None
