@@ -108,7 +108,11 @@ STEPS = [
     # variants — explain-or-fix the 33% (VERDICT task 3), and the
     # perf model's non-anchor validation points (task 6).
     ("gemm_mfu", [sys.executable, "perf/gemm_mfu.py"], 1800),
-    ("ep_overhead", [sys.executable, "perf/ep_a2a_overhead.py"], 900),
+    # MoE serving fast path: Qwen3MoE through the continuous stack,
+    # mega vs unfused, tracer-measured A2A overlap (replaces the old
+    # ep_a2a_overhead n=1 floor probe — the tracer stamps the real
+    # windows inside the serving megakernel).
+    ("moe_serve", [sys.executable, "perf/moe_serve_bench.py"], 1200),
     # Straggler-reaction proof: realized adaptive order vs ring order
     # under virtualized arrival skew (VERDICT task 7).
     ("adaptive_order", [sys.executable,

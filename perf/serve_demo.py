@@ -116,7 +116,17 @@ def _fleet_demo(args) -> int:
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--model", default="Qwen/Qwen3-0.6B")
+    p.add_argument("--model", default="Qwen/Qwen3-0.6B",
+                   help="model preset, checkpoint dir, 'stub', or "
+                   "'moe' (tiny-moe Qwen3MoE preset; size with "
+                   "--num-experts/--top-k — docs/serving.md "
+                   "'MoE serving')")
+    p.add_argument("--num-experts", type=int, default=0,
+                   help="override the MoE preset's routed expert count")
+    p.add_argument("--top-k", type=int, default=0,
+                   help="override the MoE preset's experts-per-token")
+    p.add_argument("--moe-intermediate", type=int, default=0,
+                   help="override the MoE preset's per-expert FFN width")
     p.add_argument("--full", action="store_true",
                    help="full depth (default: num_layers=8, vocab 32768)")
     p.add_argument("--mode", default="mega",
@@ -187,11 +197,20 @@ def main(argv=None) -> int:
 
     t0 = time.time()
     ctx = initialize_distributed(tp=1, devices=jax.devices()[:1])
-    overrides = {} if args.full else {
-        "num_layers": 8, "vocab_size": 32768,
-    }
+    # --model moe: the Qwen3MoE alias — resolved by the ONE helper
+    # run_server's main uses (the tiny-moe preset is already tiny, so
+    # the --full shrink overrides don't apply to it).
+    from triton_distributed_tpu.serving.run_server import (
+        resolve_model_args,
+    )
+
+    model_name, overrides = resolve_model_args(
+        args.model, args.num_experts, args.top_k, args.moe_intermediate
+    )
+    if args.model != "moe" and not args.full:
+        overrides.update({"num_layers": 8, "vocab_size": 32768})
     model = AutoLLM.from_pretrained(
-        args.model, ctx=ctx, max_length=1024, **overrides
+        model_name, ctx=ctx, max_length=1024, **overrides
     )
     jax.block_until_ready(model.params)
     mode = args.mode if not (args.cpu and args.mode == "mega") else "xla"

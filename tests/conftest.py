@@ -136,7 +136,8 @@ _FILE_ORDER = [
     "test_layers.py", "test_native.py", "test_obs.py", "test_router.py",
     "test_fleet.py", "test_migration.py",
     "test_attention.py", "test_p2p.py", "test_kv_quant.py",
-    "test_speculative.py", "test_kernel_trace.py", "test_megakernel.py",
+    "test_speculative.py", "test_kernel_trace.py",
+    "test_moe_serving.py", "test_megakernel.py",
     "test_tpu_lowering.py",
     "test_prefix_cache.py", "test_faults.py", "test_serving.py",
     "test_model.py", "test_collectives.py", "test_sp_attention.py",

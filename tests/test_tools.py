@@ -402,6 +402,26 @@ def test_tier1_marker_audit():
         f"slot-migration suite has too few tier-1-runnable tests: "
         f"{mig_fast}"
     )
+    # ISSUE-11: the MoE serving suite sits with the mega-family suites
+    # (after the tracer suite, before the interpret-heavy tail) and
+    # must carry tier-1-runnable tests — the MoE fast path has to FAIL
+    # tier-1 when broken, not wait for the post-tail test_moe.py.
+    assert "test_moe_serving.py" in order
+    assert (order.index("test_kernel_trace.py")
+            < order.index("test_moe_serving.py")
+            < order.index("test_serving.py"))
+    moe_src = open(
+        os.path.join(tests_dir, "test_moe_serving.py")
+    ).read()
+    moe_fast = [
+        n.name for n in ast.walk(ast.parse(moe_src))
+        if isinstance(n, ast.FunctionDef) and n.name.startswith("test_")
+        and not any("slow" in ast.dump(d) for d in n.decorator_list)
+    ]
+    assert len(moe_fast) >= 5, (
+        f"MoE serving suite has too few tier-1-runnable tests: "
+        f"{moe_fast}"
+    )
     # And it contains non-slow tests, so tier-1 (which skips `slow`)
     # actually exercises the tracer.
     src = open(os.path.join(tests_dir, "test_kernel_trace.py")).read()
@@ -547,6 +567,36 @@ def test_mega_serve_modules_compile():
     )
     assert proc.returncode == 0, (
         f"mega-serve modules failed to compile:\n"
+        f"{proc.stdout}\n{proc.stderr}"
+    )
+
+
+def test_moe_serving_modules_compile():
+    """ISSUE-11: the MoE serving fast path must byte-compile — the
+    routed-expert model/layer/ops stack, the megakernel's MoE task
+    modules, and the CPU-runnable bench that writes
+    perf/MOE_SERVE.json (repo convention: perf harnesses fail tier-1,
+    not a relay window)."""
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    targets = [
+        os.path.join(root, "triton_distributed_tpu", "models",
+                     "qwen_moe.py"),
+        os.path.join(root, "triton_distributed_tpu", "layers",
+                     "tp_moe.py"),
+        os.path.join(root, "triton_distributed_tpu", "ops", "moe"),
+        os.path.join(root, "triton_distributed_tpu", "megakernel"),
+        os.path.join(root, "perf", "moe_serve_bench.py"),
+    ]
+    proc = subprocess.run(
+        [sys.executable, "-m", "compileall", "-q", "-f", *targets],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, (
+        f"MoE serving modules failed to compile:\n"
         f"{proc.stdout}\n{proc.stderr}"
     )
 

@@ -155,6 +155,26 @@ class MegaDims:
     # scratch, and traced program are bit-identical to the untraced
     # build — the tracer costs literally nothing when disabled.
     trace: bool = False
+    # MoE decode (docs/megakernel.md "MoE serving"): num_experts > 0
+    # swaps the dense FC1/FC2 pair for MOE_GATE + one MOE_FFN task per
+    # LOCAL expert + the split-phase A2A combine. The w1/w2 operands
+    # become EP-sharded per-expert stacks [L, E_loc, d, 2f] / [L,
+    # E_loc, f, d] (full FFN width — ``f_loc`` is then the FULL
+    # moe_intermediate_size), a replicated router weight [L, d, E]
+    # rides as an extra VMEM operand, and the combine workspace gains a
+    # phase-0 buffer so two exchanges can be in flight per layer.
+    num_experts: int = 0
+    moe_top_k: int = 0
+    norm_topk: bool = True
+
+    @property
+    def moe(self) -> bool:
+        return self.num_experts > 0
+
+    @property
+    def experts_loc(self) -> int:
+        """Local experts per rank (EP shard of the expert axis)."""
+        return self.num_experts // self.n_ranks if self.moe else 0
 
     @property
     def qkv_loc(self) -> int:
@@ -387,6 +407,21 @@ class KernelCtx:
         # trace-ring output and the logical-clock SMEM counter.
         self.trace_out: Any = None
         self.clk: Any = None
+        # MoE refs (None unless dims.moe): the replicated router weight
+        # [L, d, E], the per-(expert, token) combine weights the gate
+        # writes ([E, 1, B] f32 — expert-leading so MOE_FFN's traced
+        # expert id indexes an untiled dim, the norm-weight trick), the
+        # combine accumulator [B, d] f32, and — under overlap_ar — the
+        # phase-0 exchange workspace (a2src/a2buf) with its own DMA
+        # semaphores (phase 1 reuses the AR workspace, whose slots the
+        # layer's attention allreduce has already quiesced).
+        self.wrouter: Any = None
+        self.moe_w: Any = None
+        self.moe_acc: Any = None
+        self.a2src: Any = None
+        self.a2buf: Any = None
+        self.a2send: Any = None
+        self.a2recv: Any = None
 
 
 def make_mega_kernel(
@@ -420,6 +455,10 @@ def make_mega_kernel(
             ln1, ln2, normf, qn, kn,                       # VMEM (small)
             *rest,
         ) = rest
+        if dims.moe:  # replicated router weight, after the norms
+            wrouter, *rest = rest
+        else:
+            wrouter = None
         if cfg.wq8:  # per-output-channel dequant scales, after norms
             sc_qkv, sc_o, sc_w1, sc_w2, sc_lm, *rest = rest
         else:
@@ -446,6 +485,19 @@ def make_mega_kernel(
             clk = rest.pop()
         else:
             trace_out = clk = None
+        moe_w = moe_acc = a2src = a2buf = a2send = a2recv = None
+        if dims.moe:
+            # MoE scratch rides after the canonical block (before the
+            # trace clock, already popped): combine weights, combine
+            # accumulator, and — under overlap_ar — the phase-0
+            # exchange workspace + semaphores.
+            if cfg.overlap_ar:
+                a2recv = rest.pop()
+                a2send = rest.pop()
+                a2buf = rest.pop()
+                a2src = rest.pop()
+            moe_acc = rest.pop()
+            moe_w = rest.pop()
         (
             logits, knew_out, vnew_out, toks_out,          # outputs
             x, h, qkv, ao, mlp, estage,                    # VMEM state
@@ -486,6 +538,10 @@ def make_mega_kernel(
         kctx.arsend, kctx.arrecv = arsend, arrecv
         kctx.tsem = tsem
         kctx.trace_out, kctx.clk = trace_out, clk
+        kctx.wrouter = wrouter
+        kctx.moe_w, kctx.moe_acc = moe_w, moe_acc
+        kctx.a2src, kctx.a2buf = a2src, a2buf
+        kctx.a2send, kctx.a2recv = a2send, a2recv
 
         ttype = task_tab[t, 0]
         kctx.layer = task_tab[t, 1]
@@ -531,15 +587,19 @@ def make_mega_kernel(
                 fire_next_tile0,
             )
 
-            if TaskType.AR_WAIT in used_types:
-                # An AR_WAIT task already fired its successor's tile-0
-                # copy BEFORE blocking on the allreduce partials (that
-                # early start is the whole overlap); firing it again
-                # here would double-start the same DMA descriptor and
-                # corrupt the semaphore accounting.
-                pl.when(ttype != int(TaskType.AR_WAIT))(
-                    lambda: fire_next_tile0(kctx)
-                )
+            waits = [t for t in (TaskType.AR_WAIT, TaskType.A2A_WAIT)
+                     if t in used_types]
+            if waits:
+                # An AR_WAIT/A2A_WAIT task already fired its
+                # successor's tile-0 copy BEFORE blocking on the
+                # inbound partials (that early start is the whole
+                # overlap); firing it again here would double-start the
+                # same DMA descriptor and corrupt the semaphore
+                # accounting.
+                not_wait = ttype != int(waits[0])
+                for w in waits[1:]:
+                    not_wait = jnp.logical_and(not_wait, ttype != int(w))
+                pl.when(not_wait)(lambda: fire_next_tile0(kctx))
             else:
                 fire_next_tile0(kctx)
 
@@ -592,6 +652,9 @@ def build_mega_call(
         grid=(dims.nsteps, len(tasks)),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 6
         + [pl.BlockSpec(memory_space=pltpu.VMEM)] * 5
+        # MoE router weight [L, d, E]: VMEM-resident like the norms —
+        # MOE_GATE reads the traced layer's [d, E] plane per step.
+        + ([pl.BlockSpec(memory_space=pltpu.VMEM)] if dims.moe else [])
         # wq8 dequant scales (~2 MB total at 0.6B): VMEM-resident like
         # the norm weights they sit next to.
         + ([pl.BlockSpec(memory_space=pltpu.VMEM)] * 5 if cfg.wq8 else [])
@@ -669,6 +732,21 @@ def build_mega_call(
             pltpu.SemaphoreType.DMA((n,)),                     # arrecv
             pltpu.SemaphoreType.DMA,                           # tsem
         ] + (
+            # MoE scratch: combine weights ([E, 1, B] f32,
+            # expert-leading for traced-index scalar reads) + combine
+            # accumulator, and — under overlap_ar — the phase-0
+            # exchange workspace (phase 1 reuses arsrc/cbuf).
+            [
+                pltpu.VMEM((dims.num_experts, 1, max(B, 1)), jnp.float32),
+                pltpu.VMEM((B, d), jnp.float32),               # moe_acc
+            ] + ([
+                pltpu.VMEM((B, d), jnp.float32),               # a2src
+                pltpu.VMEM((n, B, d), jnp.float32),            # a2buf
+                pltpu.SemaphoreType.DMA,                       # a2send
+                pltpu.SemaphoreType.DMA((n,)),                 # a2recv
+            ] if cfg.overlap_ar else [])
+            if dims.moe else []
+        ) + (
             # Logical trace clock (SMEM counter; see kernels.trace_tick).
             [pltpu.SMEM((1,), jnp.int32)] if dims.trace else []
         )),
@@ -684,6 +762,9 @@ def build_mega_call(
     itw = jnp.dtype(wdtype).itemsize
     in_vmem = itw * (2 * dims.num_layers * d + d
                      + 2 * dims.num_layers * dims.head_dim)
+    if dims.moe:
+        # Replicated router weight [L, d, E], VMEM-resident.
+        in_vmem += itw * dims.num_layers * d * dims.num_experts
     if cfg.wq8:
         in_vmem += 4 * (dims.num_layers
                         * (dims.qkv_loc + 2 * d + 2 * dims.f_loc)
@@ -702,8 +783,15 @@ def build_mega_call(
     # its megakernel): decode is one pass over every weight shard plus
     # the KV context; flops ≈ 2·B·(weight params) per matmul chain.
     L = dims.num_layers
+    # MLP weight traffic: dense streams the f_loc shard; MoE streams
+    # every LOCAL expert's full-width FFN (plus the replicated router).
+    mlp_w = (
+        dims.experts_loc * 3 * dims.d * dims.f_loc
+        + dims.d * dims.num_experts
+        if dims.moe else 3 * dims.d * dims.f_loc
+    )
     wparams = L * (
-        dims.d * dims.qkv_loc + dims.o_k * dims.d + 3 * dims.d * dims.f_loc
+        dims.d * dims.qkv_loc + dims.o_k * dims.d + mlp_w
     ) + dims.d * dims.v_loc
     kv_elems = 2 * L * B * hkv * dims.s_max * hd
     ns = dims.nsteps
@@ -782,6 +870,24 @@ def build_mega_call(
     if dims.kv_quant and not dims.page:
         raise ValueError("kv_quant requires the paged cache (scales "
                          "live on pool pages)")
+    if dims.moe:
+        if cfg.wq8:
+            raise NotImplementedError(
+                "wq8 does not compose with MoE decode yet (per-expert "
+                "per-channel scale planes)"
+            )
+        if dims.prefill:
+            raise NotImplementedError(
+                "MoE prefill runs through the model path "
+                "(Engine._prefill_mode is 'xla' under mode='mega')"
+            )
+        if dims.num_experts % dims.n_ranks:
+            raise ValueError(
+                f"num_experts {dims.num_experts} not divisible by "
+                f"tp={dims.n_ranks} (EP shards the expert axis)"
+            )
+        if not dims.moe_top_k:
+            raise ValueError("MoE dims need moe_top_k > 0")
     # ``wargs`` = the kernel-args block (weights + norms [+ wq8
     # scales]) followed by the cache operands (kc, vc[, ksc, vsc]) —
     # variadic so the wq8/kv_quant paths' extra scale operands flow

@@ -135,20 +135,30 @@ def row_tile_copy(stage, sem, w_hbm, row0, tk, d, slot):
 # same tile width, k == dims.d, col0/row0 == 0 — the streams assert
 # those invariants when consuming the prefetch flag). The cross_prefetch
 # block in ``code_generator.py`` builds its dispatch from this table.
+# Entries take ``(nl, na0)`` — the NEXT task's layer id and arg0 (the
+# local expert id for MOE_FFN; ignored by the dense entries). MoE
+# builds swap the dense FC1/FC2 entries for MOE_FFN: their w1/w2
+# operands are per-expert stacks there, and a dense-shaped descriptor
+# would not even trace.
 def stream_tile0_table(kctx):
     d = kctx.dims.d
     cfg = kctx.cfg
     col, row = [], []
-    col.append((TaskType.QKV_PROJ, lambda nl: col_tile_copy(
+    col.append((TaskType.QKV_PROJ, lambda nl, na0: col_tile_copy(
         kctx.colstage, kctx.wsem, kctx.wqkv.at[nl], d, 0, cfg.tn_qkv, 0)))
-    col.append((TaskType.FC1, lambda nl: col_tile_copy(
-        kctx.colstage, kctx.wsem, kctx.w1.at[nl], d, 0, cfg.tn_fc1, 0)))
-    col.append((TaskType.LM_HEAD, lambda nl: col_tile_copy(
+    if kctx.dims.moe:
+        col.append((TaskType.MOE_FFN, lambda nl, na0: col_tile_copy(
+            kctx.colstage, kctx.wsem, kctx.w1.at[nl, na0], d, 0,
+            cfg.tn_fc1, 0)))
+    else:
+        col.append((TaskType.FC1, lambda nl, na0: col_tile_copy(
+            kctx.colstage, kctx.wsem, kctx.w1.at[nl], d, 0, cfg.tn_fc1, 0)))
+        row.append((TaskType.FC2, lambda nl, na0: row_tile_copy(
+            kctx.rowstage, kctx.wsem, kctx.w2.at[nl], 0, cfg.tk_fc2, d, 0)))
+    col.append((TaskType.LM_HEAD, lambda nl, na0: col_tile_copy(
         kctx.colstage, kctx.wsem, kctx.lm_head, d, 0, cfg.tn_lm, 0)))
-    row.append((TaskType.O_PROJ, lambda nl: row_tile_copy(
+    row.append((TaskType.O_PROJ, lambda nl, na0: row_tile_copy(
         kctx.rowstage, kctx.wsem, kctx.wo.at[nl], 0, cfg.tk_o, d, 0)))
-    row.append((TaskType.FC2, lambda nl: row_tile_copy(
-        kctx.rowstage, kctx.wsem, kctx.w2.at[nl], 0, cfg.tk_fc2, d, 0)))
     return col, row
 
 
@@ -156,8 +166,8 @@ def fire_next_tile0(kctx):
     """Start the NEXT task's first weight-tile DMA and set the
     cross_prefetch handshake flag — THE one implementation of the
     prefetch fire, shared by the generated per-task epilogue
-    (``code_generator.py``) and the AR_WAIT body (which fires it BEFORE
-    blocking on the inbound allreduce partials, so the ICI hop hides
+    (``code_generator.py``) and the AR_WAIT/A2A_WAIT bodies (which fire
+    it BEFORE blocking on the inbound partials, so the ICI hop hides
     under the next weight stream's tile-0 HBM traffic). Both sites must
     byte-match the stream's own ``copy(0)``; sharing the fire keeps
     that a structural guarantee."""
@@ -168,17 +178,18 @@ def fire_next_tile0(kctx):
     def _fire():
         nt = kctx.task_tab[t + 1, 0]
         nl = kctx.task_tab[t + 1, 1]
+        na0 = kctx.task_tab[t + 1, 2]
         col_tab, row_tab = stream_tile0_table(kctx)
 
         for tt, make in col_tab:
             def fire(make=make):
-                make(nl).start()
+                make(nl, na0).start()
                 kctx.pre_col[0] = 1
 
             pl.when(nt == int(tt))(fire)
         for tt, make in row_tab:
             def fire(make=make):
-                make(nl).start()
+                make(nl, na0).start()
                 kctx.pre_row[0] = 1
 
             pl.when(nt == int(tt))(fire)
@@ -294,9 +305,11 @@ def _stream_cols(kctx, x_f32, w_hbm, n: int, tn: int, consume,
 
 
 def _stream_rows(kctx, x_ref, w_hbm, out_ref, n: int, tk: int,
-                 scale_row=None):
+                 scale_row=None, col_scale=None, accumulate=False):
     """Row-streamed GEMM with accumulation: ``out += x [B, K] @ w [K, d]``
-    streaming K tiles (o-proj / fc2 shape class). Overwrites ``out_ref``.
+    streaming K tiles (o-proj / fc2 shape class). Overwrites ``out_ref``
+    unless ``accumulate`` (the MoE expert loop folds every expert's
+    weighted output into the same combine accumulator).
 
     ``x_ref`` must be a (VMEM) ref: the K tile is sliced per step with a
     dynamic ``pl.ds`` on the ref — Mosaic has no lowering for
@@ -305,6 +318,11 @@ def _stream_rows(kctx, x_ref, w_hbm, out_ref, n: int, tk: int,
     ``scale_row`` (wq8): a ``[1, d]`` f32 per-output-channel dequant
     row applied to every tile product — per-column constants distribute
     over the K-tile sum, so per-tile application is exact.
+
+    ``col_scale``: a ``[B, 1]`` f32 per-BATCH-row scale (the MoE
+    combine weight: gate probability of this expert per token, 0 for
+    unrouted tokens) — per-row constants likewise distribute over the
+    K-tile sum.
     """
     stage, sem = kctx.rowstage, kctx.wsem
     depth = stage.shape[0]
@@ -330,7 +348,8 @@ def _stream_rows(kctx, x_ref, w_hbm, out_ref, n: int, tk: int,
             pl.when(pre == 0)(lambda: copy(0, 0).start())
         else:
             copy(j, j % depth).start()
-    out_ref[...] = jnp.zeros_like(out_ref)
+    if not accumulate:
+        out_ref[...] = jnp.zeros_like(out_ref)
 
     def body(j, carry):
         slot = jax.lax.rem(j, depth)
@@ -351,6 +370,8 @@ def _stream_rows(kctx, x_ref, w_hbm, out_ref, n: int, tk: int,
         )
         if scale_row is not None:
             val = val * scale_row
+        if col_scale is not None:
+            val = val * col_scale
         out_ref[...] = out_ref[...] + val
         return carry
 
@@ -404,6 +425,45 @@ def _ar_wait_recvs(kctx):
         src = jax.lax.rem(me + p, nr)
         pltpu.make_async_copy(
             kctx.cbuf.at[src], kctx.arsrc, kctx.arrecv.at[src]
+        ).wait()
+
+
+def _a2a_put_dmas(kctx):
+    """Phase-0 analog of :func:`_ar_put_dmas` over the dedicated MoE
+    combine workspace (``a2src``/``a2buf``/``a2send``/``a2recv``): a
+    separate buffer pair because phase 0's puts are still in flight
+    while the second half of the expert GEMMs overwrites the combine
+    accumulator — phase 1 then reuses the standard AR workspace, which
+    the layer's attention allreduce has already quiesced. Same
+    descriptor-sharing contract as ``_ar_put_dmas`` (A2A_SEND starts
+    these, A2A_WAIT send-waits byte-matched reconstructions)."""
+    axis = kctx.axis
+    nr = kctx.dims.n_ranks
+    me = jax.lax.axis_index(axis)
+
+    def put(p):
+        dst = jax.lax.rem(me + p, nr)
+        return pltpu.make_async_remote_copy(
+            src_ref=kctx.a2src,
+            dst_ref=kctx.a2buf.at[me],
+            send_sem=kctx.a2send,
+            recv_sem=kctx.a2recv.at[me],
+            device_id={axis: dst},
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+
+    return [put(p) for p in range(1, nr)]
+
+
+def _a2a_wait_recvs(kctx):
+    """Wait every peer's inbound phase-0 combine partial (the receive
+    half of :func:`_a2a_put_dmas`)."""
+    nr = kctx.dims.n_ranks
+    me = jax.lax.axis_index(kctx.axis)
+    for p in range(1, nr):
+        src = jax.lax.rem(me + p, nr)
+        pltpu.make_async_copy(
+            kctx.a2buf.at[src], kctx.a2src, kctx.a2recv.at[src]
         ).wait()
 
 
@@ -1052,6 +1112,199 @@ def ar_wait_body(kctx):
         for r in range(nr):
             acc = acc + kctx.cbuf[r]
         kctx.x[...] = acc
+        for dma in _ar_put_dmas(kctx):
+            dma.wait_send()
+        _barrier(kctx)
+
+    return body
+
+
+@register_task(TaskType.MOE_GATE)
+def moe_gate_body(kctx):
+    """MoE router (parity: ``ops/moe/routing.py::router_topk`` —
+    softmax over all experts, top-k, optional renormalization): writes
+    the per-(expert, token) combine weights to the ``moe_w`` scratch
+    and zeroes the combine accumulator the MOE_FFN tasks fold into.
+
+    All math runs in the ``[E, B]`` orientation (experts on the
+    sublane axis): the gate needs per-token reductions over experts,
+    and this layout gets them as axis-0 reductions without a transpose
+    Mosaic would have to relayout. Top-k is the iterative
+    max-and-retire loop (k is tiny and static); ties resolve to the
+    lowest expert index, matching ``jax.lax.top_k``."""
+
+    def body():
+        dims = kctx.dims
+        B, E, k = dims.batch, dims.num_experts, dims.moe_top_k
+        h_in = _normed_input(kctx, 1)  # [B, d] f32
+        if kctx.cfg.fuse_norms:
+            # MOE_FFN tasks read the normed input from h (under
+            # fuse_norms nothing else wrote it); without fuse_norms the
+            # NORM task already put it there.
+            kctx.h[...] = h_in
+        wr = kctx.wrouter[kctx.layer].astype(jnp.float32)  # [d, E]
+        logits = jax.lax.dot_general(
+            wr, h_in, (((0,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [E, B]
+        m = jnp.max(logits, axis=0, keepdims=True)
+        p = jnp.exp(logits - m)
+        p = p / jnp.sum(p, axis=0, keepdims=True)  # softmax over experts
+
+        eidx = jax.lax.broadcasted_iota(jnp.int32, (E, B), 0)
+        cw = jnp.zeros((E, B), jnp.float32)
+        rem = p
+        for _ in range(k):
+            mv = jnp.max(rem, axis=0, keepdims=True)  # [1, B]
+            sel = jnp.min(
+                jnp.where(rem == mv, eidx, jnp.int32(1 << 30)),
+                axis=0, keepdims=True,
+            )
+            onehot = eidx == sel
+            cw = cw + jnp.where(onehot, rem, 0.0)
+            rem = jnp.where(onehot, jnp.float32(-1.0), rem)
+        if dims.norm_topk:
+            cw = cw / jnp.sum(cw, axis=0, keepdims=True)
+        # Row-wise writes into the [E, 1, B] scratch (static unroll —
+        # a [E, B] → [E, 1, B] reshape would be a Mosaic relayout).
+        for e in range(E):
+            kctx.moe_w[e, 0:1, :] = cw[e:e + 1, :]
+        kctx.moe_acc[...] = jnp.zeros_like(kctx.moe_acc)
+
+    return body
+
+
+@register_task(TaskType.MOE_FFN)
+def moe_ffn_body(kctx):
+    """One LOCAL expert's SwiGLU FFN over every token, weighted into
+    the combine accumulator (parity: the expert-segment grouped GEMMs
+    of ``moe_reduce_rs.py``/``allgather_group_gemm.py``, one expert per
+    task so the tracer sees per-expert windows and the split-phase A2A
+    can fire mid-FFN). Experts are EP-sharded: ``arg0`` is the local
+    expert id; the combine weight for token b is
+    ``moe_w[rank·E_loc + arg0, b]`` — zero for unrouted tokens, whose
+    rows then contribute nothing (decode batches are tiny, so dense
+    per-expert compute costs the same HBM bytes as a ragged dispatch
+    and keeps the weight streams statically shaped)."""
+
+    def body():
+        dims = kctx.dims
+        B, f = dims.batch, dims.f_loc  # f = FULL expert width under EP
+        tn = kctx.cfg.tn_fc1
+        n = f // tn
+        tk = kctx.cfg.tk_fc2
+        n2 = f // tk
+        e_loc = kctx.arg0
+        layer = kctx.layer
+        ge = jax.lax.axis_index(kctx.axis) * dims.experts_loc + e_loc
+        # [B, 1] combine-weight column from scalar reads of the
+        # expert-leading moe_w scratch (ge is traced on the untiled
+        # leading dim — the ksc/vsc scalar-read pattern).
+        cw_col = jnp.concatenate(
+            [
+                jnp.full((1, 1), kctx.moe_w[ge, 0, b], jnp.float32)
+                for b in range(B)
+            ],
+            axis=0,
+        )
+        h_in = kctx.h[...]  # normed input (MOE_GATE/NORM wrote it)
+
+        # FC1: one continuous column stream over the expert's fused
+        # [d, gate|up] plane (the dense fc1_body pattern, per expert).
+        def sink(j, val):
+            @pl.when(j < n)
+            def _gate():
+                kctx.mlp[:, pl.ds(j * tn, tn)] = val * jax.lax.logistic(val)
+
+            @pl.when(j >= n)
+            def _up():
+                sl = pl.ds((j - n) * tn, tn)
+                kctx.mlp[:, sl] = kctx.mlp[:, sl] * val
+
+        _stream_cols(kctx, h_in, kctx.w1.at[layer, e_loc], 2 * n, tn, sink)
+        # FC2: row stream of the expert's [f, d] down projection,
+        # folded into the combine accumulator under the per-token gate
+        # weight (per-row constants distribute over the K-tile sum).
+        _stream_rows(
+            kctx, kctx.mlp, kctx.w2.at[layer, e_loc], kctx.moe_acc,
+            n2, tk, col_scale=cw_col, accumulate=True,
+        )
+
+        @pl.when(kctx.arg1 == 1)
+        def _handoff():
+            # Non-overlap path: the LAST local expert hands the combine
+            # partial to the fused ALLREDUCE task, which reads h.
+            kctx.h[...] = kctx.moe_acc[...]
+
+    return body
+
+
+@register_task(TaskType.A2A_SEND)
+def a2a_send_body(kctx):
+    """EP combine send (split-phase sibling of AR_SEND,
+    docs/megakernel.md "MoE serving"): push this rank's combine partial
+    — the weighted sum of its OWN experts' outputs — to every peer.
+    ``arg0`` is the phase: phase 0 fires the moment the first half of
+    the local expert GEMMs has landed, so its ICI bytes fly under the
+    SECOND half's expert grouped GEMMs (the accumulator restarts at
+    zero for them); phase 1 carries the rest and reuses the standard
+    AR workspace, whose slots the layer's attention allreduce already
+    quiesced. Dispatch needs no wire bytes on TPU decode: activations
+    and router are replicated, so every rank already holds every
+    token — the reference pays ``kernel_dispatch_token`` because its
+    tokens live on their home ranks."""
+
+    def body():
+        me = jax.lax.axis_index(kctx.axis)
+        payload = kctx.moe_acc[...]
+
+        @pl.when(kctx.arg0 == 0)
+        def _phase0():
+            kctx.a2src[...] = payload
+            kctx.a2buf[me] = payload
+            for dma in _a2a_put_dmas(kctx):
+                dma.start()
+            # Fresh partial for the second half of the experts while
+            # phase 0's bytes are in flight.
+            kctx.moe_acc[...] = jnp.zeros_like(payload)
+
+        @pl.when(kctx.arg0 == 1)
+        def _phase1():
+            kctx.arsrc[...] = payload
+            kctx.cbuf[me] = payload
+            for dma in _ar_put_dmas(kctx):
+                dma.start()
+
+        # Tracer phase mark: this phase's puts are in flight — the comm
+        # window the decoder's A2A overlap measure opens here.
+        trace_mid(kctx)
+
+    return body
+
+
+@register_task(TaskType.A2A_WAIT)
+def a2a_wait_body(kctx):
+    """EP combine wait (split-phase sibling of AR_WAIT): fire the NEXT
+    weight stream's tile-0 DMA (the overlap lever — the combine's ICI
+    hop hides under that HBM traffic), then wait both phases' inbound
+    partials, fold ``x += Σ_ranks (phase0 + phase1)``, drain the sends,
+    and barrier so both workspaces are reusable."""
+
+    def body():
+        nr = kctx.dims.n_ranks
+        if kctx.cfg.cross_prefetch:
+            fire_next_tile0(kctx)
+        # Tracer phase mark: tile-0 is issued; [mid, end] is the
+        # blocked wait + fold the overlap exists to shrink.
+        trace_mid(kctx)
+        _a2a_wait_recvs(kctx)
+        _ar_wait_recvs(kctx)
+        acc = kctx.x[...]
+        for r in range(nr):
+            acc = acc + kctx.a2buf[r] + kctx.cbuf[r]
+        kctx.x[...] = acc
+        for dma in _a2a_put_dmas(kctx):
+            dma.wait_send()
         for dma in _ar_put_dmas(kctx):
             dma.wait_send()
         _barrier(kctx)

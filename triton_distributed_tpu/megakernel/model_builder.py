@@ -130,6 +130,26 @@ class ModelBuilder:
             return self._add(TaskType.AR_WAIT, layer)
         return self._add(TaskType.ALLREDUCE, layer, **kw)
 
+    def make_moe_gate(self, layer: int, **kw) -> int:
+        return self._add(TaskType.MOE_GATE, layer, **kw)
+
+    def make_moe_ffn(self, layer: int, expert: int,
+                     handoff: bool = False) -> int:
+        """One LOCAL expert's FFN task (``arg0`` = local expert id).
+        ``handoff`` marks the last expert of the NON-overlap path: its
+        epilogue copies the combine accumulator into ``h`` so the fused
+        ALLREDUCE task (which reads ``h``) carries the MoE combine."""
+        tid = self._add(TaskType.MOE_FFN, layer, arg0=expert)
+        if handoff:
+            self.tasks[-1].arg1 = 1
+        return tid
+
+    def make_a2a_send(self, layer: int, phase: int) -> int:
+        return self._add(TaskType.A2A_SEND, layer, arg0=phase)
+
+    def make_a2a_wait(self, layer: int) -> int:
+        return self._add(TaskType.A2A_WAIT, layer)
+
     def make_lm_head(self, **kw) -> int:
         return self._add(TaskType.LM_HEAD, **kw)
 
@@ -143,8 +163,14 @@ class ModelBuilder:
         return self._add(TaskType.BARRIER, **kw)
 
     def build_decoder_graph(self) -> None:
-        """The standard dense decode-step chain (parity:
-        ``models/qwen3.py:108`` build_fwd)."""
+        """The standard decode-step chain (parity:
+        ``models/qwen3.py:108`` build_fwd). With ``dims.moe`` the MLP
+        section becomes router → per-local-expert grouped GEMMs → EP
+        combine; under ``cfg.overlap_ar`` the combine splits into the
+        A2A_SEND/A2A_WAIT pair with phase 0 fired MID-FFN, so its ICI
+        bytes fly under the second half of the expert GEMMs and the
+        final wait blocks only after the next weight stream's tile-0
+        DMA is in flight (docs/megakernel.md "MoE serving")."""
         if self.dims.n_ranks > 1:
             # Entry barrier: the first ALLREDUCE issues remote puts into
             # peers' VMEM scratch; without this, launch skew could land a
@@ -160,11 +186,35 @@ class ModelBuilder:
             self.make_o_proj(l)
             self.make_allreduce(l)
             self.make_norm(l, 1)
-            self.make_fc1(l)
-            self.make_fc2(l)
-            self.make_allreduce(l)
+            if self.dims.moe:
+                self._build_moe_mlp(l)
+            else:
+                self.make_fc1(l)
+                self.make_fc2(l)
+                self.make_allreduce(l)
         self.make_norm(0, 2)
         self.make_lm_head()
+
+    def _build_moe_mlp(self, l: int) -> None:
+        """The MoE MLP section of one layer: MOE_GATE, the local expert
+        GEMM tasks, and the combine — split-phase A2A under
+        ``overlap_ar`` (phase 0 after the first half of the experts,
+        phase 1 + wait after the rest), the fused ALLREDUCE otherwise
+        (the last expert's ``handoff`` hands it the accumulator)."""
+        self.make_moe_gate(l)
+        epr = self.dims.experts_loc
+        overlap = self.cfg.overlap_ar
+        split = max(-(-epr // 2), 1)  # ceil — phase 0 covers this many
+        for e in range(epr):
+            last = e == epr - 1
+            self.make_moe_ffn(l, e, handoff=last and not overlap)
+            if overlap and e == split - 1:
+                self.make_a2a_send(l, phase=0)
+        if overlap:
+            self.make_a2a_send(l, phase=1)
+            self.make_a2a_wait(l)
+        else:
+            self.make_allreduce(l)
 
     def build_prefill_graph(self) -> None:
         """The prompt-prefill chain (parity: the reference's prefill

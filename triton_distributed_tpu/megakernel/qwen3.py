@@ -13,6 +13,7 @@ and sharding, so the megakernel is a drop-in third decode mode next to
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -96,6 +97,84 @@ def _quantize_shard(params: Qwen3Params) -> Q8Params:
     )
 
 
+@dataclasses.dataclass
+class MoEMegaParams:
+    """EP-resharded megakernel parameters for Qwen3MoE decode.
+
+    The serving model keeps the TP expert sharding (every rank holds
+    its ``f_loc`` column shard of EVERY expert — ``layers/tp_moe.py``),
+    which is what the unfused decode path runs. The megernel's MoE
+    graph instead streams whole experts (one MOE_FFN task per LOCAL
+    expert, full FFN width) so the combine exchange carries true
+    per-expert-owner partials — EXPERT-parallel sharding. This pytree
+    is that resharding, built once from ``model.params`` device-side
+    (the Q8Params pattern): w1/w2 gather their f shards and keep only
+    this rank's ``E/n`` experts — per-rank HBM bytes are unchanged
+    (E·3df/n either way) — and the router stays replicated.
+    """
+
+    embed: jax.Array    # [V, d] replicated
+    wqkv: jax.Array     # [L, d, qkv_loc]
+    wo: jax.Array       # [L, o_k, d]
+    w1: jax.Array       # [L, E_loc, d, 2f] — gate|up fused, FULL width
+    w2: jax.Array       # [L, E_loc, f, d]
+    wrouter: jax.Array  # [L, d, E] replicated
+    lm_head: jax.Array  # [d, v_loc]
+    ln1: jax.Array
+    ln2: jax.Array
+    norm: jax.Array
+    qn: jax.Array
+    kn: jax.Array
+
+
+register_param_dataclass(MoEMegaParams, [
+    "embed", "wqkv", "wo", "w1", "w2", "wrouter", "lm_head",
+    "ln1", "ln2", "norm", "qn", "kn",
+])
+
+
+def _moe_reshard_shard(params: Qwen3Params, *, axis: str, n: int):
+    """Per-shard TP→EP expert resharding (runs inside shard_map,
+    jitted once): an expert↔f-shard ALL-TO-ALL — rank r sends its f
+    columns of expert group g to rank g and receives every rank's f
+    columns of ITS group — then restore the gate-contiguous [d, 2f]
+    fused layout. All-to-all (not gather-then-slice) keeps peak memory
+    at the FINAL size: a full [L, E, d, 2f] gather would transiently
+    hold n× each rank's steady-state MLP bytes, which at production
+    expert counts is exactly the HBM a 1/n-sized shard plan doesn't
+    have."""
+    lp = params.layers
+    mlp = lp.mlp  # TPMoEParams
+    L, E, d, two_f_loc = mlp.w1.shape
+    f_loc = two_f_loc // 2
+    epr = E // n
+    if n > 1:
+        # w1 [L, E, d, 2f_loc] → [L, E/n, d, n·2f_loc], received
+        # f-shards concatenated in source-rank order: [g0|u0|g1|u1|…].
+        w1_ep = jax.lax.all_to_all(
+            mlp.w1, axis, split_axis=1, concat_axis=3, tiled=True
+        )
+        # Reorder to [gate_full | up_full] (shard slices concatenate
+        # back into the original column order).
+        w1_ep = w1_ep.reshape(L, epr, d, n, 2, f_loc)
+        w1_ep = jnp.swapaxes(w1_ep, 3, 4).reshape(
+            L, epr, d, 2 * n * f_loc
+        )
+        # w2 [L, E, f_loc, d] → [L, E/n, f, d] (plain f split: rank
+        # order IS the original row order, no reorder needed).
+        w2_ep = jax.lax.all_to_all(
+            mlp.w2, axis, split_axis=1, concat_axis=2, tiled=True
+        )
+    else:
+        w1_ep, w2_ep = mlp.w1, mlp.w2
+    return MoEMegaParams(
+        embed=params.embed, wqkv=lp.attn.wqkv, wo=lp.attn.wo,
+        w1=w1_ep, w2=w2_ep, wrouter=mlp.w_router,
+        lm_head=params.lm_head, ln1=lp.ln1, ln2=lp.ln2,
+        norm=params.norm, qn=lp.attn.q_norm, kn=lp.attn.k_norm,
+    )
+
+
 class MegaQwen3:
     """Megakernel decode wrapper around a (loaded) :class:`Qwen3`."""
 
@@ -137,13 +216,17 @@ class MegaQwen3:
             v_pad = m.params.lm_head.shape[1]
         else:
             v_pad = pad_vocab(c.vocab_size, n)
+        moe = c.num_experts > 0
         return MegaDims(
             batch=batch,
             d=c.hidden_size,
             hq_loc=m.dims.hq_loc,
             hkv_loc=m.dims.hkv_loc,
             head_dim=c.head_dim,
-            f_loc=c.intermediate_size // n,
+            # MoE streams whole (EP-sharded) experts: f_loc is then the
+            # FULL per-expert FFN width, not a TP column shard.
+            f_loc=(c.moe_intermediate_size if moe
+                   else c.intermediate_size // n),
             v_loc=v_pad // n,
             num_layers=c.num_layers,
             s_max=s_max,
@@ -154,6 +237,9 @@ class MegaQwen3:
             kv_quant=kv_quant,
             num_pages=num_pages,
             trace=trace,
+            num_experts=c.num_experts,
+            moe_top_k=c.num_experts_per_tok,
+            norm_topk=c.norm_topk_prob,
         )
 
     @staticmethod
@@ -196,9 +282,7 @@ class MegaQwen3:
         per_shard = compiled.per_shard
         ax = m.axis
 
-        wq8 = self.cfg.wq8
-        kernel_args = self._kernel_args_q8 if wq8 else self._kernel_args
-        pspecs = self._q8_specs() if wq8 else m.param_specs
+        kernel_args, pspecs = self._args_and_specs()
 
         if page:
             def shard_fn(params: Qwen3Params, tokens, cache: PagedKVCache):
@@ -428,11 +512,83 @@ class MegaQwen3:
             step = self._built(b, int(cache.k.shape[3]))[1]
         return step(self._step_params(), tokens, cache)
 
+    @property
+    def _is_moe(self) -> bool:
+        return self.model.cfg.num_experts > 0
+
+    def _args_and_specs(self):
+        """(kernel_args fn, shard_map param specs) for this model/cfg:
+        Q8Params under ``wq8``, the EP-resharded :class:`MoEMegaParams`
+        for MoE models, the plain model tree otherwise."""
+        if self.cfg.wq8:
+            if self._is_moe:
+                raise NotImplementedError(
+                    "wq8 does not compose with MoE decode yet"
+                )
+            return self._kernel_args_q8, self._q8_specs()
+        if self._is_moe:
+            return self._kernel_args_moe, self._moe_specs()
+        return self._kernel_args, self.model.param_specs
+
+    def _moe_specs(self) -> MoEMegaParams:
+        ax = self.model.axis
+        return MoEMegaParams(
+            embed=P(), wqkv=P(None, None, ax), wo=P(None, ax, None),
+            # EP: the expert axis is the sharded one; each rank's slice
+            # holds its E/n experts at FULL width.
+            w1=P(None, ax, None, None), w2=P(None, ax, None, None),
+            wrouter=P(), lm_head=P(None, ax),
+            ln1=P(), ln2=P(), norm=P(), qn=P(), kn=P(),
+        )
+
+    def moe_params(self) -> MoEMegaParams:
+        """The EP-resharded pytree MoE steps take in place of
+        ``model.params`` (resharded once, device-side, per shard;
+        cached on this instance — the ``quantized_params`` pattern)."""
+        if getattr(self, "_moe_p", None) is None:
+            m = self.model
+            if m.params is None:
+                raise ValueError("load or init the MoE model first")
+            n = m.ctx.axis_size(m.axis)
+            if m.cfg.num_experts % n:
+                raise ValueError(
+                    f"num_experts {m.cfg.num_experts} not divisible by "
+                    f"tp={n} (the megakernel EP-shards the expert axis)"
+                )
+            f = m.ctx.shard_map(
+                functools.partial(_moe_reshard_shard, axis=m.axis, n=n),
+                in_specs=(m.param_specs,),
+                out_specs=self._moe_specs(),
+            )
+            self._moe_p = jax.jit(f)(m.params)
+            jax.block_until_ready(self._moe_p)
+        return self._moe_p
+
+    @staticmethod
+    def _kernel_args_moe(mp: MoEMegaParams):
+        V, d = mp.embed.shape
+        if V % 8:
+            raise ValueError(
+                f"megakernel needs vocab_size % 8 == 0, got {V}"
+            )
+        return (
+            mp.embed.reshape(V // 8, 8, d),
+            mp.wqkv, mp.wo, mp.w1, mp.w2, mp.lm_head,
+            mp.ln1[:, None, :], mp.ln2[:, None, :], mp.norm[None, :],
+            mp.qn[:, None, :], mp.kn[:, None, :],
+            # Router weight rides after the norms ([L, d, E] — leading
+            # L untiled so the gate can index the traced layer).
+            mp.wrouter,
+        )
+
     def _step_params(self):
         """What the built steps take as their first argument: the int8
-        pytree under ``wq8``, the model's params otherwise."""
+        pytree under ``wq8``, the EP-resharded MoE tree for MoE models,
+        the model's params otherwise."""
         if self.cfg.wq8:
             return self.quantized_params()
+        if self._is_moe:
+            return self.moe_params()
         return self.model.params
 
     def decode_fn(self, batch: int, s_max: int, page: int = 0,
@@ -505,9 +661,7 @@ class MegaQwen3:
         # needs the scoreboard edges of THIS build.
         self._last_multi_order = compiled.order
         ax = m.axis
-        wq8 = self.cfg.wq8
-        kernel_args = self._kernel_args_q8 if wq8 else self._kernel_args
-        pspecs = self._q8_specs() if wq8 else m.param_specs
+        kernel_args, pspecs = self._args_and_specs()
 
         if page:
             def shard_fn(params: Qwen3Params, tokens,
@@ -650,6 +804,12 @@ class MegaQwen3:
         """Build the prompt-prefill megakernel for an S-token prompt
         (parity: the reference's prefill TaskBuilders,
         ``model_builder.py:189-352``)."""
+        if self._is_moe:
+            raise NotImplementedError(
+                "MoE prefill runs through the model path — the serving "
+                "engines prefill with mode='xla' under mode='mega' "
+                "(MegaDispatch._prefill_mode)"
+            )
         m = self.model
         dims = dataclasses.replace(self._dims(s, s), prefill=True)
         mb = ModelBuilder(

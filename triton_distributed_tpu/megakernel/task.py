@@ -79,6 +79,27 @@ class TaskType(enum.IntEnum):
     # the GEMM.
     AR_SEND = 12     # start remote puts of h into peers' cbuf slots
     AR_WAIT = 13     # prefetch next tile-0, wait partials, x += sum
+    # MoE decode (Qwen3MoE through the megakernel, docs/megakernel.md
+    # "MoE serving"): the dense FC1/FC2 pair is replaced by a router
+    # task plus one grouped-GEMM task per LOCAL expert (weights are
+    # EP-sharded — each rank streams only the experts it owns, full FFN
+    # width), and the EP combine enters the graph as split-phase
+    # siblings of AR_SEND/AR_WAIT. On TPU decode the activations are
+    # replicated ([B, d] after the attention allreduce) and the router
+    # is replicated too, so the DISPATCH half of the reference's EP
+    # all-to-all (kernels/nvidia/ep_a2a.py kernel_dispatch_token) is
+    # data-free — every rank already holds every token; what crosses
+    # the wire is the COMBINE (kernel_combine_token): each rank's
+    # weighted sum over its own experts' outputs. A2A_SEND fires those
+    # combine puts in two phases — phase 0 the moment the FIRST HALF of
+    # the local experts' GEMMs land (so the exchange flies under the
+    # second half's expert grouped GEMMs), phase 1 after the rest — and
+    # A2A_WAIT blocks only after firing the next weight stream's tile-0
+    # DMA (fire_next_tile0, the AR_WAIT overlap lever).
+    MOE_GATE = 14    # router: softmax top-k over experts → combine weights
+    MOE_FFN = 15     # one local expert's SwiGLU FFN; arg0: local expert id
+    A2A_SEND = 16    # start combine puts of a phase partial; arg0: phase
+    A2A_WAIT = 17    # prefetch next tile-0, wait partials, x += sum
 
 
 # Resource class used by the zig-zag scheduler: tasks whose cost is
@@ -87,6 +108,7 @@ class TaskType(enum.IntEnum):
 COMM_TASKS = frozenset({
     TaskType.ALLREDUCE, TaskType.BARRIER, TaskType.EMBED,
     TaskType.AR_SEND, TaskType.AR_WAIT,
+    TaskType.A2A_SEND, TaskType.A2A_WAIT,
 })
 
 

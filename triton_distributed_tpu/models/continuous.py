@@ -399,6 +399,13 @@ class ContinuousEngine(MegaDispatch):
         # reads — the supervisor's crash-recovery feed. ``_handoff_at``
         # arms the lossless-drain sweep: at the first scheduling round
         # >= it, every active slot exports instead of finishing here.
+        # MoE serving (docs/serving.md "MoE serving"): top_k per routed
+        # token position — 0 for dense models; gates the
+        # moe_routed_tokens bumps and the last_stats expert keys.
+        self._moe_k = (
+            model.cfg.num_experts_per_tok
+            if getattr(model.cfg, "num_experts", 0) else 0
+        )
         self.snapshot_every = int(snapshot_every)
         self._handoff_at: int | None = None
         self._round = 0
@@ -464,6 +471,13 @@ class ContinuousEngine(MegaDispatch):
             "migrated_in": 0,
             "migrated_in_tokens": 0,
             "migration_fallbacks": 0,
+            # MoE serving ledger (docs/serving.md "MoE serving"):
+            # routed expert assignments (token positions through the
+            # MoE FFN × top_k) and EP a2a drops — always 0 on the
+            # lossless serving paths, surfaced so a capacity-mode EP
+            # experiment can never hide overflow.
+            "moe_routed_tokens": 0,
+            "a2a_dropped": 0,
         }
 
     @property
@@ -498,6 +512,9 @@ class ContinuousEngine(MegaDispatch):
             stats["target_steps"] = (
                 stats["decode_steps"] + stats["spec_verify_steps"]
             )
+        if self._moe_k:
+            stats["num_experts"] = self.model.cfg.num_experts
+            stats["experts_per_tok"] = self._moe_k
         return stats
 
     # -- telemetry ---------------------------------------------------------
@@ -578,6 +595,8 @@ class ContinuousEngine(MegaDispatch):
         )
         self._bump("admitted")
         self._bump("prefill_tokens", s)
+        if self._moe_k:
+            self._bump("moe_routed_tokens", s * self._moe_k)
         # Emitted HERE, aligned with the `admitted` counter — a failed
         # allocation/prefill must not leave a phantom admit event for
         # consumers correlating admits against counters or evicts.
@@ -728,6 +747,9 @@ class ContinuousEngine(MegaDispatch):
         self._kv_len[slot] = len(prompt)
         self._bump("prefill_tokens", len(prompt) - start)
         self._bump("prefill_chunks", chunks)
+        if self._moe_k:
+            self._bump("moe_routed_tokens",
+                       (len(prompt) - start) * self._moe_k)
         return logits
 
     def _decode_once(self) -> bool:
@@ -749,6 +771,9 @@ class ContinuousEngine(MegaDispatch):
         # and its first fetch raced the device's kv_len read).
         self._kv_len = self._kv_len + active
         self._bump("decode_steps")
+        if self._moe_k:
+            self._bump("moe_routed_tokens",
+                       int(active.sum()) * self._moe_k)
         # One device program computes the finite mask AND the greedy
         # base tokens, so the NaN guard adds no extra host-sync round
         # trip to the hot decode loop.
@@ -1081,6 +1106,10 @@ class ContinuousEngine(MegaDispatch):
                 continue
             req.spec.record(len(draft), a)
             self._bump("spec_verify_steps")
+            if self._moe_k:
+                # The verify chunk routes draft+1 positions per slot.
+                self._bump("moe_routed_tokens",
+                           (len(draft) + 1) * self._moe_k)
             self._bump("spec_draft_tokens", len(draft))
             self._bump("spec_accepted_tokens", a)
             self._bump("spec_rollback_tokens", len(draft) - a)
@@ -1301,6 +1330,9 @@ class ContinuousEngine(MegaDispatch):
         # STILL-RUNNING launch's cache.kv_len (see _sync_tables).
         self._kv_len = self._kv_len + self.NS * active
         self._bump("decode_steps", self.NS)
+        if self._moe_k:
+            self._bump("moe_routed_tokens",
+                       self.NS * int(active.sum()) * self._moe_k)
         self._bump("mega_launches")
         self._ns_gauge.set(
             self.stats["decode_steps"] / max(self.stats["mega_launches"], 1)

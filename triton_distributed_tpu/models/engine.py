@@ -642,6 +642,36 @@ class Engine(MegaDispatch):
             self.last_stats["kv_dtype"] = str(jnp.dtype(cache.k.dtype))
         if spec_counters is not None:
             self.last_stats.update(spec_counters)
+        if getattr(self.model.cfg, "num_experts", 0):
+            # MoE serving ledger (docs/serving.md "MoE serving"): the
+            # fixed-batch engine computes the routed count once —
+            # prefilled positions plus every decode-phase position, ×
+            # top_k assignments each. Under speculation the decode
+            # positions are what the verify/decode forwards actually
+            # routed (Σ(draft+1) per verify chunk = draft_tokens +
+            # verify_steps, plus b per batched step), matching the
+            # ContinuousEngine's per-site bumps so the shared
+            # tdt_moe_routed_tokens_total counter ties out across
+            # engines. a2a_dropped mirrors ``DispatchState.num_dropped``
+            # (ops/moe/ep_a2a.py): this engine's forward is lossless
+            # (full-expert streaming), so it is 0 by construction here;
+            # capacity-mode EP paths surface their detected drops
+            # through the same key (perf/moe_serve_bench.py).
+            k = self.model.cfg.num_experts_per_tok
+            if spec_counters is not None:
+                decode_pos = (
+                    spec_counters["spec_draft_tokens"]
+                    + spec_counters["spec_verify_steps"]
+                    + b * spec_counters["spec_decode_steps"]
+                )
+            else:
+                decode_pos = b * max(gen_len - 1, 0)
+            self.last_stats["moe_routed_tokens"] = (
+                (prefill_toks + decode_pos) * k
+            )
+            self.last_stats["a2a_dropped"] = 0
+            self.last_stats["num_experts"] = self.model.cfg.num_experts
+            self.last_stats["experts_per_tok"] = k
         if row_meta is not None:
             self._prefix_retire(
                 result, rows, true_lens, gen_len, cache, row_meta

@@ -92,4 +92,16 @@ STAT_METRICS = {
     "mega_trace_launches": ("tdt_mega_trace_launches_total",
                             "Megakernel launches whose device trace "
                             "ring was decoded."),
+    # MoE serving (docs/serving.md "MoE serving"): token positions
+    # routed through the expert FFN × top_k, and EP all-to-all drops —
+    # the serving paths are LOSSLESS (splits-exchange protocol /
+    # full-expert streaming), so a nonzero drop count is always a
+    # detected error surfaced from ``DispatchState.num_dropped``
+    # (ops/moe/ep_a2a.py), never silent truncation.
+    "moe_routed_tokens": ("tdt_moe_routed_tokens_total",
+                          "Expert assignments routed (token positions "
+                          "through the MoE FFN × top_k)."),
+    "a2a_dropped": ("tdt_moe_a2a_dropped_total",
+                    "EP all-to-all assignments dropped (capacity-mode "
+                    "overflow; 0 on the lossless serving paths)."),
 }

@@ -86,6 +86,8 @@ _COMM_OPS = frozenset(int(t) for t in COMM_TASKS)
 _AR_SEND = int(TaskType.AR_SEND)
 _AR_WAIT = int(TaskType.AR_WAIT)
 _ALLREDUCE = int(TaskType.ALLREDUCE)
+_A2A_SEND = int(TaskType.A2A_SEND)
+_A2A_WAIT = int(TaskType.A2A_WAIT)
 
 
 class TaskRecord:
@@ -259,25 +261,46 @@ def validate_ring(records: list[TaskRecord], order=None) -> list[str]:
 def overlap_report(records: list[TaskRecord]) -> dict:
     """MEASURED overlap exposure from the ring.
 
-    Per (rank, step), each comm window is either an AR_SEND..AR_WAIT
-    pair (``MegaConfig.overlap_ar``: the window opens when the send's
+    Per (rank, step), each comm window is an AR_SEND..AR_WAIT pair
+    (``MegaConfig.overlap_ar``: the window opens when the send's
     puts are in flight — its ``mid`` — and closes when the wait's
-    blocked phase ends) or a fused ALLREDUCE's ``[begin, mid]`` comm
-    phase. Hidden = the part of the window coinciding with compute
-    work: whole tasks scheduled inside it plus AR_WAIT's pre-block
-    phase (tile-0 prefetch + dispatch — ``[begin, mid]`` of the wait).
-    Exposed = the blocked remainder (``[mid, end]`` of the wait; the
-    whole comm phase of a fused exchange). ``hidden_fraction`` is what
-    the analytic arm of perf/MEGA_SERVE.json estimated; here it is
-    measured from device records.
+    blocked phase ends), a fused ALLREDUCE's ``[begin, mid]`` comm
+    phase, or — MoE graphs — an A2A_SEND..A2A_WAIT EP-combine window
+    (ONE window per gate layer: it opens at the FIRST phase's ``mid``,
+    so the second half of the expert grouped GEMMs is exactly the work
+    it hides under). Hidden = the part of the window coinciding with
+    compute work: whole tasks scheduled inside it plus the wait's
+    pre-block phase (tile-0 prefetch + dispatch — ``[begin, mid]`` of
+    the wait). Exposed = the blocked remainder (``[mid, end]`` of the
+    wait; the whole comm phase of a fused exchange).
+    ``hidden_fraction`` aggregates every window; the ``a2a_*`` keys
+    break the A2A family out (what perf/MOE_SERVE.json reports).
     """
     windows = 0
     comm = hidden = exposed = 0
+    a2a_windows = 0
+    a2a_comm = a2a_hidden = a2a_exposed = 0
     by_rs: dict[tuple, list[TaskRecord]] = {}
     for rec in records:
         by_rs.setdefault((rec.rank, rec.step), []).append(rec)
+
+    def _window(recs, open_t, close_t, wait):
+        """(comm, hidden, exposed) of one send..wait window."""
+        c = close_t - open_t
+        h = (wait.mid or wait.begin) - wait.begin
+        for other in recs:
+            if other is wait or other.is_comm:
+                continue
+            lo = max(other.begin, open_t)
+            hi = min(other.end, close_t)
+            if hi > lo:
+                h += hi - lo
+        e = close_t - (wait.mid or wait.begin)
+        return c, h, e
+
     for recs in by_rs.values():
         recs = sorted(recs, key=lambda x: x.index)
+        seen_a2a_waits = set()
         for i, rec in enumerate(recs):
             if rec.opcode == _AR_SEND:
                 wait = next(
@@ -289,21 +312,31 @@ def overlap_report(records: list[TaskRecord]) -> dict:
                 if wait is None:
                     continue
                 windows += 1
-                open_t = rec.mid or rec.end
-                close_t = wait.end
-                comm += close_t - open_t
-                # Compute coinciding with the open window: AR_WAIT's
-                # pre-block phase + whole tasks between send and wait.
-                h = (wait.mid or wait.begin) - wait.begin
-                for other in recs:
-                    if other is rec or other is wait or other.is_comm:
-                        continue
-                    lo = max(other.begin, open_t)
-                    hi = min(other.end, close_t)
-                    if hi > lo:
-                        h += hi - lo
+                c, h, e = _window(recs, rec.mid or rec.end, wait.end, wait)
+                comm += c
                 hidden += h
-                exposed += close_t - (wait.mid or wait.begin)
+                exposed += e
+            elif rec.opcode == _A2A_SEND and rec.slot == 0:
+                # ONE window per gate layer, opened by the phase-0 send
+                # (phase 1's bytes ride the same window — it closes at
+                # the shared wait's end).
+                wait = next(
+                    (w for w in recs[i + 1:]
+                     if w.opcode == _A2A_WAIT and w.layer == rec.layer),
+                    None,
+                )
+                if wait is None or id(wait) in seen_a2a_waits:
+                    continue
+                seen_a2a_waits.add(id(wait))
+                windows += 1
+                a2a_windows += 1
+                c, h, e = _window(recs, rec.mid or rec.end, wait.end, wait)
+                comm += c
+                hidden += h
+                exposed += e
+                a2a_comm += c
+                a2a_hidden += h
+                a2a_exposed += e
             elif rec.opcode == _ALLREDUCE and rec.mid:
                 windows += 1
                 comm += rec.mid - rec.begin
@@ -314,6 +347,13 @@ def overlap_report(records: list[TaskRecord]) -> dict:
         "hidden_ticks": int(hidden),
         "exposed_ticks": int(exposed),
         "hidden_fraction": (hidden / comm) if comm else None,
+        "a2a_windows": a2a_windows,
+        "a2a_comm_ticks": int(a2a_comm),
+        "a2a_hidden_ticks": int(a2a_hidden),
+        "a2a_exposed_ticks": int(a2a_exposed),
+        "a2a_hidden_fraction": (
+            (a2a_hidden / a2a_comm) if a2a_comm else None
+        ),
     }
 
 
@@ -334,6 +374,12 @@ def _overlap_report_array(arr: np.ndarray) -> dict | None:
     otherwise and the caller falls back to the general record-wise
     implementation, which stays the semantic reference."""
     ops = arr[..., TR_OPCODE]
+    if (ops == _A2A_SEND).any():
+        # MoE EP-combine windows span whole expert-GEMM runs (never
+        # send-adjacent-to-wait); the record-wise reference handles
+        # them — and MoE launches are rare enough per process that the
+        # general path's cost is irrelevant.
+        return None
     n_sends = int((ops == _AR_SEND).sum())
     mids = arr[..., TR_MID]
     windows = 0
@@ -371,6 +417,13 @@ def _overlap_report_array(arr: np.ndarray) -> dict | None:
         "hidden_ticks": hidden,
         "exposed_ticks": exposed,
         "hidden_fraction": (hidden / comm) if comm else None,
+        # Schema parity with overlap_report: no A2A records reached
+        # this path (it bails to the record-wise reference on any).
+        "a2a_windows": 0,
+        "a2a_comm_ticks": 0,
+        "a2a_hidden_ticks": 0,
+        "a2a_exposed_ticks": 0,
+        "a2a_hidden_fraction": None,
     }
 
 

@@ -278,7 +278,8 @@ def ep_moe_ffn(
     norm_topk_prob: bool = True,
     payload_dtype: str | None = None,
     ctx=None,
-) -> jax.Array:
+    return_state: bool = False,
+):
     """Full EP MoE FFN inside ``shard_map`` (parity:
     ``EPAll2AllLayer.forward`` — ``ep_a2a_layer.py:195/240``).
 
@@ -286,6 +287,13 @@ def ep_moe_ffn(
     A float bounds memory instead; overflow then surfaces in
     ``DispatchState.num_dropped`` (detected, never silent) — see module
     docstring.
+
+    ``return_state=True`` returns ``(out, state)`` so callers can
+    surface the :class:`DispatchState` ledger — in particular
+    ``num_dropped``, which serving stats report as ``a2a_dropped``
+    (docs/serving.md "MoE serving") in BOTH modes: 0 by construction on
+    the lossless path, the detected overflow count under a capacity
+    factor.
     """
     from triton_distributed_tpu.ops.moe.routing import router_topk
 
@@ -315,4 +323,5 @@ def ep_moe_ffn(
     group_sizes = jnp.bincount(recv_e, length=epr).astype(jnp.int32)
     out_sorted = grouped_ffn(sorted_x, w1, w2, group_sizes)
     expert_out = out_sorted[inv]
-    return ep_combine(expert_out, state, t, axis, method, ctx)
+    out = ep_combine(expert_out, state, t, axis, method, ctx)
+    return (out, state) if return_state else out

@@ -35,6 +35,25 @@ import sys
 import jax
 
 
+def resolve_model_args(
+    model: str, num_experts: int = 0, top_k: int = 0,
+    moe_intermediate: int = 0,
+) -> tuple[str, dict]:
+    """``--model moe`` alias resolution (ONE definition for main and
+    tests): the tiny-moe Qwen3MoE preset, with the expert knobs as
+    config overrides. Non-moe names pass through with the same
+    overrides applied (an MoE checkpoint dir can be resized too)."""
+    name = "tiny-moe" if model == "moe" else model
+    overrides: dict = {}
+    if num_experts:
+        overrides["num_experts"] = num_experts
+    if top_k:
+        overrides["num_experts_per_tok"] = top_k
+    if moe_intermediate:
+        overrides["moe_intermediate_size"] = moe_intermediate
+    return name, overrides
+
+
 def _write_port_file(path: str | None, host: str, port: int) -> None:
     """Atomic port handshake: the supervisor polls for PATH, so the
     write must never be observable half-done — write a sibling temp
@@ -49,7 +68,19 @@ def _write_port_file(path: str | None, host: str, port: int) -> None:
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--model", default="tiny")
+    p.add_argument("--model", default="tiny",
+                   help="model preset, checkpoint dir, 'stub', or "
+                   "'moe' (the tiny-moe Qwen3MoE preset; size it with "
+                   "--num-experts/--top-k/--moe-intermediate — "
+                   "docs/serving.md 'MoE serving')")
+    p.add_argument("--num-experts", type=int, default=0,
+                   help="override the MoE preset's expert count "
+                   "(routed experts; must divide by --tp for "
+                   "--mode mega's EP sharding)")
+    p.add_argument("--top-k", type=int, default=0,
+                   help="override the MoE preset's experts-per-token")
+    p.add_argument("--moe-intermediate", type=int, default=0,
+                   help="override the MoE preset's per-expert FFN width")
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=0)
@@ -132,6 +163,11 @@ def main(argv=None) -> int:
                    "Also turns the engines' kernel_trace knob on and "
                    "surfaces both in server_stats.")
     args = p.parse_args(argv)
+    # --model moe: the Qwen3MoE serving alias (tiny-moe preset so a
+    # laptop/CI run needs no checkpoint), sized by the knob overrides.
+    model_name, overrides = resolve_model_args(
+        args.model, args.num_experts, args.top_k, args.moe_intermediate
+    )
     if args.speculative and args.mode == "mega":
         # Explicit, named-knob refusal (the engines raise the same
         # conflict; failing at the CLI names the flags to change).
@@ -178,6 +214,12 @@ def main(argv=None) -> int:
                 child += ["--speculative", str(args.speculative)]
             if args.snapshot_every:
                 child += ["--snapshot-every", str(args.snapshot_every)]
+            if args.num_experts:
+                child += ["--num-experts", str(args.num_experts)]
+            if args.top_k:
+                child += ["--top-k", str(args.top_k)]
+            if args.moe_intermediate:
+                child += ["--moe-intermediate", str(args.moe_intermediate)]
             specs = [
                 ReplicaSpec(f"r{i}", list(child))
                 for i in range(args.fleet)
@@ -228,7 +270,7 @@ def main(argv=None) -> int:
     from triton_distributed_tpu.runtime.mesh import initialize_distributed
 
     ctx = initialize_distributed(tp=args.tp, devices=jax.devices()[: args.tp])
-    model = AutoLLM.from_pretrained(args.model, ctx=ctx)
+    model = AutoLLM.from_pretrained(model_name, ctx=ctx, **overrides)
     # --trace: device-side kernel tracing rides the mega engines only
     # (the xla/pallas paths have no device ring); host profiling wraps
     # the run regardless of mode.

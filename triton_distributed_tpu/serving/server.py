@@ -222,11 +222,21 @@ class ModelServer:
         # host. Routers surface per-replica details in the stats verb's
         # ``router`` ledger instead; these getattrs then report the
         # fleet-level defaults (None/0).
+        engine_cfg = getattr(
+            getattr(self.engine, "model", None), "cfg", None
+        )
         stats["engine"] = {
             "mode": getattr(self.engine, "mode", None),
             "kv_dtype": getattr(self.engine, "kv_dtype", None),
             "speculative": getattr(self.engine, "speculative", 0),
             "kernel_trace": getattr(self.engine, "kernel_trace", False),
+            # MoE knobs (docs/serving.md "MoE serving"): 0 for dense
+            # models and fleet routers (whose per-replica details ride
+            # the stats verb's ``router`` ledger).
+            "num_experts": getattr(engine_cfg, "num_experts", 0),
+            "experts_per_tok": getattr(
+                engine_cfg, "num_experts_per_tok", 0
+            ),
         }
         # --trace DIR deployments (run_server) surface where the
         # merged host+device timeline will land.
