@@ -54,13 +54,25 @@ def _fleet_demo(args) -> int:
             child += ["--kv-dtype", args.kv_dtype]
         if args.speculative:
             child += ["--speculative", str(args.speculative)]
+        if args.tier_bytes:
+            child += ["--tier-bytes", str(args.tier_bytes)]
+        if args.tier_dir:
+            # Restart-safe from one flag: children must export
+            # snapshots for the supervisor's resume store (which
+            # derives its pull cadence from resume_dir) to hold any.
+            child += ["--snapshot-every", "8"]
         env = {"JAX_PLATFORMS": "cpu"} if args.cpu else None
-        specs = [
-            ReplicaSpec(f"r{i}", list(child), env=env)
-            for i in range(args.fleet)
-        ]
+        specs = []
+        for i in range(args.fleet):
+            argv_i = list(child)
+            if args.tier_dir:
+                argv_i += ["--tier-dir",
+                           os.path.join(args.tier_dir, f"r{i}")]
+            specs.append(ReplicaSpec(f"r{i}", argv_i, env=env))
     sup = FleetSupervisor(
         specs,
+        resume_dir=(os.path.join(args.tier_dir, "resume")
+                    if args.tier_dir else None),
         router_kw={
             "request_timeout_s": args.request_timeout or None,
         },
@@ -142,6 +154,17 @@ def main(argv=None) -> int:
                    help="self-drafting speculative decoding, up to K "
                    "draft tokens per row (docs/serving.md); excluded "
                    "with --mode mega")
+    p.add_argument("--tier-bytes", type=int, default=0,
+                   help="host-RAM durable KV tier per engine, bytes "
+                   "(0 = off): evicted radix pages spill and fault "
+                   "back on digest match (docs/serving.md 'Tiered "
+                   "KV'); with --fleet children inherit it")
+    p.add_argument("--tier-dir", default=None, metavar="DIR",
+                   help="disk tier directory (atomic, checksummed); "
+                   "with --fleet each child gets DIR/r<i> and the "
+                   "supervisor persists pulled snapshots under "
+                   "DIR/resume — a restart-safe fleet from one flag "
+                   "(docs/scale-out.md 'Durable snapshots')")
     p.add_argument("--stats", action="store_true",
                    help="after generating, fetch {'cmd':'stats'} and "
                    "{'cmd':'metrics'} through the wire and pretty-print "
@@ -181,6 +204,22 @@ def main(argv=None) -> int:
             "--speculative and --mode mega do not compose (the NS-step "
             "fused launch already amortizes per-step dispatch); drop "
             "--speculative or use --mode xla/pallas"
+        )
+    if (args.tier_bytes or args.tier_dir) and not (
+            args.fleet or args.replicas):
+        # Fail fast by flag name (the speculative×mega convention): the
+        # single fixed-batch Engine has no tier — silently ignoring the
+        # flags would fake restart-safety.
+        p.error(
+            "--tier-bytes/--tier-dir ride the continuous serving stack "
+            "only (docs/serving.md 'Tiered KV'): add --replicas N or "
+            "--fleet N"
+        )
+    if args.tier_bytes and args.fleet and args.model == "stub":
+        p.error(
+            "--tier-bytes does nothing on a stub fleet (stub children "
+            "have no KV tier); --tier-dir still arms the supervisor's "
+            "durable resume store, or use a real --model"
         )
 
     import jax
@@ -225,8 +264,11 @@ def main(argv=None) -> int:
                 temperature=0.0, prefix_cache=True,
                 kv_dtype=args.kv_dtype, speculative=args.speculative,
                 kernel_trace=kernel_trace,
+                tier_bytes=args.tier_bytes,
+                tier_dir=(os.path.join(args.tier_dir, f"r{i}")
+                          if args.tier_dir else None),
             )
-            for _ in range(args.replicas)
+            for i in range(args.replicas)
         ], request_timeout_s=args.request_timeout or None)
     else:
         eng = Engine(model, temperature=0.0, mode=mode,

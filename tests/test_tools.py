@@ -402,6 +402,24 @@ def test_tier1_marker_audit():
         f"slot-migration suite has too few tier-1-runnable tests: "
         f"{mig_fast}"
     )
+    # ISSUE-12: the durable-KV-tier suite (pure store + tiny-model
+    # spill/fault-back + the supervisor-restart resume case) rides
+    # right behind the migration suite, ahead of the interpret tail,
+    # and must carry tier-1-runnable tests — containment regressions
+    # have to FAIL tier-1, not wait for a relay window.
+    assert "test_kv_tier.py" in order
+    assert (order.index("test_migration.py")
+            < order.index("test_kv_tier.py")
+            < order.index("test_serving.py"))
+    tier_src = open(os.path.join(tests_dir, "test_kv_tier.py")).read()
+    tier_fast = [
+        n.name for n in ast.walk(ast.parse(tier_src))
+        if isinstance(n, ast.FunctionDef) and n.name.startswith("test_")
+        and not any("slow" in ast.dump(d) for d in n.decorator_list)
+    ]
+    assert len(tier_fast) >= 5, (
+        f"KV-tier suite has too few tier-1-runnable tests: {tier_fast}"
+    )
     # ISSUE-11: the MoE serving suite sits with the mega-family suites
     # (after the tracer suite, before the interpret-heavy tail) and
     # must carry tier-1-runnable tests — the MoE fast path has to FAIL
@@ -601,6 +619,38 @@ def test_moe_serving_modules_compile():
     )
 
 
+def test_kv_tier_modules_compile():
+    """ISSUE-12: the durable KV tier must byte-compile — the PageStore
+    subsystem, the tier-aware prefix cache / continuous engine /
+    supervisor wiring, and the CPU-runnable bench that writes
+    perf/KV_TIER.json (repo convention: perf harnesses fail tier-1,
+    not a relay window)."""
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    targets = [
+        os.path.join(root, "triton_distributed_tpu", "models",
+                     "kv_tier.py"),
+        os.path.join(root, "triton_distributed_tpu", "models",
+                     "prefix_cache.py"),
+        os.path.join(root, "triton_distributed_tpu", "models",
+                     "continuous.py"),
+        os.path.join(root, "triton_distributed_tpu", "serving",
+                     "supervisor.py"),
+        os.path.join(root, "perf", "kv_tier_bench.py"),
+    ]
+    proc = subprocess.run(
+        [sys.executable, "-m", "compileall", "-q", "-f", *targets],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, (
+        f"KV tier modules failed to compile:\n"
+        f"{proc.stdout}\n{proc.stderr}"
+    )
+
+
 def test_serving_cli_speculative_mega_conflict():
     """Both serving CLIs refuse --speculative with --mode mega by flag
     name, BEFORE loading a model (argparse error → SystemExit 2), and
@@ -627,3 +677,31 @@ def test_serving_cli_speculative_mega_conflict():
     # Old 5-field strings (pre-overlap_ar MEGA_TUNED.json) still parse.
     old = MegaConfig.from_spec("1024:1024:2:1:0")
     assert old.overlap_ar is False and old.fuse_norms is True
+
+
+def test_serving_cli_tier_flags_require_continuous_stack():
+    """Both serving CLIs refuse --tier-bytes/--tier-dir on paths that
+    would silently ignore them (the plain fixed-batch Engine, the
+    single stub server) by flag name, BEFORE loading a model — the
+    speculative×mega fail-fast convention (docs/serving.md 'Tiered
+    KV')."""
+    import os
+    import sys
+
+    import pytest
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from perf import serve_demo
+    from triton_distributed_tpu.serving import run_server
+
+    for main in (serve_demo.main, run_server.main):
+        for flags in (["--tier-bytes", "1048576"],
+                      ["--tier-dir", "/tmp/nope.tier"]):
+            with pytest.raises(SystemExit) as ei:
+                main(flags)
+            assert ei.value.code == 2  # argparse p.error exit code
+    # The single-stub server has no tier either (fleet stub children
+    # ride the supervisor's resume_dir instead).
+    with pytest.raises(SystemExit) as ei:
+        run_server.main(["--model", "stub", "--tier-bytes", "1048576"])
+    assert ei.value.code == 2

@@ -42,6 +42,7 @@ leak is caught at the batch that caused it, not three batches later.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import itertools
 import threading
 import time
@@ -65,13 +66,16 @@ from triton_distributed_tpu.models.paged_kv_cache import (
     PoolAuditError,
     audit_pool,
     copy_page,
+    gather_pages,
     init_paged_cache,
     truncate_pages,
+    write_page,
     write_prefill,
 )
 from triton_distributed_tpu.models.prefix_cache import (
     PrefixCache,
     PrefixMatch,
+    node_chain,
     round_chunk,
 )
 from triton_distributed_tpu.models.qwen import Mode, Qwen3
@@ -126,6 +130,40 @@ class RequestResult:
 # device-task tagging would alias two requests into one thread of the
 # merged timeline.
 _TRACE_IDS = itertools.count(1)
+
+
+def _model_fingerprint(model) -> str:
+    """Identity of the weights a durable tier entry was produced
+    under: class name, every param leaf's shape/dtype (architecture),
+    and a value sample spread across the whole tree — leaves taken at
+    an even stride (the last leaf, typically the LM head, always
+    included) and, within each, elements strided across the FULL
+    flattened tensor, so a scan-stacked ``[L, ...]`` leaf samples
+    every layer band, not just layer 0. A fine-tune's gradients are
+    dense, so a partial update (later layers only, head only) still
+    moves sampled bytes. Tier entries carry this so a ``tier_dir``
+    reused across a weight update faults back NOTHING instead of
+    stale KV — cached attention state from old weights under new
+    weights is silently wrong bits, exactly what the tier promises
+    never to serve. A few tiny host fetches, computed once per engine
+    when a tier is attached."""
+    h = hashlib.sha1(type(model).__name__.encode())
+    leaves = jax.tree_util.tree_leaves(getattr(model, "params", None))
+    for leaf in leaves:
+        h.update(str(getattr(leaf, "shape", ())).encode())
+        h.update(str(getattr(leaf, "dtype", "")).encode())
+    sampled = leaves[::max(1, len(leaves) // 8)][:8]
+    if leaves and leaves[-1] is not sampled[-1]:
+        sampled.append(leaves[-1])
+    for leaf in sampled:
+        try:
+            flat = jnp.ravel(leaf)
+            stride = max(1, int(flat.shape[0]) // 64)
+            sample = np.asarray(jax.device_get(flat[::stride][:64]))
+        except Exception:  # noqa: BLE001 — identity is best-effort;
+            continue  # shapes/dtypes alone still gate architecture
+        h.update(sample.tobytes())
+    return h.hexdigest()
 
 
 class RequestFailedError(RuntimeError):
@@ -283,6 +321,9 @@ class ContinuousEngine(MegaDispatch):
         kv_dtype: str | None = None,
         kernel_trace: bool = False,
         snapshot_every: int = 0,
+        tier_bytes: int = 0,
+        tier_dir: str | None = None,
+        tier=None,
     ):
         self.model = model
         self.mode = mode
@@ -352,6 +393,42 @@ class ContinuousEngine(MegaDispatch):
         self._tok = np.zeros((max_batch,), np.int32)
         self._slots: list[Request | None] = [None] * max_batch
         self.prefix = PrefixCache(self.pool, page_size) if prefix_cache else None
+        # Durable KV tier (docs/serving.md "Tiered KV"): a host-RAM
+        # (and optionally disk) PageStore behind the radix tree —
+        # evicted prefix pages spill into it instead of dropping to
+        # nothing, admission faults tier-hit pages back cheaper than
+        # re-prefill, and the incremental snapshot buffer persists
+        # through the same store. ``tier=`` accepts a pre-built (or
+        # shared) store; otherwise ``tier_bytes``/``tier_dir`` build
+        # one. Off (None) keeps every pre-tier code path untouched.
+        # Owned = built from this engine's knobs, so every snap entry
+        # in it is this engine's (incl. leftovers a crashed previous
+        # process wrote under the same dir — see run()'s start-clear).
+        # A ``tier=`` store may be shared: only our own keys are ours.
+        self._tier_owned = tier is None and bool(tier_bytes or tier_dir)
+        if tier is None and (tier_bytes or tier_dir):
+            from triton_distributed_tpu.models.kv_tier import PageStore
+
+            # fsync=False: spills and snapshot write-throughs run ON
+            # the scheduling loop — the atomic rename alone gives
+            # process-crash durability (what restart resume needs),
+            # and an OS crash can only tear an entry the CRC drops.
+            # The supervisor's resume store keeps fsync (its writes
+            # ride the monitor thread, off any decode path).
+            tier = PageStore(capacity_bytes=tier_bytes or (64 << 20),
+                             dir=tier_dir, fsync=False)
+        self.tier = tier
+        self._tier_snap_keys: set[str] = set()
+        # Weight identity for durable entries (computed only when a
+        # tier is attached — one small host fetch): spilled pages and
+        # durable snapshots are valid under THESE weights only.
+        self._tier_fp = (
+            _model_fingerprint(model) if self.tier is not None else None
+        )
+        # One-shot leftover sweep latch — see run()'s start-clear.
+        self._tier_swept = False
+        if self.prefix is not None and self.tier is not None:
+            self.prefix.spill_fn = self._spill_page
         self.prefill_chunk = round_chunk(prefill_chunk) if prefill_chunk else 0
         # Dense batch-1 prefill scratch — only the legacy (non-prefix)
         # admission path scatters through it; the chunked path writes
@@ -478,6 +555,13 @@ class ContinuousEngine(MegaDispatch):
             # experiment can never hide overflow.
             "moe_routed_tokens": 0,
             "a2a_dropped": 0,
+            # Durable KV tier ledger (docs/serving.md "Tiered KV"):
+            # evictions demoted to the tier, and admissions extended by
+            # faulting those pages back instead of re-prefilling.
+            "tier_spilled_pages": 0,
+            "tier_hits": 0,
+            "tier_faults": 0,
+            "tier_bytes": 0,
         }
 
     @property
@@ -515,6 +599,8 @@ class ContinuousEngine(MegaDispatch):
         if self._moe_k:
             stats["num_experts"] = self.model.cfg.num_experts
             stats["experts_per_tok"] = self._moe_k
+        if self.tier is not None:
+            stats["tier"] = self.tier.snapshot()
         return stats
 
     # -- telemetry ---------------------------------------------------------
@@ -665,6 +751,17 @@ class ContinuousEngine(MegaDispatch):
         snap_wire = req.snapshot
         snap = None
         try:
+            if (isinstance(snap_wire, dict)
+                    and self._tier_fp is not None
+                    and "model_fp" in snap_wire
+                    and snap_wire["model_fp"] != self._tier_fp):
+                # Produced under different weights (a resume store or
+                # tier_dir that outlived a checkpoint swap): old-weight
+                # KV continued under new weights is wrong bits — take
+                # the replay fallback below instead.
+                raise slot_state.SnapshotStaleError(
+                    "snapshot was produced under different model weights"
+                )
             snap = (
                 slot_state.SlotSnapshot.from_wire(snap_wire)
                 if isinstance(snap_wire, dict) else snap_wire
@@ -961,6 +1058,137 @@ class ContinuousEngine(MegaDispatch):
             self.prefix.retire_sequence(toks, req.pages, req.shared_nodes)
         req.shared_nodes = []
 
+    # -- durable KV tier (docs/serving.md "Tiered KV") --------------------
+
+    def _spill_page(self, chain: list, page: int) -> None:
+        """``PrefixCache.spill_fn``: export one evicted full page to
+        the tier, keyed by its token-chain digest — byte-exact via
+        ``gather_pages`` (int8 codes + per-page scales travel as a
+        pair). Raising is fine: eviction treats any spill failure as
+        the pre-tier drop."""
+        from triton_distributed_tpu.models import kv_tier
+
+        k, v, ks, vs = gather_pages(self.cache, [page])
+        payload = kv_tier.prefix_payload(
+            chain, self.page_size, self.kv_dtype,
+            k[:, 0], v[:, 0],
+            None if ks is None else ks[:, 0],
+            None if vs is None else vs[:, 0],
+        )
+        payload["model_fp"] = self._tier_fp
+        if self.tier.put(kv_tier.PREFIX_KIND, kv_tier.chain_digest(chain),
+                         payload):
+            self._bump("tier_spilled_pages")
+            obs_events.emit("tier_spill", tokens=len(chain), page=int(page))
+
+    def _tier_fill(self, tokens) -> None:
+        """Fault-back half of the tier: extend the radix tree's
+        coverage of ``tokens`` from the tier BEFORE admission matches —
+        each hit page is re-allocated, written verbatim via
+        ``write_page`` (cheaper than re-prefilling it), and grafted
+        back into the tree where ``match()`` then pins it exactly like
+        any cached page. Stops at the first miss, divergence, or
+        allocation failure; every failure path degrades to the
+        ordinary suffix prefill — never wrong bits."""
+        if self.tier is None or self.prefix is None:
+            return
+        from triton_distributed_tpu.models import kv_tier
+
+        if not self.tier.may_contain(kv_tier.PREFIX_KIND):
+            # Nothing has ever spilled (the steady state before the
+            # first eviction): skip the per-round tree walk + SHA-1
+            # over the uncovered prefix — guaranteed misses. The queue
+            # head re-runs this every scheduling round it waits.
+            return
+        ps = self.page_size
+        toks = [int(t) for t in tokens]
+        limit = len(toks) - 1  # match()'s cap: one suffix token prefills
+        node = self.prefix.root
+        i = 0
+        faulted = bytes_in = 0
+        # The walked path is refcount-PINNED for the fill's duration:
+        # each faulted page's allocation may itself run the LRU
+        # eviction sweep, which would otherwise happily evict (and
+        # re-spill) the very nodes this prompt is about to match —
+        # observed as a fill that fed its own allocations. Pins release
+        # before match() takes its own.
+        pinned: list = []
+        try:
+            while i + ps <= limit:
+                chunk = toks[i:i + ps]
+                child = node.children.get(chunk[0])
+                if child is not None:
+                    if tuple(chunk) == child.chunk:
+                        node = child
+                        node.refcount += 1
+                        pinned.append(node)
+                        i += ps
+                        continue
+                    break  # divergent/partial sibling: the tree wins
+                digest = kv_tier.chain_digest(toks[: i + ps])
+                payload = self.tier.get(kv_tier.PREFIX_KIND, digest)
+                if payload is None:
+                    break
+                try:
+                    chain, page_size, kv_dtype, k, v, ks, vs = (
+                        kv_tier.decode_prefix_payload(payload)
+                    )
+                except kv_tier.TierIntegrityError:
+                    self.tier.delete(kv_tier.PREFIX_KIND, digest)
+                    break
+                if (chain != toks[: i + ps] or page_size != ps
+                        or kv_dtype != self.kv_dtype
+                        or payload.get("model_fp") != self._tier_fp):
+                    # Digest collision, a foreign-geometry entry, or a
+                    # page produced under DIFFERENT weights (a reused
+                    # tier_dir across a checkpoint swap): never fault
+                    # it back — that would map wrong KV under this
+                    # chain. The admission re-prefills. Deleting is
+                    # owner-only: on a SHARED store (``tier=``) the
+                    # entry may be perfectly valid for the engine that
+                    # spilled it.
+                    if self._tier_owned:
+                        self.tier.delete(kv_tier.PREFIX_KIND, digest)
+                    obs_events.emit(
+                        "tier_drop", tier_kind=kv_tier.PREFIX_KIND,
+                        key=digest[:64],
+                        reason="chain/geometry/weights mismatch",
+                    )
+                    break
+                pages = self.prefix.allocate(1)
+                if pages is None:
+                    break
+                try:
+                    self.cache = write_page(
+                        self.cache, pages[0], k, v, ks, vs
+                    )
+                except Exception:  # noqa: BLE001 — degrade to re-prefill
+                    self.pool.release(pages)
+                    if self._tier_owned:  # shared: may be valid elsewhere
+                        self.tier.delete(kv_tier.PREFIX_KIND, digest)
+                    break
+                self.prefix.insert_chain(node, chunk, pages)
+                child = node.children.get(chunk[0])
+                if child is None or child.page != pages[0]:
+                    break  # insert declined (raced sibling) — released
+                node = child
+                node.refcount += 1
+                pinned.append(node)
+                i += ps
+                faulted += 1
+                bytes_in += kv_tier.payload_nbytes(payload)
+        finally:
+            for n in pinned:
+                self.prefix.release_node(n)
+        if faulted:
+            self._bump("tier_hits")
+            self._bump("tier_faults", faulted)
+            self._bump("tier_bytes", bytes_in)
+            obs_events.emit(
+                "tier_fault", pages=faulted, bytes=bytes_in,
+                matched_tokens=i,
+            )
+
     def _request_sampling(self, req: Request) -> tuple[float, float, int]:
         """Resolve a request's effective (temperature, top_p, top_k):
         per-request overrides beat the engine defaults."""
@@ -1179,6 +1407,13 @@ class ContinuousEngine(MegaDispatch):
                         progress = False
                         break
                 elif self.prefix is not None:
+                    if self.tier is not None:
+                        # Durable-tier fault-back (docs/serving.md
+                        # "Tiered KV"): pull tier-resident pages of
+                        # this prompt back into the tree BEFORE the
+                        # match, so a spilled-then-revisited prefix
+                        # re-maps instead of re-prefilling.
+                        self._tier_fill(head.prompt)
                     m = self.prefix.match(head.prompt)
                     avail = (
                         len(self.pool.free)
@@ -1429,9 +1664,31 @@ class ContinuousEngine(MegaDispatch):
         t0 = time.monotonic()
         self._round = 0
         # A fresh batch invalidates the previous one's crash-recovery
-        # snapshots (their tickets latched when run() returned).
+        # snapshots (their tickets latched when run() returned) — the
+        # durable copies too: leftovers on disk are only meaningful
+        # after a crash, and this engine did not crash.
         with self._snap_lock:
             self._snapshots = {}
+        if self.tier is not None:
+            from triton_distributed_tpu.models.kv_tier import SNAP_KIND
+
+            if self._tier_owned and not self._tier_swept:
+                # First run over an OWNED store: sweep every snap
+                # entry, not just this object's keys — a RESPAWNED
+                # process starts with empty _tier_snap_keys, and its
+                # crashed predecessor's leftovers would otherwise
+                # accumulate forever (recovery consumed them before
+                # resubmitting; "entries mean crash", never history).
+                # One-shot: later runs track their own keys, so the
+                # per-batch cost stays a handful of deletes, not a
+                # directory sweep.
+                if self.tier.may_contain(SNAP_KIND):
+                    self.tier.clear(SNAP_KIND)
+            else:
+                for tid in self._tier_snap_keys:
+                    self.tier.delete(SNAP_KIND, tid)
+            self._tier_swept = True
+            self._tier_snap_keys = set()
         # Telemetry: every request gets a lifecycle timeline; the
         # server stamps enqueue at payload decode, direct callers get
         # it backfilled here (docs/observability.md).
@@ -1626,13 +1883,33 @@ class ContinuousEngine(MegaDispatch):
             if req is None or req.ticket_id is None:
                 continue
             try:
-                snaps[req.ticket_id] = slot_state.export_slot(
-                    self, slot
-                ).to_wire()
+                wire = slot_state.export_slot(self, slot).to_wire()
+                if self._tier_fp is not None:
+                    # Weight identity rides every buffered snapshot
+                    # (and through it the supervisor's resume store):
+                    # a tier-enabled engine refuses to IMPORT a
+                    # snapshot carrying a different fingerprint —
+                    # continuing old-weight KV under new weights is
+                    # wrong bits, so it replays instead.
+                    wire["model_fp"] = self._tier_fp
+                snaps[req.ticket_id] = wire
             except Exception:  # noqa: BLE001 — snapshotting is best-effort
                 continue
         with self._snap_lock:
             self._snapshots = snaps
+        if self.tier is not None:
+            # Durable snapshots (docs/scale-out.md "Durable
+            # snapshots"): the buffer persists through the tier, so a
+            # crashed process's LAST snapshots outlive it — a fresh
+            # process over the same tier dir reads them back and
+            # resumes mid-generation instead of replaying.
+            from triton_distributed_tpu.models.kv_tier import SNAP_KIND
+
+            for tid, snap in snaps.items():
+                self.tier.put(SNAP_KIND, tid, snap)
+            for tid in self._tier_snap_keys - set(snaps):
+                self.tier.delete(SNAP_KIND, tid)
+            self._tier_snap_keys = set(snaps)
 
     def _migrate_out(self, req: Request, reason: str) -> bool:
         """Export ``req``'s slot and tear it down with status
@@ -1688,6 +1965,35 @@ class ContinuousEngine(MegaDispatch):
 
     # -- auditing ---------------------------------------------------------
 
+    def _audit_tier(self) -> list[str]:
+        """Tier-residency cross-checks (docs/serving.md "Tiered KV"),
+        run by :meth:`audit` when a tier is attached: a tier entry
+        holds payload COPIES never pool page ids, so the pool partition
+        is tier-independent — what CAN go wrong is identity drift
+        between the tree and the store. For every full tree page whose
+        chain also has a tier entry, that entry's payload chain must
+        equal the node's chain (a mismatch means a later fault-back of
+        the evicted node would map wrong KV under this prompt); the
+        store-level key↔digest check rides ``PageStore.audit``."""
+        from triton_distributed_tpu.models import kv_tier
+
+        problems: list[str] = []
+        for node in self.prefix.walk() if self.prefix is not None else ():
+            if len(node.chunk) != self.page_size:
+                continue
+            chain = [int(t) for t in node_chain(node)]
+            entry = self.tier.peek(
+                kv_tier.PREFIX_KIND, kv_tier.chain_digest(chain)
+            )
+            if entry is None:
+                continue
+            if [int(t) for t in entry.get("chain", [])] != chain:
+                problems.append(
+                    f"tier entry for tree page {node.page} carries a "
+                    "different token chain than the node"
+                )
+        return problems
+
     def audit(self, *, raise_on_violation: bool = False) -> list[str]:
         """Pool/radix invariant audit (docs/serving.md): free list ∪
         slot-private pages ∪ tree pages ∪ trash page partition the pool
@@ -1721,6 +2027,9 @@ class ContinuousEngine(MegaDispatch):
                         f"tree node page {node.page}: refcount "
                         f"{node.refcount} != {live} live slot references"
                     )
+        if self.tier is not None:
+            problems += [f"tier: {p}" for p in self.tier.audit()]
+            problems += self._audit_tier()
         problems += audit_pool(
             self.pool, self.pool.num_pages, owners, shared=shared,
             reserved=(0,),

@@ -57,6 +57,21 @@ def round_chunk(n: int) -> int:
     return -(-n // 128) * 128
 
 
+def node_chain(node: "RadixNode") -> list[int]:
+    """The full token chain from the root through ``node``'s own chunk
+    — the identity a spilled page is keyed by in the durable KV tier
+    (``models/kv_tier.py::chain_digest``). Walks parent links, so it
+    must run BEFORE eviction detaches the node."""
+    chunks = []
+    while node is not None and node.chunk:
+        chunks.append(node.chunk)
+        node = node.parent
+    out: list[int] = []
+    for c in reversed(chunks):
+        out.extend(c)
+    return out
+
+
 class RadixNode:
     """One cached page: ``chunk`` is the exact token ids it holds."""
 
@@ -109,6 +124,13 @@ class PrefixCache:
         self.page_size = page_size
         self.root = RadixNode((), -1, None)
         self._clock = 0
+        # Durable KV tier hook (docs/serving.md "Tiered KV"): when set
+        # (``ContinuousEngine`` installs ``_spill_page``), eviction
+        # offers every full victim page — ``spill_fn(chain, page_id)``
+        # — BEFORE releasing it, so "evicted" means "demoted to
+        # host-RAM/disk" instead of "gone". Best-effort by contract: a
+        # spill failure falls back to the pre-tier drop.
+        self.spill_fn = None
         PrefixCache._live.add(self)
         self.node_count = 0  # == pages held by the tree
         self.stats = {
@@ -330,6 +352,17 @@ class PrefixCache:
             if (victim.parent is None or victim.children
                     or victim.refcount):
                 continue  # stale heap entry
+            if (self.spill_fn is not None
+                    and len(victim.chunk) == self.page_size):
+                # Export the victim to the durable tier (full pages
+                # only: fault-back re-maps whole tree pages; a partial
+                # tail is one COW/suffix-prefill away and not worth an
+                # entry). The chain must be read before the detach
+                # below severs the parent links.
+                try:
+                    self.spill_fn(node_chain(victim), victim.page)
+                except Exception:  # noqa: BLE001 — spill is best-effort
+                    pass  # fall back to the pre-tier drop
             parent = victim.parent
             del parent.children[victim.chunk[0]]
             victim.parent = None

@@ -104,4 +104,19 @@ STAT_METRICS = {
     "a2a_dropped": ("tdt_moe_a2a_dropped_total",
                     "EP all-to-all assignments dropped (capacity-mode "
                     "overflow; 0 on the lossless serving paths)."),
+    # Durable KV tier (docs/serving.md "Tiered KV"): radix evictions
+    # spilled to host-RAM/disk instead of dropped, and admissions whose
+    # prefix coverage was extended by faulting those pages back —
+    # cheaper than re-prefilling them.
+    "tier_spilled_pages": ("tdt_tier_spilled_pages_total",
+                           "Evicted radix pages exported to the KV "
+                           "tier instead of dropped."),
+    "tier_hits": ("tdt_tier_hits_total",
+                  "Admissions whose prefix coverage was extended by "
+                  "the KV tier (≥1 page faulted back)."),
+    "tier_faults": ("tdt_tier_faulted_pages_total",
+                    "Pages faulted back from the KV tier into HBM "
+                    "(written via write_page, mapped as tree pages)."),
+    "tier_bytes": ("tdt_tier_bytes_faulted_total",
+                   "Payload bytes faulted back from the KV tier."),
 }

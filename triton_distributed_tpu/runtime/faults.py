@@ -43,6 +43,17 @@ Seams currently instrumented (grep for ``fault_point``/``mutate_point``):
 ``migrate.import`` ``models/slot_state.py::import_slot`` — a snapshot
                    import dies before pages are claimed (the engine
                    falls back to replay-from-prompt)
+``tier.put``       ``models/kv_tier.py::PageStore.put`` — mutate-style
+                   (one hit counter, wire-seam pattern): a spill /
+                   snapshot persist refuses (raising mutate: the entry
+                   is simply not stored, the page drops as pre-tier),
+                   stalls, or is corrupted in flight (the checksum
+                   catches it at the next ``get``)
+``tier.get``       ``PageStore.get`` — mutate-style: a fault-back read
+                   refuses (treated as a transient miss, the request
+                   re-prefills/replays), stalls, or is corrupted (the
+                   integrity check drops the entry and degrades —
+                   wrong bits can never come out)
 =================  =====================================================
 
 The ``wire.*``/``proc.*`` seams live on the *router-process* side of
@@ -252,6 +263,64 @@ class FaultPlan:
         so killing one attempt only delays the handoff."""
         kw = {"at": at} if at else {"every": 1}
         return self.on("migrate.export", times=times, **kw)
+
+    # Tier seams (docs/serving.md "Tiered KV"). Like the wire seams,
+    # refuse/corrupt/slow all ride ONE mutate-style seam per direction
+    # (``tier.put``/``tier.get``), so they share a single deterministic
+    # hit counter: refuse is a raising mutate, slow a sleeping one.
+
+    def refuse_tier(self, op: str = "put", at: int = 0,
+                    times: int = 1, **match) -> "FaultPlan":
+        """The Nth ``tier.put``/``tier.get`` refuses: a refused put
+        drops the spill exactly like the pre-tier eviction, a refused
+        get reads as a transient miss (the entry survives) — both
+        degrade to re-prefill/replay, never corrupt. ``at=0`` fires on
+        every matching hit up to ``times``; narrow with
+        ``kind=``/``key=``."""
+        if op not in ("put", "get"):
+            raise ValueError(f"op must be 'put' or 'get', got {op!r}")
+
+        def _refuse(_value, _ctx):
+            raise FaultError(f"tier.{op}", "tier refused (injected)")
+
+        kw = {"at": at} if at else {"every": 1}
+        return self.on(f"tier.{op}", times=times, mutate=_refuse,
+                       **kw, **match)
+
+    def corrupt_tier(self, op: str = "get", at: int = 0,
+                     times: int = 1, **match) -> "FaultPlan":
+        """The Nth matching tier entry's bytes are corrupted in flight
+        (a middle byte flipped — the CRC can never validate it):
+        exercises the integrity-drop path, proving a bad entry yields
+        a degraded re-prefill and NEVER wrong KV bits."""
+        if op not in ("put", "get"):
+            raise ValueError(f"op must be 'put' or 'get', got {op!r}")
+
+        def _flip(value, _ctx):
+            b = bytearray(bytes(value))
+            if b:
+                b[len(b) // 2] ^= 0xFF
+            return bytes(b)
+
+        kw = {"at": at} if at else {"every": 1}
+        return self.on(f"tier.{op}", times=times, mutate=_flip,
+                       **kw, **match)
+
+    def slow_tier(self, delay: float, op: str = "get", at: int = 0,
+                  times: int = 1, **match) -> "FaultPlan":
+        """The Nth matching tier access stalls ``delay`` seconds (a
+        cold disk / contended host) before proceeding normally (a
+        sleeping mutate, so it shares the seam's one hit counter)."""
+        if op not in ("put", "get"):
+            raise ValueError(f"op must be 'put' or 'get', got {op!r}")
+
+        def _stall(value, _ctx):
+            time.sleep(delay)
+            return value
+
+        kw = {"at": at} if at else {"every": 1}
+        return self.on(f"tier.{op}", times=times, mutate=_stall,
+                       **kw, **match)
 
     def fail_import(self, at: int = 1, times: int = 1) -> "FaultPlan":
         """Nth snapshot import raises mid-migration (the target end

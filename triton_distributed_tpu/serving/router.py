@@ -180,6 +180,18 @@ class Router:
         come back in submission order."""
         tickets = [Ticket.of(r) for r in requests]
         for t in tickets:
+            if t.snapshot is None and self.snapshot_provider is not None:
+                # Dispatch-time consult (docs/scale-out.md "Durable
+                # snapshots"): a FRESH ticket can still have recovery
+                # state — a supervisor restarted over its resume store
+                # matches re-submitted requests by (prompt, gen_len)
+                # digest, since the pre-crash ticket ids are gone. The
+                # provider answers None for everything else, so the
+                # common path costs one call.
+                try:
+                    t.snapshot = self.snapshot_provider(t)
+                except Exception:  # noqa: BLE001 — recovery is best-effort
+                    t.snapshot = None
             self._dispatch(t)
         outs = [self._await(t) for t in tickets]
         if results:

@@ -124,6 +124,22 @@ def main(argv=None) -> int:
                    "every N scheduling rounds (0 = off) — the "
                    "export_slots verb's crash-recovery feed "
                    "(docs/scale-out.md 'Slot migration & handoff')")
+    p.add_argument("--tier-bytes", type=int, default=0,
+                   help="host-RAM durable KV tier capacity in bytes "
+                   "per engine (0 = off): evicted radix pages spill "
+                   "to the tier and fault back on digest match, "
+                   "cheaper than re-prefill (docs/serving.md 'Tiered "
+                   "KV'); applies to --continuous/--replicas engines "
+                   "and is inherited by --fleet children")
+    p.add_argument("--tier-dir", default=None, metavar="DIR",
+                   help="disk tier directory (write-through, atomic "
+                   "rename, checksummed entries): spilled pages AND "
+                   "the snapshot buffer survive a process restart. "
+                   "With --replicas/--fleet each engine gets DIR/r<i>; "
+                   "with --fleet the supervisor also persists pulled "
+                   "snapshots under DIR/resume, so ONE flag boots a "
+                   "restart-safe fleet (docs/scale-out.md 'Durable "
+                   "snapshots')")
     p.add_argument("--snapshot-s", type=float, default=0.0,
                    help="with --fleet: supervisor snapshot-pull period "
                    "in seconds (0 = off) — failed replicas' requests "
@@ -177,6 +193,23 @@ def main(argv=None) -> int:
             "per-step dispatch (docs/megakernel.md 'Serving fast "
             "path'). Drop --speculative or use --mode xla/pallas."
         )
+    if (args.tier_bytes or args.tier_dir) and args.fleet == 0 and (
+            args.model == "stub"
+            or not (args.replicas or args.continuous)):
+        # Same fail-fast convention: the fixed-batch Engine (and the
+        # single stub server) has no tier — silently ignoring the
+        # flags would leave an operator believing restart-safety is on.
+        p.error(
+            "--tier-bytes/--tier-dir ride the continuous serving "
+            "stack only (docs/serving.md 'Tiered KV'): add "
+            "--continuous, --replicas N, or --fleet N."
+        )
+    if args.tier_bytes and args.fleet > 0 and args.model == "stub":
+        p.error(
+            "--tier-bytes does nothing on a stub fleet (stub children "
+            "have no KV tier); --tier-dir still arms the supervisor's "
+            "durable resume store, or use a real --model."
+        )
 
     from triton_distributed_tpu.serving.server import ModelServer
 
@@ -212,20 +245,41 @@ def main(argv=None) -> int:
                 child += ["--kv-dtype", args.kv_dtype]
             if args.speculative:
                 child += ["--speculative", str(args.speculative)]
-            if args.snapshot_every:
-                child += ["--snapshot-every", str(args.snapshot_every)]
+            # --tier-dir promises a restart-safe fleet from one flag:
+            # children must actually EXPORT snapshots for the
+            # supervisor's resume store to hold anything (the
+            # supervisor derives its pull cadence from resume_dir the
+            # same way). An explicit --snapshot-every still wins.
+            snap_every = args.snapshot_every or (8 if args.tier_dir else 0)
+            if snap_every:
+                child += ["--snapshot-every", str(snap_every)]
             if args.num_experts:
                 child += ["--num-experts", str(args.num_experts)]
             if args.top_k:
                 child += ["--top-k", str(args.top_k)]
             if args.moe_intermediate:
                 child += ["--moe-intermediate", str(args.moe_intermediate)]
-            specs = [
-                ReplicaSpec(f"r{i}", list(child))
-                for i in range(args.fleet)
-            ]
+            if args.tier_bytes:
+                child += ["--tier-bytes", str(args.tier_bytes)]
+            specs = []
+            for i in range(args.fleet):
+                argv_i = list(child)
+                if args.tier_dir:
+                    # Per-child tier dirs: one disk tier per engine
+                    # (digest-keyed entries would be content-identical
+                    # across children, but per-child dirs keep snapshot
+                    # buffers and byte accounting disjoint).
+                    argv_i += [
+                        "--tier-dir", os.path.join(args.tier_dir, f"r{i}")
+                    ]
+                specs.append(ReplicaSpec(f"r{i}", argv_i))
         sup = FleetSupervisor(
             specs, policy=args.policy, snapshot_s=args.snapshot_s,
+            # --tier-dir makes the FLEET restart-safe too: pulled
+            # snapshots persist under DIR/resume and a restarted
+            # supervisor resumes re-submitted requests from them.
+            resume_dir=(os.path.join(args.tier_dir, "resume")
+                        if args.tier_dir else None),
             router_kw={
                 "drain_grace_s": args.drain_grace,
                 "request_timeout_s": args.request_timeout or None,
@@ -286,8 +340,11 @@ def main(argv=None) -> int:
                 kv_dtype=args.kv_dtype, speculative=args.speculative,
                 kernel_trace=kernel_trace,
                 snapshot_every=args.snapshot_every,
+                tier_bytes=args.tier_bytes,
+                tier_dir=(os.path.join(args.tier_dir, f"r{i}")
+                          if args.tier_dir else None),
             )
-            for _ in range(args.replicas)
+            for i in range(args.replicas)
         ]
         engine = Router(
             engines, policy=args.policy, drain_grace_s=args.drain_grace,
@@ -306,6 +363,7 @@ def main(argv=None) -> int:
             kv_dtype=args.kv_dtype, speculative=args.speculative,
             kernel_trace=kernel_trace,
             snapshot_every=args.snapshot_every,
+            tier_bytes=args.tier_bytes, tier_dir=args.tier_dir,
         )
         what = f"{args.model} (continuous, tp={args.tp})"
     else:

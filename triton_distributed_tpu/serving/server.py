@@ -238,6 +238,18 @@ class ModelServer:
                 engine_cfg, "num_experts_per_tok", 0
             ),
         }
+        # Durable KV tier (docs/serving.md "Tiered KV"): the deployed
+        # capacity/dir, next to kv_dtype — 0/None when no tier is
+        # attached (or when a Router fronts per-replica tiers, whose
+        # details ride the stats verb's per-replica snapshots).
+        tier = getattr(self.engine, "tier", None)
+        stats["engine"]["tier_bytes"] = (
+            int(getattr(tier, "capacity_bytes", 0)) if tier is not None
+            else 0
+        )
+        stats["engine"]["tier_dir"] = (
+            getattr(tier, "dir", None) if tier is not None else None
+        )
         # --trace DIR deployments (run_server) surface where the
         # merged host+device timeline will land.
         stats["trace_dir"] = self.trace_dir

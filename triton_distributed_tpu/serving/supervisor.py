@@ -212,6 +212,7 @@ class FleetSupervisor:
         log_dir: str | None = None,
         router_kw: dict | None = None,
         snapshot_s: float = 0.0,
+        resume_dir: str | None = None,
     ):
         if not specs:
             raise ValueError("FleetSupervisor needs at least one spec")
@@ -242,9 +243,50 @@ class FleetSupervisor:
         # snapshot degrades to replay on the target — never worse
         # than PR 9's recovery.
         self.snapshot_s = float(snapshot_s)
+        if resume_dir and not self.snapshot_s:
+            # A resume store without a pull cadence never persists
+            # anything — "restart-safe fleet from one flag" would be a
+            # lie (the store only ever REPLAYED pre-existing
+            # leftovers). Durability implies pulling; an explicit
+            # snapshot_s still wins.
+            self.snapshot_s = 1.0
         self._snaps: dict[str, dict] = {}  # slot name → {tid: snap}
         self._snap_lock = threading.Lock()  # monitor vs reroute threads
         self._next_snap_t = 0.0
+        # Durable snapshot store (docs/scale-out.md "Durable
+        # snapshots"): with ``resume_dir`` set, every pulled snapshot
+        # is ALSO persisted to a disk-backed PageStore (atomic
+        # write-then-rename, per-entry checksum — models/kv_tier.py),
+        # and a fresh supervisor booting over the same dir loads the
+        # crash leftovers: a re-submitted request whose (prompt,
+        # gen_len) digest matches a leftover resumes mid-generation
+        # instead of replaying — the supervisor-restart case a
+        # process-memory-only buffer forfeits. Integrity failures drop
+        # the entry (the request replays: degraded, never wrong), and
+        # a CLEAN shutdown clears the store — leftovers mean a crash.
+        self.resume_dir = resume_dir
+        self._store = None
+        self._store_keys: dict[str, set] = {}  # slot name → persisted tids
+        self._resume: dict[str, tuple[str, dict]] = {}  # digest → (tid, snap)
+        if resume_dir:
+            from triton_distributed_tpu.models.kv_tier import (
+                SNAP_KIND,
+                PageStore,
+                request_digest,
+            )
+
+            self._store = PageStore(dir=resume_dir)
+            for tid in self._store.keys(SNAP_KIND):
+                snap = self._store.get(SNAP_KIND, tid)  # checksum-verified
+                if not isinstance(snap, dict) or not snap.get("out"):
+                    continue
+                try:
+                    digest = request_digest(
+                        snap["prompt"], snap["gen_len"]
+                    )
+                except (KeyError, TypeError, ValueError):
+                    continue
+                self._resume[digest] = (tid, snap)
         self.log_dir = log_dir or tempfile.mkdtemp(prefix="tdt-fleet-")
         self._router_kw = dict(router_kw or {})
         self._router_kw.setdefault("policy", policy)
@@ -277,6 +319,14 @@ class FleetSupervisor:
             "tdt_supervisor_snapshot_resumes_total",
             "Orphaned tickets re-dispatched WITH a crash-recovery "
             "snapshot (vs plain replay), by slot.",
+            labels=("replica",),
+        )
+        self._m_pull_failures = obs_metrics.counter(
+            "tdt_supervisor_snapshot_pull_failures_total",
+            "Snapshot pulls (export_slots) that failed, by slot — a "
+            "permanently wedged exporter shows as a monotone ramp "
+            "here instead of silently degrading every recovery to "
+            "replay.",
             labels=("replica",),
         )
 
@@ -322,10 +372,13 @@ class FleetSupervisor:
             replicas, replica_max_pending=self.replica_max_pending,
             **self._router_kw,
         )
-        if self.snapshot_s:
+        if self.snapshot_s or self._store is not None:
             # Crash recovery consults the snapshot store on EVERY
             # re-route claim — wire-detected deaths included, which
-            # never pass through this supervisor's _fail.
+            # never pass through this supervisor's _fail — and (via
+            # the router's dispatch-time consult) on every FRESH
+            # ticket, which is how a restart-leftover snapshot finds
+            # its re-submitted request.
             self.router.snapshot_provider = self._snapshot_for
         self._thread = threading.Thread(
             target=self._monitor, daemon=True, name="fleet-supervisor",
@@ -336,7 +389,12 @@ class FleetSupervisor:
     def shutdown(self) -> None:
         """Stop monitoring, drain the router (remote drains ask each
         child to shut down), then reap every child — SIGKILLing any
-        that outlive the drain grace. Idempotent."""
+        that outlive the drain grace. Idempotent. A clean shutdown
+        CLEARS the durable resume store: requests in flight completed
+        or failed structurally through the drain, so leftovers would
+        only ever mis-resume a future unrelated request — the store's
+        contract is "an entry means a crash" (docs/scale-out.md
+        "Durable snapshots")."""
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=30)
@@ -353,6 +411,13 @@ class FleetSupervisor:
                 except subprocess.TimeoutExpired:
                     proc.kill()
                     proc.wait(timeout=10)
+            if self._store is not None:
+                from triton_distributed_tpu.models.kv_tier import SNAP_KIND
+
+                self._store.clear(SNAP_KIND)
+                with self._snap_lock:
+                    self._resume.clear()
+                self._store_keys.clear()
 
     # -- sync hooks (tests, bench) -----------------------------------------
 
@@ -476,11 +541,50 @@ class FleetSupervisor:
                 continue
             try:
                 snaps = exporter(timeout=self.heartbeat_timeout_s)
-            except Exception:  # noqa: BLE001 — best-effort feed
+                if not isinstance(snaps, dict):
+                    raise TypeError(
+                        f"export_slots answered {type(snaps).__name__}"
+                    )
+            except Exception as e:  # noqa: BLE001 — best-effort feed,
+                # but VISIBLY so: a permanently wedged exporter would
+                # otherwise silently downgrade every recovery to
+                # replay with nothing on any dashboard.
+                self._m_pull_failures.inc(replica=slot.spec.name)
+                obs_events.emit(
+                    "snapshot_pull_failed", slot=slot.spec.name,
+                    replica=rep.name,
+                    reason=f"{type(e).__name__}: {str(e)[:160]}",
+                )
                 continue
-            if isinstance(snaps, dict):
-                with self._snap_lock:
-                    self._snaps[slot.spec.name] = snaps
+            with self._snap_lock:
+                self._snaps[slot.spec.name] = snaps
+            self._persist_snaps(slot.spec.name, snaps)
+
+    def _persist_snaps(self, slot_name: str, snaps: dict) -> None:
+        """Write-through one slot's pulled snapshots to the durable
+        resume store (no-op without ``resume_dir``); entries whose
+        ticket finished since the last pull are deleted — the store
+        mirrors the child's live buffer, so restart leftovers are
+        exactly the in-flight set at the moment of death."""
+        if self._store is None:
+            return
+        from triton_distributed_tpu.models.kv_tier import SNAP_KIND
+
+        prev = self._store_keys.get(slot_name, set())
+        for tid, snap in snaps.items():
+            if isinstance(snap, dict):
+                self._store.put(SNAP_KIND, tid, snap)
+        self._store_keys[slot_name] = set(snaps)
+        for tid in prev - set(snaps):
+            # "Finished" from THIS slot's view — but a ticket that
+            # MIGRATED carries its id to another slot, and deleting
+            # here would remove the live copy that slot just
+            # persisted. Only prune ids no slot claims. (Runs on the
+            # monitor thread only, like every _store_keys access.)
+            if any(tid in keys for s, keys in self._store_keys.items()
+                   if s != slot_name):
+                continue
+            self._store.delete(SNAP_KIND, tid)
 
     def _snapshot_for(self, ticket) -> dict | None:
         """Router snapshot-provider hook (``Router.snapshot_provider``):
@@ -496,6 +600,31 @@ class FleetSupervisor:
                     "snapshot_resume", slot=name, ticket=ticket.tid,
                     tokens=(len(snap.get("out") or [])
                             if isinstance(snap, dict) else 0),
+                )
+                return snap
+        # Restart resume (docs/scale-out.md "Durable snapshots"):
+        # ticket ids do not survive a supervisor restart, so leftovers
+        # loaded from ``resume_dir`` match by (prompt, gen_len) digest
+        # instead. Popped on use — a snapshot resumes exactly one
+        # re-submitted request; the target validates it (prompt
+        # equality / geometry) and degrades to replay if stale.
+        if self._resume:
+            from triton_distributed_tpu.models.kv_tier import (
+                SNAP_KIND,
+                request_digest,
+            )
+
+            digest = request_digest(ticket.prompt, ticket.gen_len)
+            with self._snap_lock:
+                entry = self._resume.pop(digest, None)
+            if entry is not None:
+                tid, snap = entry
+                if self._store is not None:
+                    self._store.delete(SNAP_KIND, tid)
+                self._m_resumes.inc(replica="resume")
+                obs_events.emit(
+                    "snapshot_resume", slot="resume", ticket=ticket.tid,
+                    tokens=len(snap.get("out") or []), restart=True,
                 )
                 return snap
         return None
