@@ -1,0 +1,319 @@
+"""SLO goodput yardstick → perf/GOODPUT.json.
+
+The capstone artifact of the observability layer (ROADMAP item 5,
+docs/observability.md "SLO goodput"): drive production-shaped traffic
+(``perf/loadgen.py``) through the STREAMING wire against a live
+server and report what a user would see —
+
+- p50/p99 TTFT and TPOT, **stamped wire-side** (the server stamps
+  every token frame at its socket write; this bench adds no client
+  clock of its own);
+- **goodput-vs-arrival-rate** curves (≥3 rates) for a single engine
+  AND a supervised process fleet (``--fleet`` arm: 2 stub children
+  under ``FleetSupervisor`` behind one front server);
+- a **cancellation arm**: a fraction of requests cancel mid-stream;
+  reported are the tokens NOT generated (work the teardown saved) and
+  a clean pool audit (pages actually came home).
+
+Gates asserted BEFORE any number is recorded (repo convention —
+perf artifacts carry only verified numbers):
+
+- every completed streamed request's tokens are IDENTICAL to the
+  stub's pure reference generator AND to a non-streaming request for
+  the same prompt (the streaming path changes transport, never
+  tokens);
+- the post-run pool/radix audit is clean in every arm.
+
+The engine is ``models/stub.py`` (real radix/pool control plane, pure
+hash "model", seeded wall-time floor) so the bench is CPU-runnable and
+deterministic in its token outputs; the latency numbers are
+host-advisory (this is a shared CPU container), but the RELATIVE
+goodput-vs-rate shape and the wire-side measurement machinery are what
+the artifact certifies. Run on real hardware with a real model by
+swapping the server launch for ``run_server`` — the driver is
+transport-only.
+
+Usage:
+    python perf/goodput_bench.py [--out perf/GOODPUT.json] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from perf.loadgen import LoadSpec, generate_trace, replay  # noqa: E402
+
+
+def _pct(vals, q):
+    vals = [v for v in vals if v is not None]
+    if not vals:
+        return None
+    return float(np.percentile(np.asarray(vals, np.float64), q))
+
+
+def _run_rate(host, port, spec: LoadSpec) -> dict:
+    """One arrival-rate point: replay the trace, judge client-visible
+    outcomes, collect wire-side latencies."""
+    from triton_distributed_tpu.models.stub import stub_generate
+
+    trace = generate_trace(spec)
+    records = replay(trace, host, port)
+    met = missed = cancelled = errors = 0
+    ttfts, tpots, e2es = [], [], []
+    tokens_not_generated = 0
+    for row, rec in zip(trace, records):
+        if rec.get("error"):
+            errors += 1
+            missed += 1  # the user got nothing: a miss, not a skip
+            continue
+        wire = rec.get("wire") or {}
+        outcome = wire.get("outcome")
+        if outcome == "cancelled":
+            # The cancellation arm's ledger: tokens the teardown
+            # saved. Classified by the SERVER's outcome, not the
+            # trace's intent — a cancel that lost the race to a fast
+            # completion falls through to the ordinary met/missed +
+            # identity gate below (its tokens are all there).
+            cancelled += 1
+            tokens_not_generated += row["gen_len"] - len(rec["tokens"])
+            continue
+        # GATE: streamed tokens == the pure reference generator.
+        gold = stub_generate(row["prompt"], row["gen_len"])
+        assert rec["tokens"] == gold, (
+            f"streamed tokens diverged from reference for request "
+            f"{row['i']}: {rec['tokens']} != {gold}"
+        )
+        if outcome == "met":
+            met += 1
+        else:
+            missed += 1
+        ttfts.append(wire.get("ttft_s"))
+        tpots.append(wire.get("tpot_s"))
+        e2es.append(wire.get("e2e_s"))
+    judged = met + missed
+    return {
+        "rate_rps": spec.rate,
+        "process": spec.process,
+        "n_requests": spec.n_requests,
+        "met": met,
+        "missed": missed,
+        "errors": errors,
+        "cancelled": cancelled,
+        "tokens_not_generated": tokens_not_generated,
+        "goodput": (met / judged) if judged else None,
+        "ttft_p50_s": _pct(ttfts, 50),
+        "ttft_p99_s": _pct(ttfts, 99),
+        "tpot_p50_s": _pct(tpots, 50),
+        "tpot_p99_s": _pct(tpots, 99),
+        "e2e_p99_s": _pct(e2es, 99),
+    }
+
+
+def _assert_stream_matches_nonstream(host, port, spec: LoadSpec) -> int:
+    """GATE: for every unique prompt of a trace, a non-streaming
+    request returns the exact token sequence streaming delivered
+    (both equal the reference generator, checked independently)."""
+    from triton_distributed_tpu.models.stub import stub_generate
+    from triton_distributed_tpu.serving.server import (
+        request,
+        request_stream,
+    )
+
+    trace = generate_trace(spec)
+    seen = set()
+    checked = 0
+    for row in trace:
+        key = (tuple(row["prompt"]), row["gen_len"])
+        if key in seen:
+            continue
+        seen.add(key)
+        payload = {"requests": [row["prompt"]],
+                   "gen_lens": [row["gen_len"]]}
+        streamed = []
+        for fr in request_stream(host, port, dict(payload)):
+            if fr.get("frame") == "token":
+                streamed.append(fr["token"])
+            else:
+                summary = fr
+        plain = request(host, port, payload)
+        gold = stub_generate(row["prompt"], row["gen_len"])
+        assert streamed == summary["outputs"][0] == plain["outputs"][0] \
+            == gold, f"stream/non-stream divergence on request {row['i']}"
+        checked += 1
+    return checked
+
+
+def _single_arm(args, slo_spec, rates) -> dict:
+    from triton_distributed_tpu.models.stub import StubEngine
+    from triton_distributed_tpu.obs import metrics as obs_metrics
+    from triton_distributed_tpu.serving.server import ModelServer, request
+
+    eng = StubEngine(num_pages=512, page_size=4,
+                     delay_s=args.stub_delay)
+    server = ModelServer(eng, max_pending=64, slo=slo_spec).start()
+    try:
+        identity_checked = _assert_stream_matches_nonstream(
+            server.host, server.port,
+            LoadSpec(rate=rates[0], n_requests=min(args.n, 12),
+                     seed=args.seed),
+        )
+        curve = []
+        for rate in rates:
+            obs_metrics.default_registry().clear()
+            curve.append(_run_rate(
+                server.host, server.port,
+                LoadSpec(rate=rate, n_requests=args.n, seed=args.seed),
+            ))
+        # Cancellation arm: half the requests hang up mid-stream.
+        obs_metrics.default_registry().clear()
+        cancel = _run_rate(
+            server.host, server.port,
+            LoadSpec(rate=rates[1], n_requests=args.n,
+                     cancel_frac=0.5, cancel_after=2,
+                     seed=args.seed + 1),
+        )
+        slo_view = request(server.host, server.port, {"cmd": "slo"})
+        # GATE: pages came home — teardown freed every cancelled
+        # request's pages (plus the usual partition invariants).
+        problems = eng.audit()
+        assert problems == [], f"single-arm audit: {problems}"
+        assert cancel["cancelled"] > 0, "cancellation arm cancelled nothing"
+        assert cancel["tokens_not_generated"] > 0
+        return {
+            "rates": curve,
+            "cancellation": {
+                **cancel,
+                "audit_clean": True,
+            },
+            "stream_matches_nonstream": True,
+            "identity_prompts_checked": identity_checked,
+            "slo_verb_sample": slo_view["slo"]["classes"],
+        }
+    finally:
+        server.shutdown()
+
+
+def _fleet_arm(args, slo_spec, rates) -> dict:
+    from triton_distributed_tpu.obs import metrics as obs_metrics
+    from triton_distributed_tpu.serving.server import ModelServer, request
+    from triton_distributed_tpu.serving.supervisor import (
+        FleetSupervisor,
+        stub_spec,
+    )
+
+    sup = FleetSupervisor([
+        stub_spec(f"r{i}", delay_s=args.stub_delay, num_pages=512,
+                  page_size=4)
+        for i in range(args.fleet)
+    ])
+    router = sup.start()
+    server = ModelServer(router, max_pending=64, slo=slo_spec).start()
+    try:
+        curve = []
+        for rate in rates:
+            obs_metrics.default_registry().clear()
+            curve.append(_run_rate(
+                server.host, server.port,
+                LoadSpec(rate=rate, n_requests=args.n,
+                         seed=args.seed + 2),
+            ))
+        # One fleet-scope scrape rides the artifact: the merged,
+        # replica-labeled exposition is the fleet observability story
+        # (docs/scale-out.md "Fleet-scope telemetry").
+        fleet_scrape = request(server.host, server.port,
+                               {"cmd": "metrics", "scope": "fleet"})
+        assert fleet_scrape.get("scope") == "fleet"
+        assert 'replica="r0"' in fleet_scrape["prometheus"]
+        problems = request(server.host, server.port,
+                           {"cmd": "audit"})["problems"]
+        assert problems == [], f"fleet-arm audit: {problems}"
+        return {
+            "replicas": args.fleet,
+            "rates": curve,
+            "fleet_scrape_replicas": fleet_scrape["replicas"],
+            "audit_clean": True,
+        }
+    finally:
+        server.shutdown()
+        sup.shutdown()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "GOODPUT.json"))
+    p.add_argument("--n", type=int, default=28,
+                   help="requests per arrival-rate point")
+    p.add_argument("--rates", type=float, nargs="+",
+                   default=[3.0, 8.0, 16.0],
+                   help=">= 3 arrival rates (req/s) to sweep")
+    p.add_argument("--stub-delay", type=float, default=0.12,
+                   help="stub per-batch wall floor (s): sets the "
+                   "service rate the sweep saturates")
+    p.add_argument("--fleet", type=int, default=2,
+                   help="process replicas in the fleet arm (0 skips)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--quick", action="store_true",
+                   help="small n for a smoke run (artifact still "
+                   "valid, noisier)")
+    p.add_argument("--slo-ttft-s", type=float, default=0.5)
+    p.add_argument("--slo-tpot-s", type=float, default=0.10)
+    p.add_argument("--slo-e2e-s", type=float, default=4.0)
+    args = p.parse_args(argv)
+    if args.quick:
+        args.n = min(args.n, 10)
+    if len(args.rates) < 3:
+        p.error("need >= 3 arrival rates for the goodput curve")
+
+    from triton_distributed_tpu.obs.slo import SLOSpec
+
+    slo_spec = SLOSpec("default", ttft_s=args.slo_ttft_s,
+                       tpot_s=args.slo_tpot_s, e2e_s=args.slo_e2e_s)
+
+    t0 = time.time()
+    single = _single_arm(args, slo_spec, args.rates)
+    fleet = (_fleet_arm(args, slo_spec, args.rates)
+             if args.fleet > 0 else None)
+    out = {
+        "bench": "goodput_bench",
+        "method": (
+            "loadgen streaming replay (Poisson arrivals, Zipf "
+            "shared-prefix population, lognormal output lengths) "
+            "against a live wire server; every latency stamped "
+            "WIRE-side by the server's streaming frame writes; "
+            "tokens gated identical to the pure reference generator "
+            "and to non-streaming responses before recording; pool "
+            "audits gated clean. Stub engine: control-plane-real, "
+            "wall-clock advisory on this shared CPU host."
+        ),
+        "slo": slo_spec.as_dict(),
+        "stub_delay_s": args.stub_delay,
+        "n_per_rate": args.n,
+        "single": single,
+        "fleet": fleet,
+        "wall_s": round(time.time() - t0, 2),
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    print(json.dumps(
+        {"out": args.out, "wall_s": out["wall_s"],
+         "single_goodput": [r["goodput"] for r in single["rates"]],
+         "fleet_goodput": (
+             [r["goodput"] for r in fleet["rates"]] if fleet else None
+         )}, indent=2,
+    ))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

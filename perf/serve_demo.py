@@ -25,6 +25,69 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _stream_once(host, port, payload, timeout=1200):
+    """Drive one payload through the streaming wire (docs/serving.md
+    "Streaming & cancellation"), printing tokens AS THEY ARRIVE.
+    Returns (tokens, summary, wall_s); the summary's ``wire`` entries
+    carry the server's wire-side TTFT/TPOT — the numbers a user saw,
+    not an engine latch."""
+    from triton_distributed_tpu.serving.server import request_stream
+
+    t0 = time.time()
+    toks = []
+    summary = None
+    for fr in request_stream(host, port, payload, timeout=timeout):
+        if fr.get("frame") == "token":
+            toks.append(fr["token"])
+            print(fr["token"], end=" ", flush=True)
+        else:
+            summary = fr
+    print(flush=True)
+    return toks, summary, time.time() - t0
+
+
+def _drive_pair(host, port, payload, stream):
+    """The demo's cold + warm request pair (the repeat doubles as the
+    determinism check), ONE implementation for the fleet and
+    single-server paths: streaming prints tokens as they arrive and
+    reports the wire-side numbers. Returns (r1, r2, cold_s, warm_s)
+    with r1/r2 response-shaped (the stream summary carries the same
+    keys)."""
+    from triton_distributed_tpu.serving.server import request
+
+    if stream:
+        toks1, r1, cold_s = _stream_once(host, port, payload)
+        toks2, r2, warm_s = _stream_once(host, port, payload)
+        _print_stream_report(
+            r2, cold_s, warm_s, deterministic=toks1 == toks2
+        )
+        return r1, r2, cold_s, warm_s
+    t1 = time.time()
+    r1 = request(host, port, payload, timeout=1200)
+    cold_s = time.time() - t1
+    t2 = time.time()
+    r2 = request(host, port, payload, timeout=1200)
+    warm_s = time.time() - t2
+    return r1, r2, cold_s, warm_s
+
+
+def _print_stream_report(summary, cold_s, warm_s, deterministic):
+    wire = (summary or {}).get("wire") or [{}]
+    w = wire[0]
+    print(json.dumps({
+        "stream": True,
+        "deterministic": deterministic,
+        "cold_wall_s": round(cold_s, 2),
+        "warm_wall_s": round(warm_s, 2),
+        "wire_ttft_s": w.get("ttft_s"),
+        "wire_tpot_s": w.get("tpot_s"),
+        "wire_e2e_s": w.get("e2e_s"),
+        "slo_outcome": w.get("outcome"),
+        "statuses": [x["status"] for x in (summary or {}).get(
+            "results", [])],
+    }), flush=True)
+
+
 def _fleet_demo(args) -> int:
     """--fleet N: a supervised process fleet (docs/scale-out.md
     "Process fleet") driven through the wire like --replicas — the
@@ -88,12 +151,9 @@ def _fleet_demo(args) -> int:
         assert request(server.host, server.port, {"cmd": "ping"})["ok"]
         prompt = list(range(1, 33))
         payload = {"requests": [prompt], "gen_lens": [args.gen_len]}
-        t1 = time.time()
-        r1 = request(server.host, server.port, payload, timeout=1200)
-        cold_s = time.time() - t1
-        t2 = time.time()
-        r2 = request(server.host, server.port, payload, timeout=1200)
-        warm_s = time.time() - t2
+        r1, r2, cold_s, warm_s = _drive_pair(
+            server.host, server.port, payload, args.stream
+        )
         gen1 = np.asarray(r1["outputs"][0])
         gen2 = np.asarray(r2["outputs"][0])
         router_stats = r2["stats"].get("router", {})
@@ -183,6 +243,14 @@ def main(argv=None) -> int:
                    "--model/--mode/--kv-dtype/--speculative (note: "
                    "children load the NAMED preset — the demo's "
                    "depth-8 trim applies only in-process)")
+    p.add_argument("--stream", action="store_true",
+                   help="drive the generation through the streaming "
+                   "wire ('stream': true): tokens print as they "
+                   "arrive and the report carries WIRE-side TTFT/TPOT "
+                   "from the summary frame (docs/serving.md "
+                   "'Streaming & cancellation'). Without --replicas/"
+                   "--fleet the demo serves a ContinuousEngine (the "
+                   "fixed-batch Engine has no per-token emission).")
     p.add_argument("--request-timeout", type=float, default=0.0,
                    help="with --replicas: router-observed replica "
                    "timeout (seconds; 0 = off — a cold compile must "
@@ -270,6 +338,16 @@ def main(argv=None) -> int:
             )
             for i in range(args.replicas)
         ], request_timeout_s=args.request_timeout or None)
+    elif args.stream:
+        # Streaming needs the continuous 'requests' path (per-token
+        # emission); the fixed-batch Engine has none.
+        from triton_distributed_tpu.models.continuous import ContinuousEngine
+
+        eng = ContinuousEngine(
+            model, max_batch=2, max_length=1024, mode=mode,
+            temperature=0.0, prefix_cache=True, kv_dtype=args.kv_dtype,
+            speculative=args.speculative, kernel_trace=kernel_trace,
+        )
     else:
         eng = Engine(model, temperature=0.0, mode=mode,
                      paged=bool(args.kv_dtype or args.speculative),
@@ -285,7 +363,7 @@ def main(argv=None) -> int:
 
         assert request(server.host, server.port, {"cmd": "ping"})["ok"]
         prompt = list(range(1, 33))
-        if args.replicas > 0:
+        if args.replicas > 0 or args.stream:
             payload = {"requests": [prompt], "gen_lens": [args.gen_len]}
         else:
             payload = {"input_ids": [prompt], "gen_len": args.gen_len}
@@ -298,12 +376,9 @@ def main(argv=None) -> int:
                 stack.enter_context(group_profile(
                     "serve_demo", out_dir=args.trace, merge=False
                 ))
-            t1 = time.time()
-            r1 = request(server.host, server.port, payload, timeout=1200)
-            cold_s = time.time() - t1
-            t2 = time.time()
-            r2 = request(server.host, server.port, payload, timeout=1200)
-            warm_s = time.time() - t2
+            r1, r2, cold_s, warm_s = _drive_pair(
+                server.host, server.port, payload, args.stream
+            )
         if args.trace:
             # ONE merged timeline: host trace_spans + (mega) the device
             # task tracer's per-task rows, tagged with request trace
@@ -320,7 +395,7 @@ def main(argv=None) -> int:
                 "merged_trace": merged,
                 "traced_mega_launches": len(launches),
             }), flush=True)
-        if args.replicas > 0:
+        if args.replicas > 0 or args.stream:
             gen1 = np.asarray(r1["outputs"][0])
             gen2 = np.asarray(r2["outputs"][0])
             router = r2["stats"].get("router", {})

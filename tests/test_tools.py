@@ -353,6 +353,31 @@ def test_kernel_trace_modules_compile():
     )
 
 
+def test_goodput_modules_compile():
+    """ISSUE-13: the SLO-goodput yardstick's modules must byte-compile
+    — obs/slo.py is imported by the server (a syntax error takes the
+    wire down at import time), and the CPU-runnable load generator +
+    goodput bench that write perf/GOODPUT.json ride along (repo
+    convention: perf harnesses fail tier-1, not a relay window)."""
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    targets = [
+        os.path.join(root, "triton_distributed_tpu", "obs", "slo.py"),
+        os.path.join(root, "perf", "loadgen.py"),
+        os.path.join(root, "perf", "goodput_bench.py"),
+    ]
+    proc = subprocess.run(
+        [sys.executable, "-m", "compileall", "-q", "-f", *targets],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, (
+        f"goodput modules failed to compile:\n{proc.stdout}\n{proc.stderr}"
+    )
+
+
 def test_tier1_marker_audit():
     """ISSUE 8 satellite: the tier-1 window is spent by conftest's
     ``_FILE_ORDER`` schedule — audit it against reality so new trace
@@ -370,6 +395,18 @@ def test_tier1_marker_audit():
               if f.startswith("test_") and f.endswith(".py")}
     stale = [f for f in conftest._FILE_ORDER if f not in actual]
     assert not stale, f"conftest._FILE_ORDER lists missing files: {stale}"
+
+    def fast_tests(fname):
+        """Non-slow test function names of one suite file — THE fast-
+        test detector every per-suite audit below shares (a fix to
+        the decorator check must not need N coordinated edits)."""
+        src = open(os.path.join(tests_dir, fname)).read()
+        return [
+            n.name for n in ast.walk(ast.parse(src))
+            if isinstance(n, ast.FunctionDef)
+            and n.name.startswith("test_")
+            and not any("slow" in ast.dump(d) for d in n.decorator_list)
+        ]
     # The trace suite is explicitly scheduled (not just rank -1) and
     # sits before the interpret-heavy tail.
     order = conftest._FILE_ORDER
@@ -391,13 +428,7 @@ def test_tier1_marker_audit():
     assert (order.index("test_fleet.py")
             < order.index("test_migration.py")
             < order.index("test_serving.py"))
-    mig_src = open(os.path.join(tests_dir, "test_migration.py")).read()
-    mig_tree = ast.parse(mig_src)
-    mig_fast = [
-        n.name for n in ast.walk(mig_tree)
-        if isinstance(n, ast.FunctionDef) and n.name.startswith("test_")
-        and not any("slow" in ast.dump(d) for d in n.decorator_list)
-    ]
+    mig_fast = fast_tests("test_migration.py")
     assert len(mig_fast) >= 5, (
         f"slot-migration suite has too few tier-1-runnable tests: "
         f"{mig_fast}"
@@ -411,14 +442,21 @@ def test_tier1_marker_audit():
     assert (order.index("test_migration.py")
             < order.index("test_kv_tier.py")
             < order.index("test_serving.py"))
-    tier_src = open(os.path.join(tests_dir, "test_kv_tier.py")).read()
-    tier_fast = [
-        n.name for n in ast.walk(ast.parse(tier_src))
-        if isinstance(n, ast.FunctionDef) and n.name.startswith("test_")
-        and not any("slow" in ast.dump(d) for d in n.decorator_list)
-    ]
+    tier_fast = fast_tests("test_kv_tier.py")
     assert len(tier_fast) >= 5, (
         f"KV-tier suite has too few tier-1-runnable tests: {tier_fast}"
+    )
+    # ISSUE-13: the SLO-goodput suite (streaming wire grammar, cancel
+    # teardown, loadgen determinism, fleet-scope scrape) rides with
+    # the fleet-family suites — streaming/cancel regressions must
+    # FAIL tier-1, not wait for a goodput_bench run.
+    assert "test_goodput.py" in order
+    assert (order.index("test_kv_tier.py")
+            < order.index("test_goodput.py")
+            < order.index("test_serving.py"))
+    gp_fast = fast_tests("test_goodput.py")
+    assert len(gp_fast) >= 5, (
+        f"SLO-goodput suite has too few tier-1-runnable tests: {gp_fast}"
     )
     # ISSUE-11: the MoE serving suite sits with the mega-family suites
     # (after the tracer suite, before the interpret-heavy tail) and
@@ -428,37 +466,17 @@ def test_tier1_marker_audit():
     assert (order.index("test_kernel_trace.py")
             < order.index("test_moe_serving.py")
             < order.index("test_serving.py"))
-    moe_src = open(
-        os.path.join(tests_dir, "test_moe_serving.py")
-    ).read()
-    moe_fast = [
-        n.name for n in ast.walk(ast.parse(moe_src))
-        if isinstance(n, ast.FunctionDef) and n.name.startswith("test_")
-        and not any("slow" in ast.dump(d) for d in n.decorator_list)
-    ]
+    moe_fast = fast_tests("test_moe_serving.py")
     assert len(moe_fast) >= 5, (
         f"MoE serving suite has too few tier-1-runnable tests: "
         f"{moe_fast}"
     )
     # And it contains non-slow tests, so tier-1 (which skips `slow`)
     # actually exercises the tracer.
-    src = open(os.path.join(tests_dir, "test_kernel_trace.py")).read()
-    tree = ast.parse(src)
-
-    def is_slow(node):
-        for dec in node.decorator_list:
-            if "slow" in ast.dump(dec):
-                return True
-        return False
-
-    fast_tests = [
-        n.name for n in ast.walk(tree)
-        if isinstance(n, ast.FunctionDef) and n.name.startswith("test_")
-        and not is_slow(n)
-    ]
-    assert len(fast_tests) >= 5, (
+    kt_fast = fast_tests("test_kernel_trace.py")
+    assert len(kt_fast) >= 5, (
         f"device-tracer suite has too few tier-1-runnable tests: "
-        f"{fast_tests}"
+        f"{kt_fast}"
     )
 
 

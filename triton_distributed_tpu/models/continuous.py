@@ -205,6 +205,7 @@ _FAIL_EVENT_KIND = {
     "overloaded": "shed",
     "deadline_exceeded": "deadline",
     "nan_logits": "nan_guard",
+    "cancelled": "cancel",
 }
 
 
@@ -267,6 +268,14 @@ class Request:
     # the engine key on first sampled draw (greedy requests never pay).
     key: object | None = dataclasses.field(default=None, repr=False)
     key_step: int = 0
+    # Streaming (docs/serving.md "Streaming & cancellation"): called
+    # ``on_token(index, token_id)`` on the engine thread the moment a
+    # token is EMITTED (appended to ``out``) — the server's streaming
+    # path writes one wire frame per call. Tokens RESTORED from a
+    # migration snapshot never fire it (they were already delivered);
+    # the callback must not raise — a broken sink detaches itself
+    # instead of failing the request (see ``_emit_token``).
+    on_token: object | None = dataclasses.field(default=None, repr=False)
 
     @property
     def done(self) -> bool:
@@ -488,6 +497,14 @@ class ContinuousEngine(MegaDispatch):
         self._round = 0
         self._snap_lock = threading.Lock()
         self._snapshots: dict[str, dict] = {}
+        # Client-driven cancellation (docs/serving.md "Streaming &
+        # cancellation"): ticket ids whose requests should tear down
+        # at the next scheduling round. Written from ANY thread via
+        # :meth:`cancel` (the server's cancel verb and the streaming
+        # disconnect path land here mid-batch); consumed on the engine
+        # thread by ``_apply_cancels``.
+        self._cancel_lock = threading.Lock()
+        self._cancelled: set[str] = set()
         self._m_migrations = obs_metrics.counter(
             "tdt_migrations_total",
             "Slots exported for migration, by reason.",
@@ -530,6 +547,7 @@ class ContinuousEngine(MegaDispatch):
             "spec_rollback_tokens": 0,
             # Fault-tolerance ledger (docs/serving.md "Fault tolerance").
             "failed_requests": 0,
+            "cancelled_requests": 0,
             "shed_requests": 0,
             "deadline_expired": 0,
             "nonfinite_logits": 0,
@@ -909,6 +927,7 @@ class ContinuousEngine(MegaDispatch):
                 continue
             for t in slot_tokens(slot):
                 req.out.append(int(t))
+                self._emit_token(req)
                 emitted += 1
                 self._tok[slot] = int(t)
                 if req.spec is not None:
@@ -942,9 +961,14 @@ class ContinuousEngine(MegaDispatch):
     def _fail(self, req: Request, status: str, reason) -> None:
         """Fail ONE request: record the structured error and, if it
         holds a slot, tear that slot down. Everything else keeps
-        serving."""
+        serving. A client-initiated ``cancelled`` rides the same
+        teardown but its own counter — a cancellation is not a server
+        failure."""
         req.status, req.reason = status, str(reason)
-        self._bump("failed_requests")
+        if status == "cancelled":
+            self._bump("cancelled_requests")
+        else:
+            self._bump("failed_requests")
         if status == "deadline_exceeded":
             self._bump("deadline_expired")
         elif status == "overloaded":
@@ -1022,6 +1046,81 @@ class ContinuousEngine(MegaDispatch):
                 self._fail(r, "failed", f"{type(e).__name__}: {e}")
             self._sync_tables()
             return True
+
+    def _emit_token(self, req: Request) -> None:
+        """Streaming hook: hand the just-appended token to the
+        request's ``on_token`` sink. A raising sink detaches itself —
+        a client that vanished mid-stream must never fail the request
+        through its own callback (the server's disconnect path cancels
+        it explicitly instead)."""
+        cb = req.on_token
+        if cb is None:
+            return
+        try:
+            cb(len(req.out) - 1, int(req.out[-1]))
+        except Exception:  # noqa: BLE001 — sink isolation boundary
+            req.on_token = None
+
+    # -- client-driven cancellation (docs/serving.md) ----------------------
+
+    def cancel(self, ticket_ids) -> None:
+        """Request cancellation of the given ticket ids — thread-safe
+        (a set add under its own lock; the server's cancel verb and
+        the streaming disconnect path call this MID-batch). Applied at
+        the next scheduling round: queued requests fail before
+        admission, in-flight slots tear down through the standard
+        crash-safe path with status ``cancelled`` and their partial
+        tokens. Ids matching nothing in the current batch are pruned
+        when the batch ends — a cancel racing a slot's natural finish
+        simply loses (the tokens were already emitted). Ids stay
+        ARMED until consumed or batch-pruned, deliberately: a cancel
+        may legitimately beat its request here. The flip side is the
+        contract that ticket ids are request IDENTITIES — a client
+        that cancels ``job1`` and then submits a NEW request reusing
+        ``job1`` may see the armed cancel apply to it; never reuse
+        ids across requests (docs/serving.md)."""
+        ids = {str(t) for t in ticket_ids}
+        if not ids:
+            return
+        with self._cancel_lock:
+            self._cancelled |= ids
+        obs_events.emit("cancel", requested=len(ids))
+
+    def _apply_cancels(self, queue: deque) -> bool:
+        """Consume pending cancellations against the queue and the
+        active slots. Returns whether slot state changed. The
+        ``engine.cancel`` fault seam sits between the snapshot and the
+        application so chaos tests can sequence a cancel deterministically
+        against a finishing slot."""
+        with self._cancel_lock:
+            if not self._cancelled:
+                return False
+            pending = set(self._cancelled)
+        fault_point("engine.cancel", pending=len(pending))
+        consumed: set[str] = set()
+        changed = False
+        for r in list(queue):
+            if r.ticket_id is not None and r.ticket_id in pending:
+                queue.remove(r)
+                consumed.add(r.ticket_id)
+                self._fail(
+                    r, "cancelled", "cancelled by client before admission"
+                )
+        for req in list(self._slots):
+            if req is None or req.ticket_id is None:
+                continue
+            if req.ticket_id in pending:
+                consumed.add(req.ticket_id)
+                self._fail(
+                    req, "cancelled",
+                    f"cancelled by client after {len(req.out)} generated "
+                    "tokens",
+                )
+                changed = True
+        if consumed:
+            with self._cancel_lock:
+                self._cancelled -= consumed
+        return changed
 
     def _expire_deadlines(self) -> bool:
         """Fail every active request whose wall-clock deadline passed
@@ -1456,6 +1555,7 @@ class ContinuousEngine(MegaDispatch):
                     req.spec.observe(req.prompt)
                     req.spec.observe((int(first),))
                 req.out.append(int(first))
+                self._emit_token(req)
                 # The admission-sampled token is emitted output too —
                 # without this, generated_tokens undercounts by one per
                 # request vs tokens_out and Engine.serve's b*gen_len.
@@ -1744,6 +1844,10 @@ class ContinuousEngine(MegaDispatch):
         queue = deque(r for r in reqs if r.status == "ok")
 
         try:
+            # Cancellations that landed before the batch (the server's
+            # cancel verb is engine-lock-free, so one can beat run()
+            # here) drain their requests before any admission work.
+            self._apply_cancels(queue)
             self._try_admit(queue)
             while True:
                 self._round += 1
@@ -1753,6 +1857,11 @@ class ContinuousEngine(MegaDispatch):
                     # queue back; slots whose export failed keep
                     # decoding and are retried next round.
                     self._handoff_sweep(queue)
+                if self._apply_cancels(queue):
+                    # A cancellation freed a slot AND its pages: same
+                    # admit-now rule as deadline expiry below.
+                    self._sync_tables()
+                    self._try_admit(queue)
                 if self._expire_deadlines():
                     # An expiry freed a slot AND its pages: admit from
                     # the queue NOW — waiting for the next slot-state
@@ -1807,6 +1916,17 @@ class ContinuousEngine(MegaDispatch):
                     )
             if leftover:
                 self._sync_tables()
+            # Cancellations that raced past their request (the slot
+            # finished first, or the id never matched) must not leak
+            # into future batches: prune THIS batch's ids; foreign ids
+            # stay armed for the batch that carries them, bounded so a
+            # client spraying garbage ids can't grow the set forever.
+            batch_ids = {r.ticket_id for r in reqs
+                         if r.ticket_id is not None}
+            with self._cancel_lock:
+                self._cancelled -= batch_ids
+                if len(self._cancelled) > 4096:
+                    self._cancelled.clear()
 
         self.audit(raise_on_violation=True)
         if results:

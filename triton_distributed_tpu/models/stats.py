@@ -70,7 +70,13 @@ STAT_METRICS = {
     "spec_rollback_tokens": ("tdt_engine_spec_rollback_tokens_total",
                              "Draft tokens rolled back after verify."),
     "failed_requests": ("tdt_engine_failed_requests_total",
-                        "Requests finished with a non-ok status."),
+                        "Requests finished with a non-ok status "
+                        "(client cancellations excluded — those count "
+                        "in cancelled_requests)."),
+    "cancelled_requests": ("tdt_engine_cancelled_requests_total",
+                           "Requests torn down by a client "
+                           "cancellation (the cancel verb or a "
+                           "mid-stream disconnect)."),
     "shed_requests": ("tdt_engine_shed_requests_total",
                       "Requests shed by the bounded admission queue."),
     "deadline_expired": ("tdt_engine_deadline_expired_total",

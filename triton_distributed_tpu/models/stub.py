@@ -99,6 +99,14 @@ class StubEngine:
         self._snap_lock = threading.Lock()
         self._snapshots: dict[str, dict] = {}
         self._handoff = threading.Event()
+        # Client-driven cancellation (docs/serving.md "Streaming &
+        # cancellation"): ids land from any thread via :meth:`cancel`
+        # (the cancel verb is engine-lock-free) and are checked at
+        # every token boundary, so a mid-stream cancel tears a stub
+        # request down with its partial tokens exactly like the real
+        # engine.
+        self._cancel_lock = threading.Lock()
+        self._cancelled: set[str] = set()
         self._m_mig_saved = obs_metrics.counter(
             "tdt_migration_tokens_saved_total",
             "Generated tokens restored from a snapshot instead of "
@@ -122,6 +130,7 @@ class StubEngine:
             "migrated_in": 0,
             "migrated_in_tokens": 0,
             "migration_fallbacks": 0,
+            "cancelled_requests": 0,
         }
 
     def _pages_for(self, n_tokens: int) -> int:
@@ -165,6 +174,17 @@ class StubEngine:
         with self._snap_lock:
             self._snapshots = {}
         self._handoff.clear()  # one-shot, like the engine's _handoff_at
+        # Cancels that raced past their request must not leak into
+        # future batches reusing the same ticket id (the
+        # ContinuousEngine's batch-scoped prune + cap, mirrored).
+        batch_tids = {
+            getattr(req, "ticket_id", None) for req, _, _ in parsed
+        }
+        batch_tids.discard(None)
+        with self._cancel_lock:
+            self._cancelled -= batch_tids
+            if len(self._cancelled) > 4096:
+                self._cancelled.clear()
         self.last_stats = stats
         stats["prefix_cache"] = dict(self.prefix.stats)
         stats["prefix_hit_rate"] = self.prefix.hit_rate
@@ -188,6 +208,13 @@ class StubEngine:
         # not re-generated; anything malformed/stale falls back to a
         # full replay from the prompt — the same contract as the real
         # engine's import path.
+        tid = getattr(req, "ticket_id", None)
+        if tid is not None and self._cancel_hit(tid):
+            stats["cancelled_requests"] += 1
+            return RequestResult(
+                np.zeros(0, np.int32), "cancelled",
+                "cancelled by client before admission",
+            )
         out: list[int] = []
         snap = getattr(req, "snapshot", None)
         if snap is not None:
@@ -215,7 +242,6 @@ class StubEngine:
         shared = list(m.nodes)
         self.prefix.finish_cow(m)
         pages = m.pages + new
-        tid = getattr(req, "ticket_id", None)
         # A resumed request's KV is "shipped" (the hash model carries
         # none) — only a cold start pays the prefill.
         stats["prefill_tokens"] += 0 if out else s - matched
@@ -225,16 +251,30 @@ class StubEngine:
         # export (the engine's prefill_only contract). Never re-armed
         # on a resumed request — its prefill already happened.
         prefill_only = bool(getattr(req, "prefill_only", False)) and not out
+        on_token = getattr(req, "on_token", None)
         migrated = None
+        cancelled = False
         while len(out) < gen_len:
             if sleep:
                 time.sleep(sleep)
             if self._handoff.is_set():
                 migrated = "drain"
                 break
+            if tid is not None and self._cancel_hit(tid):
+                cancelled = True
+                break
             nxt = stub_next_token(ctx, self.vocab)
             out.append(nxt)
             ctx.append(nxt)
+            if on_token is not None:
+                # Streaming hook (docs/serving.md "Streaming &
+                # cancellation"): the ContinuousEngine contract —
+                # restored tokens never re-fire, a raising sink
+                # detaches instead of failing the request.
+                try:
+                    on_token(len(out) - 1, int(nxt))
+                except Exception:  # noqa: BLE001 — sink isolation
+                    on_token = None
             stats["generated_tokens"] += 1
             stats["decode_steps"] += 1
             if tid is not None:
@@ -245,6 +285,19 @@ class StubEngine:
             if prefill_only and len(out) < gen_len:
                 migrated = "prefill_handoff"
                 break
+        if cancelled:
+            # Mid-stream cancel: partial tokens back to the caller,
+            # every page back to the pool (nothing retires — a
+            # cancelled chain must not poison later matches any more
+            # than a failed one would).
+            for node in shared:
+                self.prefix.release_node(node)
+            self.pool.release(pages[len(shared):])
+            stats["cancelled_requests"] += 1
+            return RequestResult(
+                np.asarray(out, np.int32), "cancelled",
+                f"cancelled by client after {len(out)} generated tokens",
+            )
         if migrated:
             # Mid-request handoff: export the progress, release the
             # pages (nothing retires — the tree only caches completed
@@ -291,6 +344,25 @@ class StubEngine:
         return out
 
     # -- replica/server surface -------------------------------------------
+
+    def cancel(self, ticket_ids) -> None:
+        """Arm cancellation for the given ticket ids — thread-safe;
+        the server's engine-lock-free cancel verb calls this mid-batch
+        and the in-flight request stops at its next token boundary
+        (the ContinuousEngine contract, docs/serving.md)."""
+        ids = {str(t) for t in ticket_ids}
+        if ids:
+            with self._cancel_lock:
+                self._cancelled |= ids
+
+    def _cancel_hit(self, tid: str) -> bool:
+        """Consume a pending cancellation for ``tid`` (ids are
+        one-shot, like the engine's per-batch prune)."""
+        with self._cancel_lock:
+            if tid in self._cancelled:
+                self._cancelled.discard(tid)
+                return True
+        return False
 
     def request_handoff(self, after_rounds: int = 0) -> None:
         """Arm the lossless-drain export (docs/scale-out.md "Slot

@@ -16,6 +16,9 @@ drops every mutation to an attribute check.
 - :mod:`~triton_distributed_tpu.obs.events` — bounded structured-event
   ring with gap-free seq numbers for drop-aware tailing
   (``{"cmd": "events"}``).
+- :mod:`~triton_distributed_tpu.obs.slo` — declarative SLO deadlines
+  and wire-side goodput accounting (``{"cmd": "slo"}``,
+  docs/observability.md "SLO goodput").
 - :mod:`~triton_distributed_tpu.obs.kernel_trace` — decoder for the
   megakernel's device task-tracer ring (docs/observability.md "Device
   task tracer"). NOT imported here: it pulls the megakernel package
@@ -39,6 +42,7 @@ from triton_distributed_tpu.obs.metrics import (  # noqa: F401
     log_buckets,
     prometheus_text,
 )
+from triton_distributed_tpu.obs.slo import SLOSpec  # noqa: F401
 from triton_distributed_tpu.obs.timeline import (  # noqa: F401
     FINISH_STATUSES,
     Timeline,

@@ -365,6 +365,94 @@ def _escape_help(text: str) -> str:
     return text.replace("\\", "\\\\").replace("\n", "\\n")
 
 
+# One exposition sample line: name, optional {labels}, value(+timestamp
+# tail, kept verbatim). Greedy label body: a label VALUE containing the
+# literal sequence `"} ` could in principle misparse, but _escape never
+# produces one and our own exposition is the only input.
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s(.+)$"
+)
+
+
+def merge_expositions(parts: dict[str, str],
+                      label: str = "replica") -> str:
+    """Merge several Prometheus text expositions into ONE, tagging
+    every sample with ``label="<source key>"`` (inserted first; the
+    source key is escaped per the exposition grammar, so a respawned
+    replica's ``r0#2`` or any quoted name survives). ``# HELP`` /
+    ``# TYPE`` lines are kept once per family (first seen wins — the
+    registry's redeclaration rule already guarantees they agree), and
+    samples are regrouped by family across sources so TYPE adjacency
+    stays valid. Histogram child series (``_bucket``/``_sum``/
+    ``_count``) follow their declared family.
+
+    This is the fleet-scope scrape's merge half (docs/scale-out.md
+    "Fleet-scope telemetry"): each child process owns a process-local
+    registry; ``FleetSupervisor.fleet_metrics`` fans the ``metrics``
+    verb out and hands the texts here, so one scrape sees every
+    replica's counters as distinct ``{replica=...}`` series whose sum
+    equals the children's own scrapes."""
+    helps: dict[str, str] = {}
+    types: dict[str, str] = {}
+    family_of: dict[str, str] = {}
+    samples: dict[str, list[str]] = {}
+    order: list[str] = []
+
+    def family(name: str) -> str:
+        fam = family_of.get(name)
+        if fam is not None:
+            return fam
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix):
+                base = name[: -len(suffix)]
+                if base in types:
+                    return base
+        return name
+
+    for src, text in parts.items():
+        esc = _escape(src)
+        for line in (text or "").splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            if line.startswith("# HELP ") or line.startswith("# TYPE "):
+                kind, name = line.split(None, 3)[1:3]
+                store = helps if kind == "HELP" else types
+                if name not in store:
+                    store[name] = line
+                if kind == "TYPE" and "histogram" in line:
+                    for suffix in ("_bucket", "_sum", "_count"):
+                        family_of[name + suffix] = name
+                continue
+            m = _SAMPLE_RE.match(line)
+            if m is None:
+                continue  # foreign noise: never corrupt the merge
+            name, labels, value = m.groups()
+            if labels and f'{label}="' in labels:
+                # The sample already carries the merge label (the
+                # router's tdt_router_*{replica=...} series name the
+                # child they DESCRIBE): keep it — a duplicate label
+                # name would make the line grammar-invalid.
+                tagged = labels
+            elif labels:
+                tagged = f'{label}="{esc}",{labels}'
+            else:
+                tagged = f'{label}="{esc}"'
+            fam = family(name)
+            if fam not in samples:
+                samples[fam] = []
+                order.append(fam)
+            samples[fam].append(f"{name}{{{tagged}}} {value}")
+    out: list[str] = []
+    for fam in order:
+        if fam in helps:
+            out.append(helps[fam])
+        if fam in types:
+            out.append(types[fam])
+        out.extend(samples[fam])
+    return "\n".join(out) + ("\n" if out else "")
+
+
 _DEFAULT = Registry()
 
 

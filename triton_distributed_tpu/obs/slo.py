@@ -1,0 +1,243 @@
+"""SLO accounting: declarative deadlines, wire-side goodput.
+
+The serving tier's latency story used to end at histograms — useful
+for tail inspection, useless for the question an operator actually
+asks: *what fraction of requests met their deadlines?* This module is
+the goodput half of the yardstick (ROADMAP item 5, docs/observability.md
+"SLO goodput"):
+
+- :class:`SLOSpec` — a named deadline set (TTFT / TPOT / end-to-end,
+  seconds; ``None`` = no bound) per priority class. A request names
+  its class via the ``slo_class`` payload key; unknown classes fall
+  back to ``default``.
+- :func:`observe_wire` — folds ONE finished wire-side
+  :class:`~triton_distributed_tpu.obs.timeline.Timeline` (the
+  streaming path's per-frame stamps — where the user saw the tokens,
+  not where the engine latched them) into the registry:
+  ``tdt_slo_requests_total{slo_class,outcome}`` (outcome ``met`` /
+  ``missed`` / ``cancelled``), ``tdt_slo_violations_total``
+  ``{slo_class,deadline}``, and wire-side
+  ``tdt_slo_ttft/tpot/e2e_seconds{slo_class}`` histograms.
+- :func:`goodput` / :func:`snapshot` — goodput =
+  ``met / (met + missed)``. Client-initiated cancellations are
+  counted but EXCLUDED from the denominator: a user hanging up is not
+  a server miss. The server's ``{"cmd": "slo"}`` verb returns
+  :func:`snapshot`.
+
+Evaluation semantics (one rule, applied per configured deadline):
+
+- a measured duration over its bound → violated;
+- a deadline that is *unmeasurable on a successful request* (TPOT on
+  a 1-token answer, TTFT on a non-streamed payload) → skipped, not
+  violated — the spec can only judge what the wire recorded;
+- an unmeasurable deadline on a FAILED request → violated: the user
+  never got what the deadline promises, and counting a shed request
+  as "met its TTFT" would let an overloaded server shed its way to
+  100% goodput.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from triton_distributed_tpu.obs import metrics as _metrics
+
+# The deadline keys a spec may bound, in reporting order.
+DEADLINE_KEYS = ("ttft", "tpot", "e2e")
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOSpec:
+    """One priority class's deadlines, in seconds (None = unbounded).
+    An all-None spec still yields outcome accounting: every ``ok``
+    request counts ``met`` and every failed one ``missed`` — goodput
+    then measures completion, the correct degenerate reading."""
+
+    name: str = "default"
+    ttft_s: float | None = None
+    tpot_s: float | None = None
+    e2e_s: float | None = None
+
+    def deadlines(self):
+        """``(key, bound_s)`` pairs for the bounds actually set."""
+        for key in DEADLINE_KEYS:
+            bound = getattr(self, f"{key}_s")
+            if bound is not None:
+                yield key, float(bound)
+
+    def as_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "ttft_s": self.ttft_s,
+            "tpot_s": self.tpot_s,
+            "e2e_s": self.e2e_s,
+        }
+
+
+def normalize_specs(specs) -> dict[str, SLOSpec]:
+    """Accept a single spec, a ``{class: spec}`` dict, or None; return
+    a dict that always carries a ``default`` class (the fallback for
+    requests naming no/unknown classes)."""
+    if specs is None:
+        out: dict[str, SLOSpec] = {}
+    elif isinstance(specs, SLOSpec):
+        out = {specs.name: specs}
+    else:
+        out = dict(specs)
+    if "default" not in out:
+        out["default"] = SLOSpec()
+    return out
+
+
+def evaluate(tl, spec: SLOSpec) -> list[str]:
+    """The deadlines of ``spec`` that ``tl`` violated (empty == met).
+    ``tl`` must be a finished timeline; see the module docstring for
+    the unmeasurable-duration rule."""
+    ok = (tl.status or "ok") == "ok"
+    violated: list[str] = []
+    for key, bound in spec.deadlines():
+        measured = getattr(tl, f"{key}_s")
+        if measured is None:
+            if not ok:
+                violated.append(key)
+            continue
+        if measured > bound:
+            violated.append(key)
+    return violated
+
+
+def judge(tl, spec: SLOSpec) -> str:
+    """Classify one finished timeline: ``met`` / ``missed`` /
+    ``cancelled``. THE outcome rule — :func:`observe_wire` and the
+    server's fan-out (non-observing) summary path both call it, so
+    child summaries can never desynchronize from the front ledger. A
+    failed request is a miss even under an all-None spec: the user
+    got an error, and "no deadlines configured" must not let a
+    shedding server read as 100% goodput."""
+    status = tl.status or "ok"
+    if status == "cancelled":
+        return "cancelled"
+    if evaluate(tl, spec) or status != "ok":
+        return "missed"
+    return "met"
+
+
+def _handles(reg) -> dict:
+    """Per-registry tdt_slo_* handles, resolved once and cached on the
+    registry (the timeline module's ``_handles`` convention —
+    ``Registry.clear`` zeroes series in place, so cached handles
+    survive test resets)."""
+    h = getattr(reg, "_slo_handles", None)
+    if h is None:
+        h = {
+            "requests": reg.counter(
+                "tdt_slo_requests_total",
+                "Requests judged against their SLO class, by outcome "
+                "(met/missed/cancelled).",
+                labels=("slo_class", "outcome"),
+            ),
+            "violations": reg.counter(
+                "tdt_slo_violations_total",
+                "Deadline violations, by class and which deadline "
+                "(ttft/tpot/e2e) — one request can violate several.",
+                labels=("slo_class", "deadline"),
+            ),
+            "ttft": reg.histogram(
+                "tdt_slo_ttft_seconds",
+                "WIRE-side time to first token (streamed frame "
+                "departure), by SLO class.",
+                labels=("slo_class",),
+            ),
+            "tpot": reg.histogram(
+                "tdt_slo_tpot_seconds",
+                "WIRE-side per-token time after the first frame, by "
+                "SLO class.",
+                labels=("slo_class",),
+            ),
+            "e2e": reg.histogram(
+                "tdt_slo_e2e_seconds",
+                "WIRE-side end-to-end latency, by SLO class.",
+                labels=("slo_class",),
+            ),
+        }
+        reg._slo_handles = h
+    return h
+
+
+def observe_wire(tl, spec: SLOSpec | None = None,
+                 registry=None) -> str:
+    """Fold one FINISHED wire-side timeline into the SLO ledger.
+    Returns the outcome: ``met``, ``missed``, or ``cancelled``."""
+    reg = registry if registry is not None else _metrics.default_registry()
+    spec = spec if spec is not None else SLOSpec()
+    h = _handles(reg)
+    cls = spec.name
+    outcome = judge(tl, spec)
+    if outcome == "cancelled":
+        h["requests"].inc(slo_class=cls, outcome="cancelled")
+        return "cancelled"
+    if (tl.status or "ok") == "ok":
+        # Latency quantiles describe SERVED requests only: a
+        # cancellation's time-to-hangup, a shed's near-zero synthetic
+        # e2e, or a failure's partial span would all DEFLATE the
+        # served p99s exactly when an operator reads them (failures
+        # are counted and violation-labeled, not timed).
+        for key in DEADLINE_KEYS:
+            measured = getattr(tl, f"{key}_s")
+            if measured is not None:
+                h[key].observe(measured, slo_class=cls)
+    for key in evaluate(tl, spec):
+        h["violations"].inc(slo_class=cls, deadline=key)
+    h["requests"].inc(slo_class=cls, outcome=outcome)
+    return outcome
+
+
+def goodput(slo_class: str = "default", registry=None) -> float | None:
+    """``met / (met + missed)`` for one class; None before any
+    judgeable request (cancellations alone don't make a denominator)."""
+    reg = registry if registry is not None else _metrics.default_registry()
+    h = _handles(reg)
+    met = h["requests"].value(slo_class=slo_class, outcome="met")
+    missed = h["requests"].value(slo_class=slo_class, outcome="missed")
+    total = met + missed
+    if total <= 0:
+        return None
+    return met / total
+
+
+def snapshot(specs=None, registry=None) -> dict:
+    """The ``{"cmd": "slo"}`` payload: per observed class — outcome
+    counts, goodput, wire-side p50/p99 TTFT/TPOT/e2e — plus the
+    deployed specs so a scraper sees the deadlines the numbers were
+    judged against."""
+    reg = registry if registry is not None else _metrics.default_registry()
+    h = _handles(reg)
+    specs = normalize_specs(specs)
+    classes: set[str] = set(specs)
+    # list() first: a concurrent observe_wire may grow the series dict
+    # mid-scrape (the slo verb is engine-lock-free by design).
+    for key in list(getattr(h["requests"], "_series", {})):
+        classes.add(key[0])
+    out: dict = {"classes": {}, "specs": {
+        name: spec.as_dict() for name, spec in sorted(specs.items())
+    }}
+    for cls in sorted(classes):
+        met = h["requests"].value(slo_class=cls, outcome="met")
+        missed = h["requests"].value(slo_class=cls, outcome="missed")
+        cancelled = h["requests"].value(slo_class=cls, outcome="cancelled")
+        entry = {
+            "met": met,
+            "missed": missed,
+            "cancelled": cancelled,
+            "goodput": goodput(cls, reg),
+            "violations": {
+                key: h["violations"].value(slo_class=cls, deadline=key)
+                for key in DEADLINE_KEYS
+            },
+        }
+        for key in DEADLINE_KEYS:
+            hist = h[key]
+            entry[f"{key}_p50_s"] = hist.quantile(0.50, slo_class=cls)
+            entry[f"{key}_p99_s"] = hist.quantile(0.99, slo_class=cls)
+        out["classes"][cls] = entry
+    return out

@@ -36,8 +36,10 @@ import time
 
 from triton_distributed_tpu.obs import metrics as _metrics
 
-# PR 3 failure taxonomy (models/continuous.py) + success. Exposition
-# labels come from Request.status, which is always one of these.
+# PR 3 failure taxonomy (models/continuous.py) + success + the
+# client-initiated ``cancelled`` terminal (docs/serving.md "Streaming
+# & cancellation"). Exposition labels come from Request.status, which
+# is always one of these.
 FINISH_STATUSES = (
     "ok",
     "unservable",
@@ -46,17 +48,25 @@ FINISH_STATUSES = (
     "nan_logits",
     "failed",
     "aborted",
+    "cancelled",
 )
 
 
 class Timeline:
     """Monotonic lifecycle stamps for one request. Stamps latch on
     first write (a retried admission keeps the FIRST admit time — the
-    queue-wait the client actually experienced)."""
+    queue-wait the client actually experienced).
+
+    ``token_ts`` is the per-token stamp trail the STREAMING path fills
+    (docs/serving.md "Streaming & cancellation"): one monotonic stamp
+    per token frame, taken at the wire write — so TTFT/TPOT derived
+    from a streamed timeline measure when tokens reached the socket,
+    not when the engine latched them. Engine-side timelines leave it
+    empty and keep the PR 5 first-token/finish arithmetic."""
 
     __slots__ = ("enqueue_t", "admit_t", "first_chunk_t", "first_token_t",
                  "finish_t", "tokens_in", "tokens_out", "status",
-                 "reroutes")
+                 "reroutes", "token_ts")
 
     def __init__(self):
         self.enqueue_t: float | None = None
@@ -72,6 +82,8 @@ class Timeline:
         # before this attempt. Stamped by the router, folded into
         # ``tdt_request_reroutes_total`` at finish.
         self.reroutes = 0
+        # Wire-side per-token stamps (streaming path only).
+        self.token_ts: list[float] = []
 
     def _stamp(self, attr: str) -> None:
         if getattr(self, attr) is None:
@@ -88,6 +100,15 @@ class Timeline:
 
     def stamp_first_token(self) -> None:
         self._stamp("first_token_t")
+
+    def stamp_token(self) -> None:
+        """One per-token stamp (streaming wire writes). The first one
+        also latches ``first_token_t``, so a wire-side timeline's TTFT
+        is the first FRAME's departure, not an engine-side latch."""
+        t = time.monotonic()
+        if self.first_token_t is None:
+            self.first_token_t = t
+        self.token_ts.append(t)
 
     def finish(self, status: str) -> bool:
         """Latch the terminal stamp + status; True exactly once (the
@@ -127,7 +148,13 @@ class Timeline:
     def tpot_s(self) -> float | None:
         """Steady-state per-output-token time: decode time after the
         first token, averaged over the remaining tokens. None until a
-        second token exists (a 1-token request has no decode phase)."""
+        second token exists (a 1-token request has no decode phase).
+        With per-token wire stamps (streaming) the span is measured
+        frame-to-frame — finish-side slack (summary construction)
+        never inflates it."""
+        if len(self.token_ts) >= 2:
+            return ((self.token_ts[-1] - self.token_ts[0])
+                    / (len(self.token_ts) - 1))
         span = self._delta(self.first_token_t, self.finish_t)
         if span is None or self.tokens_out < 2:
             return None

@@ -22,6 +22,15 @@ Seams currently instrumented (grep for ``fault_point``/``mutate_point``):
 ``server.recv``    ``ModelServer._serve_lines`` read side — socket
                    drops / slow clients (``delay=``)
 ``server.send``    ``ModelServer._serve_lines`` write side
+``stream.send``    one streaming token frame's bytes (mutate-style,
+                   the wire-seam pattern: drop via a raising rule —
+                   the server reads it as a client disconnect and
+                   CANCELS the payload's requests — garble via
+                   corruption the client's JSON parse catches)
+``engine.cancel``  ``ContinuousEngine._apply_cancels`` — between the
+                   pending-cancel snapshot and its application, so a
+                   cancel can be raced deterministically against a
+                   slot's natural finish (``delay=``)
 ``replica.run``    ``EngineReplica._run_batch`` — replica-kill /
                    replica-hang for the multi-engine router tier
                    (``replica=`` narrows to one replica by name)
@@ -231,6 +240,41 @@ class FaultPlan:
                     times: int = 1) -> "FaultPlan":
         """Nth server read stalls ``delay`` seconds before proceeding."""
         return self.on("server.recv", at=at, times=times, delay=delay)
+
+    def drop_stream(self, at: int = 1, times: int = 1,
+                    **match) -> "FaultPlan":
+        """The Nth streaming token-frame write raises as if the client
+        vanished mid-stream: the server's stream sink marks itself
+        broken and CANCELS the payload's requests — slots torn down,
+        pages freed, survivors untouched (docs/serving.md 'Streaming &
+        cancellation'). Narrow with ``tid=``."""
+
+        def _raise(_value, _ctx):
+            raise BrokenPipeError("stream client vanished (injected)")
+
+        return self.on("stream.send", at=at, times=times, mutate=_raise,
+                       **match)
+
+    def garble_stream(self, at: int = 1, times: int = 1,
+                      **match) -> "FaultPlan":
+        """The Nth streaming frame's bytes are reversed in flight
+        (valid JSON never survives it): the CLIENT's frame parse fails
+        mid-stream — exercising the consumer-side protocol-error path
+        while the server keeps serving."""
+
+        def _garble(value, _ctx):
+            return bytes(reversed(bytes(value)))
+
+        return self.on("stream.send", at=at, times=times, mutate=_garble,
+                       **match)
+
+    def slow_cancel(self, delay: float, at: int = 1,
+                    times: int = 1) -> "FaultPlan":
+        """The Nth cancel application stalls ``delay`` seconds between
+        snapshotting the pending ids and applying them — the
+        deterministic handle on the cancel-vs-natural-finish race
+        (whichever side the test wants to win, it sequences here)."""
+        return self.on("engine.cancel", at=at, times=times, delay=delay)
 
     def kill_replica(self, replica: str | None = None, at: int = 0,
                      times: int = 1) -> "FaultPlan":

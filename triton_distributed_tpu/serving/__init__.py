@@ -19,7 +19,11 @@ from triton_distributed_tpu.serving.remote import (
 )
 from triton_distributed_tpu.serving.replica import EngineReplica, Ticket
 from triton_distributed_tpu.serving.router import Router
-from triton_distributed_tpu.serving.server import ModelServer, request
+from triton_distributed_tpu.serving.server import (
+    ModelServer,
+    request,
+    request_stream,
+)
 from triton_distributed_tpu.serving.supervisor import (
     FleetSupervisor,
     ReplicaSpec,
@@ -32,5 +36,6 @@ from triton_distributed_tpu.serving.supervisor import (
 __all__ = [
     "EngineReplica", "FleetSupervisor", "ModelServer", "RemoteEngine",
     "RemoteReplica", "ReplicaSpec", "Router", "SpawnError", "Ticket",
-    "model_spec", "request", "spawn_replica", "stub_spec",
+    "model_spec", "request", "request_stream", "spawn_replica",
+    "stub_spec",
 ]

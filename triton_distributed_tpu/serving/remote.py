@@ -90,7 +90,8 @@ class RemoteEngine:
     # -- wire --------------------------------------------------------------
 
     def call(self, payload: dict, *, timeout: float | None = None,
-             generation: bool = False) -> dict:
+             generation: bool = False, on_token: dict | None = None
+             ) -> dict:
         """One request/response round trip on a fresh connection, with
         every fault seam on the path. ``generation=True`` additionally
         offers the child's pid to the mid-batch ``proc.*`` seams right
@@ -98,7 +99,14 @@ class RemoteEngine:
         land. The seams carry ``what`` ("batch"/"probe") so chaos
         plans can target generation traffic without a supervisor
         heartbeat racing them for the hit (the fault conveniences
-        match ``what="batch"`` by default)."""
+        match ``what="batch"`` by default).
+
+        ``on_token`` (streaming batches, docs/serving.md "Streaming &
+        cancellation"): a ``{tid: callback}`` map — token frames the
+        child pushes before its response line forward to
+        ``on_token[tid](i, token)`` as they arrive, and the returned
+        dict is the summary frame. ONE wire implementation for both
+        shapes, so every seam/timeout behavior stays shared."""
         what = "batch" if generation else "probe"
         # A caller deadline bounds the WHOLE round trip, connect
         # included: the supervisor's heartbeat deadline must not
@@ -107,6 +115,7 @@ class RemoteEngine:
         conn_to = self.connect_timeout_s
         if timeout is not None:
             conn_to = min(conn_to, timeout)
+        sinks = dict(on_token) if on_token else None
         fault_point("wire.connect", replica=self.name, what=what)
         with socket.create_connection(
             (self.host, self.port), timeout=conn_to
@@ -121,23 +130,60 @@ class RemoteEngine:
                 if generation:
                     mutate_point("proc.kill", self.pid, replica=self.name)
                     mutate_point("proc.hang", self.pid, replica=self.name)
-                line = f.readline()
-        if not line:
-            raise ConnectionError(
-                f"replica {self.name} closed the connection mid-request"
-            )
-        line = mutate_point("wire.recv", line, replica=self.name,
-                            what=what)
-        try:
-            return json.loads(line)
-        except ValueError as e:
-            raise ConnectionError(
-                f"replica {self.name} sent a garbled response: {e}"
-            ) from e
+                while True:
+                    line = f.readline()
+                    if not line:
+                        raise ConnectionError(
+                            f"replica {self.name} closed the "
+                            "connection mid-request"
+                        )
+                    line = mutate_point("wire.recv", line,
+                                        replica=self.name, what=what)
+                    try:
+                        obj = json.loads(line)
+                    except ValueError as e:
+                        raise ConnectionError(
+                            f"replica {self.name} sent a garbled "
+                            f"response: {e}"
+                        ) from e
+                    if (sinks is not None and isinstance(obj, dict)
+                            and obj.get("frame") == "token"):
+                        cb = sinks.get(obj.get("tid"))
+                        if cb is not None:
+                            try:
+                                cb(int(obj["i"]), int(obj["token"]))
+                            except Exception:  # noqa: BLE001 — a
+                                # broken sink detaches, the stream
+                                # (and the batch behind it) lives on
+                                sinks.pop(obj.get("tid"), None)
+                        continue
+                    return obj
 
     def generate(self, payload: dict) -> dict:
         return self.call(payload, timeout=self.recv_timeout_s,
                          generation=True)
+
+    def generate_stream(self, payload: dict, on_token: dict) -> dict:
+        """A streaming batch round trip: :meth:`call` with the frame
+        sinks attached (the payload carries ``"stream": true``).
+        Returns the summary frame; wire failures raise exactly like
+        :meth:`generate` — whatever frames already flowed were already
+        delivered (at-least-once, deduped by index at the front
+        sink)."""
+        return self.call(payload, timeout=self.recv_timeout_s,
+                         generation=True, on_token=on_token)
+
+    def cancel(self, ticket_ids) -> None:
+        """Forward a cancellation to the child (its cancel verb is
+        engine-lock-free, so it lands mid-batch). A wire error means
+        the child is already gone — its batch dies with it."""
+        try:
+            self.call(
+                {"cmd": "cancel", "ticket_ids": list(ticket_ids)},
+                timeout=self.probe_timeout_s,
+            )
+        except (OSError, ConnectionError):
+            pass
 
     # -- engine surface the router touches ---------------------------------
 
@@ -256,6 +302,11 @@ class RemoteReplica(EngineReplica):
             "gen_lens": [t.gen_len for t in tickets],
             "ticket_ids": [t.tid for t in tickets],
             "want_digest": True,
+            # Internal fan-out marker: the child must not fold these
+            # into ITS wire-side SLO ledger — the user-facing hop (the
+            # front server) judges goodput exactly once per request
+            # (docs/observability.md "SLO goodput").
+            "fanout": True,
         }
         # Sampling/deadline knobs ride as per-request lists; None
         # entries fall back to the child engine's defaults (the
@@ -290,8 +341,18 @@ class RemoteReplica(EngineReplica):
             payload["prefill_only"] = [
                 bool(t.prefill_only) for t in tickets
             ]
+        # Streaming fan-in (docs/serving.md "Streaming & cancellation"):
+        # a batch with token sinks asks the child to stream, and each
+        # arriving frame forwards to its ticket's sink — so the front
+        # server's wire stamps cover the cross-process hop too.
+        sinks = {t.tid: t.on_token for t in tickets
+                 if t.on_token is not None}
         try:
-            resp = self._remote.generate(payload)
+            if sinks:
+                payload["stream"] = True
+                resp = self._remote.generate_stream(payload, sinks)
+            else:
+                resp = self._remote.generate(payload)
         except Exception as e:  # noqa: BLE001 — the wire is the boundary
             self._die(f"wire failure: {type(e).__name__}: {e}")
             return

@@ -87,7 +87,7 @@ class Ticket:
                  "deadline_s", "enqueue_t", "reroutes", "replica_history",
                  "result", "_event", "_lock", "_rerouted_from",
                  "last_dispatch_t", "_prompt_list", "tid", "snapshot",
-                 "prefill_only")
+                 "prefill_only", "on_token", "client_tid")
 
     def __init__(self, prompt, gen_len: int, *, temperature=None,
                  top_p=None, top_k=None, deadline_s=None, enqueue_t=None):
@@ -124,6 +124,21 @@ class Ticket:
         # hop).
         self.snapshot: dict | None = None
         self.prefill_only: bool = False
+        # Streaming sink (docs/serving.md "Streaming & cancellation"):
+        # ``on_token(index, token_id)`` fires per emitted token — on
+        # the replica worker thread for in-process replicas, on frame
+        # receipt for RemoteReplicas. Re-dispatches re-fire earlier
+        # indices (at-least-once); the server's stream sink dedups by
+        # index, so the wire sees each token once.
+        self.on_token = None
+        # The CLIENT's id for this request (None when it gave none).
+        # Kept ALONGSIDE the generated ``tid``, never instead of it:
+        # everything wire-side (result latching, frames, the child's
+        # duplicate-id refusal) keys by the process-unique ``tid``, so
+        # two payloads reusing one client id can be co-batched without
+        # conflating — while ``EngineReplica.cancel`` matches either,
+        # so the id a client holds still cancels end-to-end.
+        self.client_tid: str | None = None
 
     @property
     def prompt_tokens(self) -> list[int]:
@@ -137,14 +152,24 @@ class Ticket:
     @classmethod
     def of(cls, req) -> "Ticket":
         """Build from an engine :class:`Request` (the server's form) or
-        a ``(prompt, gen_len)`` tuple."""
+        a ``(prompt, gen_len)`` tuple. A request's ``ticket_id`` rides
+        as ``client_tid`` NEXT TO the generated process-unique ``tid``
+        — cancellation matches either (``EngineReplica.cancel``), but
+        the wire keys by ``tid`` alone, so a client id reused across
+        concurrent payloads can never conflate two requests in one
+        child batch (or get a healthy child's duplicate-id refusal
+        read as a replica death)."""
         if isinstance(req, Request):
             tl = req.timeline
-            return cls(
+            t = cls(
                 req.prompt, req.gen_len, temperature=req.temperature,
                 top_p=req.top_p, top_k=req.top_k, deadline_s=req.deadline_s,
                 enqueue_t=tl.enqueue_t if tl is not None else None,
             )
+            if req.ticket_id is not None:
+                t.client_tid = str(req.ticket_id)
+            t.on_token = req.on_token
+            return t
         prompt, gen_len = req
         return cls(prompt, gen_len)
 
@@ -162,6 +187,7 @@ class Ticket:
             top_p=self.top_p, top_k=self.top_k, deadline_s=self.deadline_s,
             timeline=tl, snapshot=self.snapshot,
             prefill_only=self.prefill_only, ticket_id=self.tid,
+            on_token=self.on_token,
         )
 
     def complete(self, result: RequestResult) -> bool:
@@ -342,6 +368,46 @@ class EngineReplica:
             "served": self.served,
             "last_error": self.last_error,
         }
+
+    def cancel(self, ticket_ids) -> int:
+        """Client-driven cancellation (docs/serving.md "Streaming &
+        cancellation"). Ids match a ticket's unique ``tid`` OR its
+        ``client_tid``: queued matches complete immediately with
+        status ``cancelled`` (removed before the worker can run
+        them); IN-FLIGHT matches forward their UNIQUE tids to the
+        engine's own ``cancel`` (over the wire for a RemoteReplica) —
+        the engine only ever sees tids it was dispatched, so a
+        client id reused across payloads cancels every carrier
+        without spraying foreign ids. Returns how many QUEUED tickets
+        were cancelled here — in-flight cancels surface through their
+        tickets' eventual ``cancelled`` results."""
+        ids = {str(t) for t in ticket_ids}
+        if not ids:
+            return 0
+
+        def hit(t: Ticket) -> bool:
+            return t.tid in ids or (t.client_tid is not None
+                                    and t.client_tid in ids)
+
+        with self._cond:
+            queued = [t for t in self._queue if hit(t)]
+            if queued:
+                self._queue = [t for t in self._queue if not hit(t)]
+            inflight = [t.tid for t in self._current_batch if hit(t)]
+        n = 0
+        for t in queued:
+            if t.complete(RequestResult(
+                np.zeros(0, np.int32), "cancelled",
+                "cancelled by client before dispatch",
+            )):
+                n += 1
+        canceller = getattr(self.engine, "cancel", None)
+        if inflight and canceller is not None:
+            try:
+                canceller(sorted(inflight))
+            except Exception:  # noqa: BLE001 — remote best-effort
+                pass
+        return n
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -252,6 +252,29 @@ class Router:
         agg["router"] = router
         return agg
 
+    def cancel(self, ticket_ids) -> int:
+        """Client-driven cancellation across the fleet (docs/serving.md
+        "Streaming & cancellation"): every live replica gets the ids —
+        queued tickets complete ``cancelled`` immediately, in-flight
+        ones tear down at their engine's next round (over the wire for
+        process replicas). The router cannot know which replica holds
+        which ticket without racing dispatch, so the fan-out IS the
+        protocol; ids matching nothing are pruned engine-side. Returns
+        how many queued tickets were cancelled synchronously."""
+        tids = [str(t) for t in ticket_ids]
+        if not tids:
+            return 0
+        n = 0
+        for r in self.replicas:
+            if r.state in (DEAD, DRAINED):
+                continue
+            try:
+                n += r.cancel(tids)
+            except Exception:  # noqa: BLE001 — best-effort per replica
+                continue
+        obs_events.emit("cancel", requested=len(tids), queued_hits=n)
+        return n
+
     def kernel_trace_summary(self) -> dict:
         """Fleet device-tracer state for the server's
         ``{"cmd": "kernel_trace"}`` verb (docs/observability.md

@@ -170,6 +170,17 @@ def main(argv=None) -> int:
                    help="with --model stub: page-pool size")
     p.add_argument("--stub-page-size", type=int, default=16,
                    help="with --model stub: tokens per page")
+    p.add_argument("--slo-ttft-ms", type=float, default=0.0,
+                   help="default-class SLO deadline on WIRE-side time "
+                   "to first token, milliseconds (0 = unbounded); the "
+                   "{'cmd':'slo'} verb reports goodput against it "
+                   "(docs/observability.md 'SLO goodput')")
+    p.add_argument("--slo-tpot-ms", type=float, default=0.0,
+                   help="default-class SLO deadline on wire-side "
+                   "per-token time, milliseconds (0 = unbounded)")
+    p.add_argument("--slo-e2e-ms", type=float, default=0.0,
+                   help="default-class SLO deadline on wire-side "
+                   "end-to-end latency, milliseconds (0 = unbounded)")
     p.add_argument("--trace", default=None, metavar="DIR",
                    help="wrap the whole run in group_profile(DIR) and "
                    "merge ONE chrome timeline on exit — host "
@@ -212,6 +223,21 @@ def main(argv=None) -> int:
         )
 
     from triton_distributed_tpu.serving.server import ModelServer
+
+    # Default-class SLO deadlines (docs/observability.md "SLO
+    # goodput"): the FRONT server judges wire-side timelines against
+    # these; fleet children never need them (their batches are
+    # internal fan-out and skip the ledger).
+    slo = None
+    if args.slo_ttft_ms or args.slo_tpot_ms or args.slo_e2e_ms:
+        from triton_distributed_tpu.obs.slo import SLOSpec
+
+        slo = SLOSpec(
+            "default",
+            ttft_s=(args.slo_ttft_ms / 1e3) if args.slo_ttft_ms else None,
+            tpot_s=(args.slo_tpot_ms / 1e3) if args.slo_tpot_ms else None,
+            e2e_s=(args.slo_e2e_ms / 1e3) if args.slo_e2e_ms else None,
+        )
 
     if args.fleet > 0:
         # Supervised process fleet (docs/scale-out.md "Process
@@ -288,7 +314,7 @@ def main(argv=None) -> int:
         router = sup.start()
         server = ModelServer(
             router, host=args.host, port=args.port,
-            drain_grace_s=args.drain_grace,
+            drain_grace_s=args.drain_grace, slo=slo,
         )
         print(f"serving {args.model} fleet x{args.fleet} "
               f"({args.policy} router, logs {sup.log_dir}) on "
@@ -312,7 +338,7 @@ def main(argv=None) -> int:
         )
         server = ModelServer(
             engine, host=args.host, port=args.port,
-            drain_grace_s=args.drain_grace,
+            drain_grace_s=args.drain_grace, slo=slo,
         )
         print(f"serving stub on {server.host}:{server.port}")
         _write_port_file(args.port_file, server.host, server.port)
@@ -379,7 +405,7 @@ def main(argv=None) -> int:
         what = f"{args.model} (tp={args.tp})"
     server = ModelServer(
         engine, host=args.host, port=args.port,
-        drain_grace_s=args.drain_grace, trace_dir=args.trace,
+        drain_grace_s=args.drain_grace, trace_dir=args.trace, slo=slo,
     )
     print(f"serving {what} on {server.host}:{server.port}")
     _write_port_file(args.port_file, server.host, server.port)

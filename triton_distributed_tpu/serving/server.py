@@ -17,9 +17,20 @@ framed protocol. Here the protocol is newline-delimited JSON over TCP:
        "stats": {...}}
     → {"cmd": "stats"}           ← {"stats": {..., "server": {...}}}
     → {"cmd": "metrics"}         ← {"prometheus": "...", "metrics": {...}}
+    → {"cmd": "metrics", "scope": "fleet"}
+                                 ← {"prometheus": <replica-labeled merge
+                                    of every child's exposition>,
+                                    "replicas": [...], "errors": {...}}
     → {"cmd": "events", "since": 0, "limit": 100, "kind": "span"}
                                  ← {"events": [...], "dropped": 0,
                                     "next_since": 17}
+    → {"cmd": "events", "scope": "fleet"}
+                                 ← {"events": [replica-tagged,
+                                    fleet_seq-stitched], "dropped": n}
+    → {"cmd": "cancel", "ticket_ids": ["t1p9"]}
+                                 ← {"ok": true, "requested": 1}
+    → {"cmd": "slo"}             ← {"slo": {"classes": {...},
+                                    "specs": {...}}}
     → {"cmd": "kernel_trace"}    ← {"kernel_trace": {"launches": ...,
                                     "recent": [...]}}
     → {"cmd": "ping"}            ← {"ok": true, "draining": false}
@@ -35,6 +46,32 @@ snapshots to RESUME from — docs/scale-out.md "Slot migration &
 handoff") and ``prefill_only`` flags (export right after admission:
 the prefill→decode handoff); a ``migrated`` result entry then carries
 its ``snapshot`` back.
+
+**Streaming** (docs/serving.md "Streaming & cancellation"): a
+``requests`` payload with ``"stream": true`` pushes one line-JSON
+frame per EMITTED token before the final response line::
+
+    ← {"frame": "token", "tid": "t1p9", "i": 0, "token": 17,
+       "t": <monotonic stamp taken at the wire write>}
+    ← ... one per token, per request, "i" strictly increasing ...
+    ← {"frame": "summary", "outputs": [...], "results": [...],
+       "ticket_ids": [...], "wire": [{"ttft_s": ..., "tpot_s": ...,
+       "e2e_s": ..., "tokens_out": ..., "outcome": "met"}, ...],
+       "stats": {...}}
+
+``t`` stamps are taken AT the frame write — TTFT/TPOT measured from
+them are what the user saw, not an engine-side latch; the per-request
+``wire`` entries in the summary carry the derived wire-side numbers
+and the SLO outcome (``obs/slo.py``). Requests without client
+``ticket_ids`` get server-assigned ids (echoed in frames and the
+summary) so a mid-stream ``{"cmd": "cancel"}`` on a second connection
+can target them; a client that simply disconnects mid-stream is
+detected at the next frame write and its requests are cancelled the
+same way — slots torn down, pages freed, status ``cancelled`` with
+the partial tokens. Re-dispatched work (router reroutes, migrations)
+may re-emit earlier tokens; the sink dedups by index so each token
+crosses the wire exactly once, and tokens a resume skipped are
+back-filled before the summary.
 
 The per-request sampling/deadline keys are scalars (applied to every
 request) or per-request lists; omitted/null entries fall back to the
@@ -91,7 +128,9 @@ serialize), and drains the replica fleet on shutdown.
 
 from __future__ import annotations
 
+import itertools
 import json
+import os
 import random
 import socket
 import threading
@@ -102,9 +141,10 @@ import numpy as np
 from triton_distributed_tpu.models.engine import Engine
 from triton_distributed_tpu.obs import events as obs_events
 from triton_distributed_tpu.obs import metrics as obs_metrics
+from triton_distributed_tpu.obs import slo as obs_slo
 from triton_distributed_tpu.obs.metrics import prometheus_text
 from triton_distributed_tpu.obs.timeline import Timeline
-from triton_distributed_tpu.runtime.faults import fault_point
+from triton_distributed_tpu.runtime.faults import fault_point, mutate_point
 
 
 # The probe verbs _dispatch_inner answers. ONE tuple: the metrics
@@ -114,11 +154,150 @@ from triton_distributed_tpu.runtime.faults import fault_point
 # it serializes behind generation — run it quiesced).
 PROBE_CMDS = ("ping", "healthz", "stats", "metrics", "events",
               "kernel_trace", "audit", "shutdown", "export_slots",
-              "handoff")
+              "handoff", "cancel", "slo")
+
+# Server-assigned stream ticket ids (payloads that stream without
+# client ticket_ids still need cancellable identities); pid-suffixed
+# like replica tids so they stay unique across routers sharing a
+# replica.
+_STREAM_IDS = itertools.count(1)
 
 
 class _BadRequest(ValueError):
     """Client-side protocol error: mapped to status ``bad_request``."""
+
+
+class _StreamSink:
+    """Per-payload streaming state (docs/serving.md "Streaming &
+    cancellation"): ONE wire write path for every token frame of a
+    streamed ``requests`` payload, with the three properties the wire
+    grammar promises:
+
+    - **exactly-once frames** — engines re-emit earlier indices on
+      re-dispatch (router reroutes, migration replays; at-least-once
+      by design); the sink dedups by per-request index so each token
+      crosses the wire once, and :meth:`finish` back-fills tokens a
+      snapshot resume skipped before the summary goes out;
+    - **wire-side stamps** — each frame's departure stamps the
+      request's wire :class:`Timeline` (``stamp_token``), the numbers
+      TTFT/TPOT/goodput are derived from;
+    - **disconnect → cancel** — a failed frame write (client gone, or
+      the injected ``stream.send`` fault) marks the sink broken and
+      cancels the payload's requests through the engine's ``cancel``,
+      so an abandoned stream frees its slots and pages instead of
+      generating tokens nobody reads.
+
+    Callbacks arrive on the engine thread (single engine) or replica
+    worker threads (router) — the internal lock serializes writes.
+    Back-pressure caveat: a frame write blocks ITS emitter, which for
+    a single engine is only that payload's loop, but on a router a
+    replica worker streaming for client A stalls any work co-batched
+    with A on that replica (bounded by the connection's socket
+    timeout). A per-connection writer thread with a bounded queue
+    would decouple it — not built until a workload needs it.
+    """
+
+    def __init__(self, server: "ModelServer", f, tids: list):
+        self._server = server
+        self._f = f
+        self.tids = tids
+        self._lock = threading.Lock()
+        self._sent = [0] * len(tids)
+        self.timelines = [Timeline() for _ in tids]
+        self.broken = False
+        self._closed = False
+
+    def attach_enqueue(self, enqueue_t: float | None) -> None:
+        for tl in self.timelines:
+            tl.enqueue_t = enqueue_t
+            tl.stamp_enqueue()
+
+    def seed(self, ri: int, n: int) -> None:
+        """Start request ``ri``'s stream at index ``n`` — the tokens a
+        payload-carried snapshot already restored. The client
+        resubmitting its own snapshot HOLDS that prefix; without the
+        seed, the first live token (index n) would read as a gap and
+        every post-resume frame would defer to the summary back-fill,
+        freezing the stream for exactly the migration-resume case."""
+        with self._lock:
+            self._sent[ri] = max(self._sent[ri], int(n))
+
+    def sink_for(self, ri: int):
+        """The ``on_token`` callback for request index ``ri``."""
+
+        def cb(i, token):
+            self.push(ri, int(i), int(token))
+
+        return cb
+
+    def push(self, ri: int, i: int, token: int) -> None:
+        with self._lock:
+            if self._closed or self.broken:
+                return
+            if i != self._sent[ri]:
+                # i < sent: re-dispatch replay, already delivered.
+                # i > sent: a resume skipped past frames this sink
+                # never carried (lost with a dying child's socket) —
+                # streaming the jump would violate the in-order
+                # contract, and the missing tokens aren't known HERE;
+                # finish() back-fills the whole ordered tail from the
+                # final result instead.
+                return
+            self._write(ri, i, token)
+
+    def _write(self, ri: int, i: int, token: int) -> None:
+        """One frame out (caller holds the lock). The ``t`` stamp is
+        taken at the write — the wire-side clock."""
+        frame = {"frame": "token", "tid": self.tids[ri], "i": i,
+                 "token": token, "t": time.monotonic()}
+        try:
+            data = json.dumps(frame).encode() + b"\n"
+            data = mutate_point("stream.send", data,
+                                tid=self.tids[ri], i=i)
+            self._f.write(data)
+            self._f.flush()
+        except Exception:  # noqa: BLE001 — the client vanished
+            self.broken = True
+            self._disconnect()
+            return
+        self._sent[ri] = i + 1
+        self.timelines[ri].stamp_token()
+        if obs_metrics.default_registry().enabled:
+            self._server._m_frames.inc()
+
+    def _disconnect(self) -> None:
+        self._server._m_disconnects.inc()
+        obs_events.emit("stream_disconnect", requests=len(self.tids))
+        if self._closed:
+            # The disconnect surfaced during finish()'s back-fill —
+            # the engine batch already returned AND pruned this
+            # batch's cancel ids, so arming them now would only go
+            # stale and kill a future request that reuses the same
+            # client ticket id. There is nothing left to cancel.
+            return
+        canceller = getattr(self._server.engine, "cancel", None)
+        if canceller is not None:
+            try:
+                canceller(self.tids)
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                pass
+
+    def finish(self, results) -> None:
+        """Close the sink (late worker callbacks become no-ops) and
+        back-fill any tokens the frames never carried — a snapshot
+        resume on another replica starts past what ITS engine emitted,
+        and those earlier tokens may predate this sink entirely. They
+        reached the user NOW, so their stamps are now: wire-honest."""
+        with self._lock:
+            self._closed = True
+            if self.broken:
+                return
+            for ri, r in enumerate(results):
+                toks = [int(t) for t in r.tokens]
+                for i in range(self._sent[ri], len(toks)):
+                    self._write(ri, i, toks[i])
+                    if self.broken:
+                        return
 
 
 class ModelServer:
@@ -144,9 +323,16 @@ class ModelServer:
         max_pending: int = 8,
         drain_grace_s: float = 2.0,
         trace_dir: str | None = None,
+        slo=None,
     ):
         self.engine = engine
         self.max_pending = max_pending
+        # SLO specs (docs/observability.md "SLO goodput"): a single
+        # SLOSpec, a {class: spec} dict, or None — normalized so a
+        # `default` class always exists. Streaming payloads judge
+        # their wire-side timelines against the request's class; the
+        # {"cmd": "slo"} verb reports the resulting goodput.
+        self.slo_specs = obs_slo.normalize_specs(slo)
         # Informational: where a --trace run merges its host+device
         # timeline (run_server owns the actual group_profile capture;
         # the server only surfaces the knob in server_stats so a
@@ -203,6 +389,15 @@ class ModelServer:
             "Structured error responses, by verb and status.",
             labels=("verb", "status"),
         )
+        self._m_frames = obs_metrics.counter(
+            "tdt_server_stream_frames_total",
+            "Token frames pushed to streaming clients.",
+        )
+        self._m_disconnects = obs_metrics.counter(
+            "tdt_server_stream_disconnects_total",
+            "Streaming payloads whose client vanished mid-stream "
+            "(their requests are cancelled).",
+        )
 
     def _count(self, key: str) -> None:
         with self._counters_lock:
@@ -250,6 +445,13 @@ class ModelServer:
         stats["engine"]["tier_dir"] = (
             getattr(tier, "dir", None) if tier is not None else None
         )
+        # Deployed SLO deadlines (docs/observability.md "SLO
+        # goodput"): scrapers see what the goodput numbers are judged
+        # against without shelling into the host.
+        stats["engine"]["slo"] = {
+            name: spec.as_dict()
+            for name, spec in sorted(self.slo_specs.items())
+        }
         # --trace DIR deployments (run_server) surface where the
         # merged host+device timeline will land.
         stats["trace_dir"] = self.trace_dir
@@ -283,13 +485,15 @@ class ModelServer:
             return "generate"
         return "unknown"
 
-    def _dispatch(self, req) -> dict:
+    def _dispatch(self, req, stream_f=None) -> dict:
         """Route one parsed payload with per-verb telemetry; every
         failure becomes a structured error response — nothing escapes
-        to kill the connection."""
+        to kill the connection. ``stream_f`` is the connection's
+        buffered file: a ``"stream": true`` generation payload pushes
+        its token frames through it before the returned summary."""
         verb = self._verb_of(req)
         t0 = time.monotonic()
-        resp = self._dispatch_inner(req)
+        resp = self._dispatch_inner(req, stream_f)
         if obs_metrics.default_registry().enabled:
             self._m_requests.inc(verb=verb)
             self._m_seconds.observe(time.monotonic() - t0, verb=verb)
@@ -298,13 +502,43 @@ class ModelServer:
                 self._m_errors.inc(verb=verb, status=str(err.get("status")))
         return resp
 
-    def _dispatch_inner(self, req) -> dict:
+    def _dispatch_inner(self, req, stream_f=None) -> dict:
         try:
             if not isinstance(req, dict):
                 raise _BadRequest("payload must be a JSON object")
             cmd = req.get("cmd")
             if cmd == "ping":
                 return {"ok": True, "draining": self._shutdown.is_set()}
+            if cmd == "cancel":
+                # Client-driven cancellation (docs/serving.md
+                # "Streaming & cancellation"). Engine-lock-FREE (a set
+                # add / queue filter): the whole point is landing
+                # MID-generation, from a second connection, against a
+                # batch the engine lock is busy serving.
+                tids = req.get("ticket_ids")
+                if (not isinstance(tids, list) or not tids
+                        or not all(isinstance(t, (str, int))
+                                   for t in tids)):
+                    raise _BadRequest(
+                        "cancel needs a non-empty ticket_ids list of "
+                        "strings/ints"
+                    )
+                canceller = getattr(self.engine, "cancel", None)
+                if canceller is None:
+                    raise _BadRequest(
+                        "this engine has no cancel() "
+                        "(ContinuousEngine/StubEngine/Router expose it; "
+                        "see docs/serving.md 'Streaming & cancellation')"
+                    )
+                canceller([str(t) for t in tids])
+                return {"ok": True, "requested": len(tids)}
+            if cmd == "slo":
+                # Goodput readout (docs/observability.md "SLO
+                # goodput"): per-class met/missed/cancelled counts,
+                # goodput, and wire-side latency quantiles, judged
+                # against this server's deployed specs. Probe verb —
+                # registry reads only.
+                return {"slo": obs_slo.snapshot(self.slo_specs)}
             if cmd == "healthz":
                 # The heartbeat target (docs/scale-out.md "Process
                 # fleet"): liveness ONLY. No engine lock, no
@@ -370,11 +604,76 @@ class ModelServer:
                 # Probe verb: reads the registry under its own short
                 # lock, never the engine lock — scraping answers
                 # mid-generation (docs/observability.md).
+                scope = req.get("scope")
+                if scope not in (None, "process", "fleet"):
+                    raise _BadRequest(
+                        "metrics scope must be 'process' or 'fleet'"
+                    )
+                if scope == "fleet":
+                    fleet = getattr(self.engine, "fleet", None)
+                    if fleet is not None and hasattr(fleet,
+                                                     "fleet_metrics"):
+                        # Process fleet (docs/scale-out.md "Fleet-scope
+                        # telemetry"): the supervisor fans the metrics
+                        # verb out to every child and merges the
+                        # expositions replica-labeled — one scrape
+                        # sees the whole fleet.
+                        out = fleet.fleet_metrics()
+                        return {
+                            "prometheus": out["prometheus"],
+                            "scope": "fleet",
+                            "replicas": out["replicas"],
+                            "errors": out["errors"],
+                        }
+                    # No process fleet behind this server: in-process
+                    # replicas share THIS registry, so the process
+                    # scrape already IS the fleet view.
+                    reg = obs_metrics.default_registry()
+                    return {
+                        "prometheus": prometheus_text(reg),
+                        "metrics": reg.snapshot(),
+                        "scope": "process",
+                    }
                 reg = obs_metrics.default_registry()
                 return {
                     "prometheus": prometheus_text(reg),
                     "metrics": reg.snapshot(),
                 }
+            if (cmd == "events"
+                    and req.get("scope") not in (None, "process")):
+                # Same validation rule as metrics: a typo'd scope must
+                # not silently degrade a fleet scraper to one process.
+                if req.get("scope") != "fleet":
+                    raise _BadRequest(
+                        "events scope must be 'process' or 'fleet'"
+                    )
+                fleet = getattr(self.engine, "fleet", None)
+                if fleet is None or not hasattr(fleet, "fleet_events"):
+                    raise _BadRequest(
+                        "events scope 'fleet' needs a supervised "
+                        "process fleet behind this server "
+                        "(docs/scale-out.md 'Fleet-scope telemetry')"
+                    )
+                limit = req.get("limit")
+                if limit is not None and (not isinstance(limit, int)
+                                          or limit < 0):
+                    raise _BadRequest(
+                        "events limit must be an integer >= 0"
+                    )
+                if req.get("kind") is not None or "since" in req:
+                    # The fleet stream's per-child cursors are SHARED
+                    # server-side state: a kind-filtered pull would
+                    # advance them past every other-kind event
+                    # (dropped=0) and hide those events forever, and a
+                    # client `since` cannot seek them — refusing both
+                    # loudly beats silently returning an arbitrary
+                    # window.
+                    raise _BadRequest(
+                        "fleet-scope events supports neither kind nor "
+                        "since (server-side shared cursors page "
+                        "forward); filter the merged rows client-side"
+                    )
+                return fleet.fleet_events(limit=limit)
             if cmd == "events":
                 try:
                     # JSON null is a natural "from the start" / "no
@@ -449,12 +748,13 @@ class ModelServer:
                     )
                 return {"kernel_trace": summary()}
             if "requests" in req or "input_ids" in req:
-                return self._generate_guarded(req)
+                return self._generate_guarded(req, stream_f)
             accepted = [
                 f"cmd ({'|'.join(PROBE_CMDS)})",
                 "requests + gen_lens/temperatures/top_ps/top_ks/"
                 "deadline_s/trace_ids/ticket_ids/want_digest/"
-                "snapshots/prefill_only (continuous batching)",
+                "snapshots/prefill_only/stream/slo_class (continuous "
+                "batching)",
                 "input_ids + gen_len/prompt_start (fixed batch)",
             ]
             raise _BadRequest(
@@ -476,7 +776,7 @@ class ModelServer:
             self._count("errors")
             return self._error("internal", f"{type(e).__name__}: {e}")
 
-    def _generate_guarded(self, req: dict) -> dict:
+    def _generate_guarded(self, req: dict, stream_f=None) -> dict:
         """Admission control around the engine: refuse while draining,
         shed when too many payloads already wait on the engine lock."""
         if self._shutdown.is_set():
@@ -485,24 +785,35 @@ class ModelServer:
                 "shutting_down",
                 "server is draining; no new generation work accepted",
             )
+        shed_depth = None
         with self._pending_lock:
             if self._pending >= self.max_pending:
-                self._count("shed")
-                # Load-proportional backoff hint: clients that honor
-                # ``retry_after_s`` (see :func:`request`) spread their
-                # retries with the depth of the queue they bounced
-                # off, instead of hammering a shedding server in
-                # lockstep.
-                return self._error(
-                    "overloaded",
-                    f"{self._pending} generation payloads already "
-                    f"pending (bound {self.max_pending}); retry with "
-                    "backoff",
-                    retry_after_s=round(
-                        min(max(0.1 * self._pending, 0.05), 2.0), 3
-                    ),
-                )
-            self._pending += 1
+                shed_depth = self._pending
+            else:
+                self._pending += 1
+        if shed_depth is not None:
+            self._count("shed")
+            # Front-door sheds are MISSES: the user got nothing, and
+            # a server that sheds its way past the engine must not
+            # read as 100% goodput (the invariant
+            # docs/observability.md states; engine-level sheds are
+            # judged through their results the same way). Outside the
+            # pending lock: the ledger fold must not serialize the
+            # admission gate during exactly the storm that sheds.
+            self._observe_shed(req)
+            # Load-proportional backoff hint: clients that honor
+            # ``retry_after_s`` (see :func:`request`) spread their
+            # retries with the depth of the queue they bounced off,
+            # instead of hammering a shedding server in lockstep.
+            return self._error(
+                "overloaded",
+                f"{shed_depth} generation payloads already "
+                f"pending (bound {self.max_pending}); retry with "
+                "backoff",
+                retry_after_s=round(
+                    min(max(0.1 * shed_depth, 0.05), 2.0), 3
+                ),
+            )
         # Enqueue stamp BEFORE the engine lock: a request's queue-wait
         # must include the time its payload spent waiting on other
         # generations, not just the engine's admission queue.
@@ -510,15 +821,55 @@ class ModelServer:
         try:
             if self._concurrent:
                 self._count("requests")
-                return self._generate(req, enqueue_t)
+                return self._generate(req, enqueue_t, stream_f)
             with self._engine_lock:
                 self._count("requests")
-                return self._generate(req, enqueue_t)
+                return self._generate(req, enqueue_t, stream_f)
         finally:
             with self._pending_lock:
                 self._pending -= 1
 
-    def _generate(self, req: dict, enqueue_t: float | None = None) -> dict:
+    def _observe_synthetic(self, n: int, slo_class, enqueue_t,
+                           status: str, tokens_out: int = 0) -> None:
+        """Fold ``n`` synthetic wire timelines (no per-token stamps)
+        into the SLO ledger — THE shared implementation for front-door
+        sheds and fixed-batch serves, so the class-resolution rule
+        (unknown → ``default``, bounded cardinality) lives once."""
+        spec = self.slo_specs.get(
+            slo_class if isinstance(slo_class, str) else "default"
+        ) or self.slo_specs["default"]
+        for _ in range(max(int(n), 1)):
+            tl = Timeline()
+            if status == "ok":
+                # Only a SERVED synthetic gets measurable durations; a
+                # shed's ~0-second "e2e" would evaluate UNDER any e2e
+                # bound, recording a miss with zero violations — the
+                # unmeasurable-on-failure rule (obs/slo.py) is what
+                # makes violations explain every miss.
+                tl.enqueue_t = enqueue_t
+                tl.stamp_enqueue()
+            tl.tokens_out = tokens_out
+            tl.finish(status)
+            obs_slo.observe_wire(tl, spec)
+
+    def _observe_shed(self, req) -> None:
+        """Fold a front-door shed into the SLO ledger: one ``missed``
+        per request the refused payload carried (best-effort — the
+        payload was never validated). Internal fan-out payloads skip,
+        same as :meth:`_judge_wire`."""
+        if not isinstance(req, dict) or req.get("fanout"):
+            return
+        reqs = req.get("requests")
+        if isinstance(reqs, list):
+            n = len(reqs)
+        else:
+            rows = req.get("input_ids")
+            n = len(rows) if isinstance(rows, list) else 1
+        self._observe_synthetic(n, req.get("slo_class"), None,
+                                "overloaded")
+
+    def _generate(self, req: dict, enqueue_t: float | None = None,
+                  stream_f=None) -> dict:
         if "requests" in req:
             if not hasattr(self.engine, "run"):
                 raise _BadRequest(
@@ -570,13 +921,16 @@ class ModelServer:
                 trace_ids = [
                     None if x is None else str(x) for x in trace_ids
                 ]
-            # Ticket ids (docs/scale-out.md "Process fleet"): opaque
-            # per-request tokens a RemoteReplica uses to latch results
-            # by identity instead of position. The engine never sees
-            # them — they are echoed verbatim in the response, which is
-            # the whole contract: a response carrying an id the caller
-            # no longer waits on is recognized and discarded, so an
-            # at-least-once redispatch can never double-emit.
+            # Ticket ids (docs/scale-out.md "Process fleet",
+            # docs/serving.md "Streaming & cancellation"): per-request
+            # identities. A RemoteReplica latches results by them, the
+            # engines match cancellations against them, stream frames
+            # carry them — and they are echoed verbatim in the
+            # response, so a response carrying an id the caller no
+            # longer waits on is recognized and discarded (the
+            # at-least-once dedup). All of that keys BY id, so
+            # duplicates within one payload would silently conflate
+            # two requests — refused here, next to the shape check.
             ticket_ids = req.get("ticket_ids")
             if ticket_ids is not None and (
                     not isinstance(ticket_ids, list)
@@ -585,6 +939,14 @@ class ModelServer:
                     f"{len(prompts)} requests but ticket_ids is "
                     f"{ticket_ids!r} (want a {len(prompts)}-entry list)"
                 )
+            if ticket_ids is not None:
+                given = [str(t) for t in ticket_ids if t is not None]
+                if len(given) != len(set(given)):
+                    raise ValueError(
+                        "ticket_ids must be unique within a payload "
+                        "(results latch, cancellations match, and "
+                        "stream frames key by id)"
+                    )
             # Slot migration (docs/scale-out.md "Slot migration &
             # handoff"): per-request snapshots resume migrated work
             # (the engine imports instead of re-prefilling);
@@ -610,6 +972,59 @@ class ModelServer:
                     f"{prefill_only!r} (want a {len(prompts)}-entry "
                     "list)"
                 )
+            # SLO class (docs/observability.md "SLO goodput"): scalar
+            # or per-request list. Unknown classes collapse into the
+            # deployed `default` spec — outcome labels come from the
+            # CONFIGURED spec names, so a client can't grow the label
+            # cardinality with arbitrary strings.
+            slo_cls = req.get("slo_class")
+            if slo_cls is None:
+                slo_classes = ["default"] * len(prompts)
+            elif isinstance(slo_cls, str):
+                slo_classes = [slo_cls] * len(prompts)
+            elif (isinstance(slo_cls, list)
+                  and len(slo_cls) == len(prompts)):
+                slo_classes = [
+                    "default" if c is None else str(c) for c in slo_cls
+                ]
+            else:
+                raise ValueError(
+                    f"{len(prompts)} requests but slo_class is "
+                    f"{slo_cls!r} (want a string or a "
+                    f"{len(prompts)}-entry list)"
+                )
+            # Streaming (docs/serving.md "Streaming & cancellation"):
+            # per-token frames need cancellable identities — client
+            # ticket_ids when given, server-assigned otherwise (echoed
+            # in every frame and the summary).
+            stream = bool(req.get("stream"))
+            # Engine-side ids are ALWAYS strings: the cancel verb
+            # coerces its ids to str, so an int ticket_id here would
+            # make cancellation a silent no-op. The wire echo below
+            # still returns the client's ids verbatim.
+            eff_tids = (
+                None if ticket_ids is None
+                else [None if t is None else str(t) for t in ticket_ids]
+            )
+            sink = None
+            if stream:
+                if stream_f is None:
+                    raise _BadRequest(
+                        "streaming is only available over the socket "
+                        "transport"
+                    )
+                if eff_tids is None:
+                    eff_tids = [None] * len(prompts)
+                eff_tids = [
+                    t if t is not None
+                    else f"s{next(_STREAM_IDS)}p{os.getpid()}"
+                    for t in eff_tids
+                ]
+                sink = _StreamSink(self, stream_f, eff_tids)
+                sink.attach_enqueue(enqueue_t)
+                for i, sn in enumerate(snapshots):
+                    if isinstance(sn, dict):
+                        sink.seed(i, len(sn.get("out") or []))
             from triton_distributed_tpu.models.continuous import Request
 
             def _timeline() -> Timeline:
@@ -625,7 +1040,10 @@ class ModelServer:
                         trace_id=tid, snapshot=sn,
                         prefill_only=bool(po),
                         ticket_id=(
-                            None if ticket_ids is None else ticket_ids[i]
+                            None if eff_tids is None else eff_tids[i]
+                        ),
+                        on_token=(
+                            None if sink is None else sink.sink_for(i)
                         ),
                     )
                     for i, (p, g, t, tp, tk, dl, tid, sn, po) in enumerate(
@@ -653,8 +1071,46 @@ class ModelServer:
                 ],
                 "stats": self.engine.last_stats,
             }
-            if ticket_ids is not None:
-                resp["ticket_ids"] = ticket_ids
+            # Wire-side SLO accounting belongs at the USER-facing hop:
+            # internal fan-out payloads (a RemoteReplica batch carries
+            # "fanout") skip it, or the fleet scrape would double-count
+            # every request at the child AND the front.
+            judge = not req.get("fanout")
+            if sink is not None:
+                # Late worker callbacks stop, tokens a resume skipped
+                # back-fill, THEN the summary rides _respond.
+                sink.finish(results)
+                resp["frame"] = "summary"
+                # Client ids echo VERBATIM (the non-streaming
+                # contract); entries the client left null — and fully
+                # absent lists — surface the server-ASSIGNED ids the
+                # frames carried, so the summary always names every
+                # request's cancellable identity.
+                resp["ticket_ids"] = (
+                    eff_tids if ticket_ids is None
+                    else [t if t is not None else eff_tids[i]
+                          for i, t in enumerate(ticket_ids)]
+                )
+                resp["wire"] = self._judge_wire(
+                    sink.timelines, results, prompts, slo_classes,
+                    observe=judge,
+                )
+            else:
+                if judge:
+                    # Non-streamed payloads still fold an e2e-only
+                    # wire timeline into the SLO ledger (TTFT/TPOT
+                    # need frames; see docs/observability.md).
+                    tls = []
+                    for p in prompts:
+                        tl = Timeline()
+                        tl.enqueue_t = enqueue_t
+                        tl.stamp_enqueue()
+                        tls.append(tl)
+                    self._judge_wire(
+                        tls, results, prompts, slo_classes, observe=True,
+                    )
+                if ticket_ids is not None:
+                    resp["ticket_ids"] = ticket_ids
             if req.get("want_digest"):
                 # Batch-boundary digest publication over the wire: the
                 # RemoteReplica mirrors the in-process replica's
@@ -667,15 +1123,75 @@ class ModelServer:
                     digest() if digest is not None else None
                 )
             return resp
+        if req.get("stream"):
+            raise _BadRequest(
+                "streaming needs a 'requests' payload (continuous "
+                "batching); the fixed-batch input_ids path has no "
+                "per-token emission (docs/serving.md 'Streaming & "
+                "cancellation')"
+            )
         input_ids = np.asarray(req["input_ids"], np.int32)
         gen_len = int(req.get("gen_len", 16))
         out = self.engine.serve(
             input_ids, gen_len, prompt_start=req.get("prompt_start")
         )
+        if not req.get("fanout"):
+            # Fixed-batch serves are judged too (e2e only, one per
+            # batch row): without this, a workload driving only
+            # input_ids payloads would record its SHEDS as missed but
+            # never a met — goodput would read 0 on a healthy server.
+            self._observe_synthetic(
+                int(input_ids.shape[0]), req.get("slo_class"),
+                enqueue_t, "ok", tokens_out=gen_len,
+            )
         return {
             "output_ids": out.tolist(),
             "stats": self.engine.last_stats,
         }
+
+    def _judge_wire(self, timelines, results, prompts, slo_classes,
+                    *, observe: bool) -> list:
+        """Finish each request's WIRE-side timeline, judge it against
+        its SLO class, and (when ``observe``) fold it into the
+        ``tdt_slo_*`` ledger. Returns the summary's per-request
+        ``wire`` entries. Unknown classes resolve to the deployed
+        ``default`` spec (bounded label cardinality)."""
+        entries = []
+        for i, r in enumerate(results):
+            tl = timelines[i]
+            if r.status == "migrated":
+                # NON-terminal: the serving tier re-dispatches the
+                # snapshot and the request is judged exactly once, at
+                # its eventual completion — folding the export leg in
+                # would record a spurious miss per healthy migration.
+                entries.append({
+                    "slo_class": slo_classes[i],
+                    "outcome": "migrated",
+                    "status": r.status,
+                    "tokens_out": len(r.tokens),
+                    "ttft_s": None, "tpot_s": None, "e2e_s": None,
+                })
+                continue
+            tl.tokens_in = len(prompts[i])
+            tl.tokens_out = len(r.tokens)
+            tl.finish(r.status)
+            spec = self.slo_specs.get(slo_classes[i])
+            if spec is None:
+                spec = self.slo_specs["default"]
+            outcome = (
+                obs_slo.observe_wire(tl, spec) if observe
+                else obs_slo.judge(tl, spec)
+            )
+            entries.append({
+                "slo_class": spec.name,
+                "outcome": outcome,
+                "status": r.status,
+                "tokens_out": tl.tokens_out,
+                "ttft_s": tl.ttft_s,
+                "tpot_s": tl.tpot_s,
+                "e2e_s": tl.e2e_s,
+            })
+        return entries
 
     def _serve_conn(self, conn: socket.socket) -> None:
         conn.settimeout(self.IDLE_TIMEOUT_S)
@@ -741,7 +1257,7 @@ class ModelServer:
                         f"malformed JSON: {type(e).__name__}: {e}",
                     ))
                     continue
-                self._respond(f, self._dispatch(payload))
+                self._respond(f, self._dispatch(payload, stream_f=f))
                 if self._shutdown.is_set():
                     return
 
@@ -881,3 +1397,35 @@ def request(
                 continue
             raise RuntimeError(f"server error: {err}")
         return resp
+
+
+def request_stream(host: str, port: int, payload: dict,
+                   timeout: float = 120.0):
+    """Streaming client (docs/serving.md "Streaming & cancellation"):
+    a generator over the wire frames of one ``requests`` payload —
+    token frames as they arrive, then the summary frame, then it
+    stops. ``"stream": true`` is added to the payload. A structured
+    server error raises ``RuntimeError``; a connection that dies
+    mid-stream raises ``ConnectionError`` (whatever frames already
+    arrived were already yielded). To cancel mid-stream, send
+    ``{"cmd": "cancel", "ticket_ids": [...]}`` on a SECOND connection
+    using the tids the frames carry — or just close this one: the
+    server detects the disconnect at its next frame write and cancels
+    the payload's requests itself."""
+    payload = dict(payload)
+    payload["stream"] = True
+    with socket.create_connection((host, port), timeout=timeout) as s, \
+            s.makefile("rwb") as f:
+        f.write(json.dumps(payload).encode() + b"\n")
+        f.flush()
+        while True:
+            line = f.readline()
+            if not line:
+                raise ConnectionError("server closed mid-stream")
+            obj = json.loads(line)
+            if isinstance(obj, dict) and obj.get("error") is not None:
+                raise RuntimeError(f"server error: {obj['error']}")
+            yield obj
+            if not (isinstance(obj, dict)
+                    and obj.get("frame") == "token"):
+                return

@@ -291,6 +291,18 @@ class FleetSupervisor:
         self._router_kw = dict(router_kw or {})
         self._router_kw.setdefault("policy", policy)
         self.router: Router | None = None
+        # Fleet-scope telemetry aggregation (docs/scale-out.md
+        # "Fleet-scope telemetry"). TWO locks, deliberately:
+        # ``_scrape_lock`` serializes whole fleet_events scrapes
+        # (shared cursors mean concurrent scrapes would double-pull)
+        # and is held ACROSS the child RPCs — so nothing the monitor/
+        # respawn path needs may ever take it; ``_cursor_lock`` guards
+        # the cursor/seq state itself and is only ever held briefly,
+        # which is the one the respawn path's cursor reset uses.
+        self._scrape_lock = threading.Lock()
+        self._cursor_lock = threading.Lock()
+        self._event_cursors: dict[str, int] = {}
+        self._fleet_seq = 0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         # Monitor-vs-shutdown exclusion: a tick must not respawn into a
@@ -380,6 +392,11 @@ class FleetSupervisor:
             # ticket, which is how a restart-leftover snapshot finds
             # its re-submitted request.
             self.router.snapshot_provider = self._snapshot_for
+        # Fleet-scope scrape hand-off: the front ModelServer reaches
+        # fleet_metrics()/fleet_events() through its engine — the
+        # router IS that engine, so it carries the back-reference
+        # ({"cmd": "metrics", "scope": "fleet"}, docs/scale-out.md).
+        self.router.fleet = self
         self._thread = threading.Thread(
             target=self._monitor, daemon=True, name="fleet-supervisor",
         )
@@ -475,6 +492,135 @@ class FleetSupervisor:
             ],
             "log_dir": self.log_dir,
         }
+
+    # -- fleet-scope telemetry (docs/scale-out.md) --------------------------
+
+    def fleet_metrics(self) -> dict:
+        """ONE scrape for the whole fleet: fan the ``metrics`` verb
+        out to every live child, merge the expositions with a
+        ``replica`` label (``obs.metrics.merge_expositions``), and
+        include THIS process's registry as ``replica="router"`` — the
+        front tier's own tdt_router_*/tdt_server_*/tdt_slo_* series.
+        Each child's counters stay distinct series, so summing across
+        the replica label reproduces the children's own scrapes
+        exactly (tested). Unreachable children land in ``errors``
+        instead of failing the scrape — a fleet with a crashed replica
+        is precisely when you want the survivors' numbers. Children
+        are scraped serially (worst case N × the per-child timeout):
+        fine at this supervisor's single-host fleet sizes; fan the
+        calls out on threads before pointing it at a big fleet."""
+        from triton_distributed_tpu.obs.metrics import merge_expositions
+
+        parts: dict[str, str] = {"router": obs_metrics.prometheus_text()}
+        errors: dict[str, str] = {}
+        for slot in self._slots:
+            rep = slot.replica
+            if rep is None:
+                errors[slot.spec.name] = slot.last_failure or "down"
+                continue
+            remote = getattr(rep, "_remote", None)
+            if remote is None:
+                continue  # in-process replica: already in the registry
+            try:
+                resp = remote.call(
+                    {"cmd": "metrics"},
+                    timeout=max(self.heartbeat_timeout_s * 4, 2.0),
+                )
+                err = resp.get("error")
+                if err is not None:
+                    raise RuntimeError(str(err))
+                parts[rep.name] = str(resp.get("prometheus") or "")
+            except Exception as e:  # noqa: BLE001 — scrape survivors
+                errors[rep.name] = f"{type(e).__name__}: {e}"
+        merged = merge_expositions(parts, label="replica")
+        return {
+            "prometheus": merged,
+            "replicas": [n for n in parts if n != "router"],
+            "errors": errors,
+        }
+
+    def fleet_events(self, limit: int | None = None) -> dict:
+        """ONE event stream for the whole fleet: tail every child's
+        ring (per-child cursors persist across calls, so repeated
+        scrapes page forward drop-aware) plus this process's own ring,
+        tag each event with its ``replica``, and stitch them into one
+        ``fleet_seq`` order. Events are merged by their monotonic
+        stamps — CLOCK_MONOTONIC is system-wide on a host, and the
+        fleet is single-host by construction (the supervisor spawned
+        the children), so cross-process ordering by ``t`` is sound.
+        ``limit`` bounds each SOURCE's page, not the merged total.
+
+        No ``kind`` filter, deliberately: the cursors are SHARED state
+        — a kind-filtered pull would advance them past every
+        other-kind event with ``dropped=0``, silently hiding those
+        events from all later scrapes. Consumers filter the merged
+        rows client-side; likewise the stream assumes ONE logical
+        consumer (two independent fleet tailers steal from each
+        other)."""
+        from triton_distributed_tpu.obs import events as _events
+
+        with self._scrape_lock:  # serialize scrapes; respawn never
+            rows: list[dict] = []  # takes this lock (see __init__)
+            dropped = 0
+            errors: dict[str, str] = {}
+            for slot in self._slots:
+                rep = slot.replica
+                if rep is None:
+                    # Same visibility rule as fleet_metrics: a down
+                    # child's ABSENT events must read as "down", not
+                    # as "nothing happened" — this is exactly the
+                    # crash window whose events an operator needs.
+                    errors[slot.spec.name] = slot.last_failure or "down"
+                    continue
+                remote = getattr(rep, "_remote", None)
+                if remote is None:
+                    continue
+                with self._cursor_lock:
+                    since = self._event_cursors.get(slot.spec.name, 0)
+                payload: dict = {"cmd": "events", "since": since}
+                if limit is not None:
+                    payload["limit"] = limit
+                try:
+                    resp = remote.call(
+                        payload,
+                        timeout=max(self.heartbeat_timeout_s * 4, 2.0),
+                    )
+                    err = resp.get("error")
+                    if err is not None:
+                        raise RuntimeError(str(err))
+                except Exception as e:  # noqa: BLE001 — scrape survivors
+                    errors[rep.name] = f"{type(e).__name__}: {e}"
+                    continue
+                with self._cursor_lock:
+                    self._event_cursors[slot.spec.name] = int(
+                        resp.get("next_since", since)
+                    )
+                dropped += int(resp.get("dropped", 0) or 0)
+                for e in resp.get("events", []):
+                    if isinstance(e, dict):
+                        e = dict(e)
+                        e["replica"] = rep.name
+                        rows.append(e)
+            ring = _events.default_ring()
+            with self._cursor_lock:
+                since = self._event_cursors.get("__local__", 0)
+            evts, d = ring.tail(since, limit)
+            dropped += d
+            with self._cursor_lock:
+                self._event_cursors["__local__"] = (
+                    evts[-1].seq if evts else since + d
+                )
+            for e in evts:
+                row = e.as_dict()
+                row["replica"] = "router"
+                rows.append(row)
+            rows.sort(key=lambda e: e.get("t") or 0.0)
+            with self._cursor_lock:
+                base = self._fleet_seq
+                self._fleet_seq += len(rows)
+        for i, e in enumerate(rows):
+            e["fleet_seq"] = base + i + 1
+        return {"events": rows, "dropped": dropped, "errors": errors}
 
     # -- monitor -----------------------------------------------------------
 
@@ -748,6 +894,14 @@ class FleetSupervisor:
         # its snapshots must not outlive it into the fresh generation.
         with self._snap_lock:
             self._snaps.pop(slot.spec.name, None)
+        # A fresh child's event ring restarts at seq 1: the dead
+        # generation's cursor would make every event below it
+        # invisible to the fleet stream (with dropped=0) until the new
+        # ring caught up — exactly the crash-recovery events an
+        # operator needs most. _cursor_lock, NOT _scrape_lock: a slow
+        # fleet scrape must never stall a respawn.
+        with self._cursor_lock:
+            self._event_cursors.pop(slot.spec.name, None)
         slot.fails_in_a_row = 0  # a successful bind resets the backoff
         slot.missed_beats = 0
         slot.next_respawn_t = None
