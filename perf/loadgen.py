@@ -65,6 +65,12 @@ class LoadSpec:
     cancel_frac: float = 0.0
     cancel_after: int = 2
     slo_class: str = "default"
+    # Per-request class mix (docs/observability.md "SLO goodput"):
+    # ``((name, weight), ...)`` pairs — each request draws its
+    # ``slo_class`` with probability ∝ weight (seeded, replay-
+    # identical). Empty keeps the scalar ``slo_class`` for every
+    # request — traces from pre-mix specs are bit-identical.
+    class_mix: tuple = ()
     seed: int = 0
 
 
@@ -132,6 +138,18 @@ def generate_trace(spec: LoadSpec) -> list[dict]:
             "cancel_after": cancel_after,
             "slo_class": spec.slo_class,
         })
+    if spec.class_mix:
+        # Class draws come AFTER every pre-existing draw so a spec
+        # without a mix consumes the rng stream exactly as before —
+        # the cross-PR trace-identity contract stays intact.
+        names = [str(n) for n, _w in spec.class_mix]
+        w = np.asarray([float(wt) for _n, wt in spec.class_mix],
+                       np.float64)
+        if len(names) == 0 or (w <= 0).all():
+            raise ValueError(f"bad class_mix: {spec.class_mix!r}")
+        w = w / w.sum()
+        for row in trace:
+            row["slo_class"] = names[int(rng.choice(len(names), p=w))]
     return trace
 
 

@@ -102,10 +102,17 @@ def _fleet_demo(args) -> int:
 
     t0 = time.time()
     mode = args.mode if not (args.cpu and args.mode == "mega") else "xla"
+    pool_fleet = args.prefill_replicas > 0 or args.decode_replicas > 0
+    if pool_fleet:
+        members = (
+            [(f"p{i}", "prefill") for i in range(args.prefill_replicas)]
+            + [(f"d{i}", "decode") for i in range(args.decode_replicas)]
+        )
+    else:
+        members = [(f"r{i}", "mixed") for i in range(args.fleet)]
     if args.model == "stub":
-        specs = [
-            stub_spec(f"r{i}", delay_s=0.05) for i in range(args.fleet)
-        ]
+        def make_spec(name, role="mixed"):
+            return stub_spec(name, delay_s=0.05, role=role)
     else:
         child = [
             sys.executable, "-m",
@@ -125,15 +132,18 @@ def _fleet_demo(args) -> int:
             # derives its pull cadence from resume_dir) to hold any.
             child += ["--snapshot-every", "8"]
         env = {"JAX_PLATFORMS": "cpu"} if args.cpu else None
-        specs = []
-        for i in range(args.fleet):
+
+        def make_spec(name, role="mixed"):
             argv_i = list(child)
             if args.tier_dir:
                 argv_i += ["--tier-dir",
-                           os.path.join(args.tier_dir, f"r{i}")]
-            specs.append(ReplicaSpec(f"r{i}", argv_i, env=env))
+                           os.path.join(args.tier_dir, name)]
+            return ReplicaSpec(name, argv_i, env=env, role=role)
+
+    specs = [make_spec(name, role) for name, role in members]
     sup = FleetSupervisor(
         specs,
+        policy="pools" if pool_fleet else "affinity",
         resume_dir=(os.path.join(args.tier_dir, "resume")
                     if args.tier_dir else None),
         router_kw={
@@ -141,10 +151,26 @@ def _fleet_demo(args) -> int:
         },
     )
     router = sup.start()
+    scaler = None
+    if args.autoscale:
+        from triton_distributed_tpu.serving.autoscaler import Autoscaler
+
+        scaler = Autoscaler(
+            sup, lambda role, name: make_spec(name, role),
+            pool_bounds={
+                "prefill": (args.prefill_replicas,
+                            args.prefill_replicas + 2),
+                "decode": (args.decode_replicas,
+                           args.decode_replicas + 2),
+            },
+        ).start()
     server = ModelServer(router).start()
     print(json.dumps({
-        "serving": args.model, "mode": mode, "fleet": args.fleet,
-        "port": server.port, "logs": sup.log_dir,
+        "serving": args.model, "mode": mode,
+        "fleet": len(members), "pools": router.pool_shape()
+        if pool_fleet else None,
+        "autoscale": bool(scaler), "port": server.port,
+        "logs": sup.log_dir,
         "startup_s": round(time.time() - t0, 1),
     }), flush=True)
     try:
@@ -182,6 +208,8 @@ def _fleet_demo(args) -> int:
             request(server.host, server.port, {"cmd": "shutdown"},
                     timeout=10.0)
         server.shutdown()
+        if scaler is not None:
+            scaler.stop()
         sup.shutdown()
     return 0
 
@@ -243,6 +271,18 @@ def main(argv=None) -> int:
                    "--model/--mode/--kv-dtype/--speculative (note: "
                    "children load the NAMED preset — the demo's "
                    "depth-8 trim applies only in-process)")
+    p.add_argument("--prefill-replicas", type=int, default=0,
+                   help="role-typed PROCESS fleet: N children tagged "
+                   "prefill, routed with --policy pools "
+                   "(docs/scale-out.md 'Disaggregated pools & "
+                   "autoscaling'); goes with --decode-replicas and "
+                   "sizes the fleet itself — drop --fleet N")
+    p.add_argument("--decode-replicas", type=int, default=0,
+                   help="role-typed fleet: N children tagged decode "
+                   "(post-prefill slots decode here)")
+    p.add_argument("--autoscale", action="store_true",
+                   help="run the pool autoscaler over the role-typed "
+                   "fleet (needs --prefill-replicas/--decode-replicas)")
     p.add_argument("--stream", action="store_true",
                    help="drive the generation through the streaming "
                    "wire ('stream': true): tokens print as they "
@@ -289,6 +329,33 @@ def main(argv=None) -> int:
             "have no KV tier); --tier-dir still arms the supervisor's "
             "durable resume store, or use a real --model"
         )
+    # Role-typed pools ride the PROCESS fleet only — refuse by flag
+    # name everywhere else instead of silently serving an untyped
+    # fleet (docs/scale-out.md 'Disaggregated pools & autoscaling').
+    pool_fleet = args.prefill_replicas > 0 or args.decode_replicas > 0
+    if pool_fleet:
+        if args.prefill_replicas <= 0 or args.decode_replicas <= 0:
+            p.error(
+                "--prefill-replicas and --decode-replicas go together "
+                "(a one-role fleet has nowhere to hand prefilled "
+                "slots); give both, each >= 1"
+            )
+        if args.fleet:
+            p.error(
+                "--prefill-replicas/--decode-replicas size the fleet "
+                "themselves (prefill+decode children); drop --fleet N"
+            )
+        if args.replicas:
+            p.error(
+                "--prefill-replicas/--decode-replicas are PROCESS-"
+                "fleet pool shapes; in-process --replicas would "
+                "silently ignore the role tags — drop --replicas"
+            )
+    if args.autoscale and not pool_fleet:
+        p.error(
+            "--autoscale resizes role pools: add --prefill-replicas N "
+            "and --decode-replicas M"
+        )
 
     import jax
 
@@ -299,7 +366,7 @@ def main(argv=None) -> int:
     from triton_distributed_tpu.runtime.mesh import initialize_distributed
     from triton_distributed_tpu.serving.server import ModelServer, request
 
-    if args.fleet > 0:
+    if args.fleet > 0 or pool_fleet:
         return _fleet_demo(args)
 
     t0 = time.time()

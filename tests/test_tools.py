@@ -378,6 +378,35 @@ def test_goodput_modules_compile():
     )
 
 
+def test_pools_modules_compile():
+    """ISSUE-15: the elastic pool control plane must byte-compile —
+    pools.py/autoscaler.py are imported by the serving package (a
+    syntax error takes every fleet down at import time), and the
+    pools bench that writes perf/POOLS.json rides along (repo
+    convention: perf harnesses fail tier-1, not a relay window)."""
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    serving = os.path.join(root, "triton_distributed_tpu", "serving")
+    targets = [
+        os.path.join(serving, "pools.py"),
+        os.path.join(serving, "autoscaler.py"),
+        os.path.join(serving, "router.py"),
+        os.path.join(serving, "supervisor.py"),
+        os.path.join(root, "perf", "pools_bench.py"),
+    ]
+    proc = subprocess.run(
+        [sys.executable, "-m", "compileall", "-q", "-f", *targets],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, (
+        f"pool control-plane modules failed to compile:\n"
+        f"{proc.stdout}\n{proc.stderr}"
+    )
+
+
 def test_tier1_marker_audit():
     """ISSUE 8 satellite: the tier-1 window is spent by conftest's
     ``_FILE_ORDER`` schedule — audit it against reality so new trace
@@ -457,6 +486,21 @@ def test_tier1_marker_audit():
     gp_fast = fast_tests("test_goodput.py")
     assert len(gp_fast) >= 5, (
         f"SLO-goodput suite has too few tier-1-runnable tests: {gp_fast}"
+    )
+    # ISSUE-15: the elastic-pools suite (role scoring, scheduler
+    # waves/shedding, autoscaler control loop on a fake fleet, pools
+    # routing, batched handoff export) rides right behind the goodput
+    # suite, ahead of the interpret tail, and must carry tier-1-
+    # runnable tests — control-plane regressions have to FAIL tier-1,
+    # not wait for a pools_bench run.
+    assert "test_pools.py" in order
+    assert (order.index("test_goodput.py")
+            < order.index("test_pools.py")
+            < order.index("test_serving.py"))
+    pool_fast = fast_tests("test_pools.py")
+    assert len(pool_fast) >= 5, (
+        f"elastic-pools suite has too few tier-1-runnable tests: "
+        f"{pool_fast}"
     )
     # ISSUE-11: the MoE serving suite sits with the mega-family suites
     # (after the tracer suite, before the interpret-heavy tail) and

@@ -261,6 +261,11 @@ class Request:
     snapshot: dict | None = dataclasses.field(default=None, repr=False)
     prefill_only: bool = False
     ticket_id: str | None = None
+    # SLO class (obs/slo.py, serving/pools.py): admission class the
+    # goodput yardstick and the pool scheduler judge this request
+    # under. The engine itself never branches on it — it rides along
+    # so telemetry and router-side scheduling see one name end to end.
+    slo_class: str | None = None
     # Per-request PRNG state: sampled requests draw from their OWN key
     # via fold_in(key, key_step) — never the engine-global key — so a
     # migrated slot's seeded-sampled continuation replays the exact
@@ -333,6 +338,7 @@ class ContinuousEngine(MegaDispatch):
         tier_bytes: int = 0,
         tier_dir: str | None = None,
         tier=None,
+        handoff_batch: bool = True,
     ):
         self.model = model
         self.mode = mode
@@ -385,6 +391,13 @@ class ContinuousEngine(MegaDispatch):
         self.max_length = max_length or model.cfg.max_length
         self.pps = self.max_length // page_size
         self.max_queue = max_queue
+        # Handoff-burst batching (docs/scale-out.md "Disaggregated
+        # pools & autoscaling"): an armed drain sweep exports every
+        # active slot through ONE concatenated page gather
+        # (slot_state.export_slots_batch) instead of per-slot serial
+        # round trips. Bit-identical either way; the flag exists so
+        # perf/pools_bench.py can measure the wall delta.
+        self.handoff_batch = bool(handoff_batch)
 
         # +1: page 0 is reserved as the trash page every inactive slot's
         # table points at, and must not shave serviceable capacity.
@@ -2031,18 +2044,22 @@ class ContinuousEngine(MegaDispatch):
                 self.tier.delete(SNAP_KIND, tid)
             self._tier_snap_keys = set(snaps)
 
-    def _migrate_out(self, req: Request, reason: str) -> bool:
+    def _migrate_out(self, req: Request, reason: str,
+                     snap=None) -> bool:
         """Export ``req``'s slot and tear it down with status
         ``migrated`` (the serving tier re-dispatches the snapshot
         elsewhere). Returns False — and leaves the request RUNNING —
         when the export itself fails (e.g. an injected
         ``migrate.export`` fault): the slot then simply finishes here,
-        which keeps a handoff drain lossless either way."""
+        which keeps a handoff drain lossless either way. ``snap``
+        short-circuits the export with a snapshot the caller already
+        holds (the batched handoff sweep)."""
         from triton_distributed_tpu.models import slot_state
 
         slot = req.slot
         try:
-            snap = slot_state.export_slot(self, slot)
+            if snap is None:
+                snap = slot_state.export_slot(self, slot)
             req.snapshot = snap.to_wire()
         except Exception as e:  # noqa: BLE001 — export is best-effort
             obs_events.emit(
@@ -2068,11 +2085,29 @@ class ContinuousEngine(MegaDispatch):
         """The armed handoff fires: export every active slot (a slot
         whose export fails keeps decoding — retried next round) and
         mark everything still queued ``migrated`` with no snapshot
-        (nothing computed yet; it re-dispatches as a plain request)."""
+        (nothing computed yet; it re-dispatches as a plain request).
+
+        With ``handoff_batch`` (the default) the sweep gathers every
+        active slot's pages in ONE device round trip
+        (``slot_state.export_slots_batch`` — bit-identical to the
+        serial path); any batch failure (e.g. an injected
+        ``migrate.export`` fault) degrades to the per-slot exports, so
+        a single bad slot never blocks the others' handoff."""
+        from triton_distributed_tpu.models import slot_state
+
+        active = [s for s in range(self.max_batch)
+                  if self._slots[s] is not None]
+        snaps: dict = {}
+        if self.handoff_batch and len(active) > 1:
+            try:
+                snaps = slot_state.export_slots_batch(self, active)
+            except Exception:  # noqa: BLE001 — degrade to serial
+                snaps = {}
         changed = False
-        for slot in range(self.max_batch):
+        for slot in active:
             req = self._slots[slot]
-            if req is not None and self._migrate_out(req, "drain"):
+            if req is not None and self._migrate_out(
+                    req, "drain", snap=snaps.get(slot)):
                 changed = True
         while queue:
             r = queue.popleft()

@@ -220,18 +220,11 @@ def _arr_from_wire(d: dict | None) -> np.ndarray | None:
 # -- export ---------------------------------------------------------------
 
 
-def export_slot(engine, slot: int, *, target_digest=None) -> SlotSnapshot:
-    """Snapshot ``slot``'s live request from ``engine`` (pure read — the
-    slot keeps decoding; teardown is the caller's decision). Call at a
-    scheduling-round boundary on the engine's own thread: that is where
-    host tables, ``out``, and the device cache agree.
-
-    ``target_digest`` (a :meth:`PrefixCache.prefix_digest` forest from
-    the intended target) turns on the prefix delta: payload for leading
-    pages fully covered by the digest is omitted and
-    ``from_prefix_pages`` records how many the import must instead pin
-    from its own tree."""
-    fault_point("migrate.export", slot=slot)
+def _export_plan(engine, slot: int, target_digest):
+    """The read-only half of an export that decides WHAT ships:
+    ``(req, kv_len, skip, ship_ids)`` — the live request, its valid KV
+    rows, the prefix-delta page count, and the pool page ids whose
+    payload must travel."""
     req = engine._slots[slot]
     if req is None:
         raise SnapshotError(f"slot {slot} has no active request")
@@ -251,10 +244,14 @@ def export_slot(engine, slot: int, *, target_digest=None) -> SlotSnapshot:
         # import pins full tree pages, never a partial (COW) match.
         skip = min(matched // page, valid)
     ship_ids = [int(p) for p in req.pages[skip:valid]]
-    if ship_ids:
-        k, v, ks, vs = gather_pages(engine.cache, ship_ids)
-    else:
-        k = v = ks = vs = None
+    return req, kv_len, skip, ship_ids
+
+
+def _build_snapshot(engine, req, kv_len: int, skip: int,
+                    k, v, ks, vs) -> SlotSnapshot:
+    """Assemble one :class:`SlotSnapshot` from a plan plus its gathered
+    page payloads (``k``/``v``/``ks``/``vs`` may be views into a larger
+    batched gather — ``to_wire`` re-contiguifies)."""
     spec = None
     if req.spec is not None:
         spec = {
@@ -270,7 +267,7 @@ def export_slot(engine, slot: int, *, target_digest=None) -> SlotSnapshot:
         out=[int(t) for t in req.out],
         gen_len=int(req.gen_len),
         kv_len=kv_len,
-        page_size=page,
+        page_size=int(engine.page_size),
         kv_dtype=engine.kv_dtype,
         k_pages=k, v_pages=v, k_scale=ks, v_scale=vs,
         from_prefix_pages=skip,
@@ -281,6 +278,70 @@ def export_slot(engine, slot: int, *, target_digest=None) -> SlotSnapshot:
         trace_id=req.trace_id,
         exported_at=time.time(),
     )
+
+
+def export_slot(engine, slot: int, *, target_digest=None) -> SlotSnapshot:
+    """Snapshot ``slot``'s live request from ``engine`` (pure read — the
+    slot keeps decoding; teardown is the caller's decision). Call at a
+    scheduling-round boundary on the engine's own thread: that is where
+    host tables, ``out``, and the device cache agree.
+
+    ``target_digest`` (a :meth:`PrefixCache.prefix_digest` forest from
+    the intended target) turns on the prefix delta: payload for leading
+    pages fully covered by the digest is omitted and
+    ``from_prefix_pages`` records how many the import must instead pin
+    from its own tree."""
+    fault_point("migrate.export", slot=slot)
+    req, kv_len, skip, ship_ids = _export_plan(engine, slot,
+                                               target_digest)
+    if ship_ids:
+        k, v, ks, vs = gather_pages(engine.cache, ship_ids)
+    else:
+        k = v = ks = vs = None
+    return _build_snapshot(engine, req, kv_len, skip, k, v, ks, vs)
+
+
+def export_slots_batch(engine, slots, *,
+                       target_digest=None) -> dict:
+    """Snapshot several active slots in ONE device gather: slot →
+    :class:`SlotSnapshot`, bit-identical to per-slot
+    :func:`export_slot` calls (the gather is per-page, so splitting a
+    concatenated gather per slot reproduces each slot's pages exactly).
+
+    What changes is the cost shape: a drain sweep or a prefill burst
+    exporting ``n`` slots pays one ``jnp.take`` launch + one
+    device→host fetch over the concatenated ship lists instead of
+    ``n`` serial round trips (docs/scale-out.md "Disaggregated pools &
+    autoscaling"; ``perf/pools_bench.py`` measures the delta). A slot
+    with no live request raises :class:`SnapshotError`, same as the
+    serial path — filter first when sweeping."""
+    plans = []
+    for slot in slots:
+        fault_point("migrate.export", slot=slot)
+        plans.append((slot, *_export_plan(engine, slot, target_digest)))
+    all_ids: list[int] = []
+    for _slot, _req, _kv_len, _skip, ship_ids in plans:
+        all_ids.extend(ship_ids)
+    if all_ids:
+        k_all, v_all, ks_all, vs_all = gather_pages(engine.cache,
+                                                    all_ids)
+    else:
+        k_all = v_all = ks_all = vs_all = None
+    out: dict = {}
+    off = 0
+    for slot, req, kv_len, skip, ship_ids in plans:
+        n = len(ship_ids)
+        if n:
+            sl = slice(off, off + n)
+            k, v = k_all[:, sl], v_all[:, sl]
+            ks = None if ks_all is None else ks_all[:, sl]
+            vs = None if vs_all is None else vs_all[:, sl]
+        else:
+            k = v = ks = vs = None
+        off += n
+        out[slot] = _build_snapshot(engine, req, kv_len, skip,
+                                    k, v, ks, vs)
+    return out
 
 
 def prefix_delta(snap: SlotSnapshot, target_digest) -> SlotSnapshot:

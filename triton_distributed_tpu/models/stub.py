@@ -79,7 +79,8 @@ class StubEngine:
     """
 
     def __init__(self, *, num_pages: int = 128, page_size: int = 16,
-                 vocab: int = 211, delay_s: float = 0.0):
+                 vocab: int = 211, delay_s: float = 0.0,
+                 max_batch: int = 0):
         self.pool = PagePool(num_pages)
         self.page_size = int(page_size)
         self.prefix = PrefixCache(self.pool, self.page_size)
@@ -90,6 +91,17 @@ class StubEngine:
         # incremental snapshot buffer below has real partial progress
         # for a mid-batch SIGKILL to leave behind.
         self.delay_s = float(delay_s)
+        # Decode-slot capacity model: a real engine runs at most
+        # `max_batch` slots per continuous-batching round, so an
+        # N-request batch costs ceil(N / max_batch) rounds of wall
+        # time and requests past the cap don't see a first token until
+        # a slot frees. 0 = unbounded (the historical shape: one
+        # delay_s per engine batch no matter its size), which keeps
+        # the chaos suite fast; the capacity bench sets it so replica
+        # throughput is finite and saturation is measurable.
+        if int(max_batch) < 0:
+            raise ValueError(f"max_batch must be >= 0, got {max_batch}")
+        self.max_batch = int(max_batch)
         self.last_stats: dict = self._zero_stats()
         # Slot migration (docs/scale-out.md "Slot migration & handoff"):
         # the stub keeps a per-ticket snapshot of each in-flight
@@ -145,7 +157,6 @@ class StubEngine:
         buffer — so a mid-batch SIGKILL leaves resumable progress and a
         handoff request (:meth:`request_handoff`) exports mid-request."""
         stats = self._zero_stats()
-        total_toks = 0
         parsed = []
         for req in requests:
             prompt = getattr(req, "prompt", None)
@@ -155,22 +166,33 @@ class StubEngine:
             else:
                 gen_len = req.gen_len
             parsed.append((req, prompt, int(gen_len)))
-            total_toks += max(int(gen_len), 1)
-        sleep = self.delay_s / max(total_toks, 1)
+        # Capacity model: each `max_batch`-sized round costs one
+        # delay_s (spread over ITS tokens), so an over-cap batch's
+        # tail requests wait whole rounds for a slot — the queueing a
+        # saturated replica really exhibits, visible wire-side as
+        # first-token latency. max_batch=0 keeps the one-round shape.
+        round_size = self.max_batch or max(len(parsed), 1)
         outs: list[RequestResult] = []
-        for req, prompt, gen_len in parsed:
-            if self._handoff.is_set():
-                # Not-yet-started requests hand back un-run. NOT
-                # counted as migrated_out — nothing was exported; the
-                # real engine's sweep makes the same distinction, so
-                # stub and ContinuousEngine fleets report one schema.
-                outs.append(RequestResult(
-                    np.zeros(0, np.int32), "migrated",
-                    "handoff drain before admission",
-                    getattr(req, "snapshot", None),
-                ))
-                continue
-            outs.append(self._serve_one(req, prompt, gen_len, stats, sleep))
+        for lo in range(0, max(len(parsed), 1), round_size):
+            chunk = parsed[lo:lo + round_size]
+            chunk_toks = sum(max(g, 1) for _, _, g in chunk)
+            sleep = self.delay_s / max(chunk_toks, 1)
+            for req, prompt, gen_len in chunk:
+                if self._handoff.is_set():
+                    # Not-yet-started requests hand back un-run. NOT
+                    # counted as migrated_out — nothing was exported;
+                    # the real engine's sweep makes the same
+                    # distinction, so stub and ContinuousEngine fleets
+                    # report one schema.
+                    outs.append(RequestResult(
+                        np.zeros(0, np.int32), "migrated",
+                        "handoff drain before admission",
+                        getattr(req, "snapshot", None),
+                    ))
+                    continue
+                outs.append(
+                    self._serve_one(req, prompt, gen_len, stats, sleep)
+                )
         with self._snap_lock:
             self._snapshots = {}
         self._handoff.clear()  # one-shot, like the engine's _handoff_at

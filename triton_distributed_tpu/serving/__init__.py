@@ -13,6 +13,8 @@ keeps the wire server as the transport. The process fleet
 ``ModelServer`` and ``FleetSupervisor`` owns spawn/heartbeat/respawn.
 """
 
+from triton_distributed_tpu.serving.autoscaler import Autoscaler
+from triton_distributed_tpu.serving.pools import Scheduler
 from triton_distributed_tpu.serving.remote import (
     RemoteEngine,
     RemoteReplica,
@@ -34,8 +36,8 @@ from triton_distributed_tpu.serving.supervisor import (
 )
 
 __all__ = [
-    "EngineReplica", "FleetSupervisor", "ModelServer", "RemoteEngine",
-    "RemoteReplica", "ReplicaSpec", "Router", "SpawnError", "Ticket",
-    "model_spec", "request", "request_stream", "spawn_replica",
-    "stub_spec",
+    "Autoscaler", "EngineReplica", "FleetSupervisor", "ModelServer",
+    "RemoteEngine", "RemoteReplica", "ReplicaSpec", "Router",
+    "Scheduler", "SpawnError", "Ticket", "model_spec", "request",
+    "request_stream", "spawn_replica", "stub_spec",
 ]

@@ -1,0 +1,337 @@
+"""Goodput-driven pool autoscaler (docs/scale-out.md "Disaggregated
+pools & autoscaling").
+
+A control loop over the signals the fleet already exports — per-role
+slot occupancy and free pages (``tdt_pool_*``, ``serving/pools.py``),
+the router's shed-skip ledger (prefill queue pressure), and the SLO
+violation counters (``obs/slo.py``: TTFT violations indict the
+prefill pool, TPOT/e2e the decode pool) — resizing role pools through
+the supervisor:
+
+- **Scale-up** rides the existing respawn path: a fresh role-tagged
+  ``ReplicaSpec`` through ``FleetSupervisor.add_slot`` joins routing
+  the moment it spawns healthy.
+- **Scale-down** is the LOSSLESS drain: ``Router.drain_replica(name,
+  handoff=True)`` exports unfinished slots onto survivors (zero
+  tokens lost, zero duplicates — the PR 10 snapshot machinery), then
+  ``FleetSupervisor.retire_slot`` reaps the child.
+- **Stability** — hysteresis (separate up/down thresholds, scale-down
+  only after ``down_ticks`` consecutive calm ticks), per-pool
+  ``cooldown_s`` after any action, hard min/max bounds, and
+  crash-loop-breaker awareness: PARKED slots count toward the max AND
+  veto scale-up for their pool — the breaker parked that spec because
+  it crash-loops, and spawning more of the same would fight the
+  supervisor instead of serving anyone.
+
+The fleet surface is duck-typed (``router`` / ``pool_slots`` /
+``add_slot`` / ``retire_slot``) so unit tests drive the loop with a
+fake fleet and deterministic ``tick()`` calls; production wires it to
+a live ``FleetSupervisor`` (``run_server --autoscale``). Decisions
+land in ``tdt_autoscaler_*`` counters and ``autoscale`` events —
+emitted in the supervisor process, so a fleet-scope
+``{"cmd": "events", "scope": "fleet"}`` scrape shows every scaling
+decision tagged ``replica="router"`` (docs/observability.md).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+
+from triton_distributed_tpu.obs import events as obs_events
+from triton_distributed_tpu.obs import metrics as obs_metrics
+from triton_distributed_tpu.serving import pools
+from triton_distributed_tpu.serving.replica import DRAINED, HEALTHY
+
+
+def _handles(reg):
+    h = getattr(reg, "_autoscaler_handles", None)
+    if h is None:
+        h = {
+            "decisions": reg.counter(
+                "tdt_autoscaler_decisions_total",
+                "Autoscaler scaling actions taken, by action and "
+                "pool role.", labels=("action", "role")),
+            "skips": reg.counter(
+                "tdt_autoscaler_skips_total",
+                "Scaling intents vetoed (bounds, cooldown, parked "
+                "slots, respawn in progress), by reason.",
+                labels=("reason",)),
+            "pool_size": reg.gauge(
+                "tdt_autoscaler_pool_size",
+                "Pool size the autoscaler currently accounts for "
+                "(slots incl. parked), by role.", labels=("role",)),
+        }
+        reg._autoscaler_handles = h
+    return h
+
+
+def _violation_deltas(reg, last: dict) -> dict:
+    """Per-deadline SLO-violation deltas since the previous tick
+    (``tdt_slo_violations_total{slo_class,deadline}``): ``ttft``
+    indicts admission (prefill pool), ``tpot``/``e2e`` the decode
+    tail. Reads the live series racy-but-benign — a tick sees at
+    worst one sample late."""
+    out = {"ttft": 0, "tpot": 0, "e2e": 0}
+    m = reg.get("tdt_slo_violations_total")
+    if m is None:
+        return out
+    try:
+        di = m.label_names.index("deadline")
+    except ValueError:
+        return out
+    totals = {k: 0 for k in out}
+    for key, v in list(m._series.items()):
+        d = key[di]
+        if d in totals:
+            totals[d] += v
+    for k, total in totals.items():
+        out[k] = max(total - last.get(k, 0), 0)
+        last[k] = total
+    return out
+
+
+class Autoscaler:
+    """Resize role pools against fleet pressure.
+
+    ``pool_bounds`` maps role → ``(min, max)`` replica counts; only
+    roles listed there are managed. ``spec_factory(role, name)``
+    builds the ``ReplicaSpec`` a scale-up spawns. ``fleet`` is a
+    :class:`~triton_distributed_tpu.serving.supervisor.FleetSupervisor`
+    (or any object with the same ``router``/``pool_slots``/
+    ``add_slot``/``retire_slot`` surface).
+    """
+
+    def __init__(self, fleet, spec_factory, *,
+                 pool_bounds: dict,
+                 interval_s: float = 0.5,
+                 cooldown_s: float = 4.0,
+                 up_occupancy: float = 0.75,
+                 down_occupancy: float = 0.25,
+                 down_ticks: int = 4,
+                 drain_grace_s: float | None = None):
+        for role, (lo, hi) in pool_bounds.items():
+            pools.validate_role(role)
+            if not (0 <= lo <= hi):
+                raise ValueError(
+                    f"pool {role}: bad bounds min={lo} max={hi}")
+        if not (0.0 <= down_occupancy < up_occupancy <= 1.0):
+            raise ValueError(
+                f"need 0 <= down_occupancy < up_occupancy <= 1, got "
+                f"{down_occupancy}/{up_occupancy}")
+        self.fleet = fleet
+        self.spec_factory = spec_factory
+        self.pool_bounds = {r: (int(lo), int(hi))
+                            for r, (lo, hi) in pool_bounds.items()}
+        self.interval_s = float(interval_s)
+        self.cooldown_s = float(cooldown_s)
+        self.up_occupancy = float(up_occupancy)
+        self.down_occupancy = float(down_occupancy)
+        self.down_ticks = int(down_ticks)
+        self.drain_grace_s = drain_grace_s
+        self.stats = {"ticks": 0, "scale_ups": 0, "scale_downs": 0,
+                      "skips": 0}
+        self._cooldown_until = {r: 0.0 for r in self.pool_bounds}
+        self._calm_ticks = {r: 0 for r in self.pool_bounds}
+        self._last_shed_skips = None
+        self._last_violations: dict = {}
+        self._draining: dict[str, str] = {}  # slot name -> role
+        self._ids = itertools.count(1)
+        self._reg = obs_metrics.default_registry()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+
+    # -- loop --------------------------------------------------------------
+
+    def start(self) -> "Autoscaler":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="autoscaler")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception as e:  # noqa: BLE001 — the loop must survive
+                obs_events.emit("autoscale", action="error",
+                                reason=f"{type(e).__name__}: {e}"[:200])
+
+    # -- one decision round ------------------------------------------------
+
+    def tick(self, now: float | None = None) -> list[dict]:
+        """One control round; returns the decisions taken (also
+        emitted as ``autoscale`` events). Thread-safe but serialized —
+        the loop and a test driving ``tick()`` directly never overlap
+        decisions."""
+        with self._lock:
+            return self._tick_locked(
+                time.monotonic() if now is None else now)
+
+    def _tick_locked(self, now: float) -> list[dict]:
+        self.stats["ticks"] += 1
+        h = _handles(self._reg)
+        router = self.fleet.router
+        summary = pools.publish_pool_gauges(
+            router.replicas, self._reg)
+        shed_delta = self._shed_delta(router)
+        viol = _violation_deltas(self._reg, self._last_violations)
+        decisions: list[dict] = []
+        self._finish_retires(decisions)
+        for role, (lo, hi) in self.pool_bounds.items():
+            slots = self.fleet.pool_slots(role)
+            parked = [s for s in slots if s.get("parked")]
+            total = len(slots)
+            h["pool_size"].set(total, role=role)
+            sig = summary.get(role) or {"occupancy": 0.0, "replicas": 0,
+                                        "pending": 0, "free_pages": 0}
+            occ = sig["occupancy"]
+            # Pool-specific urgency on top of raw occupancy: prefill
+            # answers admission pressure (router shed-skips, TTFT
+            # violations), decode the generation tail (TPOT/e2e).
+            if role == pools.PREFILL and (shed_delta > 0
+                                          or viol["ttft"] > 0):
+                occ = max(occ, 1.0)
+            if role == pools.DECODE and (viol["tpot"] > 0
+                                         or viol["e2e"] > 0):
+                occ = max(occ, 1.0)
+            if role == pools.MIXED and (
+                    shed_delta > 0 or any(viol.values())):
+                occ = max(occ, 1.0)
+            healthy = sig["replicas"]
+            if occ >= self.up_occupancy:
+                self._calm_ticks[role] = 0
+                self._try_scale_up(role, total, hi, parked, occ, sig,
+                                   now, decisions)
+            elif occ <= self.down_occupancy and healthy > 0:
+                self._calm_ticks[role] += 1
+                if self._calm_ticks[role] >= self.down_ticks:
+                    self._try_scale_down(role, lo, occ, sig, now,
+                                         decisions)
+            else:
+                self._calm_ticks[role] = 0
+        return decisions
+
+    def _shed_delta(self, router) -> int:
+        cur = router.stats.get("shed_skips", 0)
+        last = self._last_shed_skips
+        self._last_shed_skips = cur
+        if last is None:
+            return 0
+        return max(cur - last, 0)
+
+    def _skip(self, role: str, reason: str, decisions: list) -> None:
+        self.stats["skips"] += 1
+        _handles(self._reg)["skips"].inc(reason=reason)
+        decisions.append({"action": "skip", "role": role,
+                          "reason": reason})
+        obs_events.emit("autoscale", action="skip", role=role,
+                        reason=reason)
+
+    def _try_scale_up(self, role, total, hi, parked, occ, sig, now,
+                      decisions) -> None:
+        if now < self._cooldown_until[role]:
+            self._skip(role, "cooldown", decisions)
+            return
+        if parked:
+            # Crash-loop breaker awareness: the supervisor parked this
+            # pool's spec because it crash-loops; spawning more of the
+            # same fights the breaker, not the load.
+            self._skip(role, "parked", decisions)
+            return
+        if total >= hi:
+            self._skip(role, "at_max", decisions)
+            return
+        if any(s.get("replica_state") not in (HEALTHY, DRAINED)
+               or s.get("down") for s in self.fleet.pool_slots(role)):
+            # A slot is already down/respawning: the supervisor is
+            # mid-recovery — adding capacity now would race it.
+            self._skip(role, "respawn_in_progress", decisions)
+            return
+        name = f"{role}-as{next(self._ids)}"
+        spec = self.spec_factory(role, name)
+        try:
+            self.fleet.add_slot(spec)
+        except Exception as e:  # noqa: BLE001 — spawn failures are data
+            self._skip(role, f"spawn_failed:{type(e).__name__}",
+                       decisions)
+            return
+        self.stats["scale_ups"] += 1
+        self._cooldown_until[role] = now + self.cooldown_s
+        h = _handles(self._reg)
+        h["decisions"].inc(action="scale_up", role=role)
+        decisions.append({"action": "scale_up", "role": role,
+                          "replica": name})
+        obs_events.emit(
+            "autoscale", action="scale_up", role=role, replica=name,
+            occupancy=round(occ, 3), pending=sig["pending"],
+            pool=total + 1,
+        )
+
+    def _try_scale_down(self, role, lo, occ, sig, now,
+                        decisions) -> None:
+        if now < self._cooldown_until[role]:
+            self._skip(role, "cooldown", decisions)
+            return
+        slots = self.fleet.pool_slots(role)
+        live = [s for s in slots
+                if not s.get("parked") and s["name"] not in
+                self._draining]
+        if len(live) <= lo:
+            self._skip(role, "at_min", decisions)
+            return
+        healthy = [s for s in live
+                   if s.get("replica_state") == HEALTHY]
+        if len(healthy) <= lo:
+            self._skip(role, "at_min", decisions)
+            return
+        victim = min(healthy, key=lambda s: s.get("pending", 0))
+        self._calm_ticks[role] = 0
+        self._cooldown_until[role] = now + self.cooldown_s
+        # Lossless drain: unfinished slots export and re-admit on the
+        # survivors through the snapshot machinery before the child is
+        # reaped — zero tokens lost, zero duplicates.
+        ok = self.fleet.router.drain_replica(
+            victim["replica_name"], self.drain_grace_s, handoff=True)
+        self.stats["scale_downs"] += 1
+        h = _handles(self._reg)
+        h["decisions"].inc(action="scale_down", role=role)
+        action = {"action": "scale_down", "role": role,
+                  "replica": victim["name"], "drained": bool(ok)}
+        obs_events.emit(
+            "autoscale", action="scale_down", role=role,
+            replica=victim["name"], drained=bool(ok),
+            occupancy=round(occ, 3), pool=len(live) - 1,
+        )
+        if ok:
+            self.fleet.retire_slot(victim["name"])
+        else:
+            # Drain timed out: the replica is DRAINING and off
+            # rotation (no new work lands on it); retire once its
+            # worker reports drained instead of killing in-flight
+            # work.
+            self._draining[victim["name"]] = role
+        decisions.append(action)
+
+    def _finish_retires(self, decisions: list) -> None:
+        for name, role in list(self._draining.items()):
+            for s in self.fleet.pool_slots(role):
+                if s["name"] == name:
+                    if s.get("replica_state") in (DRAINED, None):
+                        self.fleet.retire_slot(name)
+                        self._draining.pop(name, None)
+                        decisions.append({"action": "retired",
+                                          "role": role,
+                                          "replica": name})
+                    break
+            else:
+                self._draining.pop(name, None)

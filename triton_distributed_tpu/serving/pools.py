@@ -1,0 +1,272 @@
+"""Role-typed replica pools: placement scoring and SLO-aware
+scheduling (docs/scale-out.md "Disaggregated pools & autoscaling").
+
+The serving tier so far ran one undifferentiated pool: a prefill burst
+steals decode slots and a long decode tail starves admissions. This
+module is the pure half of the elastic control plane:
+
+- **Roles** — a replica carries ``role`` ∈ {``prefill``, ``decode``,
+  ``mixed``}. Roles are ROUTER-SIDE metadata: the engines behind the
+  replicas stay identical (any replica CAN do either phase — that is
+  what makes degraded fallback lossless), the role only steers
+  placement and scaling.
+- **Placement scoring** — :func:`decode_score` weighs a decode
+  target's radix-digest match against its pressure (slot occupancy +
+  free pages) instead of digest-match-only; the ``Router``'s
+  ``policy="pools"`` uses it to place migrated (post-prefill) work.
+- **Scheduler** — priority admission classes (PR 13's ``slo_class``),
+  per-step prefill/decode token budgets, and deadline-aware shedding
+  that prefers to shed requests already past their SLO.
+- **Pool gauges** — ``tdt_pool_*`` per-role fleet pressure, the
+  signals the :class:`~triton_distributed_tpu.serving.autoscaler.
+  Autoscaler` reads (docs/observability.md).
+
+Everything here is deterministic, process-local, and duck-typed
+against the replica surface (``role``/``state``/``pending``/
+``max_pending``/``free_pages``), so unit tests drive it with plain
+fakes and the router drives it with live replicas.
+"""
+
+from __future__ import annotations
+
+import time
+
+from triton_distributed_tpu.obs import events as obs_events
+from triton_distributed_tpu.obs import metrics as obs_metrics
+
+PREFILL = "prefill"
+DECODE = "decode"
+MIXED = "mixed"
+ROLES = (PREFILL, DECODE, MIXED)
+
+# decode_score weights: a full-prompt digest match is worth crossing a
+# fully-occupied replica's pressure penalty (2 > 1), but not twice —
+# pressure can still outvote a short match, which is the whole point
+# of weighing match against occupancy instead of match-only.
+MATCH_WEIGHT = 2.0
+PRESSURE_WEIGHT = 1.0
+FREE_WEIGHT = 0.25
+
+
+def replica_role(rep) -> str:
+    """A replica's role; anything that never declared one is
+    ``mixed`` (every pre-pools replica keeps its old behavior)."""
+    role = getattr(rep, "role", MIXED) or MIXED
+    return role if role in ROLES else MIXED
+
+
+def prefill_capable(rep) -> bool:
+    return replica_role(rep) in (PREFILL, MIXED)
+
+
+def decode_capable(rep) -> bool:
+    return replica_role(rep) in (DECODE, MIXED)
+
+
+def validate_role(role: str) -> str:
+    if role not in ROLES:
+        raise ValueError(
+            f"replica role must be one of {ROLES}, got {role!r}"
+        )
+    return role
+
+
+def occupancy(rep) -> float:
+    """Slot occupancy in [0, 1]: queued+in-flight over the routing
+    bound. The same pending/max_pending the shed-aware skip uses, as a
+    fraction — the decode-pressure half of placement and the
+    autoscaler's primary signal."""
+    cap = max(int(getattr(rep, "max_pending", 1)), 1)
+    return min(rep.pending / cap, 1.0)
+
+
+def decode_score(rep, matched: int, prompt_len: int, *,
+                 max_free: int = 0) -> float:
+    """Placement score for a decode hop: higher is better.
+
+    ``matched`` is the replica's radix-digest match in tokens for this
+    request's prompt; ``max_free`` normalizes the free-page term
+    across the candidate pool (pass the pool's max ``free_pages``; 0
+    disables the term — remote replicas report 0 free pages until
+    their first batch). A saturated replica with a perfect match can
+    still lose to an idle one with none: match wins ties, pressure
+    breaks monopolies."""
+    match_frac = matched / max(prompt_len, 1)
+    score = MATCH_WEIGHT * match_frac - PRESSURE_WEIGHT * occupancy(rep)
+    if max_free > 0:
+        score += FREE_WEIGHT * (rep.free_pages / max_free)
+    return score
+
+
+def pool_shape(replicas) -> dict:
+    """Per-role replica counts: total and healthy (state ==
+    ``healthy``). The ``server_stats``/``stats``-verb surface of the
+    pool layout."""
+    shape = {r: {"replicas": 0, "healthy": 0} for r in ROLES}
+    for rep in replicas:
+        row = shape[replica_role(rep)]
+        row["replicas"] += 1
+        if getattr(rep, "state", "healthy") == "healthy":
+            row["healthy"] += 1
+    return shape
+
+
+def _handles(reg):
+    """Per-registry metric handles, resolved once (the obs/slo.py
+    caching pattern): pool pressure publishes on every autoscaler tick
+    and must not pay get-or-create lookups."""
+    h = getattr(reg, "_pool_handles", None)
+    if h is None:
+        h = {
+            "replicas": reg.gauge(
+                "tdt_pool_replicas",
+                "Healthy replicas per pool role.", labels=("role",)),
+            "pending": reg.gauge(
+                "tdt_pool_pending",
+                "Queued + in-flight tickets per pool role.",
+                labels=("role",)),
+            "free_pages": reg.gauge(
+                "tdt_pool_free_pages",
+                "KV pool pages free across a pool role's replicas.",
+                labels=("role",)),
+            "occupancy": reg.gauge(
+                "tdt_pool_occupancy",
+                "Mean slot occupancy (pending/max_pending) per pool "
+                "role, healthy replicas only.", labels=("role",)),
+            "shed": reg.counter(
+                "tdt_pool_sched_shed_total",
+                "Tickets shed by the pool scheduler (already past "
+                "their SLO deadline), by class.",
+                labels=("slo_class",)),
+            "deferred": reg.counter(
+                "tdt_pool_sched_deferred_total",
+                "Tickets deferred past a dispatch wave by the "
+                "prefill/decode token budgets."),
+        }
+        reg._pool_handles = h
+    return h
+
+
+def publish_pool_gauges(replicas, reg=None) -> dict:
+    """Fold the fleet's per-replica pressure into the ``tdt_pool_*``
+    gauges, per role, and return the computed summary (role →
+    replicas/pending/free_pages/occupancy). Healthy replicas only:
+    a draining or dead replica is not capacity."""
+    reg = reg if reg is not None else obs_metrics.default_registry()
+    h = _handles(reg)
+    out: dict = {}
+    for role in ROLES:
+        live = [r for r in replicas
+                if replica_role(r) == role
+                and getattr(r, "state", "healthy") == "healthy"]
+        pending = sum(r.pending for r in live)
+        free = sum(r.free_pages for r in live)
+        occ = (sum(occupancy(r) for r in live) / len(live)
+               if live else 0.0)
+        out[role] = {"replicas": len(live), "pending": pending,
+                     "free_pages": free, "occupancy": occ}
+        h["replicas"].set(len(live), role=role)
+        h["pending"].set(pending, role=role)
+        h["free_pages"].set(free, role=role)
+        h["occupancy"].set(occ, role=role)
+    return out
+
+
+class Scheduler:
+    """Priority admission + token budgets + deadline-aware shedding.
+
+    ``class_priority`` maps ``slo_class`` → rank (lower runs first;
+    unknown classes rank after every named one, in arrival order).
+    ``prefill_token_budget`` bounds the PROMPT tokens of fresh tickets
+    per dispatch wave; ``decode_token_budget`` bounds the remaining
+    GENERATION tokens of snapshot-resumed tickets per wave (0 = no
+    bound). A ticket larger than its whole budget still gets a wave of
+    its own — budgets pace, they never starve.
+
+    Shedding is deadline-aware and prefers the already-lost: a ticket
+    whose ``deadline_s`` has ALREADY elapsed (measured from its
+    enqueue stamp) is completed as ``deadline_exceeded`` up front —
+    the engine would shed it at admission anyway (PR 3), so spending a
+    dispatch hop on it only steals budget from requests that can still
+    meet their SLO.
+    """
+
+    def __init__(self, *, class_priority: dict | None = None,
+                 prefill_token_budget: int = 0,
+                 decode_token_budget: int = 0):
+        self.class_priority = dict(class_priority or {})
+        self.prefill_token_budget = int(prefill_token_budget)
+        self.decode_token_budget = int(decode_token_budget)
+
+    def priority(self, slo_class) -> int:
+        return self.class_priority.get(
+            slo_class or "default", len(self.class_priority)
+        )
+
+    def _cost(self, ticket) -> tuple[str, int]:
+        """(budget kind, token cost) for one ticket: fresh work costs
+        its prompt against the prefill budget; resumed work costs its
+        remaining generation against the decode budget."""
+        snap = getattr(ticket, "snapshot", None)
+        if snap is not None:
+            done = len(snap.get("out") or []) if isinstance(snap, dict) \
+                else 0
+            return "decode", max(int(ticket.gen_len) - done, 1)
+        return "prefill", max(len(ticket.prompt), 1)
+
+    def plan(self, tickets, now: float | None = None):
+        """Partition ``tickets`` into ``(waves, shed)``.
+
+        ``waves`` is a list of ticket lists: priority-ordered
+        (class rank, then arrival), each wave respecting both token
+        budgets. ``shed`` holds tickets already past their SLO
+        deadline — the caller completes them without dispatching."""
+        now = time.monotonic() if now is None else now
+        live, shed = [], []
+        for t in tickets:
+            dl = getattr(t, "deadline_s", None)
+            enq = getattr(t, "enqueue_t", None)
+            if dl is not None and enq is not None and now > enq + dl:
+                shed.append(t)
+            else:
+                live.append(t)
+        order = sorted(
+            range(len(live)),
+            key=lambda i: (self.priority(getattr(live[i], "slo_class",
+                                                 None)), i),
+        )
+        waves: list[list] = []
+        budgets = {"prefill": self.prefill_token_budget,
+                   "decode": self.decode_token_budget}
+        wave: list = []
+        spent = {"prefill": 0, "decode": 0}
+        for i in order:
+            t = live[i]
+            kind, cost = self._cost(t)
+            cap = budgets[kind]
+            if wave and cap > 0 and spent[kind] + cost > cap:
+                waves.append(wave)
+                wave, spent = [], {"prefill": 0, "decode": 0}
+            wave.append(t)
+            spent[kind] += cost
+        if wave:
+            waves.append(wave)
+        return waves, shed
+
+    def record_plan(self, waves, shed, reg=None) -> None:
+        """Fold one plan into the scheduler telemetry: shed tickets by
+        class, deferred = everything past the first wave."""
+        reg = reg if reg is not None else obs_metrics.default_registry()
+        h = _handles(reg)
+        for t in shed:
+            h["shed"].inc(
+                slo_class=getattr(t, "slo_class", None) or "default")
+        deferred = sum(len(w) for w in waves[1:])
+        if deferred:
+            h["deferred"].inc(deferred)
+        if shed:
+            obs_events.emit(
+                "sched_shed", count=len(shed),
+                classes=sorted({getattr(t, "slo_class", None) or
+                                "default" for t in shed}),
+            )

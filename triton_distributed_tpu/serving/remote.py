@@ -264,7 +264,7 @@ class RemoteReplica(EngineReplica):
     """
 
     def __init__(self, host: str, port: int, *, name: str,
-                 proc=None, max_pending: int = 8,
+                 proc=None, max_pending: int = 8, role: str = "mixed",
                  connect_timeout_s: float = 10.0,
                  recv_timeout_s: float | None = None):
         self.proc = proc
@@ -275,7 +275,8 @@ class RemoteReplica(EngineReplica):
             recv_timeout_s=recv_timeout_s,
         )
         self._remote = remote
-        super().__init__(remote, name=name, max_pending=max_pending)
+        super().__init__(remote, name=name, max_pending=max_pending,
+                         role=role)
 
     @property
     def pid(self) -> int | None:
@@ -313,7 +314,8 @@ class RemoteReplica(EngineReplica):
         # server's knob() contract).
         for key, attr in (("temperatures", "temperature"),
                           ("top_ps", "top_p"), ("top_ks", "top_k"),
-                          ("deadline_s", "deadline_s")):
+                          ("deadline_s", "deadline_s"),
+                          ("slo_class", "slo_class")):
             vals = [getattr(t, attr) for t in tickets]
             if any(v is not None for v in vals):
                 payload[key] = vals

@@ -87,10 +87,11 @@ class Ticket:
                  "deadline_s", "enqueue_t", "reroutes", "replica_history",
                  "result", "_event", "_lock", "_rerouted_from",
                  "last_dispatch_t", "_prompt_list", "tid", "snapshot",
-                 "prefill_only", "on_token", "client_tid")
+                 "prefill_only", "on_token", "client_tid", "slo_class")
 
     def __init__(self, prompt, gen_len: int, *, temperature=None,
-                 top_p=None, top_k=None, deadline_s=None, enqueue_t=None):
+                 top_p=None, top_k=None, deadline_s=None, enqueue_t=None,
+                 slo_class=None):
         self.tid = f"t{next(_TICKET_IDS)}p{os.getpid()}"
         self.prompt = np.asarray(prompt, np.int32)
         self.gen_len = int(gen_len)
@@ -99,6 +100,11 @@ class Ticket:
         self.top_k = top_k
         self.deadline_s = deadline_s
         self.enqueue_t = enqueue_t
+        # Priority class (PR 13's ``slo_class``): rides the ticket so
+        # the pool scheduler can order and shed by class, and every
+        # dispatch (local or wire) rebuilds the Request with it — a
+        # migrated hop is judged under the SAME class it arrived with.
+        self.slo_class = slo_class
         self.reroutes = 0
         # Replica names in dispatch order. Appended by
         # EngineReplica.submit UNDER the replica's lock, atomically
@@ -165,6 +171,7 @@ class Ticket:
                 req.prompt, req.gen_len, temperature=req.temperature,
                 top_p=req.top_p, top_k=req.top_k, deadline_s=req.deadline_s,
                 enqueue_t=tl.enqueue_t if tl is not None else None,
+                slo_class=getattr(req, "slo_class", None),
             )
             if req.ticket_id is not None:
                 t.client_tid = str(req.ticket_id)
@@ -187,7 +194,7 @@ class Ticket:
             top_p=self.top_p, top_k=self.top_k, deadline_s=self.deadline_s,
             timeline=tl, snapshot=self.snapshot,
             prefill_only=self.prefill_only, ticket_id=self.tid,
-            on_token=self.on_token,
+            on_token=self.on_token, slo_class=self.slo_class,
         )
 
     def complete(self, result: RequestResult) -> bool:
@@ -257,14 +264,24 @@ class EngineReplica:
     MAX_RUN_BATCH = 64
 
     def __init__(self, engine, name: str | None = None, *,
-                 max_pending: int = 8):
+                 max_pending: int = 8, role: str = "mixed"):
         if not hasattr(engine, "run"):
             raise ValueError(
                 "EngineReplica wraps a ContinuousEngine (needs .run); "
                 f"got {type(engine).__name__}"
             )
+        if role not in ("prefill", "decode", "mixed"):
+            raise ValueError(
+                f"role must be 'prefill', 'decode', or 'mixed', "
+                f"got {role!r}"
+            )
         self.engine = engine
         self.name = name if name is not None else f"replica-{id(engine):x}"
+        # Pool role (docs/scale-out.md "Disaggregated pools &
+        # autoscaling"): router-side placement metadata — the engine
+        # behind a prefill replica is identical to a decode one, so
+        # degraded fallback (serving end-to-end on either) stays legal.
+        self.role = role
         self.max_pending = int(max_pending)
         self._cond = threading.Condition()
         self._queue: list[Ticket] = []
@@ -360,6 +377,7 @@ class EngineReplica:
         return {
             "name": self.name,
             "state": state,
+            "role": self.role,
             "pending": queued + inflight,
             "inflight": inflight,
             "free_pages": self.free_pages,

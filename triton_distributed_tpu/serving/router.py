@@ -51,6 +51,7 @@ from triton_distributed_tpu.models.continuous import (
 )
 from triton_distributed_tpu.obs import events as obs_events
 from triton_distributed_tpu.obs import metrics as obs_metrics
+from triton_distributed_tpu.serving import pools as pools_mod
 from triton_distributed_tpu.serving.replica import (
     DEAD,
     DRAINED,
@@ -87,12 +88,14 @@ class Router:
         max_reroutes: int = 2,
         request_timeout_s: float | None = None,
         replica_max_pending: int = 8,
+        scheduler=None,
     ):
         if policy not in ("affinity", "round_robin",
-                          "migrate_after_prefill"):
+                          "migrate_after_prefill", "pools"):
             raise ValueError(
-                "policy must be 'affinity', 'round_robin', or "
-                f"'migrate_after_prefill', got {policy!r}"
+                "policy must be 'affinity', 'round_robin', "
+                "'migrate_after_prefill', or 'pools', got "
+                f"{policy!r}"
             )
         self.replicas: list[EngineReplica] = [
             e if isinstance(e, EngineReplica)
@@ -108,6 +111,12 @@ class Router:
         self.drain_grace_s = float(drain_grace_s)
         self.max_reroutes = int(max_reroutes)
         self.request_timeout_s = request_timeout_s
+        # Pool scheduler (docs/scale-out.md "Disaggregated pools &
+        # autoscaling"): when set, run() orders each payload by
+        # priority class, paces it against the prefill/decode token
+        # budgets, and sheds tickets already past their SLO deadline
+        # before they cost a dispatch hop.
+        self.scheduler = scheduler
         # Crash-recovery snapshot feed (docs/scale-out.md "Slot
         # migration & handoff"): when set (the FleetSupervisor installs
         # it), a re-routed ticket without a snapshot asks the provider
@@ -136,6 +145,13 @@ class Router:
             # handoff drains and prefill→decode handoffs.
             "migrations": 0,
             "prefill_migrations": 0,
+            # Pool placement (policy="pools"): fresh hops landed on
+            # the prefill pool / migrated hops scored onto the decode
+            # pool, plus scheduler sheds (past-SLO tickets completed
+            # without a dispatch).
+            "pool_prefill": 0,
+            "pool_decode": 0,
+            "sched_sheds": 0,
         }
         for r in self.replicas:
             r.on_failure = self._on_replica_failure
@@ -192,7 +208,28 @@ class Router:
                     t.snapshot = self.snapshot_provider(t)
                 except Exception:  # noqa: BLE001 — recovery is best-effort
                     t.snapshot = None
-            self._dispatch(t)
+        if self.scheduler is not None:
+            # Pool scheduling (docs/scale-out.md "Disaggregated pools
+            # & autoscaling"): priority-ordered waves under the token
+            # budgets; tickets already past their SLO deadline shed
+            # HERE — the engine would deadline-shed them at admission
+            # anyway, so the hop they save goes to requests that can
+            # still meet their SLO.
+            waves, shed = self.scheduler.plan(tickets)
+            self.scheduler.record_plan(waves, shed)
+            for t in shed:
+                if t.complete(RequestResult(
+                    np.zeros(0, np.int32), "deadline_exceeded",
+                    "shed by pool scheduler: past SLO deadline "
+                    "before dispatch",
+                )):
+                    self._bump("sched_sheds")
+            for wave in waves:
+                for t in wave:
+                    self._dispatch(t)
+        else:
+            for t in tickets:
+                self._dispatch(t)
         outs = [self._await(t) for t in tickets]
         if results:
             return outs
@@ -244,6 +281,7 @@ class Router:
             router = dict(self.stats)
         router["policy"] = self.policy
         router["replicas"] = reps
+        router["pools"] = self.pool_shape()
         router["retired_replicas"] = len(self._retired)
         router["healthy_replicas"] = self._refresh_healthy()
         router["affinity_hit_rate"] = (
@@ -467,6 +505,8 @@ class Router:
         # anyway — the router never bounces a request it could hold
         # (the engine-side max_queue/deadline bounds still shed).
         pool = open_ or live
+        if self.policy == "pools":
+            return self._pick_pools(ticket, pool)
         if self.policy == "round_robin":
             with self._lock:
                 rep = pool[self._rr % len(pool)]
@@ -486,6 +526,48 @@ class Router:
         rep = min(pool, key=lambda r: (r.pending, -r.free_pages))
         return rep, 0, "least_loaded"
 
+    def _pick_pools(self, ticket: Ticket, pool):
+        """Role-aware placement (docs/scale-out.md "Disaggregated
+        pools & autoscaling"): a FRESH ticket prefills on the prefill
+        pool (prefix-affinity within it, least-loaded fallback); a
+        MIGRATED ticket decodes on the decode pool scored by
+        ``pools.decode_score`` — radix-digest match weighed against
+        slot occupancy and free pages instead of match-only. Either
+        pool being empty falls back to every open replica: roles
+        steer, they never strand."""
+        toks = ticket.prompt_tokens
+        if ticket.snapshot is not None:
+            cands = [r for r in pool if pools_mod.decode_capable(r)]
+            cands = cands or pool
+            max_free = max((r.free_pages for r in cands), default=0)
+            best, best_score, best_m = None, None, 0
+            for r in cands:
+                m = r.match_len(toks)
+                s = pools_mod.decode_score(r, m, len(toks),
+                                           max_free=max_free)
+                if best_score is None or s > best_score:
+                    best, best_score, best_m = r, s, m
+            return best, best_m, "pool_decode"
+        cands = [r for r in pool if pools_mod.prefill_capable(r)]
+        cands = cands or pool
+        best, best_len = None, 0
+        for r in cands:
+            m = r.match_len(toks)
+            if m > best_len or (
+                m == best_len and best is not None and m > 0
+                and r.pending < best.pending
+            ):
+                best, best_len = r, m
+        if best is not None and best_len > 0:
+            return best, best_len, "pool_prefill"
+        rep = min(cands, key=lambda r: (r.pending, -r.free_pages))
+        return rep, 0, "pool_prefill"
+
+    def pool_shape(self) -> dict:
+        """Per-role replica counts (total + healthy) — the pool-layout
+        surface ``server_stats`` and the stats verb expose."""
+        return pools_mod.pool_shape(self.replicas)
+
     def _dispatch(self, ticket: Ticket, exclude: str | None = None) -> None:
         # migrate_after_prefill (docs/scale-out.md "Slot migration &
         # handoff"): a fresh ticket's first hop only PREFILLS — the
@@ -496,6 +578,16 @@ class Router:
         if self.policy == "migrate_after_prefill":
             ticket.prefill_only = (
                 ticket.snapshot is None and len(self._candidates()) > 1
+            )
+        elif self.policy == "pools":
+            # Disaggregation proper: prefill-only iff the handoff has
+            # a decode-capable target to land on — otherwise the
+            # chosen replica serves end-to-end (a one-replica or
+            # prefill-only fleet stays correct, just not split).
+            live = self._candidates()
+            ticket.prefill_only = (
+                ticket.snapshot is None and len(live) > 1
+                and any(pools_mod.decode_capable(r) for r in live)
             )
         first = True
         while True:
@@ -521,6 +613,11 @@ class Router:
                 self._bump("least_loaded")
             elif decision == "round_robin":
                 self._bump("round_robin")
+            elif decision in ("pool_prefill", "pool_decode"):
+                self._bump(decision)
+                if matched > 0:
+                    self._bump("affinity_hit_tokens", matched)
+                    self._m_affinity.inc(matched)
             self._m_routed.inc(replica=rep.name, decision=decision)
             obs_events.emit(
                 "route", replica=rep.name, decision=decision,

@@ -113,12 +113,34 @@ def main(argv=None) -> int:
                    help="serve ONE ContinuousEngine (continuous "
                    "batching, 'requests' payloads) instead of the "
                    "fixed-batch Engine — the process-fleet child shape")
-    p.add_argument("--policy", default="affinity",
+    p.add_argument("--policy", default=None,
                    choices=["affinity", "round_robin",
-                            "migrate_after_prefill"],
+                            "migrate_after_prefill", "pools"],
                    help="router policy with --replicas/--fleet "
-                   "(migrate_after_prefill = prefill→decode handoff, "
-                   "docs/scale-out.md 'Slot migration & handoff')")
+                   "(migrate_after_prefill = prefill→decode handoff; "
+                   "pools = role-aware placement over prefill/decode "
+                   "pools, docs/scale-out.md 'Disaggregated pools & "
+                   "autoscaling'). Default: affinity, or pools when "
+                   "--prefill-replicas/--decode-replicas shape the "
+                   "fleet")
+    p.add_argument("--prefill-replicas", type=int, default=0,
+                   help="boot a ROLE-TYPED process fleet: N children "
+                   "tagged prefill (fresh requests land here; the "
+                   "pools policy hands their slots to the decode pool "
+                   "after the first token — docs/scale-out.md "
+                   "'Disaggregated pools & autoscaling'). Goes with "
+                   "--decode-replicas; sizes the fleet itself, so "
+                   "drop --fleet N")
+    p.add_argument("--decode-replicas", type=int, default=0,
+                   help="role-typed fleet: N children tagged decode "
+                   "(migrated post-prefill slots decode here, placed "
+                   "by digest-match vs pool pressure)")
+    p.add_argument("--autoscale", action="store_true",
+                   help="run the goodput-driven pool autoscaler over "
+                   "the role-typed fleet (scale-up spawns role-tagged "
+                   "children, scale-down drains losslessly; bounds "
+                   "[N, N+2] per pool) — needs --prefill-replicas/"
+                   "--decode-replicas")
     p.add_argument("--snapshot-every", type=int, default=0,
                    help="ContinuousEngine incremental slot snapshots "
                    "every N scheduling rounds (0 = off) — the "
@@ -170,6 +192,13 @@ def main(argv=None) -> int:
                    help="with --model stub: page-pool size")
     p.add_argument("--stub-page-size", type=int, default=16,
                    help="with --model stub: tokens per page")
+    p.add_argument("--stub-max-batch", type=int, default=0,
+                   help="with --model stub: decode-slot capacity per "
+                   "continuous-batching round (an N-request batch "
+                   "costs ceil(N/cap) rounds of --stub-delay wall "
+                   "time; 0 = unbounded). Gives a stub replica FINITE "
+                   "throughput so capacity benches can saturate it "
+                   "(perf/pools_bench.py)")
     p.add_argument("--slo-ttft-ms", type=float, default=0.0,
                    help="default-class SLO deadline on WIRE-side time "
                    "to first token, milliseconds (0 = unbounded); the "
@@ -221,6 +250,43 @@ def main(argv=None) -> int:
             "have no KV tier); --tier-dir still arms the supervisor's "
             "durable resume store, or use a real --model."
         )
+    # Role-typed pools (docs/scale-out.md "Disaggregated pools &
+    # autoscaling") — fail-fast by flag name on every path that would
+    # silently ignore them (the PR 12 guardrail convention).
+    pool_fleet = args.prefill_replicas > 0 or args.decode_replicas > 0
+    if pool_fleet:
+        if args.prefill_replicas <= 0 or args.decode_replicas <= 0:
+            p.error(
+                "--prefill-replicas and --decode-replicas go together "
+                "(a one-role fleet has nowhere to hand prefilled "
+                "slots); give both, each >= 1."
+            )
+        if args.fleet:
+            p.error(
+                "--prefill-replicas/--decode-replicas size the fleet "
+                "themselves (prefill+decode children); drop --fleet N."
+            )
+        if args.replicas or args.continuous:
+            p.error(
+                "--prefill-replicas/--decode-replicas are PROCESS-"
+                "fleet pool shapes; --replicas/--continuous serve "
+                "in-process engines that would silently ignore the "
+                "role tags. Drop those flags."
+            )
+        if args.policy not in (None, "pools"):
+            p.error(
+                f"--policy {args.policy} ignores replica roles; a "
+                "role-typed fleet routes with --policy pools (the "
+                "default when --prefill-replicas/--decode-replicas "
+                "are given)."
+            )
+    if args.autoscale and not pool_fleet:
+        p.error(
+            "--autoscale resizes role pools: add --prefill-replicas N "
+            "and --decode-replicas M (docs/scale-out.md "
+            "'Disaggregated pools & autoscaling')."
+        )
+    policy = args.policy or ("pools" if pool_fleet else "affinity")
 
     from triton_distributed_tpu.serving.server import ModelServer
 
@@ -239,25 +305,36 @@ def main(argv=None) -> int:
             e2e_s=(args.slo_e2e_ms / 1e3) if args.slo_e2e_ms else None,
         )
 
-    if args.fleet > 0:
+    if args.fleet > 0 or pool_fleet:
         # Supervised process fleet (docs/scale-out.md "Process
         # fleet"): N run_server children under the FleetSupervisor,
         # the router served from THIS process — no model loads here.
+        # --prefill-replicas/--decode-replicas shape the same fleet
+        # into role-typed pools (docs/scale-out.md "Disaggregated
+        # pools & autoscaling").
         from triton_distributed_tpu.serving.supervisor import (
             FleetSupervisor,
             ReplicaSpec,
             stub_spec,
         )
 
+        if pool_fleet:
+            members = (
+                [(f"p{i}", "prefill")
+                 for i in range(args.prefill_replicas)]
+                + [(f"d{i}", "decode")
+                   for i in range(args.decode_replicas)]
+            )
+        else:
+            members = [(f"r{i}", "mixed") for i in range(args.fleet)]
         if args.model == "stub":
-            specs = [
-                stub_spec(
-                    f"r{i}", delay_s=args.stub_delay,
+            def make_spec(name: str, role: str = "mixed") -> ReplicaSpec:
+                return stub_spec(
+                    name, delay_s=args.stub_delay,
                     num_pages=args.stub_pages,
-                    page_size=args.stub_page_size,
+                    page_size=args.stub_page_size, role=role,
+                    max_batch=args.stub_max_batch,
                 )
-                for i in range(args.fleet)
-            ]
         else:
             child = [
                 sys.executable, "-m",
@@ -287,8 +364,8 @@ def main(argv=None) -> int:
                 child += ["--moe-intermediate", str(args.moe_intermediate)]
             if args.tier_bytes:
                 child += ["--tier-bytes", str(args.tier_bytes)]
-            specs = []
-            for i in range(args.fleet):
+
+            def make_spec(name: str, role: str = "mixed") -> ReplicaSpec:
                 argv_i = list(child)
                 if args.tier_dir:
                     # Per-child tier dirs: one disk tier per engine
@@ -296,11 +373,13 @@ def main(argv=None) -> int:
                     # across children, but per-child dirs keep snapshot
                     # buffers and byte accounting disjoint).
                     argv_i += [
-                        "--tier-dir", os.path.join(args.tier_dir, f"r{i}")
+                        "--tier-dir", os.path.join(args.tier_dir, name)
                     ]
-                specs.append(ReplicaSpec(f"r{i}", argv_i))
+                return ReplicaSpec(name, argv_i, role=role)
+
+        specs = [make_spec(name, role) for name, role in members]
         sup = FleetSupervisor(
-            specs, policy=args.policy, snapshot_s=args.snapshot_s,
+            specs, policy=policy, snapshot_s=args.snapshot_s,
             # --tier-dir makes the FLEET restart-safe too: pulled
             # snapshots persist under DIR/resume and a restarted
             # supervisor resumes re-submitted requests from them.
@@ -312,17 +391,39 @@ def main(argv=None) -> int:
             },
         )
         router = sup.start()
+        scaler = None
+        if args.autoscale:
+            from triton_distributed_tpu.serving.autoscaler import (
+                Autoscaler,
+            )
+
+            scaler = Autoscaler(
+                sup, lambda role, name: make_spec(name, role),
+                pool_bounds={
+                    "prefill": (args.prefill_replicas,
+                                args.prefill_replicas + 2),
+                    "decode": (args.decode_replicas,
+                               args.decode_replicas + 2),
+                },
+                drain_grace_s=args.drain_grace,
+            ).start()
         server = ModelServer(
             router, host=args.host, port=args.port,
             drain_grace_s=args.drain_grace, slo=slo,
         )
-        print(f"serving {args.model} fleet x{args.fleet} "
-              f"({args.policy} router, logs {sup.log_dir}) on "
+        shape = (f"{args.prefill_replicas}p+{args.decode_replicas}d"
+                 if pool_fleet else f"x{args.fleet}")
+        print(f"serving {args.model} fleet {shape} "
+              f"({policy} router"
+              f"{', autoscaled' if scaler is not None else ''}, "
+              f"logs {sup.log_dir}) on "
               f"{server.host}:{server.port}")
         _write_port_file(args.port_file, server.host, server.port)
         try:
             server.serve_forever()
         finally:
+            if scaler is not None:
+                scaler.stop()
             sup.shutdown()
         return 0
 
@@ -334,7 +435,7 @@ def main(argv=None) -> int:
 
         engine = StubEngine(
             num_pages=args.stub_pages, page_size=args.stub_page_size,
-            delay_s=args.stub_delay,
+            delay_s=args.stub_delay, max_batch=args.stub_max_batch,
         )
         server = ModelServer(
             engine, host=args.host, port=args.port,
@@ -373,10 +474,10 @@ def main(argv=None) -> int:
             for i in range(args.replicas)
         ]
         engine = Router(
-            engines, policy=args.policy, drain_grace_s=args.drain_grace,
+            engines, policy=policy, drain_grace_s=args.drain_grace,
             request_timeout_s=args.request_timeout or None,
         )
-        what = f"{args.model} x{args.replicas} ({args.policy} router)"
+        what = f"{args.model} x{args.replicas} ({policy} router)"
     elif args.continuous:
         # The process-fleet child shape (docs/scale-out.md): ONE
         # ContinuousEngine speaking 'requests' payloads, with the

@@ -452,6 +452,15 @@ class ModelServer:
             name: spec.as_dict()
             for name, spec in sorted(self.slo_specs.items())
         }
+        # Pool shape (docs/scale-out.md "Disaggregated pools &
+        # autoscaling"): per-role replica counts when a pool-aware
+        # Router fronts the engine — absent for single-engine servers.
+        shape = getattr(self.engine, "pool_shape", None)
+        if callable(shape):
+            try:
+                stats["pools"] = shape()
+            except Exception:  # noqa: BLE001 — stats must answer
+                pass
         # --trace DIR deployments (run_server) surface where the
         # merged host+device timeline will land.
         stats["trace_dir"] = self.trace_dir
@@ -1039,6 +1048,7 @@ class ModelServer:
                         deadline_s=dl, timeline=_timeline(),
                         trace_id=tid, snapshot=sn,
                         prefill_only=bool(po),
+                        slo_class=slo_classes[i],
                         ticket_id=(
                             None if eff_tids is None else eff_tids[i]
                         ),

@@ -76,35 +76,43 @@ class ReplicaSpec:
     respawn as ``name#<generation>`` (router identities must be unique
     across a slot's lifetime — see ``Router.replace_replica``), while
     metrics stay labeled by the slot so respawns don't grow label
-    cardinality."""
+    cardinality. ``role`` tags the replica's pool (prefill / decode /
+    mixed, serving/pools.py) — router-side placement metadata only;
+    the child process is identical either way, and respawns keep the
+    slot's role across generations."""
 
     name: str
     argv: list[str]
     env: dict | None = None
+    role: str = "mixed"
 
 
 def stub_spec(name: str, *, delay_s: float = 0.0, num_pages: int = 256,
-              page_size: int = 16, extra: tuple = ()) -> ReplicaSpec:
+              page_size: int = 16, role: str = "mixed",
+              max_batch: int = 0, extra: tuple = ()) -> ReplicaSpec:
     """A deterministic stub-engine replica (models/stub.py) — what the
     chaos suite and ``perf/fleet_bench.py`` spawn: full wire server,
-    real radix control plane, no model load."""
+    real radix control plane, no model load. ``max_batch`` bounds the
+    child's per-round decode slots (0 = unbounded), giving it finite
+    throughput for capacity benches (perf/pools_bench.py)."""
     return ReplicaSpec(name, [
         sys.executable, "-m", "triton_distributed_tpu.serving.run_server",
         "--model", "stub", "--port", "0",
         "--stub-delay", str(delay_s),
         "--stub-pages", str(num_pages),
         "--stub-page-size", str(page_size),
+        "--stub-max-batch", str(max_batch),
         *extra,
-    ])
+    ], role=role)
 
 
-def model_spec(name: str, model: str = "tiny", *,
+def model_spec(name: str, model: str = "tiny", *, role: str = "mixed",
                extra: tuple = ()) -> ReplicaSpec:
     """A real-model replica child (the production shape)."""
     return ReplicaSpec(name, [
         sys.executable, "-m", "triton_distributed_tpu.serving.run_server",
         "--model", model, "--port", "0", *extra,
-    ])
+    ], role=role)
 
 
 def spawn_replica(spec: ReplicaSpec, *, generation: int = 0,
@@ -159,7 +167,8 @@ def spawn_replica(spec: ReplicaSpec, *, generation: int = 0,
         )
     host, _, port = addr.rpartition(":")
     return RemoteReplica(host, int(port), name=name, proc=proc,
-                         max_pending=max_pending)
+                         max_pending=max_pending,
+                         role=getattr(spec, "role", "mixed"))
 
 
 @dataclasses.dataclass
@@ -471,6 +480,87 @@ class FleetSupervisor:
                 return s
         raise KeyError(f"no slot named {name!r}")
 
+    # -- elastic slots (serving/autoscaler.py) ------------------------------
+
+    def pool_slots(self, role: str) -> list[dict]:
+        """Snapshot of every slot whose spec carries ``role`` — the
+        autoscaler's view of one pool (park/drain/respawn state per
+        slot), decoupled from the router's rotation."""
+        with self._lock:
+            rows = []
+            for s in self._slots:
+                if getattr(s.spec, "role", "mixed") != role:
+                    continue
+                rep = s.replica
+                rows.append({
+                    "name": s.spec.name,
+                    "parked": s.parked,
+                    "down": rep is None,
+                    "replica_name": (rep.name if rep is not None
+                                     else s.last_name),
+                    "replica_state": (rep.state if rep is not None
+                                      else None),
+                    "pending": rep.pending if rep is not None else 0,
+                })
+            return rows
+
+    def add_slot(self, spec: ReplicaSpec) -> RemoteReplica:
+        """Grow the fleet by one slot at runtime — the autoscaler's
+        scale-up path, riding the same spawn/handshake machinery as
+        boot. The child joins the router the moment it binds, and from
+        then on the monitor heartbeats/respawns/parks the new slot
+        exactly like a boot-time one. Raises :class:`SpawnError` (the
+        fleet is unchanged) when the child never binds."""
+        with self._lock:
+            if any(s.spec.name == spec.name for s in self._slots):
+                raise ValueError(f"slot {spec.name!r} already exists")
+            slot = _Slot(spec=spec)
+            rep = self._spawn(slot)
+            slot.replica = rep
+            slot.last_name = rep.name
+            self._slots.append(slot)
+            if self.router is not None:
+                self.router.add_replica(rep)
+            obs_events.emit(
+                "slot_added", slot=spec.name, replica=rep.name,
+                role=getattr(spec, "role", "mixed"), pid=rep.pid,
+            )
+            return rep
+
+    def retire_slot(self, name: str) -> bool:
+        """Remove one slot from supervision — the autoscaler's
+        scale-down path, called AFTER ``Router.drain_replica`` moved
+        the replica off rotation (its unfinished slots handed off
+        losslessly). Reaps the child process (the remote drain already
+        asked it to exit) and drops the slot's monitor/snapshot/cursor
+        state; the drained replica entry stays in the router so its
+        lifetime totals keep aggregating. Returns False for an unknown
+        slot."""
+        with self._lock:
+            for i, s in enumerate(self._slots):
+                if s.spec.name == name:
+                    slot = self._slots.pop(i)
+                    break
+            else:
+                return False
+            rep = slot.replica
+            proc = rep.proc if rep is not None else None
+            if proc is not None and proc.poll() is None:
+                try:
+                    proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait(timeout=10)
+            with self._snap_lock:
+                self._snaps.pop(name, None)
+            with self._cursor_lock:
+                self._event_cursors.pop(name, None)
+            obs_events.emit(
+                "slot_retired", slot=name,
+                replica=rep.name if rep is not None else slot.last_name,
+            )
+            return True
+
     def stats(self) -> dict:
         """The supervisor ledger (per-slot generation/parked/failure
         state) — surfaced by the fleet bench and debuggable from a
@@ -479,6 +569,7 @@ class FleetSupervisor:
             "slots": [
                 {
                     "name": s.spec.name,
+                    "role": getattr(s.spec, "role", "mixed"),
                     "generation": s.generation,
                     "respawns": s.respawns,
                     "parked": s.parked,
