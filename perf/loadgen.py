@@ -70,7 +70,20 @@ class LoadSpec:
     # ``slo_class`` with probability ∝ weight (seeded, replay-
     # identical). Empty keeps the scalar ``slo_class`` for every
     # request — traces from pre-mix specs are bit-identical.
+    #
+    # The class name ``"agentic"`` is special: those requests are
+    # reshaped into repetitive re-ask continuations — the prompt
+    # becomes its shared prefix plus one per-prefix motif repeated
+    # ``agentic_repeats`` times, the multi-turn agent-loop shape
+    # (same tool-call scaffolding re-sent each turn) that speculative
+    # decoding — n-gram AND radix-tree drafting — feeds on
+    # (docs/serving.md "Speculative decoding"). Motifs are drawn from
+    # the seeded rng AFTER every pre-existing draw, so specs without
+    # an agentic class (and all pre-mix specs) keep bit-identical
+    # traces.
     class_mix: tuple = ()
+    agentic_motif: int = 6
+    agentic_repeats: int = 3
     seed: int = 0
 
 
@@ -150,6 +163,26 @@ def generate_trace(spec: LoadSpec) -> list[dict]:
         w = w / w.sum()
         for row in trace:
             row["slo_class"] = names[int(rng.choice(len(names), p=w))]
+        if "agentic" in names:
+            # Repetitive re-ask continuation class: one motif PER
+            # PREFIX (drawn lazily, in row order — deterministic), so
+            # agentic requests sharing a prefix repeat the SAME
+            # continuation and the radix tree sees cross-request
+            # reuse, not just within-prompt n-gram repetition. Draws
+            # land after every other rng use: mixes without "agentic"
+            # consume the stream exactly as before.
+            motifs: dict[int, list[int]] = {}
+            for row in trace:
+                if row["slo_class"] != "agentic":
+                    continue
+                pi = row["prefix_id"]
+                if pi not in motifs:
+                    motifs[pi] = rng.integers(
+                        1, spec.vocab, size=spec.agentic_motif
+                    ).tolist()
+                row["prompt"] = (
+                    prefixes[pi] + motifs[pi] * spec.agentic_repeats
+                )
     return trace
 
 

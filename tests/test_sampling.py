@@ -128,3 +128,78 @@ def test_gumbel_max_matches_target_distribution():
         jax.random.key(1), (8,), jnp.float32
     ))
     assert int(zero) == int(sampling.greedy(logits))
+
+
+# -- filter edge cases (ISSUE 16: the tree verifier samples through
+# -- these exact filters at every node) ------------------------------------
+
+
+def test_top_k_at_or_above_vocab_matches_disabled():
+    """``top_k >= V`` must be EXACTLY the disabled filter (not an
+    off-by-one that drops the minimum): same filtered logits, same
+    target distribution, for k = V and beyond."""
+    rng = np.random.default_rng(9)
+    logits = _logits(rng.normal(size=6) * 3.0)
+    base = np.asarray(sampling.filter_logits(logits, 0.7, top_k=0))
+    for k in (6, 7, 100):
+        np.testing.assert_array_equal(
+            np.asarray(sampling.filter_logits(logits, 0.7, top_k=k)), base
+        )
+        np.testing.assert_array_equal(
+            np.asarray(sampling.target_probs(logits, 0.7, top_k=k)),
+            np.asarray(sampling.target_probs(logits, 0.7, top_k=0)),
+        )
+    assert np.isfinite(base).all()  # nothing masked
+
+
+def test_top_p_one_is_pure_temperature_scaling():
+    """``top_p=1.0`` takes the no-filter branch exactly: full support,
+    and ``target_probs`` is the plain tempered softmax."""
+    rng = np.random.default_rng(10)
+    logits = _logits(rng.normal(size=8))
+    t = 0.6
+    filtered = np.asarray(sampling.filter_logits(logits, t, top_p=1.0))
+    np.testing.assert_array_equal(
+        filtered, np.asarray(logits, np.float32) / t
+    )
+    probs = np.asarray(sampling.target_probs(logits, t, top_p=1.0))
+    expect = np.asarray(jax.nn.softmax(jnp.asarray(filtered)))
+    np.testing.assert_allclose(probs, expect, rtol=1e-6)
+    assert (probs > 0).all()
+
+
+def test_top_p_epsilon_boundary_around_cutoff():
+    """The nucleus keeps the smallest prefix whose cumulative mass
+    REACHES top_p: a hair below the top token's own mass keeps just it,
+    a hair above pulls in exactly one more token — the boundary the
+    acceptance rule's support comparison sits on."""
+    probs = np.asarray([0.5, 0.3, 0.15, 0.05], np.float64)
+    logits = _logits(np.log(probs))
+    eps = 1e-3
+    lo = np.asarray(sampling.filter_logits(logits, 1.0, top_p=0.5 - eps))
+    assert np.isfinite(lo[0]) and np.isneginf(lo[1:]).all()
+    hi = np.asarray(sampling.filter_logits(logits, 1.0, top_p=0.5 + eps))
+    assert np.isfinite(hi[:2]).all() and np.isneginf(hi[2:]).all()
+    # And the renormalized target matches the surviving prefix exactly.
+    tp = np.asarray(sampling.target_probs(logits, 1.0, top_p=0.5 + eps))
+    np.testing.assert_allclose(tp[:2], [0.5 / 0.8, 0.3 / 0.8], rtol=1e-5)
+    np.testing.assert_allclose(tp[2:], 0.0)
+
+
+def test_temperature_limit_converges_to_greedy():
+    """``t → 0+`` converges on the ``t=0`` one-hot path: the sampled
+    token equals the argmax for every key and the target distribution
+    approaches one-hot — no cliff between the two code paths."""
+    logits = _logits([0.3, 2.1, -0.5, 1.9, 0.0])
+    best = int(sampling.greedy(logits))
+    for t in (1e-2, 1e-4):
+        for s in range(6):
+            assert int(
+                sampling.sample(logits, jax.random.key(s), t)
+            ) == best
+        probs = np.asarray(sampling.target_probs(logits, t))
+        assert probs[best] > 1.0 - 1e-6
+    np.testing.assert_allclose(
+        np.asarray(sampling.target_probs(logits, 0.0)),
+        np.eye(5, dtype=np.float32)[best],
+    )

@@ -502,6 +502,20 @@ def test_tier1_marker_audit():
         f"elastic-pools suite has too few tier-1-runnable tests: "
         f"{pool_fast}"
     )
+    # ISSUE-16: the tree-speculation suite rides right behind the
+    # linear-speculation suite (shared tiny-model jit warmup), ahead of
+    # the interpret tail, and must carry tier-1-runnable tests — a
+    # tree-verify exactness regression has to FAIL tier-1, not wait
+    # for a spec_decode_bench run.
+    assert "test_tree_spec.py" in order
+    assert (order.index("test_speculative.py")
+            < order.index("test_tree_spec.py")
+            < order.index("test_serving.py"))
+    tree_fast = fast_tests("test_tree_spec.py")
+    assert len(tree_fast) >= 5, (
+        f"tree-speculation suite has too few tier-1-runnable tests: "
+        f"{tree_fast}"
+    )
     # ISSUE-11: the MoE serving suite sits with the mega-family suites
     # (after the tracer suite, before the interpret-heavy tail) and
     # must carry tier-1-runnable tests — the MoE fast path has to FAIL
@@ -713,10 +727,56 @@ def test_kv_tier_modules_compile():
     )
 
 
-def test_serving_cli_speculative_mega_conflict():
+def test_tree_speculation_modules_compile():
+    """ISSUE-16: every layer the tree-speculation path threads through
+    must byte-compile — the drafter/verifier, the radix proposer, the
+    row-move commit, the biased flash kernel and its model plumbing,
+    both engines, and the CPU-runnable bench that writes
+    perf/SPEC_DECODE.json (repo convention: perf harnesses fail
+    tier-1, not a relay window)."""
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    targets = [
+        os.path.join(root, "triton_distributed_tpu", "models",
+                     "speculative.py"),
+        os.path.join(root, "triton_distributed_tpu", "models",
+                     "prefix_cache.py"),
+        os.path.join(root, "triton_distributed_tpu", "models",
+                     "paged_kv_cache.py"),
+        os.path.join(root, "triton_distributed_tpu", "models",
+                     "qwen.py"),
+        os.path.join(root, "triton_distributed_tpu", "models",
+                     "engine.py"),
+        os.path.join(root, "triton_distributed_tpu", "models",
+                     "continuous.py"),
+        os.path.join(root, "triton_distributed_tpu", "layers",
+                     "tp_attn.py"),
+        os.path.join(root, "triton_distributed_tpu", "ops", "attention",
+                     "flash_attention.py"),
+        os.path.join(root, "perf", "spec_decode_bench.py"),
+        os.path.join(root, "perf", "loadgen.py"),
+    ]
+    proc = subprocess.run(
+        [sys.executable, "-m", "compileall", "-q", "-f", *targets],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, (
+        f"tree-speculation modules failed to compile:\n"
+        f"{proc.stdout}\n{proc.stderr}"
+    )
+
+
+def test_serving_cli_speculative_mega_conflict(capsys):
     """Both serving CLIs refuse --speculative with --mode mega by flag
-    name, BEFORE loading a model (argparse error → SystemExit 2), and
-    the spec-string parser round-trips the new overlap_ar field."""
+    name, BEFORE loading a model (argparse error → SystemExit 2) — for
+    EVERY --model spelling, including the ones whose name resolution
+    used to run first and die on a missing checkpoint instead of the
+    named-flag message (ISSUE-16 satellite). The refusal text names
+    the actual conflicting pair. The spec-string parser round-trips
+    the new overlap_ar field."""
     import os
     import sys
 
@@ -727,9 +787,12 @@ def test_serving_cli_speculative_mega_conflict():
     from triton_distributed_tpu.serving import run_server
 
     for main in (serve_demo.main, run_server.main):
-        with pytest.raises(SystemExit) as ei:
-            main(["--speculative", "2", "--mode", "mega"])
-        assert ei.value.code == 2  # argparse p.error exit code
+        for extra in ([], ["--model", "moe"], ["--model", "stub"]):
+            with pytest.raises(SystemExit) as ei:
+                main([*extra, "--speculative", "2", "--mode", "mega"])
+            assert ei.value.code == 2  # argparse p.error exit code
+            err = capsys.readouterr().err
+            assert "--speculative and --mode mega" in err, err
 
     from triton_distributed_tpu.megakernel.code_generator import MegaConfig
 

@@ -144,6 +144,8 @@ def tp_attn_prefill_paged_chunk(
     k_scale: jax.Array | None = None,  # [P, hkv_loc] f32 — int8 pool scales
     v_scale: jax.Array | None = None,
     q_end: jax.Array | None = None,    # scalar int32 — end of REAL rows
+    rope_pos: jax.Array | None = None,  # [C] int32 — rope positions (tree)
+    attn_bias: jax.Array | None = None,  # [C, S_kv] f32 additive mask
 ):
     """Per-shard chunked-prefill step over the paged pool (inside
     ``shard_map``): QKV for ``C`` suffix tokens, rope at absolute
@@ -164,6 +166,16 @@ def tp_attn_prefill_paged_chunk(
     with garbage amax, permanently requantizing accepted history
     against rows that are not part of the sequence.
 
+    ``rope_pos``/``attn_bias`` serve the tree-speculation verify chunk:
+    rows are tree NODES in DFS storage order, roped at their tree DEPTH
+    (``rope_pos[i] = q_offset + depth_i``, which differs from the
+    storage position for branched nodes) while the KV scatter keeps
+    storage positions ``q_offset + i`` — accepted rows later row-move to
+    their linear positions bit-identically, because K/V content depends
+    only on token and rope position. ``attn_bias`` masks sibling
+    branches out of each other's softmax (0 visible / -1e30 masked over
+    the gathered dense view).
+
     Activations stay replicated (decode's AR layout, not prefill's
     sequence-sharded one): chunks are short, so the ag/rs overlap machinery
     would buy nothing, and replication keeps one compiled program valid for
@@ -180,9 +192,10 @@ def tp_attn_prefill_paged_chunk(
     q, k, v = dims.split_qkv(qkv)  # [C, h, hd]
     q = _rms_head(q, params.q_norm)
     k = _rms_head(k, params.k_norm)
-    pos = q_offset + jnp.arange(c, dtype=jnp.int32)  # [C] absolute
-    q = apply_rope(q.swapaxes(0, 1), pos, dims.rope_theta)  # [h, C, hd]
-    k = apply_rope(k.swapaxes(0, 1), pos, dims.rope_theta)
+    pos = q_offset + jnp.arange(c, dtype=jnp.int32)  # [C] absolute storage
+    rpos = pos if rope_pos is None else rope_pos
+    q = apply_rope(q.swapaxes(0, 1), rpos, dims.rope_theta)  # [h, C, hd]
+    k = apply_rope(k.swapaxes(0, 1), rpos, dims.rope_theta)
     v = v.swapaxes(0, 1)
 
     # Scatter the chunk's KV through the table. Final-chunk right-padding
@@ -241,11 +254,13 @@ def tp_attn_prefill_paged_chunk(
         o = flash_attention(
             q[None], k_dense, v_dense, causal=True, kv_offset=q_offset,
             block_k=page, k_scale=ks_dense, v_scale=vs_dense,
+            bias=None if attn_bias is None else attn_bias[:, :s_max],
         )[0]  # [h, C, hd]
     else:
         o = flash_attention(
             q[None], k_dense, v_dense, causal=True, kv_offset=q_offset,
             block_k=128 if s_max % 128 == 0 else page,
+            bias=None if attn_bias is None else attn_bias[:, :s_max],
         )[0]  # [h, C, hd]
     o_flat = o.swapaxes(0, 1).reshape(c, dims.hq_loc * dims.head_dim)
     o_flat = o_flat.astype(x.dtype)

@@ -58,7 +58,10 @@ from triton_distributed_tpu.models.engine import (
     MegaDispatch,
     prefill_suffix_chunks,
 )
-from triton_distributed_tpu.models.stats import STAT_METRICS
+from triton_distributed_tpu.models.stats import (
+    STAT_METRIC_ALIASES,
+    STAT_METRICS,
+)
 from triton_distributed_tpu.obs import events as obs_events
 from triton_distributed_tpu.obs import metrics as obs_metrics
 from triton_distributed_tpu.obs.timeline import Timeline, observe_request
@@ -331,6 +334,7 @@ class ContinuousEngine(MegaDispatch):
         prefix_cache: bool = False,
         prefill_chunk: int = 0,
         speculative: int = 0,
+        spec_width: int = 4,
         max_queue: int | None = None,
         kv_dtype: str | None = None,
         kernel_trace: bool = False,
@@ -383,6 +387,21 @@ class ContinuousEngine(MegaDispatch):
         # scales (PR 7 lifted the old full-width exclusion).
         self.kv_dtype = kv_dtype if kv_dtype is not None else (
             model.cfg.kv_dtype
+        )
+        # Tree speculation (docs/serving.md "Speculative decoding"):
+        # with ``spec_width > 1`` a slot whose radix tree / KV tier
+        # remembers several continuations of its suffix drafts them as
+        # a token TRIE and verifies every branch in the one chunk
+        # forward (the pad rows a linear draft wastes carry the extra
+        # branches). Full-width pools only: the commit is a KV
+        # row-move, and ``quantized_row_scatter``'s reset-scales-at-
+        # offset-0 semantics make moved int8 rows unrepresentable —
+        # quantized pools keep width-1 chains (today's linear path,
+        # bit-for-bit).
+        self.spec_width = max(int(spec_width), 1)
+        self._spec_tree = (
+            bool(speculative) and self.spec_width > 1
+            and self.kv_dtype is None
         )
         self.eos_id = eos_id
         self.key = jax.random.key(seed)
@@ -468,9 +487,31 @@ class ContinuousEngine(MegaDispatch):
         # Registry.clear zeroes series in place, so the handles stay
         # valid across test resets.
         self._metric_handles = {
-            key: obs_metrics.counter(name, help)
+            key: [obs_metrics.counter(name, help)]
             for key, (name, help) in STAT_METRICS.items()
         }
+        # Fleet-dashboard aliases: extra registry names incrementing in
+        # lockstep with their primary (stats.py STAT_METRIC_ALIASES).
+        for key, aliases in STAT_METRIC_ALIASES.items():
+            self._metric_handles[key].extend(
+                obs_metrics.counter(name, help) for name, help in aliases
+            )
+        # Touch every unlabeled series at 0 so the full catalog renders
+        # from the first scrape — a counter that never fired (say,
+        # tree branch-accepts on a cold engine) must read 0 on the
+        # dashboard, not be indistinguishable from "not exported".
+        for handles in self._metric_handles.values():
+            for handle in handles:
+                handle.inc(0)
+        # Cumulative accept rate as a scrape-friendly gauge (the ratio
+        # of two counters is a dashboard recording rule away, but spec
+        # health is the first thing a tree-speculation rollout watches).
+        # Last-write-wins and UNLABELED like the gauges below.
+        self._spec_accept_gauge = obs_metrics.gauge(
+            "tdt_spec_accept_rate",
+            "Cumulative speculative accept rate (accepted / drafted) "
+            "of this process's serving engine.",
+        )
         # Last-write-wins and UNLABELED by design: a serving process
         # hosts one engine (ModelServer owns exactly one), so one
         # series is the truth there; with several engines in-process
@@ -558,6 +599,14 @@ class ContinuousEngine(MegaDispatch):
             "spec_draft_tokens": 0,
             "spec_accepted_tokens": 0,
             "spec_rollback_tokens": 0,
+            # Tree-speculation ledger (docs/serving.md "Speculative
+            # decoding"): multi-branch rounds, drafted trie nodes,
+            # cumulative drafted depth, and rounds whose accepted path
+            # left the primary branch (the row-move commits).
+            "spec_tree_rounds": 0,
+            "spec_tree_nodes": 0,
+            "spec_tree_depth": 0,
+            "spec_tree_branch_accepts": 0,
             # Fault-tolerance ledger (docs/serving.md "Fault tolerance").
             "failed_requests": 0,
             "cancelled_requests": 0,
@@ -642,7 +691,8 @@ class ContinuousEngine(MegaDispatch):
         (``last_stats``) and fleet-wide (``{"cmd": "metrics"}``).
         ``inc`` no-ops when telemetry is disabled."""
         self.stats[key] += n
-        self._metric_handles[key].inc(n)
+        for handle in self._metric_handles[key]:
+            handle.inc(n)
 
     def _finish_obs(self, req: Request) -> None:
         """Latch a request's terminal timeline stamp and fold it into
@@ -1362,15 +1412,29 @@ class ContinuousEngine(MegaDispatch):
 
     # -- speculative decoding ---------------------------------------------
 
+    def _new_spec_state(self):
+        """A fresh per-request SpecState under this engine's knobs —
+        admission and snapshot import build through here so both get
+        the same width ceiling (1 when tree speculation is off or the
+        pool is quantized)."""
+        from triton_distributed_tpu.models.speculative import SpecState
+
+        return SpecState(
+            self.speculative,
+            w_max=self.spec_width if self._spec_tree else 1,
+        )
+
     def _plan_drafts(self):
-        """Propose a draft for every active slot. Returns
+        """Propose a draft for every active slot — a ``TreeDraft`` when
+        tree speculation is on and the slot has a genuinely branching
+        candidate set, else today's linear token list. Returns
         ``(drafts, ok)``; ``ok=False`` when some slot is too close to
         ``max_length`` for even a zero-draft verify chunk (its pad rows
         would run past the page table) — that round must use the
         batched single-step decode instead."""
         from triton_distributed_tpu.models.speculative import cap_draft
 
-        drafts: dict[int, list[int]] = {}
+        drafts: dict = {}
         for slot, req in enumerate(self._slots):
             if req is None:
                 continue
@@ -1380,8 +1444,64 @@ class ContinuousEngine(MegaDispatch):
             )
             if k < 0:
                 return {}, False
-            drafts[slot] = req.spec.propose(k) if k > 0 else []
+            if k <= 0:
+                drafts[slot] = []
+                continue
+            if self._spec_tree and req.spec.width > 1:
+                tree = self._plan_tree(req, slot, k)
+                if tree is not None:
+                    drafts[slot] = tree
+                    continue
+            drafts[slot] = req.spec.propose(k)
         return drafts, True
+
+    def _plan_tree(self, req, slot: int, k: int):
+        """Build ``slot``'s draft trie for a ``k``-token budget, or
+        None when the candidates don't actually branch (a single path
+        keeps the linear verify — identical chunk shape, identical
+        per-verify PRNG consumption, no mask program).
+
+        Branch sources, merged by the trie (shared prefixes dedup):
+        the radix tree's continuations of the slot's FULL history
+        (other finished requests that shared this prefix and then
+        diverged), the durable KV tier's RAM-resident chains (spilled
+        continuations whose token identity survives in the headers),
+        and the slot's own n-gram proposal as the fallback branch.
+
+        Budgets: per-branch depth ≤ ``k`` (so emitted ≤ accepted+1
+        stays within the generation budget ``cap_draft`` enforced) and
+        total nodes ≤ ``round_chunk(k+1)`` — the branches beyond the
+        linear draft ride ONLY in rows the chunk would have padded
+        anyway, so a tree verify is never a bigger program than the
+        linear verify it replaces."""
+        from triton_distributed_tpu.models.speculative import TreeDraft
+
+        from triton_distributed_tpu.models import kv_tier
+
+        if self.prefix is None:
+            return None
+        hist = [int(t) for t in req.prompt] + [int(t) for t in req.out]
+        paths = self.prefix.propose_continuations(
+            hist, width=req.spec.width, depth=k,
+            tier_chains=(
+                self.tier.resident_chains()
+                if self.tier is not None
+                and self.tier.may_contain(kv_tier.PREFIX_KIND)
+                else None
+            ),
+        )
+        ngram = req.spec.propose(k)
+        if ngram:
+            paths.append(ngram)
+        if not paths:
+            return None
+        tree = TreeDraft(int(self._tok[slot]))
+        node_budget = round_chunk(k + 1)
+        for p in paths:
+            tree.add_path(p[:k], budget=node_budget)
+        if tree.is_chain:
+            return None
+        return tree
 
     def _spec_round(self, drafts: dict[int, list[int]]) -> bool:
         """One speculative round: every slot in ``drafts`` verifies its
@@ -1394,6 +1514,7 @@ class ContinuousEngine(MegaDispatch):
         error, clean teardown); the other slots' round proceeds.
         Returns whether slot state changed."""
         from triton_distributed_tpu.models.speculative import (
+            TreeDraft,
             spec_verify_slot,
         )
 
@@ -1406,6 +1527,17 @@ class ContinuousEngine(MegaDispatch):
             kv = int(self._kv_len[slot])
             draft = drafts[slot]
             t, p, k = self._request_sampling(req)
+            if isinstance(draft, TreeDraft):
+                if self._spec_tree_slot(req, slot, draft, kv, t, p, k,
+                                        bursts):
+                    any_failed = True
+                else:
+                    drafted_total += draft.num_drafted
+                    accepted_total += len(bursts[slot]) - 1
+                    rolled_total += (
+                        draft.num_drafted - (len(bursts[slot]) - 1)
+                    )
+                continue
             # One per-request subkey per verify (the internal
             # accept/resample splits derive from it) — the draw
             # sequence stays the request's own across a migration.
@@ -1470,7 +1602,76 @@ class ContinuousEngine(MegaDispatch):
             accept_rate=accepted_total / max(drafted_total, 1),
         ):
             self._sync_tables()
+        self._spec_accept_gauge.set(
+            self.stats["spec_accepted_tokens"]
+            / max(self.stats["spec_draft_tokens"], 1)
+        )
         return changed or any_failed
+
+    def _spec_tree_slot(
+        self, req, slot: int, tree, kv: int,
+        t: float, p: float, k: int, bursts: dict,
+    ) -> bool:
+        """One TREE verify of ``slot`` inside a speculative round:
+        single multi-branch chunk forward, sample/argmax-then-match
+        walk, row-move commit of the accepted branch. On success
+        ``bursts[slot]`` holds the emitted tokens and the host kv_len
+        is advanced (the round's ``_sync_tables`` is the rollback, as
+        in the linear path); returns True when the slot FAILED (fault
+        seam or non-finite logits — same isolation contract as the
+        linear arm)."""
+        from triton_distributed_tpu.models.speculative import (
+            commit_tree_path,
+            spec_verify_tree,
+        )
+
+        nk = (lambda: self._req_key(req)) if t > 0.0 else None
+        try:
+            emitted, self.cache, path = spec_verify_tree(
+                self.model, self.cache, slot, tree, kv,
+                self._prefill_mode, next_key=nk,
+                temperature=t, top_p=p, top_k=k,
+            )
+        except FaultError as e:
+            # The seam fires before the chunk donated the cache —
+            # per-slot isolation is safe (see the linear arm).
+            self._bump("decode_faults")
+            self._fail(req, "failed", f"{type(e).__name__}: {e}")
+            return True
+        except Exception:
+            # Post-donation failure: re-raise to _step_guard (the
+            # cache can no longer be trusted — same as the linear arm).
+            raise
+        if emitted is None:
+            self._bump("nonfinite_logits")
+            self._fail(
+                req, "nan_logits",
+                f"non-finite logits in speculative tree-verify chunk "
+                f"after {len(req.out)} tokens",
+            )
+            return True
+        a = len(path)
+        # Commit: the accepted branch's rows move from their DFS
+        # storage slots to the contiguous positions linear decode
+        # would have written; a primary-branch accept is a no-op.
+        moved = any(int(n) != j + 1 for j, n in enumerate(path))
+        self.cache = commit_tree_path(self.cache, slot, kv, path)
+        req.spec.record_tree(tree.num_drafted, tree.max_depth, a)
+        self._bump("spec_verify_steps")
+        self._bump("spec_tree_rounds")
+        self._bump("spec_tree_nodes", tree.num_drafted)
+        self._bump("spec_tree_depth", tree.max_depth)
+        if moved:
+            self._bump("spec_tree_branch_accepts")
+        if self._moe_k:
+            # The verify chunk routes every trie node's position.
+            self._bump("moe_routed_tokens", len(tree) * self._moe_k)
+        self._bump("spec_draft_tokens", tree.num_drafted)
+        self._bump("spec_accepted_tokens", a)
+        self._bump("spec_rollback_tokens", tree.num_drafted - a)
+        self._kv_len[slot] = kv + a + 1
+        bursts[slot] = emitted
+        return False
 
     def _maybe_finish(self, req: Request, t: int) -> bool:
         """Evict ``req`` if token ``t`` completed it (gen_len or eos)."""
@@ -1560,11 +1761,7 @@ class ContinuousEngine(MegaDispatch):
                 if req.timeline is not None:
                     req.timeline.stamp_first_token()
                 if self.speculative and req.spec is None:
-                    from triton_distributed_tpu.models.speculative import (  # noqa: E501
-                        SpecState,
-                    )
-
-                    req.spec = SpecState(self.speculative)
+                    req.spec = self._new_spec_state()
                     req.spec.observe(req.prompt)
                     req.spec.observe((int(first),))
                 req.out.append(int(first))

@@ -229,6 +229,7 @@ class Engine(MegaDispatch):
         prefix_cache: bool = False,
         prefill_chunk: int = 0,
         speculative: int = 0,
+        spec_width: int = 4,
         kv_dtype: str | None = None,
         kernel_trace: bool = False,
     ):
@@ -291,6 +292,16 @@ class Engine(MegaDispatch):
                     "not the megakernel"
                 )
         self.speculative = int(speculative)
+        # Tree speculation (docs/serving.md "Speculative decoding"):
+        # multi-branch draft tries verified in one chunk forward.
+        # Full-width pools only — the row-move commit cannot preserve
+        # quantized rows (scale reset at page offset 0), so int8 pools
+        # keep width-1 chains.
+        self.spec_width = max(int(spec_width), 1)
+        self._spec_tree = (
+            bool(speculative) and self.spec_width > 1
+            and self.kv_dtype is None
+        )
         # Device task tracer (docs/observability.md "Device task
         # tracer"): multi-step mega launches in serve() carry the
         # in-kernel trace ring; decoded launches feed
@@ -694,10 +705,14 @@ class Engine(MegaDispatch):
         excludes the prefill-sampled first token (already appended by
         ``serve``)."""
         from triton_distributed_tpu.models.paged_kv_cache import rollback_kv
+        from triton_distributed_tpu.models.prefix_cache import round_chunk
         from triton_distributed_tpu.models.speculative import (
             SpecState,
+            TreeDraft,
             cap_draft,
+            commit_tree_path,
             spec_verify_slot,
+            spec_verify_tree,
         )
         from triton_distributed_tpu.runtime.profiling import trace_span
 
@@ -705,17 +720,32 @@ class Engine(MegaDispatch):
         kv = true_lens.astype(np.int64).copy()
         outs, states = [], []
         for i in range(b):
-            st = SpecState(self.speculative)
+            st = SpecState(
+                self.speculative,
+                w_max=self.spec_width if self._spec_tree else 1,
+            )
             st.observe(rows[i][: int(true_lens[i])])
             st.observe([int(first_toks[i])])
             states.append(st)
             outs.append([int(first_toks[i])])
+        # Radix continuations feed the draft tries when the cross-serve
+        # prefix state exists — previous serves' finished chains are
+        # exactly the re-ask population tree speculation wins on.
+        radix = (
+            self._prefix_state.tree
+            if self._spec_tree and self._prefix_state is not None
+            else None
+        )
         counters = {
             "spec_verify_steps": 0,
             "spec_decode_steps": 0,
             "spec_draft_tokens": 0,
             "spec_accepted_tokens": 0,
             "spec_rollback_tokens": 0,
+            "spec_tree_rounds": 0,
+            "spec_tree_nodes": 0,
+            "spec_tree_depth": 0,
+            "spec_tree_branch_accepts": 0,
         }
 
         def verify_row(i, draft, cache):
@@ -752,6 +782,74 @@ class Engine(MegaDispatch):
             outs[i].extend(emitted)
             return cache
 
+        def verify_tree_row(i, tr, cache):
+            def nk():
+                self.key, sub = jax.random.split(self.key)
+                return sub
+
+            emitted, cache, path = spec_verify_tree(
+                self.model, cache, i, tr, int(kv[i]),
+                self._prefill_mode, next_key=nk,
+                temperature=self.temperature, top_p=self.top_p,
+                top_k=self.top_k,
+            )
+            if emitted is None:
+                from triton_distributed_tpu.models.sampling import (
+                    NonFiniteLogitsError,
+                )
+
+                raise NonFiniteLogitsError(
+                    f"non-finite logits in speculative tree-verify "
+                    f"chunk (row {i})", slot=i,
+                )
+            a = len(path)
+            counters["spec_verify_steps"] += 1
+            counters["spec_tree_rounds"] += 1
+            counters["spec_tree_nodes"] += tr.num_drafted
+            counters["spec_tree_depth"] += tr.max_depth
+            if any(int(n) != j + 1 for j, n in enumerate(path)):
+                counters["spec_tree_branch_accepts"] += 1
+            counters["spec_draft_tokens"] += tr.num_drafted
+            counters["spec_accepted_tokens"] += a
+            states[i].record_tree(tr.num_drafted, tr.max_depth, a)
+            # Commit the accepted branch's rows into linear positions
+            # BEFORE the rollback truncates kv_len past them.
+            cache = commit_tree_path(cache, i, int(kv[i]), path)
+            new_kv = int(kv[i]) + a + 1
+            if a < tr.num_drafted:
+                counters["spec_rollback_tokens"] += tr.num_drafted - a
+                with trace_span("spec:rollback", slot=i,
+                                tokens=tr.num_drafted - a):
+                    cache = rollback_kv(cache, i, new_kv)
+            kv[i] = new_kv
+            states[i].observe(emitted)
+            outs[i].extend(emitted)
+            return cache
+
+        def plan_row(i, k):
+            """One row's draft for a ``k``-token budget: a TreeDraft
+            when the candidate set genuinely branches, else a linear
+            token list (possibly radix-sourced), else None."""
+            if k <= 0:
+                return None
+            if radix is not None and states[i].width > 1:
+                paths = radix.propose_continuations(
+                    states[i].draft.history,
+                    width=states[i].width, depth=k,
+                )
+                ng = states[i].propose(k)
+                if ng:
+                    paths.append(ng)
+                if paths:
+                    tr = TreeDraft(outs[i][-1])
+                    for p in paths:
+                        tr.add_path(p[:k], budget=round_chunk(k + 1))
+                    if not tr.is_chain:
+                        return tr
+                    return tr.chain_tokens() or None
+                return None
+            return states[i].propose(k) or None
+
         while True:
             live = [i for i in range(b) if len(outs[i]) < gen_len]
             if not live:
@@ -763,11 +861,14 @@ class Engine(MegaDispatch):
                     states[i].k, int(kv[i]), budget, max_length
                 )
                 assert k >= 0, "speculative capacity guard violated"
-                d = states[i].propose(k) if k > 0 else []
-                if d:
+                d = plan_row(i, k)
+                if d is not None:
                     drafts[i] = d
             for i, draft in drafts.items():
-                cache = verify_row(i, draft, cache)
+                if isinstance(draft, TreeDraft):
+                    cache = verify_tree_row(i, draft, cache)
+                else:
+                    cache = verify_row(i, draft, cache)
             undrafted = [i for i in live if i not in drafts]
             if not undrafted:
                 continue

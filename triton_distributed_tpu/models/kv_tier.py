@@ -220,6 +220,13 @@ class PageStore:
         self._ram: "OrderedDict[tuple[str, str], bytes]" = OrderedDict()
         self._ram_bytes = 0
         self._lock = threading.RLock()
+        # Memo for :meth:`resident_chains`: (mutation counter, chains).
+        # Every RAM-membership change bumps ``_mut``, invalidating it —
+        # the tree-speculation drafter calls resident_chains once per
+        # verify round, and re-decoding every header each time would
+        # put a JSON parse loop on the decode path.
+        self._mut = 0
+        self._chain_memo: tuple[int, list[list[int]]] | None = None
         # Monotone per-kind non-emptiness flags (see :meth:`may_contain`):
         # one listdir at construction counts entries a PRIOR process
         # left on disk; every successful put flips the flag for good.
@@ -314,9 +321,11 @@ class PageStore:
             self._ram_bytes -= len(old)
         self._ram[(kind, key)] = blob
         self._ram_bytes += len(blob)
+        self._mut += 1
         while self._ram_bytes > self.capacity_bytes and len(self._ram) > 1:
             _, evicted = self._ram.popitem(last=False)
             self._ram_bytes -= len(evicted)
+            self._mut += 1
             self.stats["evictions"] += 1
             self._m_evictions.inc()
         self._g_bytes.set(self._ram_bytes)
@@ -447,6 +456,7 @@ class PageStore:
             blob = self._ram.pop((kind, key), None)
             if blob is not None:
                 self._ram_bytes -= len(blob)
+                self._mut += 1
                 self._g_bytes.set(self._ram_bytes)
             self.stats["drops"] += 1
         self._m_drops.inc()
@@ -467,6 +477,7 @@ class PageStore:
             blob = self._ram.pop((kind, key), None)
             if blob is not None:
                 self._ram_bytes -= len(blob)
+                self._mut += 1
                 self._g_bytes.set(self._ram_bytes)
         if self.dir:
             try:
@@ -483,6 +494,8 @@ class PageStore:
             for k in [k for k in self._ram if kind is None or k[0] == kind]:
                 self._ram_bytes -= len(self._ram.pop(k))
                 removed += 1
+            if removed:
+                self._mut += 1
             self._g_bytes.set(self._ram_bytes)
         if self.dir:
             for kd in (PREFIX_KIND, SNAP_KIND):
@@ -532,6 +545,42 @@ class PageStore:
                     except (OSError, ValueError):
                         continue
         return sorted(out)
+
+    def resident_chains(self) -> list[list[int]]:
+        """Token chains of the RAM-resident ``prefix`` entries — the
+        population the tree-speculation drafter scans for continuations
+        of a slot's history whose KV was evicted from the radix tree
+        but survives in this tier (``PrefixCache.propose_continuations``
+        ``tier_chains=``). Header-only decode: the payload arrays stay
+        base64; only the chain list is parsed. Memoized until the RAM
+        membership mutates, no stats / LRU movement / fault seams (a
+        draft read must never perturb the tier), disk-only entries are
+        deliberately out of scope (scanning a directory per verify
+        round is not a decode-path cost)."""
+        with self._lock:
+            memo = self._chain_memo
+            if memo is not None and memo[0] == self._mut:
+                return memo[1]
+            mut = self._mut
+            blobs = [
+                blob for (kd, _), blob in self._ram.items()
+                if kd == PREFIX_KIND
+            ]
+        chains: list[list[int]] = []
+        for blob in blobs:
+            try:
+                _, sep, body = blob[len(_MAGIC):].partition(b"\n")
+                if not blob.startswith(_MAGIC) or not sep:
+                    continue
+                chain = json.loads(body).get("chain")
+            except ValueError:
+                continue  # a later get() integrity-drops it
+            if isinstance(chain, list) and chain:
+                chains.append([int(t) for t in chain])
+        with self._lock:
+            if self._mut == mut:
+                self._chain_memo = (mut, chains)
+        return chains
 
     @property
     def ram_bytes(self) -> int:

@@ -393,6 +393,76 @@ _set_len_jit = jax.jit(
 )
 
 
+def move_kv_rows(
+    cache: PagedKVCache,
+    slot: int,
+    src: list[int],
+    dst: list[int],
+) -> PagedKVCache:
+    """Move token rows of ``slot`` from absolute positions ``src`` to
+    ``dst`` (both K and V, all layers) — the tree-speculation COMMIT:
+    a verify chunk wrote the draft tree's nodes at DFS storage
+    positions ``kv + i``, acceptance picked one root path, and its
+    nodes compact to the contiguous positions ``kv+1 .. kv+a`` a linear
+    decode would have written (the subsequent ``kv_len`` rollback then
+    makes every losing branch ordinary garbage-beyond-kv_len). Rows are
+    gathered BEFORE any scatter, so overlapping src/dst are safe; DFS
+    order guarantees a node's storage index is ≥ its depth, so every
+    move is leftward (``dst[i] <= src[i]``) and distinct dst never
+    collide. Moved rows are bit-identical to linearly-written rows:
+    K/V content depends only on the token and its rope position, and
+    tree nodes rope at their DEPTH, not their storage slot.
+
+    Full-width pools only: a quantized pool's per-page scales make a
+    row hop between pages a requantization event whose rounding depends
+    on move order — the engine keeps quantized pools on width-1 chains,
+    which never need moves. Positions are traced (one compiled program
+    per move-count bucket); the move list is right-padded to a power of
+    two with position-0 self-moves (position 0 is a prompt row, never a
+    real src or dst, and duplicate identical writes are benign).
+    """
+    if cache.quantized:
+        raise ValueError(
+            "move_kv_rows is full-width-pool only; quantized pools run "
+            "width-1 speculation chains (no row moves)"
+        )
+    if len(src) != len(dst):
+        raise ValueError(f"src/dst length mismatch ({len(src)} vs {len(dst)})")
+    pairs = [(int(s), int(d)) for s, d in zip(src, dst) if int(s) != int(d)]
+    if not pairs:
+        return cache
+    m = 1 << (len(pairs) - 1).bit_length()
+    pairs += [(0, 0)] * (m - len(pairs))
+    src_a = jnp.asarray([p[0] for p in pairs], jnp.int32)
+    dst_a = jnp.asarray([p[1] for p in pairs], jnp.int32)
+    page = int(cache.k_pages.shape[3])
+    k_pages, v_pages = _move_rows_jit(
+        cache.k_pages, cache.v_pages, cache.page_table[slot],
+        src_a, dst_a, page,
+    )
+    return dataclasses.replace(cache, k_pages=k_pages, v_pages=v_pages)
+
+
+def _move_rows(kp, vp, table_row, src, dst, page: int):
+    ps, so = jnp.take(table_row, src // page), src % page
+    pd, do = jnp.take(table_row, dst // page), dst % page
+    # Two advanced indices split by slices → advanced axes lead: the
+    # gathered rows are [m, L, H, hd]. Gather both pools before either
+    # scatter so overlapping positions read pre-move content.
+    rows_k = kp[:, ps, :, so, :]
+    rows_v = vp[:, ps, :, so, :]
+    kp = kp.at[:, pd, :, do, :].set(rows_k)
+    vp = vp.at[:, pd, :, do, :].set(rows_v)
+    return kp, vp
+
+
+# Donated like the other pool writers (an eager scatter would copy the
+# pool to move a handful of rows); one program per move-count bucket.
+_move_rows_jit = jax.jit(
+    _move_rows, static_argnums=(5,), donate_argnums=(0, 1)
+)
+
+
 def truncate_pages(
     pool: PagePool,
     pages: list[int],

@@ -499,6 +499,99 @@ class PrefixCache:
             yield n
             stack.extend(n.children.values())
 
+    # -- speculation ------------------------------------------------------
+
+    def propose_continuations(
+        self,
+        tokens,
+        *,
+        width: int,
+        depth: int,
+        tier_chains=None,
+    ) -> list[list[int]]:
+        """Draft continuations of ``tokens`` for tree speculation: up
+        to ``width`` candidate paths of up to ``depth`` tokens each,
+        read from what the radix tree remembers FOLLOWING this exact
+        history. The cache is a population of full (prompt + generated)
+        chains of finished sequences — when several of them shared this
+        request's history and then diverged, the divergence shows up
+        here as sibling children, and every branch is worth drafting
+        (the verify chunk scores them all in one forward).
+
+        Pure read: no pins, no LRU touch, no stats — drafting must
+        never perturb eviction order or hit-rate accounting. The walk
+        requires the FULL history to be cached (token-exact); any
+        mismatch or cache exhaustion before the history's end returns
+        no radix paths, because a continuation of a different prefix is
+        noise, not signal. Branch exploration is recency-first
+        (``last_use`` descending), so the paths lean toward what
+        recent traffic actually generated.
+
+        ``tier_chains`` extends the population with the durable KV
+        tier's RAM-resident chains (``PageStore.resident_chains``) —
+        continuations whose pages were evicted from the tree but whose
+        token identity survives in the spill headers. Matching there is
+        a flat prefix scan (the tier is keyed by digest, not by token).
+        """
+        toks = [int(t) for t in tokens]
+        width = max(int(width), 0)
+        depth = max(int(depth), 0)
+        out: list[list[int]] = []
+        if depth and width:
+            node, stem, i, dead = self.root, [], 0, False
+            while i < len(toks):
+                child = node.children.get(toks[i])
+                if child is None:
+                    dead = True
+                    break
+                lcp = 0
+                for a, b in zip(child.chunk, toks[i:i + len(child.chunk)]):
+                    if a != b:
+                        break
+                    lcp += 1
+                if lcp < len(child.chunk):
+                    if i + lcp == len(toks):
+                        # History ends INSIDE this chunk: the chunk's
+                        # own tail is the (single) continuation stem,
+                        # then the subtree below it.
+                        stem = [int(t) for t in child.chunk[lcp:]]
+                        node = child
+                    else:
+                        dead = True  # diverged mid-chunk: wrong prefix
+                    break
+                if len(child.chunk) < self.page_size and i + lcp < len(toks):
+                    dead = True  # partial leaf, history runs past it
+                    break
+                node = child
+                i += lcp
+
+            def descend(n: RadixNode, prefix: list[int]) -> None:
+                if len(out) >= width:
+                    return
+                if len(prefix) >= depth or not n.children:
+                    if prefix:
+                        out.append(prefix[:depth])
+                    return
+                for c in sorted(n.children.values(),
+                                key=lambda x: -x.last_use):
+                    descend(c, prefix + [int(t) for t in c.chunk])
+                    if len(out) >= width:
+                        return
+
+            if not dead:
+                descend(node, stem)
+        if tier_chains:
+            hits = 0
+            for chain in tier_chains:
+                if hits >= width:
+                    break
+                if len(chain) > len(toks) and chain[:len(toks)] == toks:
+                    out.append(
+                        [int(t) for t in chain[len(toks):len(toks) + depth]]
+                    )
+                    hits += 1
+        return out
+
 
 def digest_match_len(digest: list | None, tokens) -> int:
     """Longest cached prefix of ``tokens`` visible in a

@@ -369,6 +369,7 @@ class Qwen3:
 
     def _prefill_chunk_shard(
         self, params, tokens, cache, slot, q_offset, new_len, last_idx,
+        tree_mask=None, tree_depth=None,
         *, mode: Mode, kv_pages: int | None = None,
         all_logits: bool = False,
     ):
@@ -383,11 +384,36 @@ class Qwen3:
         prompt's last real token on the final chunk; ignored upstream on
         earlier chunks). Same layer scan as :meth:`_decode_shard_paged`
         with chunk attention against prefix pages + chunk.
+
+        ``tree_mask [C, C]``/``tree_depth [C]`` put the chunk in tree
+        mode (speculative tree verify): rows are draft-tree nodes in DFS
+        storage order, ``tree_mask[i, j]`` is 0 where node j is an
+        ancestor-or-self of node i and ``-1e30`` otherwise (sibling
+        branches never attend to each other), and each node ropes at
+        ``q_offset + tree_depth[i]`` — its position on its OWN root
+        path — while its KV still scatters at storage ``q_offset + i``.
+        The [C, C] mask expands to the gathered dense view's [C, S_kv]
+        additive bias once here (prefix columns fully visible, columns
+        past the chunk left to causality), shared by every layer.
         """
         cfg = self.cfg
         x = self._embed(params, tokens)  # [C, d]
         table_row = cache.page_table[slot]
         ar = "pallas_ar" if mode == "pallas" else "xla_ar"
+        rope_pos = attn_bias = None
+        if tree_mask is not None:
+            c = tokens.shape[0]
+            page = cache.k_pages.shape[3]
+            pps = table_row.shape[0] if kv_pages is None else kv_pages
+            cols = jnp.arange(pps * page, dtype=jnp.int32)
+            rel = jnp.clip(cols - q_offset, 0, c - 1)
+            in_chunk = (cols >= q_offset) & (cols < q_offset + c)
+            attn_bias = jnp.where(
+                in_chunk[None, :],
+                jnp.take(tree_mask.astype(jnp.float32), rel, axis=1),
+                0.0,
+            )  # [C, S_kv]
+            rope_pos = q_offset + tree_depth
 
         def layer_fn(carry, inp):
             x = carry
@@ -397,6 +423,7 @@ class Qwen3:
                 lp.attn, h, kp, vp, table_row, q_offset, self.dims,
                 kv_pages=kv_pages, axis=self.axis, mode=ar, ctx=self.ctx,
                 k_scale=ks, v_scale=vs, q_end=new_len,
+                rope_pos=rope_pos, attn_bias=attn_bias,
             )
             x = x + a
             h = rms_norm(x, lp.ln2, cfg.rms_eps)
@@ -435,6 +462,8 @@ class Qwen3:
         mode: Mode = "xla",
         kv_pages: int | None = None,
         all_logits: bool = False,
+        tree_mask=None,   # [C, C] f32 — 0 visible / -1e30 masked
+        tree_depth=None,  # [C] int32 — per-node depth below q_offset
     ):
         """Jitted chunked prefill of ``slot``'s suffix over the paged
         pool — the prefix-cache data plane: matched prefix pages are
@@ -443,33 +472,47 @@ class Qwen3:
         traced), so a handful of compiled programs serve every
         admission. Returns ``(last_idx logits [V], cache)`` — or
         ``(per-position logits [C, V], cache)`` with ``all_logits=True``
-        (the speculative verify path scores every chunk position)."""
+        (the speculative verify path scores every chunk position).
+        ``tree_mask``/``tree_depth`` (passed together) run the chunk as
+        a speculative draft TREE under a tree-attention mask — one
+        compiled tree program per chunk width, the mask and depths ride
+        as traced operands."""
         from triton_distributed_tpu.models.paged_kv_cache import (
             paged_cache_specs,
         )
 
         quant = cache.k_scale is not None
+        tree = tree_mask is not None
+        if tree != (tree_depth is not None):
+            raise ValueError("tree_mask and tree_depth go together")
         key = ("chunk", mode, int(tokens.shape[0]), kv_pages, all_logits,
-               quant)
+               quant, tree)
         if key not in self._prefill_jit:
+            tree_specs = (P(), P()) if tree else ()
             f = self.ctx.shard_map(
                 functools.partial(self._prefill_chunk_shard, mode=mode,
                                   kv_pages=kv_pages, all_logits=all_logits),
                 in_specs=(
                     self.param_specs, P(),
                     paged_cache_specs(self.axis, quant),
-                    P(), P(), P(), P(),
+                    P(), P(), P(), P(), *tree_specs,
                 ),
                 out_specs=(P(), paged_cache_specs(self.axis, quant)),
             )
             self._prefill_jit[key] = jax.jit(
-                lambda p, t, c, s, o, n, li: f(p, t, c, s, o, n, li),
+                lambda p, t, c, s, o, n, li, *tr: f(p, t, c, s, o, n, li,
+                                                    *tr),
                 donate_argnums=(2,),
             )
+        tree_args = ()
+        if tree:
+            tree_args = (jnp.asarray(tree_mask, jnp.float32),
+                         jnp.asarray(tree_depth, jnp.int32))
         return self._prefill_jit[key](
             self.params, jnp.asarray(tokens, jnp.int32), cache,
             jnp.asarray(slot, jnp.int32), jnp.asarray(q_offset, jnp.int32),
             jnp.asarray(new_len, jnp.int32), jnp.asarray(last_idx, jnp.int32),
+            *tree_args,
         )
 
     # -- jitted SPMD entry points ----------------------------------------

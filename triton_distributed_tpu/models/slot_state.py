@@ -258,6 +258,9 @@ def _build_snapshot(engine, req, kv_len: int, skip: int,
             "k_max": req.spec.k_max, "k_min": req.spec.k_min,
             "k": req.spec.k, "proposed": req.spec.proposed,
             "accepted": req.spec.accepted,
+            # Tree-speculation width ledger (absent on pre-tree
+            # snapshots; import defaults cover it).
+            "width": req.spec.width, "w_max": req.spec.w_max,
         }
     key_data = None
     if req.key is not None:
@@ -490,11 +493,18 @@ def import_slot(engine, req, snap: SlotSnapshot, slot: int) -> None:
     if engine.speculative:
         from triton_distributed_tpu.models.speculative import SpecState
 
-        st = SpecState(engine.speculative)
+        if hasattr(engine, "_new_spec_state"):
+            st = engine._new_spec_state()
+        else:
+            st = SpecState(engine.speculative)
         sp = snap.spec or {}
         st.k = int(sp.get("k", st.k))
         st.proposed = int(sp.get("proposed", 0))
         st.accepted = int(sp.get("accepted", 0))
+        # Width rides the snapshot (pre-tree snapshots omit it);
+        # clamped to THIS engine's ceiling — a full-width exporter's
+        # wide ledger must not make a quantized importer draft trees.
+        st.width = max(min(int(sp.get("width", st.width)), st.w_max), 1)
         st.observe(req.prompt)
         st.observe(req.out)
         req.spec = st

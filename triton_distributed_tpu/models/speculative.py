@@ -1,4 +1,5 @@
-"""Self-drafting speculative decoding: n-gram draft + one-forward verify.
+"""Self-drafting speculative decoding: n-gram/tree draft + one-forward
+verify.
 
 Steady-state decode pays one full target forward per emitted token —
 the serial bottleneck the paper's philosophy (hide latency behind work
@@ -34,6 +35,26 @@ arXiv:2401.16677): per-slot ``SpecState`` counts proposed/accepted and
 adapts K — additive growth on full acceptance, multiplicative back-off
 on any rejection — so a slot whose traffic stops drafting well stops
 paying verify overhead.
+
+**Tree speculation**: a linear draft bets everything on ONE
+continuation; when the radix tree (or the KV tier's spilled chains)
+has seen SEVERAL continuations of the slot's suffix, ``TreeDraft``
+stacks them into a token trie and verifies every branch in the SAME
+single forward. The chunk already pads to ``round_chunk`` rows, so the
+extra branches ride in rows a linear draft would have wasted on
+padding. A tree-attention mask (additive 0/-1e30 bias threaded down to
+the flash kernel) keeps siblings invisible to each other, and each
+node ropes at ``kv + depth`` — so an accepted branch's KV rows are
+bit-identical to the rows linear decode would have written, and the
+commit is a plain row-move (``paged_kv_cache.move_kv_rows``) followed
+by the usual kv_len rollback. Acceptance walks the tree root-down
+drawing the TARGET token first (argmax, or one per-request subkey per
+emitted token — the same key consumption as non-speculative decode)
+and descending into the drafted child that matches: the emitted stream
+never depends on the tree's shape, so greedy stays bit-identical,
+sampling stays exactly distribution-preserving, and seeded replays
+stay bit-exact even when the draft source (another request's radix
+residue) is not replayable.
 """
 
 from __future__ import annotations
@@ -121,7 +142,13 @@ class SpecState:
     A rejected verify still emits one token, so over-drafting costs
     only the wasted tail compute of one chunk — the controller's job is
     to bound that waste when acceptance collapses, not to give up
-    drafting on the first miss."""
+    drafting on the first miss.
+
+    Tree mode adds a WIDTH ledger (``record_tree``): a full-depth
+    accept widens the next tree by one branch (cap ``w_max``), a
+    zero-accept round narrows it by one — at width 1 the slot is back
+    to today's linear chain (or no draft at all when the drafter goes
+    quiet), so cold traffic pays nothing for the tree machinery."""
 
     def __init__(
         self,
@@ -130,10 +157,13 @@ class SpecState:
         k_min: int = 1,
         max_ngram: int = 3,
         min_ngram: int = 1,
+        w_max: int = 1,
     ):
         self.k_max = max(int(k_max), 1)
         self.k_min = max(min(int(k_min), self.k_max), 1)
         self.k = self.k_max
+        self.w_max = max(int(w_max), 1)
+        self.width = self.w_max
         self.draft = NGramDraft(max_ngram, min_ngram)
         self.proposed = 0
         self.accepted = 0
@@ -154,6 +184,24 @@ class SpecState:
                 self.k = min(self.k + 2, self.k_max)
             else:
                 self.k = min(max(accepted + 1, self.k_min), self.k_max)
+
+    def record_tree(self, nodes: int, depth: int, accepted: int) -> None:
+        """Fold one TREE verify: ``nodes`` drafted trie nodes (root
+        excluded), ``depth`` the deepest drafted path, ``accepted`` the
+        accepted path length. K adapts on accepted-vs-depth — the
+        per-path analog of the linear rule — and width widens on a
+        full-depth accept, narrowing back toward linear when a whole
+        tree missed."""
+        self.proposed += nodes
+        self.accepted += accepted
+        if nodes:
+            if depth and accepted >= depth:
+                self.k = min(self.k + 2, self.k_max)
+                self.width = min(self.width + 1, self.w_max)
+            else:
+                self.k = min(max(accepted + 1, self.k_min), self.k_max)
+                if accepted == 0:
+                    self.width = max(self.width - 1, 1)
 
     @property
     def accept_rate(self) -> float:
@@ -316,3 +364,242 @@ def spec_verify_slot(
     )
     emitted = [int(d) for d in draft[:accepted]] + [nxt]
     return emitted, cache, accepted, key
+
+
+class TreeDraft:
+    """A multi-branch draft: a token trie rooted at the slot's pending
+    token, flattened in insertion (DFS) order for one verify chunk.
+
+    Node 0 is the ROOT — the pending token the engine was about to feed
+    back. Nodes ``1..n-1`` are drafted continuations. Because children
+    are appended after their parent, a node's storage index is always
+    >= its depth, which is what makes the commit row-move strictly
+    leftward (``dst <= src``) and overlap-safe.
+    """
+
+    def __init__(self, pending: int):
+        self.tokens: list[int] = [int(pending)]
+        self.parent: list[int] = [-1]
+        self.depth: list[int] = [0]
+        self._children: list[dict[int, int]] = [{}]
+
+    def __len__(self) -> int:
+        return len(self.tokens)
+
+    @property
+    def num_drafted(self) -> int:
+        return len(self.tokens) - 1
+
+    @property
+    def max_depth(self) -> int:
+        return max(self.depth)
+
+    @property
+    def is_chain(self) -> bool:
+        """True when the trie is a single path (every node has at most
+        one child) — the degenerate tree that behaves exactly like a
+        linear draft."""
+        return all(len(c) <= 1 for c in self._children)
+
+    def chain_tokens(self) -> list[int]:
+        """The drafted tokens of a single-path trie, root excluded —
+        only meaningful when :attr:`is_chain` holds (insertion order IS
+        path order for a chain)."""
+        return [int(t) for t in self.tokens[1:]]
+
+    def child(self, node: int, token: int) -> int | None:
+        return self._children[node].get(int(token))
+
+    def add_path(self, path, budget: int | None = None) -> int:
+        """Insert one candidate continuation below the root, sharing
+        any already-inserted prefix (siblings merge by token, so
+        overlapping proposals from the radix tree, the KV tier, and the
+        n-gram drafter dedup for free). Stops growing when ``budget``
+        total nodes would be exceeded. Returns nodes added."""
+        cur = 0
+        added = 0
+        for t in path:
+            t = int(t)
+            nxt = self._children[cur].get(t)
+            if nxt is None:
+                if budget is not None and len(self.tokens) >= budget:
+                    break
+                nxt = len(self.tokens)
+                self.tokens.append(t)
+                self.parent.append(cur)
+                self.depth.append(self.depth[cur] + 1)
+                self._children.append({})
+                self._children[cur][t] = nxt
+                added += 1
+            cur = nxt
+        return added
+
+    def mask(self, c: int) -> np.ndarray:
+        """The ``[c, c]`` additive attention bias for a ``c``-row
+        chunk: row ``i`` sees column ``j`` (bias 0) iff ``j`` is an
+        ancestor-of-or-equal-to ``i``; everything else gets -1e30. Pad
+        rows ``i >= n`` get plain causal rows — their outputs are
+        garbage either way (always rolled back), a causal row just
+        keeps them shaped like ordinary prefill padding. Columns
+        OUTSIDE the chunk (the committed prefix) are the caller's
+        business: the model layer extends the bias with zeros there, so
+        every row keeps the committed history visible."""
+        n = len(self.tokens)
+        m = np.full((c, c), -1e30, np.float32)
+        for i in range(n):
+            j = i
+            while j >= 0:
+                m[i, j] = 0.0
+                j = self.parent[j]
+        for i in range(n, c):
+            m[i, : i + 1] = 0.0
+        return m
+
+    def depths(self, c: int) -> np.ndarray:
+        """Per-row rope depth for a ``c``-row chunk: node ``i`` ropes
+        at ``kv + depth[i]`` — the position linear decode would have
+        used — so an accepted branch's KV rows are bit-identical to
+        linearly-written ones and the commit can be a pure row-move.
+        Pad rows rope at their storage index, same as ordinary
+        prefill."""
+        n = len(self.tokens)
+        return np.asarray(self.depth + list(range(n, c)), np.int32)
+
+
+def verify_tree_greedy(
+    logits: np.ndarray, tree: TreeDraft
+) -> tuple[list[int], list[int]]:
+    """Greedy tree acceptance: walk from the root, at each node taking
+    the TARGET's argmax for that node's prefix and descending into the
+    drafted child carrying that token, if any. Every emitted token is
+    the target's own argmax — bit-identical to non-speculative greedy
+    decode regardless of what was drafted. Returns ``(path, emitted)``:
+    the accepted node indices root-down (root excluded) and their
+    tokens plus the final correction/bonus argmax."""
+    preds = np.argmax(logits, axis=-1)
+    path: list[int] = []
+    emitted: list[int] = []
+    cur = 0
+    while True:
+        t = int(preds[cur])
+        emitted.append(t)
+        nxt = tree.child(cur, t)
+        if nxt is None:
+            return path, emitted
+        path.append(nxt)
+        cur = nxt
+
+
+def verify_tree_sampled(
+    logits: np.ndarray,
+    tree: TreeDraft,
+    next_key,
+    temperature: float,
+    top_p: float = 1.0,
+    top_k: int = 0,
+) -> tuple[list[int], list[int]]:
+    """Distribution-preserving tree acceptance: sample-then-match.
+
+    At each node the TARGET token is drawn first — ``sampling.sample``
+    under the node's filtered distribution with one fresh subkey from
+    ``next_key()`` per EMITTED token, the exact key consumption of
+    non-speculative decode — and the walk descends into the drafted
+    child carrying that token, if any. Each emitted token is therefore
+    an ancestral sample of the target's own filtered distribution for
+    its own prefix: the emitted stream's law is EXACTLY the
+    non-speculative one (no residual renormalization to get wrong) and
+    it does not depend on the draft tree's shape — which is what keeps
+    seeded replays bit-exact across migration even though the tree was
+    built from a non-replayable source (another request's radix
+    residue). The branch-acceptance probability at a node with drafted
+    children ``C`` is ``sum_{c in C} p(c)`` — the multi-branch
+    generalization of the linear delta-proposal accept rule. Returns
+    ``(path, emitted)`` as :func:`verify_tree_greedy`."""
+    path: list[int] = []
+    emitted: list[int] = []
+    cur = 0
+    while True:
+        t = int(
+            sampling.sample(
+                jnp.asarray(logits[cur]), next_key(), temperature, top_p, top_k
+            )
+        )
+        emitted.append(t)
+        nxt = tree.child(cur, t)
+        if nxt is None:
+            return path, emitted
+        path.append(nxt)
+        cur = nxt
+
+
+def spec_verify_tree(
+    model,
+    cache,
+    slot: int,
+    tree: TreeDraft,
+    kv_len: int,
+    mode,
+    *,
+    next_key=None,
+    temperature: float = 0.0,
+    top_p: float = 1.0,
+    top_k: int = 0,
+):
+    """One TREE verify of ``slot``: every trie node runs through a
+    single chunked paged-prefill forward under the tree-attention mask
+    and depth-rope, then the sample/argmax-then-match walk accepts one
+    root path. Returns ``(emitted tokens, cache, path)``; ``emitted``
+    is None on non-finite logits (same donated-cache contract as
+    :func:`spec_verify_slot`, caller fails the slot as ``nan_logits``).
+
+    The chunk writes KV for every node at ``kv + storage index`` and
+    advances the slot's device kv_len past the whole chunk; the CALLER
+    commits the accepted path with :func:`commit_tree_path` and then
+    rolls kv_len back to ``kv + len(path) + 1`` exactly as in the
+    linear path.
+    """
+    fault_point("spec.verify", slot=slot)
+    n = len(tree)
+    c = round_chunk(n)
+    page = int(cache.k_pages.shape[3])
+    pps = int(cache.page_table.shape[1])
+    buf = np.zeros(c, np.int32)
+    buf[:n] = tree.tokens
+    kv_pages = gather_bucket(int(kv_len) + c, page, pps)
+    with trace_span("spec:tree", slot=slot, nodes=tree.num_drafted,
+                    depth=tree.max_depth, offset=int(kv_len), _ring=False):
+        logits, cache = model.prefill_paged_chunk(
+            buf, slot, int(kv_len), int(kv_len) + n, n - 1, cache, mode,
+            kv_pages=kv_pages, all_logits=True,
+            tree_mask=tree.mask(c), tree_depth=tree.depths(c),
+        )
+    arr = np.asarray(logits[:n], np.float32)
+    arr = mutate_point("spec.logits", arr, slot=slot)
+    if not np.isfinite(arr).all():
+        return None, cache, []
+    if temperature <= 0.0:
+        path, emitted = verify_tree_greedy(arr, tree)
+    else:
+        path, emitted = verify_tree_sampled(
+            arr, tree, next_key, temperature, top_p, top_k
+        )
+    obs_events.emit(
+        "spec_verify", slot=slot, drafted=tree.num_drafted,
+        accepted=len(path), tree=True,
+    )
+    return emitted, cache, path
+
+
+def commit_tree_path(cache, slot: int, kv_len: int, path: list[int]):
+    """Commit an accepted root path: row-move the accepted nodes' KV
+    from their DFS storage slots (``kv + node index``) to the
+    contiguous positions linear decode would have written
+    (``kv+1..kv+len(path)``). DFS order guarantees ``index >= depth``
+    so every move is leftward; ``move_kv_rows`` skips self-moves, so a
+    primary-branch (already contiguous) accept is a no-op. The caller
+    still owns the kv_len rollback afterwards."""
+    from triton_distributed_tpu.models.paged_kv_cache import move_kv_rows
+
+    src = [int(kv_len) + int(i) for i in path]
+    dst = [int(kv_len) + j for j in range(1, len(path) + 1)]
+    return move_kv_rows(cache, slot, src, dst)

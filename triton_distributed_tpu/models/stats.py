@@ -69,6 +69,25 @@ STAT_METRICS = {
                              "Draft tokens accepted by verify."),
     "spec_rollback_tokens": ("tdt_engine_spec_rollback_tokens_total",
                              "Draft tokens rolled back after verify."),
+    # Tree speculation (docs/serving.md "Speculative decoding"): multi-
+    # branch draft trees verified in one forward. ``nodes`` counts
+    # drafted trie nodes (root excluded — they are the spec_draft_tokens
+    # of tree rounds), ``depth`` accumulates each tree's deepest drafted
+    # path (divide by rounds for the mean), and ``branch_accepts``
+    # counts rounds whose accepted path left the primary branch — the
+    # rounds a linear draft would have lost outright.
+    "spec_tree_rounds": ("tdt_spec_tree_rounds_total",
+                         "Tree-speculation verify rounds (multi-branch "
+                         "draft chunks)."),
+    "spec_tree_nodes": ("tdt_spec_tree_nodes_total",
+                        "Draft tree nodes verified (root excluded)."),
+    "spec_tree_depth": ("tdt_spec_tree_depth_total",
+                        "Cumulative deepest-drafted-path depth across "
+                        "tree rounds."),
+    "spec_tree_branch_accepts": ("tdt_spec_tree_branch_accepts_total",
+                                 "Tree rounds whose accepted path left "
+                                 "the primary branch (commit needed a "
+                                 "KV row-move)."),
     "failed_requests": ("tdt_engine_failed_requests_total",
                         "Requests finished with a non-ok status "
                         "(client cancellations excluded — those count "
@@ -125,4 +144,24 @@ STAT_METRICS = {
                     "(written via write_page, mapped as tree pages)."),
     "tier_bytes": ("tdt_tier_bytes_faulted_total",
                    "Payload bytes faulted back from the KV tier."),
+}
+
+# Extra registry names mirroring the SAME counter as a STAT_METRICS
+# entry — fleet spec-health dashboards key on the short ``tdt_spec_*``
+# family while the per-engine ``tdt_engine_spec_*`` names stay the
+# drill-down. ``_bump`` increments every handle of a key, so the alias
+# can never drift from its primary.
+STAT_METRIC_ALIASES = {
+    "spec_draft_tokens": (
+        ("tdt_spec_draft_tokens_total",
+         "Draft tokens proposed (alias of "
+         "tdt_engine_spec_draft_tokens_total for fleet spec-health "
+         "dashboards)."),
+    ),
+    "spec_rollback_tokens": (
+        ("tdt_spec_rollback_tokens_total",
+         "Draft tokens rolled back after verify (alias of "
+         "tdt_engine_spec_rollback_tokens_total for fleet spec-health "
+         "dashboards)."),
+    ),
 }

@@ -36,6 +36,7 @@ def _attn_kernel(
     v_ref,    # [1, block_k, d] VMEM
     ks_ref,   # [1, 1] VMEM f32 or None — this kv block's K dequant scale
     vs_ref,   # [1, 1] VMEM f32 or None — this kv block's V dequant scale
+    b_ref,    # [block_q, block_k] VMEM f32 or None — additive score bias
     o_ref,    # [1, block_q, d] VMEM
     lse_ref,  # [1, 1, sq] VMEM or None — full row; slice qi written at
               # finalize (Mosaic requires the block's trailing dims to
@@ -76,6 +77,12 @@ def _attn_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * mult  # [block_q, block_k]
+        if b_ref is not None:
+            # Additive score bias (0 / -inf): the tree-attention mask of
+            # the speculative verify chunk. Applied before the causal
+            # mask — the bias only ever masks MORE than causality, so
+            # the causal block-skip above stays sound.
+            s = s + b_ref[...]
         if causal:
             rows = kv_offset + qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
@@ -124,6 +131,7 @@ def flash_attention(
     return_lse: bool = False,
     k_scale: jax.Array | None = None,  # [B, Hkv, Sk/block_k] f32
     v_scale: jax.Array | None = None,
+    bias: jax.Array | None = None,     # [Sq, Sk] f32 additive score bias
     interpret=None,
 ):
     """Causal/GQA flash attention. ``kv_offset``: absolute position of
@@ -140,6 +148,14 @@ def flash_attention(
     in-register after QK^T / P·V. Callers align ``block_k`` with the
     quantization granularity (the chunk path sets ``block_k =
     page_size`` so per-page pool scales ARE per-block scales).
+
+    ``bias`` is an optional ``[Sq, Sk]`` f32 additive score bias shared
+    across batch and heads (0 = visible, ``-1e30`` = masked) — the
+    tree-attention mask of speculative verify chunks, where sibling
+    draft branches must not attend to each other. It composes with
+    ``causal=True``: tree masks only ever REMOVE visibility relative to
+    storage-order causality (ancestors precede descendants in storage),
+    so the causal block skip stays valid.
 
     Returns ``o [B, Hq, Sq, D]`` (and ``lse [B, Hq, Sq]`` f32 when
     ``return_lse`` — base-e log-sum-exp of scaled scores, the quantity the
@@ -167,6 +183,8 @@ def flash_attention(
                 f"{name} shape {sc.shape} != per-block layout "
                 f"{(b, hkv, sk // block_k)} (block_k={block_k})"
             )
+    if bias is not None and bias.shape != (sq, sk):
+        raise ValueError(f"bias shape {bias.shape} != {(sq, sk)}")
     # jax.export can't serialize the host callbacks interpret-mode
     # Pallas lowers to; portable exports take the XLA-reference path
     # (same contract as flash_decode's portable fallback).
@@ -181,7 +199,7 @@ def flash_attention(
             )[..., None]
         return mha_reference(
             q, k, v, causal=causal, sm_scale=sm_scale,
-            kv_offset=kv_offset, return_lse=return_lse,
+            kv_offset=kv_offset, return_lse=return_lse, bias=bias,
         )
     if sq % block_q or sk % block_k:
         raise ValueError(f"seq ({sq},{sk}) not divisible by blocks "
@@ -212,7 +230,8 @@ def flash_attention(
         block_k=block_k,
     )
     kernel = functools.partial(
-        _adapt_refs, kernel, dynamic_off, quant, return_lse
+        _adapt_refs, kernel, dynamic_off, quant, bias is not None,
+        return_lse,
     )
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
@@ -236,6 +255,11 @@ def flash_attention(
         operands += [
             k_scale.reshape(b * hkv, -1), v_scale.reshape(b * hkv, -1)
         ]
+    if bias is not None:
+        in_specs.append(
+            pl.BlockSpec((block_q, block_k), lambda bh, qi, ki: (qi, ki))
+        )
+        operands.append(bias.astype(jnp.float32))
     scratch_shapes = [
         pltpu.VMEM((block_q, d), jnp.float32),
         pltpu.VMEM((block_q, 1), jnp.float32),
@@ -291,12 +315,12 @@ def _drop_scalar_arg(index_map):
     return lambda bh, qi, ki, _off: index_map(bh, qi, ki)
 
 
-def _adapt_refs(kernel, has_off: bool, has_scales: bool, has_lse: bool,
-                *refs):
+def _adapt_refs(kernel, has_off: bool, has_scales: bool, has_bias: bool,
+                has_lse: bool, *refs):
     """Route pallas_call's positional refs into ``_attn_kernel``'s
     keyword-stable signature: optional scalar-prefetch offset first,
-    optional int8 dequant scales after v, optional lse output, then the
-    three scratch refs."""
+    optional int8 dequant scales after v, optional score bias, optional
+    lse output, then the three scratch refs."""
     refs = list(refs)
     off_ref = refs.pop(0) if has_off else None
     q_ref, k_ref, v_ref = refs[:3]
@@ -305,16 +329,20 @@ def _adapt_refs(kernel, has_off: bool, has_scales: bool, has_lse: bool,
     if has_scales:
         ks_ref, vs_ref = refs[3:5]
         nxt = 5
+    b_ref = None
+    if has_bias:
+        b_ref = refs[nxt]
+        nxt += 1
     o_ref = refs[nxt]
     lse_ref = refs[nxt + 1] if has_lse else None
     acc, m_i, l_i = refs[-3:]
-    kernel(off_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, lse_ref,
-           acc, m_i, l_i)
+    kernel(off_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, b_ref, o_ref,
+           lse_ref, acc, m_i, l_i)
 
 
 def mha_reference(
     q, k, v, *, causal=True, sm_scale=None, kv_offset: int = 0,
-    return_lse: bool = False,
+    return_lse: bool = False, bias=None,
 ):
     """Golden attention (parity: the reference's torch-SDPA goldens)."""
     b, hq, sq, d = q.shape
@@ -325,6 +353,8 @@ def mha_reference(
     v = jnp.repeat(v, hq // hkv, axis=1)
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
     s *= sm_scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)[None, None]
     if causal:
         rows = kv_offset + jnp.arange(sq)[:, None]
         cols = jnp.arange(sk)[None, :]

@@ -219,20 +219,24 @@ def main(argv=None) -> int:
                    "Also turns the engines' kernel_trace knob on and "
                    "surfaces both in server_stats.")
     args = p.parse_args(argv)
-    # --model moe: the Qwen3MoE serving alias (tiny-moe preset so a
-    # laptop/CI run needs no checkpoint), sized by the knob overrides.
-    model_name, overrides = resolve_model_args(
-        args.model, args.num_experts, args.top_k, args.moe_intermediate
-    )
     if args.speculative and args.mode == "mega":
-        # Explicit, named-knob refusal (the engines raise the same
-        # conflict; failing at the CLI names the flags to change).
+        # Explicit, named-knob refusal naming the ACTUAL conflicting
+        # pair — speculative × mega — and fired BEFORE any model-name
+        # resolution so every --model (qwen/moe/stub) gets the same
+        # named-flag message instead of whatever resolve_model_args
+        # surfaces first. (The engines raise the same conflict; failing
+        # at the CLI names the flags to change.)
         p.error(
             "--speculative and --mode mega do not compose: the "
             "megakernel's NS-step fused launch already amortizes "
             "per-step dispatch (docs/megakernel.md 'Serving fast "
             "path'). Drop --speculative or use --mode xla/pallas."
         )
+    # --model moe: the Qwen3MoE serving alias (tiny-moe preset so a
+    # laptop/CI run needs no checkpoint), sized by the knob overrides.
+    model_name, overrides = resolve_model_args(
+        args.model, args.num_experts, args.top_k, args.moe_intermediate
+    )
     if (args.tier_bytes or args.tier_dir) and args.fleet == 0 and (
             args.model == "stub"
             or not (args.replicas or args.continuous)):
