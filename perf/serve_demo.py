@@ -136,8 +136,13 @@ def _fleet_demo(args) -> int:
         def make_spec(name, role="mixed"):
             argv_i = list(child)
             if args.tier_dir:
+                # --tier-shared: every child mounts the SAME fabric
+                # dir (docs/scale-out.md "KV fabric") so a fresh
+                # replica boots warm from the pool's spills; default
+                # stays per-child DIR/r<i>.
                 argv_i += ["--tier-dir",
-                           os.path.join(args.tier_dir, name)]
+                           (args.tier_dir if args.tier_shared
+                            else os.path.join(args.tier_dir, name))]
             return ReplicaSpec(name, argv_i, env=env, role=role)
 
     specs = [make_spec(name, role) for name, role in members]
@@ -146,6 +151,8 @@ def _fleet_demo(args) -> int:
         policy="pools" if pool_fleet else "affinity",
         resume_dir=(os.path.join(args.tier_dir, "resume")
                     if args.tier_dir else None),
+        tier_fabric=(args.model != "stub"
+                     and bool(args.tier_bytes or args.tier_dir)),
         router_kw={
             "request_timeout_s": args.request_timeout or None,
         },
@@ -249,10 +256,17 @@ def main(argv=None) -> int:
                    "KV'); with --fleet children inherit it")
     p.add_argument("--tier-dir", default=None, metavar="DIR",
                    help="disk tier directory (atomic, checksummed); "
-                   "with --fleet each child gets DIR/r<i> and the "
-                   "supervisor persists pulled snapshots under "
-                   "DIR/resume — a restart-safe fleet from one flag "
+                   "with --fleet each child gets DIR/r<i> (or the "
+                   "shared DIR with --tier-shared) and the supervisor "
+                   "persists pulled snapshots under DIR/resume — a "
+                   "restart-safe fleet from one flag "
                    "(docs/scale-out.md 'Durable snapshots')")
+    p.add_argument("--tier-shared", action="store_true",
+                   help="share ONE KV tier across the replicas "
+                   "(docs/scale-out.md 'KV fabric'): with --fleet "
+                   "every child mounts the same --tier-dir; with "
+                   "--replicas the engines share one in-process "
+                   "PageStore")
     p.add_argument("--stats", action="store_true",
                    help="after generating, fetch {'cmd':'stats'} and "
                    "{'cmd':'metrics'} through the wire and pretty-print "
@@ -329,6 +343,32 @@ def main(argv=None) -> int:
             "have no KV tier); --tier-dir still arms the supervisor's "
             "durable resume store, or use a real --model"
         )
+    if args.tier_shared:
+        # Refuse by flag name (the run_server convention): sharing a
+        # tier needs multiple engines and a tier to share.
+        if not (args.fleet or args.replicas > 1
+                or args.prefill_replicas or args.decode_replicas):
+            p.error(
+                "--tier-shared shares ONE KV tier ACROSS replicas "
+                "(docs/scale-out.md 'KV fabric'); add --fleet N or "
+                "--replicas N (N >= 2)"
+            )
+        if args.model == "stub" and not args.replicas:
+            p.error(
+                "--tier-shared does nothing on a stub fleet (stub "
+                "children have no KV tier); use a real --model"
+            )
+        if (args.fleet or args.prefill_replicas
+                or args.decode_replicas) and not args.tier_dir:
+            p.error(
+                "--tier-shared on a PROCESS fleet shares through disk; "
+                "give the common directory with --tier-dir DIR"
+            )
+        if args.replicas > 1 and not (args.tier_bytes or args.tier_dir):
+            p.error(
+                "--tier-shared needs a tier to share: add --tier-bytes "
+                "N and/or --tier-dir DIR"
+            )
     # Role-typed pools ride the PROCESS fleet only — refuse by flag
     # name everywhere else instead of silently serving an untyped
     # fleet (docs/scale-out.md 'Disaggregated pools & autoscaling').
@@ -393,15 +433,27 @@ def main(argv=None) -> int:
         from triton_distributed_tpu.models.continuous import ContinuousEngine
         from triton_distributed_tpu.serving.router import Router
 
+        shared_tier = None
+        if args.tier_shared and (args.tier_bytes or args.tier_dir):
+            # One PageStore behind every replica (docs/scale-out.md
+            # "KV fabric"): spills land where siblings fault back.
+            from triton_distributed_tpu.models.kv_tier import PageStore
+
+            shared_tier = PageStore(
+                capacity_bytes=args.tier_bytes or (64 << 20),
+                dir=args.tier_dir, fsync=False,
+            )
         eng = Router([
             ContinuousEngine(
                 model, max_batch=2, max_length=1024, mode=mode,
                 temperature=0.0, prefix_cache=True,
                 kv_dtype=args.kv_dtype, speculative=args.speculative,
                 kernel_trace=kernel_trace,
+                tier=shared_tier,
                 tier_bytes=args.tier_bytes,
                 tier_dir=(os.path.join(args.tier_dir, f"r{i}")
-                          if args.tier_dir else None),
+                          if args.tier_dir and shared_tier is None
+                          else None),
             )
             for i in range(args.replicas)
         ], request_timeout_s=args.request_timeout or None)

@@ -475,6 +475,20 @@ def test_tier1_marker_audit():
     assert len(tier_fast) >= 5, (
         f"KV-tier suite has too few tier-1-runnable tests: {tier_fast}"
     )
+    # ISSUE-17: the KV-fabric suite (wire tier verbs, peer fault-back
+    # bit-exactness, chaos degradation, tier-aware placement) rides
+    # right behind the KV-tier suite it extends, ahead of the
+    # interpret tail, and must carry tier-1-runnable tests — a
+    # wrong-bits-from-a-peer regression has to FAIL tier-1.
+    assert "test_kv_fabric.py" in order
+    assert (order.index("test_kv_tier.py")
+            < order.index("test_kv_fabric.py")
+            < order.index("test_serving.py"))
+    fabric_fast = fast_tests("test_kv_fabric.py")
+    assert len(fabric_fast) >= 5, (
+        f"KV-fabric suite has too few tier-1-runnable tests: "
+        f"{fabric_fast}"
+    )
     # ISSUE-13: the SLO-goodput suite (streaming wire grammar, cancel
     # teardown, loadgen determinism, fleet-scope scrape) rides with
     # the fleet-family suites — streaming/cancel regressions must
@@ -727,6 +741,32 @@ def test_kv_tier_modules_compile():
     )
 
 
+def test_kv_fabric_modules_compile():
+    """ISSUE-17: the KV fabric must byte-compile — the fabric client /
+    wire peers (kv_tier.py), the suite itself, and the CPU-runnable
+    bench that writes perf/KV_FABRIC.json (repo convention: perf
+    harnesses fail tier-1, not a relay window)."""
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    targets = [
+        os.path.join(root, "triton_distributed_tpu", "models",
+                     "kv_tier.py"),
+        os.path.join(root, "tests", "test_kv_fabric.py"),
+        os.path.join(root, "perf", "kv_fabric_bench.py"),
+    ]
+    proc = subprocess.run(
+        [sys.executable, "-m", "compileall", "-q", "-f", *targets],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, (
+        f"KV fabric modules failed to compile:\n"
+        f"{proc.stdout}\n{proc.stderr}"
+    )
+
+
 def test_tree_speculation_modules_compile():
     """ISSUE-16: every layer the tree-speculation path threads through
     must byte-compile — the drafter/verifier, the radix proposer, the
@@ -830,3 +870,36 @@ def test_serving_cli_tier_flags_require_continuous_stack():
     with pytest.raises(SystemExit) as ei:
         run_server.main(["--model", "stub", "--tier-bytes", "1048576"])
     assert ei.value.code == 2
+
+def test_serving_cli_tier_shared_guardrails(capsys):
+    """Both serving CLIs refuse every --tier-shared combination that
+    would silently do nothing (single engine, stub fleet, process
+    fleet without a common dir, threaded replicas without a tier) by
+    flag name, BEFORE loading a model — the PR 12 tier-flag
+    convention (docs/scale-out.md 'KV fabric')."""
+    import os
+    import sys
+
+    import pytest
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from perf import serve_demo
+    from triton_distributed_tpu.serving import run_server
+
+    cases = (
+        # One engine: nothing to share.
+        ["--tier-shared", "--tier-bytes", "1048576"],
+        # Stub fleet children have no KV tier at all.
+        ["--model", "stub", "--fleet", "2", "--tier-shared"],
+        # Separate processes share through DISK: --tier-dir required.
+        ["--fleet", "2", "--tier-shared", "--tier-bytes", "1048576"],
+        # Threaded replicas still need a tier to share.
+        ["--replicas", "2", "--tier-shared"],
+    )
+    for main in (serve_demo.main, run_server.main):
+        for flags in cases:
+            with pytest.raises(SystemExit) as ei:
+                main(flags)
+            assert ei.value.code == 2, flags  # argparse p.error
+            err = capsys.readouterr().err
+            assert "--tier-shared" in err, (flags, err)

@@ -342,6 +342,7 @@ class ContinuousEngine(MegaDispatch):
         tier_bytes: int = 0,
         tier_dir: str | None = None,
         tier=None,
+        fabric=None,
         handoff_batch: bool = True,
     ):
         self.model = model
@@ -459,6 +460,15 @@ class ContinuousEngine(MegaDispatch):
             tier = PageStore(capacity_bytes=tier_bytes or (64 << 20),
                              dir=tier_dir, fsync=False)
         self.tier = tier
+        # KV fabric (docs/scale-out.md "KV fabric"): a
+        # ``kv_tier.FabricClient`` consulted by ``_tier_fill`` on a
+        # LOCAL tier miss — peers' tier entries are pulled over the
+        # wire (or in-process), validated through the same codec +
+        # geometry/fingerprint checks as local entries, and grafted
+        # identically. None (default) keeps every single-replica path
+        # untouched; a fabric without a tier is ignored (nowhere to
+        # graft through).
+        self.fabric = fabric if tier is not None else None
         self._tier_snap_keys: set[str] = set()
         # Weight identity for durable entries (computed only when a
         # tier is attached — one small host fetch): spilled pages and
@@ -642,6 +652,9 @@ class ContinuousEngine(MegaDispatch):
             "tier_hits": 0,
             "tier_faults": 0,
             "tier_bytes": 0,
+            # KV fabric (docs/scale-out.md "KV fabric"): the subset of
+            # tier_faults whose entry came from a PEER replica's tier.
+            "tier_remote_pages": 0,
         }
 
     @property
@@ -681,6 +694,8 @@ class ContinuousEngine(MegaDispatch):
             stats["experts_per_tok"] = self._moe_k
         if self.tier is not None:
             stats["tier"] = self.tier.snapshot()
+        if self.fabric is not None:
+            stats["fabric"] = self.fabric.snapshot()
         return stats
 
     # -- telemetry ---------------------------------------------------------
@@ -1243,6 +1258,17 @@ class ContinuousEngine(MegaDispatch):
             self._bump("tier_spilled_pages")
             obs_events.emit("tier_spill", tokens=len(chain), page=int(page))
 
+    def tier_digest(self) -> dict | None:
+        """The tier's compact content summary wrapped with this
+        engine's page size — what replicas publish next to
+        ``prefix_digest()`` at batch boundaries so the router can score
+        tier affinity and peers can gate fabric probes. None without a
+        tier. Memoized inside the store (mutation-counter keyed), so a
+        per-batch call is a dict copy, not a scan."""
+        if self.tier is None:
+            return None
+        return {"ps": int(self.page_size), **self.tier.digest()}
+
     def _tier_fill(self, tokens) -> None:
         """Fault-back half of the tier: extend the radix tree's
         coverage of ``tokens`` from the tier BEFORE admission matches —
@@ -1256,18 +1282,24 @@ class ContinuousEngine(MegaDispatch):
             return
         from triton_distributed_tpu.models import kv_tier
 
-        if not self.tier.may_contain(kv_tier.PREFIX_KIND):
+        fabric = self.fabric
+        if fabric is not None and not fabric.peers:
+            fabric = None
+        if not self.tier.may_contain(kv_tier.PREFIX_KIND) and fabric is None:
             # Nothing has ever spilled (the steady state before the
-            # first eviction): skip the per-round tree walk + SHA-1
-            # over the uncovered prefix — guaranteed misses. The queue
-            # head re-runs this every scheduling round it waits.
+            # first eviction) and no fabric peer to ask: skip the
+            # per-round tree walk + SHA-1 over the uncovered prefix —
+            # guaranteed misses. The queue head re-runs this every
+            # scheduling round it waits. With peers attached the walk
+            # must run: a cold LOCAL tier is exactly when a neighbor's
+            # entries are worth pulling (the warm-boot path).
             return
         ps = self.page_size
         toks = [int(t) for t in tokens]
         limit = len(toks) - 1  # match()'s cap: one suffix token prefills
         node = self.prefix.root
         i = 0
-        faulted = bytes_in = 0
+        faulted = bytes_in = remote = 0
         # The walked path is refcount-PINNED for the fill's duration:
         # each faulted page's allocation may itself run the LRU
         # eviction sweep, which would otherwise happily evict (and
@@ -1289,6 +1321,16 @@ class ContinuousEngine(MegaDispatch):
                     break  # divergent/partial sibling: the tree wins
                 digest = kv_tier.chain_digest(toks[: i + ps])
                 payload = self.tier.get(kv_tier.PREFIX_KIND, digest)
+                from_fabric = False
+                if payload is None and fabric is not None:
+                    # Local miss → peer fault-back: the fabric returns
+                    # a payload already CRC/header-validated through
+                    # the SAME codec a local read crosses; the
+                    # chain/geometry/fingerprint checks below then run
+                    # UNCHANGED — a remote entry can fail them exactly
+                    # like a local one, and fails to re-prefill.
+                    payload = fabric.fetch(kv_tier.PREFIX_KIND, digest)
+                    from_fabric = payload is not None
                 if payload is None:
                     break
                 try:
@@ -1296,7 +1338,8 @@ class ContinuousEngine(MegaDispatch):
                         kv_tier.decode_prefix_payload(payload)
                     )
                 except kv_tier.TierIntegrityError:
-                    self.tier.delete(kv_tier.PREFIX_KIND, digest)
+                    if not from_fabric:  # nothing local to delete
+                        self.tier.delete(kv_tier.PREFIX_KIND, digest)
                     break
                 if (chain != toks[: i + ps] or page_size != ps
                         or kv_dtype != self.kv_dtype
@@ -1308,8 +1351,9 @@ class ContinuousEngine(MegaDispatch):
                     # chain. The admission re-prefills. Deleting is
                     # owner-only: on a SHARED store (``tier=``) the
                     # entry may be perfectly valid for the engine that
-                    # spilled it.
-                    if self._tier_owned:
+                    # spilled it, and a fabric-pulled entry lives on
+                    # the PEER — nothing local to delete.
+                    if self._tier_owned and not from_fabric:
                         self.tier.delete(kv_tier.PREFIX_KIND, digest)
                     obs_events.emit(
                         "tier_drop", tier_kind=kv_tier.PREFIX_KIND,
@@ -1326,7 +1370,7 @@ class ContinuousEngine(MegaDispatch):
                     )
                 except Exception:  # noqa: BLE001 — degrade to re-prefill
                     self.pool.release(pages)
-                    if self._tier_owned:  # shared: may be valid elsewhere
+                    if self._tier_owned and not from_fabric:
                         self.tier.delete(kv_tier.PREFIX_KIND, digest)
                     break
                 self.prefix.insert_chain(node, chunk, pages)
@@ -1339,6 +1383,12 @@ class ContinuousEngine(MegaDispatch):
                 i += ps
                 faulted += 1
                 bytes_in += kv_tier.payload_nbytes(payload)
+                if from_fabric:
+                    # Adopt the validated entry into the LOCAL tier:
+                    # the next evict/re-admit cycle (and peers probing
+                    # us) hit here instead of re-crossing the wire.
+                    self.tier.put(kv_tier.PREFIX_KIND, digest, payload)
+                    remote += 1
         finally:
             for n in pinned:
                 self.prefix.release_node(n)
@@ -1346,9 +1396,11 @@ class ContinuousEngine(MegaDispatch):
             self._bump("tier_hits")
             self._bump("tier_faults", faulted)
             self._bump("tier_bytes", bytes_in)
+            if remote:
+                self._bump("tier_remote_pages", remote)
             obs_events.emit(
                 "tier_fault", pages=faulted, bytes=bytes_in,
-                matched_tokens=i,
+                matched_tokens=i, remote_pages=remote,
             )
 
     def _request_sampling(self, req: Request) -> tuple[float, float, int]:

@@ -41,10 +41,13 @@ same line-JSON-safe dicts the migration wire already speaks.
 
 from __future__ import annotations
 
+import base64
 import hashlib
 import json
 import os
+import socket
 import threading
+import time
 import zlib
 from collections import OrderedDict
 
@@ -227,6 +230,7 @@ class PageStore:
         # put a JSON parse loop on the decode path.
         self._mut = 0
         self._chain_memo: tuple[int, list[list[int]]] | None = None
+        self._digest_memo: tuple[int, dict] | None = None
         # Monotone per-kind non-emptiness flags (see :meth:`may_contain`):
         # one listdir at construction counts entries a PRIOR process
         # left on disk; every successful put flips the flag for good.
@@ -448,6 +452,37 @@ class PageStore:
         except TierIntegrityError:
             return None
 
+    def contains(self, kind: str, key: str) -> bool:
+        """Membership WITHOUT decode, stats, or LRU movement — the
+        fabric's ``tier_probe`` answer. A True here is advisory (the
+        entry may still fail its checksum at pull time); a False is
+        authoritative for this instant."""
+        with self._lock:
+            if (kind, key) in self._ram:
+                return True
+        if self.dir:
+            return os.path.exists(self._path(kind, key))
+        return False
+
+    def get_blob(self, kind: str, key: str) -> bytes | None:
+        """One entry's raw WIRE bytes (header + checksummed body),
+        verbatim — the fabric's ``tier_get`` serve side. No decode, no
+        stats, no LRU movement, no fault seams: validation is the
+        PULLER's job (:func:`_decode` at the far end), so the PR 12
+        codec is the transport and a garbled blob CRC-drops there
+        exactly like a corrupt local entry."""
+        with self._lock:
+            blob = self._ram.get((kind, key))
+            if blob is not None:
+                return blob
+        if self.dir:
+            try:
+                with open(self._path(kind, key), "rb") as f:
+                    return f.read()
+            except OSError:
+                return None
+        return None
+
     def _drop(self, kind: str, key: str, reason: str) -> None:
         """Remove a failed entry from BOTH tiers: the bits are suspect
         wherever they live, and leaving them would re-fail every later
@@ -582,6 +617,41 @@ class PageStore:
                 self._chain_memo = (mut, chains)
         return chains
 
+    def digest(self) -> dict:
+        """Compact content summary for fleet placement and peer
+        fault-back: ``{"hash", "counts", "chains"}`` where ``chains``
+        is the sorted 16-hex truncations of the RAM-resident ``prefix``
+        chain digests (the keys ARE chain digests), ``counts`` the
+        per-kind RAM entry counts, and ``hash`` a digest of the chain
+        set (cheap change detection for publishers). Memoized on the
+        same mutation counter as :meth:`resident_chains` — replicas
+        publish it at every batch boundary without re-scanning. RAM
+        scope only: disk-resident entries still answer ``tier_probe``,
+        but a directory walk per publish is not a batch-boundary cost.
+        Truncated digests can collide harmlessly — placement scores and
+        probe gating degrade to an extra probe; actual pulls re-key on
+        FULL digests and re-validate the payload chain."""
+        with self._lock:
+            memo = self._digest_memo
+            if memo is not None and memo[0] == self._mut:
+                return memo[1]
+            counts: dict[str, int] = {}
+            chains: list[str] = []
+            for (kd, key) in self._ram:
+                counts[kd] = counts.get(kd, 0) + 1
+                if kd == PREFIX_KIND:
+                    chains.append(key[:16])
+            chains.sort()
+            out = {
+                "hash": hashlib.sha1(
+                    "\n".join(chains).encode()
+                ).hexdigest()[:16],
+                "counts": counts,
+                "chains": chains,
+            }
+            self._digest_memo = (self._mut, out)
+            return out
+
     @property
     def ram_bytes(self) -> int:
         with self._lock:
@@ -628,3 +698,330 @@ class PageStore:
                 f"RAM byte ledger {ram_bytes} != {total} held"
             )
         return problems
+
+
+# -- KV fabric ------------------------------------------------------------
+#
+# Cross-replica prefix exchange (docs/scale-out.md "KV fabric"): every
+# replica's tier entries become pullable by peers, so N replicas hold
+# ONE N-sized cache instead of N small ones. The reference stack's move
+# is making remote memory a first-class directly-addressable tier
+# (NVSHMEM symmetric gets, Triton-distributed's remote-pull
+# primitives); this applies it one level up — tier entries travel as
+# their checksummed wire bytes, so the PR 12 codec IS the transport and
+# a garbled remote entry CRC-drops to re-prefill exactly like a
+# corrupt local one.
+
+# One wire line bound for fabric responses; mirrors the server's
+# MAX_LINE_BYTES (models/ cannot import serving/ — layering).
+_MAX_WIRE_LINE = 1 << 20
+
+
+def tier_digest_match_len(digest, tokens) -> int:
+    """Whole-page match length of ``tokens`` against a published tier
+    digest (:meth:`PageStore.digest` wrapped with the engine's
+    ``"ps"``): contiguous pages from the root whose truncated chain
+    digests appear in the digest's chain set, capped so at least one
+    token is left to prefill (the engine's fault-back walk does the
+    same). 0 on a missing/foreign digest — placement then falls back
+    to radix affinity alone."""
+    if not isinstance(digest, dict):
+        return 0
+    try:
+        ps = int(digest.get("ps") or 0)
+    except (TypeError, ValueError):
+        return 0
+    chains = digest.get("chains")
+    if ps <= 0 or not chains or not isinstance(chains, (list, set)):
+        return 0
+    have = set(chains)
+    n = len(tokens)
+    matched = 0
+    i = ps
+    while i < n:
+        if chain_digest(tokens[:i])[:16] not in have:
+            break
+        matched = i
+        i += ps
+    return matched
+
+
+class LocalFabricPeer:
+    """In-process peer: a direct reference to another replica's store
+    (the ``--replicas`` threaded-fleet shape). Pulls still return the
+    encoded wire blob, so the client decodes/validates identically to
+    a wire pull — one transport semantics, two carriers."""
+
+    def __init__(self, name: str, store: PageStore):
+        self.name = str(name)
+        self._store = store
+
+    def probe(self, kind: str, key: str) -> bool:
+        return self._store.contains(kind, key)
+
+    def get(self, kind: str, key: str) -> bytes | None:
+        return self._store.get_blob(kind, key)
+
+
+class WireFabricPeer:
+    """Wire peer: ``tier_probe``/``tier_get`` line-JSON verbs against
+    another replica's :class:`~serving.server.ModelServer` (the
+    ``--fleet`` process shape). One short-lived connection per call —
+    the verbs are engine-lock-free probe traffic, same as
+    ``metrics``/``healthz``."""
+
+    def __init__(self, name: str, host: str, port: int, *,
+                 connect_timeout_s: float = 0.25):
+        self.name = str(name)
+        self.host = str(host)
+        self.port = int(port)
+        self.connect_timeout_s = float(connect_timeout_s)
+
+    def _call(self, payload: dict, timeout_s: float) -> dict:
+        with socket.create_connection(
+            (self.host, self.port),
+            timeout=max(self.connect_timeout_s, 0.05),
+        ) as sock:
+            sock.settimeout(max(timeout_s, 0.05))
+            sock.sendall(
+                json.dumps(payload, separators=(",", ":")).encode() + b"\n"
+            )
+            buf = b""
+            while not buf.endswith(b"\n"):
+                chunk = sock.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+                if len(buf) > _MAX_WIRE_LINE:
+                    raise TierIntegrityError("fabric response over line bound")
+        resp = json.loads(buf.decode())
+        if not isinstance(resp, dict) or resp.get("error"):
+            raise TierIntegrityError(
+                f"fabric peer error: {resp.get('error') if isinstance(resp, dict) else resp!r}"
+            )
+        return resp
+
+    def probe(self, kind: str, key: str, timeout_s: float = 0.25) -> bool:
+        resp = self._call(
+            {"cmd": "tier_probe", "kind": kind, "keys": [key]}, timeout_s
+        )
+        have = resp.get("have")
+        return bool(isinstance(have, list) and have and have[0])
+
+    def get(self, kind: str, key: str,
+            timeout_s: float = 0.5) -> bytes | None:
+        resp = self._call(
+            {"cmd": "tier_get", "kind": kind, "key": key}, timeout_s
+        )
+        if not resp.get("found"):
+            return None
+        try:
+            return base64.b64decode(resp["blob"], validate=True)
+        except (KeyError, TypeError, ValueError) as e:
+            raise TierIntegrityError(f"undecodable fabric blob: {e}") from e
+
+
+class FabricClient:
+    """Bounded, deadline-checked peer fault-back for one engine's tier.
+
+    ``fetch`` is consulted by ``ContinuousEngine._tier_fill`` on a
+    LOCAL tier miss: probe peers for the chain digest, pull the entry's
+    wire bytes, validate them through :func:`_decode` (CRC + header —
+    the same containment boundary local reads cross), and hand back the
+    decoded payload for the engine's unchanged geometry/fingerprint
+    validation. Every failure — refused connect, hung peer past the
+    deadline, garbled bytes, over-bound response — degrades to None
+    (re-prefill) and never blocks admission: pulls are capped by
+    ``max_inflight`` across threads and by ``pull_timeout_s`` of total
+    wall clock per fetch, and a failing peer cools down for
+    ``cooldown_s`` so a dead neighbor costs one timeout, not one per
+    request."""
+
+    def __init__(self, *, pull_timeout_s: float = 0.5,
+                 max_inflight: int = 2, cooldown_s: float = 5.0):
+        self.pull_timeout_s = float(pull_timeout_s)
+        self.cooldown_s = float(cooldown_s)
+        self._sem = threading.BoundedSemaphore(max(1, int(max_inflight)))
+        self._lock = threading.Lock()
+        self._peers: list = []
+        self._cool: dict[str, float] = {}
+        self.stats = {
+            "probes": 0,
+            "pulls": 0,
+            "pull_bytes": 0,
+            "pull_failures": 0,
+            "remote_hits": 0,
+        }
+        # Resolved once and pre-touched at 0: a cold scrape must show
+        # the full fabric series (PR 15 convention).
+        self._m_probes = obs_metrics.counter(
+            "tdt_fabric_probes_total",
+            "Fabric peer probes issued (tier_probe) on local tier "
+            "misses.",
+        )
+        self._m_pulls = obs_metrics.counter(
+            "tdt_fabric_pulls_total",
+            "Fabric entry pulls attempted (tier_get) after a positive "
+            "peer probe.",
+        )
+        self._m_pull_bytes = obs_metrics.counter(
+            "tdt_fabric_pull_bytes_total",
+            "Wire bytes of fabric entries pulled and validated.",
+        )
+        self._m_pull_failures = obs_metrics.counter(
+            "tdt_fabric_pull_failures_total",
+            "Fabric probe/pull failures (dead peer, deadline, garbled "
+            "or over-bound entry) — each degraded to re-prefill, never "
+            "wrong bits or a blocked admission.",
+        )
+        self._m_remote_hits = obs_metrics.counter(
+            "tdt_fabric_remote_hits_total",
+            "Fabric pulls that validated and served a peer's tier "
+            "entry.",
+        )
+        for m in (self._m_probes, self._m_pulls, self._m_pull_bytes,
+                  self._m_pull_failures, self._m_remote_hits):
+            m.inc(0)
+
+    # -- peer wiring -------------------------------------------------------
+
+    @property
+    def peers(self) -> list:
+        with self._lock:
+            return list(self._peers)
+
+    def set_peers(self, peers) -> None:
+        """Replace the peer set (any objects with ``name``/``probe``/
+        ``get``) — the in-process wiring path."""
+        with self._lock:
+            self._peers = list(peers)
+            self._cool.clear()
+
+    def set_wire_peers(self, peers) -> None:
+        """Replace the peer set from ``tier_peers`` wire dicts
+        (``{"name", "host", "port"}``) — the supervisor broadcast
+        path. Malformed rows are skipped, not fatal: a stale broadcast
+        must never wedge the serving loop."""
+        built = []
+        for p in peers or ():
+            try:
+                built.append(WireFabricPeer(
+                    str(p["name"]), str(p["host"]), int(p["port"]),
+                ))
+            except (KeyError, TypeError, ValueError):
+                continue
+        self.set_peers(built)
+
+    # -- pull --------------------------------------------------------------
+
+    def _fail(self, peer_name: str, kind: str, key: str,
+              reason: str) -> None:
+        with self._lock:
+            self.stats["pull_failures"] += 1
+        self._m_pull_failures.inc()
+        obs_events.emit(
+            "fabric_pull_failed", peer=str(peer_name)[:64],
+            tier_kind=kind, key=str(key)[:16], reason=str(reason)[:160],
+        )
+
+    def fetch(self, kind: str, key: str) -> dict | None:
+        """Probe peers for ``(kind, key)`` and pull + validate the
+        first hit. Returns the DECODED payload dict (what
+        ``PageStore.get`` returns) or None — the caller cannot tell a
+        dead fabric from a fleet-wide miss, which is the point: both
+        degrade to re-prefill."""
+        deadline = time.monotonic() + self.pull_timeout_s
+        if not self._sem.acquire(blocking=False):
+            # At the in-flight bound: skip rather than queue — peer
+            # fault-back is an optimization, admission latency is not.
+            self._fail("*", kind, key, "inflight bound")
+            return None
+        try:
+            return self._fetch_locked(kind, key, deadline)
+        finally:
+            self._sem.release()
+
+    def _fetch_locked(self, kind: str, key: str,
+                      deadline: float) -> dict | None:
+        now = time.monotonic()
+        for peer in self.peers:
+            if now >= deadline:
+                self._fail(peer.name, kind, key, "deadline")
+                return None
+            with self._lock:
+                until = self._cool.get(peer.name, 0.0)
+            if now < until:
+                continue
+            try:
+                with self._lock:
+                    self.stats["probes"] += 1
+                self._m_probes.inc()
+                probe_key = mutate_point(
+                    "fabric.probe", key, peer=peer.name, kind=kind,
+                )
+                have = peer.probe(kind, probe_key)
+            except Exception as e:  # noqa: BLE001 — containment boundary
+                self._cool_peer(peer.name)
+                self._fail(peer.name, kind, key,
+                           f"probe: {type(e).__name__}: {e}")
+                now = time.monotonic()
+                continue
+            now = time.monotonic()
+            if not have:
+                continue
+            if now >= deadline:
+                self._fail(peer.name, kind, key, "deadline")
+                return None
+            try:
+                with self._lock:
+                    self.stats["pulls"] += 1
+                self._m_pulls.inc()
+                blob = peer.get(kind, key)
+                blob = mutate_point(
+                    "fabric.get", blob, peer=peer.name, kind=kind,
+                    key=key,
+                )
+            except Exception as e:  # noqa: BLE001 — containment boundary
+                self._cool_peer(peer.name)
+                self._fail(peer.name, kind, key,
+                           f"pull: {type(e).__name__}: {e}")
+                now = time.monotonic()
+                continue
+            now = time.monotonic()
+            if blob is None:
+                continue  # raced away between probe and pull
+            if now > deadline:
+                # The bytes arrived late (hung peer): honoring them
+                # would make the timeout advisory. Drop, re-prefill.
+                self._fail(peer.name, kind, key, "deadline")
+                return None
+            try:
+                payload = _decode(kind, key, bytes(blob))
+            except Exception as e:  # noqa: BLE001 — the codec IS the
+                # transport: a garbled remote entry dies HERE, exactly
+                # where a corrupt local one does.
+                self._fail(peer.name, kind, key,
+                           f"integrity: {type(e).__name__}: {e}")
+                continue
+            with self._lock:
+                self.stats["remote_hits"] += 1
+                self.stats["pull_bytes"] += len(blob)
+            self._m_remote_hits.inc()
+            self._m_pull_bytes.inc(len(blob))
+            obs_events.emit(
+                "fabric_pull", peer=str(peer.name)[:64], tier_kind=kind,
+                key=str(key)[:16], nbytes=len(blob),
+            )
+            return payload
+        return None
+
+    def _cool_peer(self, name: str) -> None:
+        with self._lock:
+            self._cool[name] = time.monotonic() + self.cooldown_s
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = dict(self.stats)
+            out["peers"] = [str(getattr(p, "name", "?"))
+                            for p in self._peers]
+        return out

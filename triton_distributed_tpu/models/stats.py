@@ -144,6 +144,10 @@ STAT_METRICS = {
                     "(written via write_page, mapped as tree pages)."),
     "tier_bytes": ("tdt_tier_bytes_faulted_total",
                    "Payload bytes faulted back from the KV tier."),
+    "tier_remote_pages": ("tdt_tier_remote_pages_total",
+                          "Tier pages faulted back from a PEER replica "
+                          "over the KV fabric (subset of "
+                          "tdt_tier_faulted_pages_total)."),
 }
 
 # Extra registry names mirroring the SAME counter as a STAT_METRICS

@@ -63,6 +63,16 @@ Seams currently instrumented (grep for ``fault_point``/``mutate_point``):
                    re-prefills/replays), stalls, or is corrupted (the
                    integrity check drops the entry and degrades —
                    wrong bits can never come out)
+``fabric.probe``   ``kv_tier.FabricClient`` peer probe — mutate-style:
+                   a dead/refusing peer (raising mutate) cools down
+                   and the fetch falls through to the local-miss path;
+                   a stall trips the fetch deadline (``peer=`` narrows
+                   to one peer by name)
+``fabric.get``     the pulled entry's wire bytes — mutate-style: a
+                   garbled remote entry CRC-drops to re-prefill
+                   exactly like a corrupt local one (the PR 12 codec
+                   is the transport); a stall past the pull deadline
+                   discards even valid late bytes
 =================  =====================================================
 
 The ``wire.*``/``proc.*`` seams live on the *router-process* side of
@@ -364,6 +374,63 @@ class FaultPlan:
 
         kw = {"at": at} if at else {"every": 1}
         return self.on(f"tier.{op}", times=times, mutate=_stall,
+                       **kw, **match)
+
+    # Fabric seams (docs/scale-out.md "KV fabric") — same one-seam-per-
+    # direction discipline as the tier seams: refuse is a raising
+    # mutate, slow a sleeping one, garble a byte flip the puller's CRC
+    # catches. Narrow with ``peer=`` (peer name) / ``kind=`` / ``key=``.
+
+    def refuse_fabric(self, op: str = "get", at: int = 0,
+                      times: int = 1, **match) -> "FaultPlan":
+        """The Nth matching fabric probe/pull raises as if the peer
+        were dead or refusing: the peer cools down and the fetch
+        degrades to the local-miss path (re-prefill) without blocking
+        admission. ``at=0`` fires on every matching hit up to
+        ``times``."""
+        if op not in ("probe", "get"):
+            raise ValueError(f"op must be 'probe' or 'get', got {op!r}")
+
+        def _refuse(_value, _ctx):
+            raise FaultError(f"fabric.{op}", "fabric peer refused (injected)")
+
+        kw = {"at": at} if at else {"every": 1}
+        return self.on(f"fabric.{op}", times=times, mutate=_refuse,
+                       **kw, **match)
+
+    def corrupt_fabric(self, at: int = 0, times: int = 1,
+                       **match) -> "FaultPlan":
+        """The Nth matching pulled entry's wire bytes are corrupted in
+        flight (a middle byte flipped — the CRC can never validate
+        it): the puller drops the entry and re-prefills BIT-EXACTLY,
+        proving a garbled remote entry dies at the same containment
+        boundary as a corrupt local one."""
+
+        def _flip(value, _ctx):
+            b = bytearray(bytes(value))
+            if b:
+                b[len(b) // 2] ^= 0xFF
+            return bytes(b)
+
+        kw = {"at": at} if at else {"every": 1}
+        return self.on("fabric.get", times=times, mutate=_flip,
+                       **kw, **match)
+
+    def slow_fabric(self, delay: float, op: str = "get", at: int = 0,
+                    times: int = 1, **match) -> "FaultPlan":
+        """The Nth matching fabric access stalls ``delay`` seconds (a
+        hung peer): a stall past the client's ``pull_timeout_s`` trips
+        the fetch deadline — the pull fails, admission re-prefills and
+        never waits the peer out."""
+        if op not in ("probe", "get"):
+            raise ValueError(f"op must be 'probe' or 'get', got {op!r}")
+
+        def _stall(value, _ctx):
+            time.sleep(delay)
+            return value
+
+        kw = {"at": at} if at else {"every": 1}
+        return self.on(f"fabric.{op}", times=times, mutate=_stall,
                        **kw, **match)
 
     def fail_import(self, at: int = 1, times: int = 1) -> "FaultPlan":

@@ -46,6 +46,13 @@ ROLES = (PREFILL, DECODE, MIXED)
 MATCH_WEIGHT = 2.0
 PRESSURE_WEIGHT = 1.0
 FREE_WEIGHT = 0.25
+# Tier coverage BEYOND the radix match (docs/scale-out.md "KV
+# fabric"): faulting a page back from the replica's tier is cheaper
+# than re-prefilling it but dearer than a radix hit (write_page +
+# graft vs an already-mapped node), so the increment scores at half
+# the radix weight — a pure-tier full match (2·0 + 1·1 = 1) exactly
+# offsets full occupancy, while a radix match (2) still clears it.
+TIER_MATCH_WEIGHT = 1.0
 
 
 def replica_role(rep) -> str:
@@ -81,18 +88,24 @@ def occupancy(rep) -> float:
 
 
 def decode_score(rep, matched: int, prompt_len: int, *,
-                 max_free: int = 0) -> float:
+                 max_free: int = 0, tier_matched: int = 0) -> float:
     """Placement score for a decode hop: higher is better.
 
     ``matched`` is the replica's radix-digest match in tokens for this
     request's prompt; ``max_free`` normalizes the free-page term
     across the candidate pool (pass the pool's max ``free_pages``; 0
     disables the term — remote replicas report 0 free pages until
-    their first batch). A saturated replica with a perfect match can
-    still lose to an idle one with none: match wins ties, pressure
+    their first batch). ``tier_matched`` is the replica's TIER-digest
+    match in tokens: only its coverage BEYOND the radix match counts
+    (pages the radix already holds would never fault back), at
+    ``TIER_MATCH_WEIGHT``. A saturated replica with a perfect match
+    can still lose to an idle one with none: match wins ties, pressure
     breaks monopolies."""
     match_frac = matched / max(prompt_len, 1)
     score = MATCH_WEIGHT * match_frac - PRESSURE_WEIGHT * occupancy(rep)
+    tier_extra = max(int(tier_matched) - int(matched), 0)
+    if tier_extra:
+        score += TIER_MATCH_WEIGHT * tier_extra / max(prompt_len, 1)
     if max_free > 0:
         score += FREE_WEIGHT * (rep.free_pages / max_free)
     return score

@@ -86,6 +86,7 @@ class RemoteEngine:
         self.recv_timeout_s = recv_timeout_s
         self.last_stats: dict = {}
         self._digest = None
+        self._tier_digest = None
 
     # -- wire --------------------------------------------------------------
 
@@ -237,6 +238,16 @@ class RemoteEngine:
     def set_digest(self, digest) -> None:
         self._digest = digest
 
+    def tier_digest(self):
+        """The child's tier digest as last piggybacked on a batch
+        response (docs/scale-out.md "KV fabric") — the inherited
+        ``_publish_digest`` reads this exactly like the in-process
+        replica reads its engine's."""
+        return self._tier_digest
+
+    def set_tier_digest(self, digest) -> None:
+        self._tier_digest = digest
+
     def drain(self) -> int:
         """Replica drain, remote form: ask the child to shut down (its
         server refuses new work, finishes in flight, exits). A wire
@@ -247,6 +258,7 @@ class RemoteEngine:
         except (OSError, ConnectionError):
             pass
         self._digest = []
+        self._tier_digest = None
         return 0
 
 
@@ -303,6 +315,7 @@ class RemoteReplica(EngineReplica):
             "gen_lens": [t.gen_len for t in tickets],
             "ticket_ids": [t.tid for t in tickets],
             "want_digest": True,
+            "want_tier_digest": True,
             # Internal fan-out marker: the child must not fold these
             # into ITS wire-side SLO ledger — the user-facing hop (the
             # front server) judges goodput exactly once per request
@@ -398,6 +411,7 @@ class RemoteReplica(EngineReplica):
         stats = resp.get("stats") or {}
         self._remote.last_stats = stats
         self._remote.set_digest(resp.get("prefix_digest"))
+        self._remote.set_tier_digest(resp.get("tier_digest"))
         self.runs += 1
         for k in self.totals:
             self.totals[k] += stats.get(k, 0)

@@ -304,6 +304,7 @@ class EngineReplica:
         self._handoff = False
         self._digest_lock = threading.Lock()
         self._prefix_digest = None
+        self._tier_digest = None
         self._digest_version: int | None = None
         self._publish_digest()
         self._thread = threading.Thread(
@@ -368,6 +369,20 @@ class EngineReplica:
         with self._digest_lock:
             digest = self._prefix_digest
         return digest_match_len(digest, tokens)
+
+    def tier_match_len(self, tokens) -> int:
+        """Tier-affinity score (docs/scale-out.md "KV fabric"):
+        longest whole-page prefix of ``tokens`` resident in this
+        replica's last published TIER digest — pages the engine would
+        fault back from its tier instead of re-prefilling. 0 without a
+        tier."""
+        from triton_distributed_tpu.models.kv_tier import (
+            tier_digest_match_len,
+        )
+
+        with self._digest_lock:
+            digest = self._tier_digest
+        return tier_digest_match_len(digest, tokens)
 
     def snapshot(self) -> dict:
         with self._cond:
@@ -665,6 +680,12 @@ class EngineReplica:
         Inserted+evicted page counts version every shape mutation
         (in-place tail upgrades count as insertions; dedupes/COW touch
         no chain)."""
+        # Tier digest rides every publish: the store memoizes it on
+        # its own mutation counter, so an unchanged tier costs a dict
+        # ref — no scan — and a spill/adoption between radix versions
+        # still lands (docs/scale-out.md "KV fabric").
+        td = getattr(self.engine, "tier_digest", None)
+        tier_digest = td() if td is not None else None
         prefix = getattr(self.engine, "prefix", None)
         if prefix is not None:
             version = (
@@ -672,6 +693,8 @@ class EngineReplica:
                 + prefix.stats["evicted_pages"]
             )
             if version == self._digest_version:
+                with self._digest_lock:
+                    self._tier_digest = tier_digest
                 return
             self._digest_version = version
         digest = (
@@ -680,6 +703,7 @@ class EngineReplica:
         )
         with self._digest_lock:
             self._prefix_digest = digest
+            self._tier_digest = tier_digest
 
     # -- death -------------------------------------------------------------
 

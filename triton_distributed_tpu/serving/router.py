@@ -135,6 +135,12 @@ class Router:
             "routed": 0,
             "affinity_hits": 0,
             "affinity_hit_tokens": 0,
+            # KV-fabric placement (docs/scale-out.md "KV fabric"):
+            # decisions where a replica's TIER digest (pages it would
+            # fault back instead of re-prefilling) beat every radix
+            # match, and the tokens so covered.
+            "tier_affinity_hits": 0,
+            "tier_affinity_hit_tokens": 0,
             "least_loaded": 0,
             "round_robin": 0,
             "shed_skips": 0,
@@ -167,6 +173,12 @@ class Router:
         self._m_affinity = obs_metrics.counter(
             "tdt_router_affinity_hit_tokens_total",
             "Prompt tokens routed onto a replica already caching them.",
+        )
+        self._m_tier_affinity = obs_metrics.counter(
+            "tdt_router_tier_affinity_hit_tokens_total",
+            "Prompt tokens routed onto a replica whose KV TIER holds "
+            "them (fault-back beats re-prefill; docs/scale-out.md "
+            "'KV fabric').",
         )
         self._m_reroutes = obs_metrics.counter(
             "tdt_router_reroutes_total",
@@ -512,17 +524,26 @@ class Router:
                 rep = pool[self._rr % len(pool)]
                 self._rr += 1
             return rep, 0, "round_robin"
-        best, best_len = None, 0
+        best, best_len, best_radix = None, 0, 0
         toks = ticket.prompt_tokens  # converted once, scored N times
         for r in pool:
             m = r.match_len(toks)
-            if m > best_len or (
-                m == best_len and best is not None and m > 0
+            # Tier affinity (docs/scale-out.md "KV fabric"): pages a
+            # replica would FAULT BACK from its tier are nearly as good
+            # as radix-resident ones — both beat re-prefilling on a
+            # cold neighbor. The max keeps radix and tier coverage on
+            # one scale (tokens of prompt already held).
+            tl = getattr(r, "tier_match_len", None)
+            eff = max(m, tl(toks) if tl is not None else 0)
+            if eff > best_len or (
+                eff == best_len and best is not None and eff > 0
                 and r.pending < best.pending
             ):
-                best, best_len = r, m
+                best, best_len, best_radix = r, eff, m
         if best is not None and best_len > 0:
-            return best, best_len, "affinity"
+            return best, best_len, (
+                "affinity" if best_radix >= best_len else "tier_affinity"
+            )
         rep = min(pool, key=lambda r: (r.pending, -r.free_pages))
         return rep, 0, "least_loaded"
 
@@ -543,10 +564,13 @@ class Router:
             best, best_score, best_m = None, None, 0
             for r in cands:
                 m = r.match_len(toks)
+                tl = getattr(r, "tier_match_len", None)
+                t = tl(toks) if tl is not None else 0
                 s = pools_mod.decode_score(r, m, len(toks),
-                                           max_free=max_free)
+                                           max_free=max_free,
+                                           tier_matched=t)
                 if best_score is None or s > best_score:
-                    best, best_score, best_m = r, s, m
+                    best, best_score, best_m = r, s, max(m, t)
             return best, best_m, "pool_decode"
         cands = [r for r in pool if pools_mod.prefill_capable(r)]
         cands = cands or pool
@@ -609,6 +633,10 @@ class Router:
                 self._bump("affinity_hits")
                 self._bump("affinity_hit_tokens", matched)
                 self._m_affinity.inc(matched)
+            elif decision == "tier_affinity":
+                self._bump("tier_affinity_hits")
+                self._bump("tier_affinity_hit_tokens", matched)
+                self._m_tier_affinity.inc(matched)
             elif decision == "least_loaded":
                 self._bump("least_loaded")
             elif decision == "round_robin":

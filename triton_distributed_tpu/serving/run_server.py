@@ -157,11 +157,21 @@ def main(argv=None) -> int:
                    help="disk tier directory (write-through, atomic "
                    "rename, checksummed entries): spilled pages AND "
                    "the snapshot buffer survive a process restart. "
-                   "With --replicas/--fleet each engine gets DIR/r<i>; "
-                   "with --fleet the supervisor also persists pulled "
-                   "snapshots under DIR/resume, so ONE flag boots a "
-                   "restart-safe fleet (docs/scale-out.md 'Durable "
-                   "snapshots')")
+                   "With --replicas/--fleet each engine gets DIR/r<i> "
+                   "unless --tier-shared makes DIR one fleet-wide "
+                   "fabric dir; with --fleet the supervisor also "
+                   "persists pulled snapshots under DIR/resume, so ONE "
+                   "flag boots a restart-safe fleet (docs/scale-out.md "
+                   "'Durable snapshots')")
+    p.add_argument("--tier-shared", action="store_true",
+                   help="share ONE KV tier across the replicas instead "
+                   "of per-engine DIR/r<i> splits (docs/scale-out.md "
+                   "'KV fabric'): with --fleet every child mounts the "
+                   "same --tier-dir (digest-keyed, checksummed entries "
+                   "make concurrent writers safe, and a fresh "
+                   "autoscaler replica boots warm from the pool's "
+                   "spills); with --replicas the engines share one "
+                   "in-process PageStore")
     p.add_argument("--snapshot-s", type=float, default=0.0,
                    help="with --fleet: supervisor snapshot-pull period "
                    "in seconds (0 = off) — failed replicas' requests "
@@ -254,6 +264,35 @@ def main(argv=None) -> int:
             "have no KV tier); --tier-dir still arms the supervisor's "
             "durable resume store, or use a real --model."
         )
+    if args.tier_shared:
+        # Same fail-fast-by-flag-name convention: a shared tier only
+        # means something when there are multiple engines to share it.
+        many = (args.fleet > 0 or args.replicas > 1
+                or args.prefill_replicas > 0 or args.decode_replicas > 0)
+        if not many:
+            p.error(
+                "--tier-shared shares ONE KV tier ACROSS replicas "
+                "(docs/scale-out.md 'KV fabric'); add --fleet N, "
+                "--replicas N (N >= 2), or the --prefill-replicas/"
+                "--decode-replicas pool shape."
+            )
+        if args.model == "stub" and args.replicas == 0:
+            p.error(
+                "--tier-shared does nothing on a stub fleet (stub "
+                "children have no KV tier); use a real --model."
+            )
+        if (args.fleet > 0 or args.prefill_replicas > 0
+                or args.decode_replicas > 0) and not args.tier_dir:
+            p.error(
+                "--tier-shared on a PROCESS fleet shares through disk "
+                "— the children are separate processes, so give the "
+                "common directory with --tier-dir DIR."
+            )
+        if args.replicas > 1 and not (args.tier_bytes or args.tier_dir):
+            p.error(
+                "--tier-shared needs a tier to share: add --tier-bytes "
+                "N and/or --tier-dir DIR."
+            )
     # Role-typed pools (docs/scale-out.md "Disaggregated pools &
     # autoscaling") — fail-fast by flag name on every path that would
     # silently ignore them (the PR 12 guardrail convention).
@@ -372,12 +411,21 @@ def main(argv=None) -> int:
             def make_spec(name: str, role: str = "mixed") -> ReplicaSpec:
                 argv_i = list(child)
                 if args.tier_dir:
-                    # Per-child tier dirs: one disk tier per engine
-                    # (digest-keyed entries would be content-identical
-                    # across children, but per-child dirs keep snapshot
-                    # buffers and byte accounting disjoint).
+                    # Default: per-child tier dirs — one disk tier per
+                    # engine (digest-keyed entries would be content-
+                    # identical across children, but per-child dirs
+                    # keep snapshot buffers and byte accounting
+                    # disjoint). --tier-shared mounts every child on
+                    # the SAME dir instead (docs/scale-out.md "KV
+                    # fabric"): atomic-rename writes and checksummed,
+                    # digest-keyed entries make concurrent writers
+                    # safe, and a fresh autoscaler replica's disk
+                    # prescan finds the pool's spills at boot — the
+                    # warm-boot path.
                     argv_i += [
-                        "--tier-dir", os.path.join(args.tier_dir, name)
+                        "--tier-dir",
+                        (args.tier_dir if args.tier_shared
+                         else os.path.join(args.tier_dir, name)),
                     ]
                 return ReplicaSpec(name, argv_i, role=role)
 
@@ -389,6 +437,11 @@ def main(argv=None) -> int:
             # supervisor resumes re-submitted requests from them.
             resume_dir=(os.path.join(args.tier_dir, "resume")
                         if args.tier_dir else None),
+            # Tiered real-model children carry a FabricClient; the
+            # supervisor broadcasts the peer table so local misses can
+            # fault back over the wire (docs/scale-out.md "KV fabric").
+            tier_fabric=(args.model != "stub"
+                         and bool(args.tier_bytes or args.tier_dir)),
             router_kw={
                 "drain_grace_s": args.drain_grace,
                 "request_timeout_s": args.request_timeout or None,
@@ -464,6 +517,19 @@ def main(argv=None) -> int:
         from triton_distributed_tpu.models.continuous import ContinuousEngine
         from triton_distributed_tpu.serving.router import Router
 
+        tiered = bool(args.tier_bytes or args.tier_dir)
+        shared_tier = None
+        if tiered and args.tier_shared:
+            # One in-process PageStore behind every replica
+            # (docs/scale-out.md "KV fabric"): each engine's spills
+            # land where its siblings' fault-backs look, no fabric
+            # round-trip needed. Owner-only deletes keep eviction safe.
+            from triton_distributed_tpu.models.kv_tier import PageStore
+
+            shared_tier = PageStore(
+                capacity_bytes=args.tier_bytes or (64 << 20),
+                dir=args.tier_dir, fsync=False,
+            )
         engines = [
             ContinuousEngine(
                 model, max_batch=args.max_batch, mode=args.mode,
@@ -471,12 +537,31 @@ def main(argv=None) -> int:
                 kv_dtype=args.kv_dtype, speculative=args.speculative,
                 kernel_trace=kernel_trace,
                 snapshot_every=args.snapshot_every,
+                tier=shared_tier,
                 tier_bytes=args.tier_bytes,
                 tier_dir=(os.path.join(args.tier_dir, f"r{i}")
-                          if args.tier_dir else None),
+                          if args.tier_dir and shared_tier is None
+                          else None),
             )
             for i in range(args.replicas)
         ]
+        if tiered and shared_tier is None and len(engines) > 1:
+            # Per-replica tiers → cross-wire the KV fabric in-process
+            # (docs/scale-out.md "KV fabric"): each engine's local tier
+            # miss probes its siblings' stores before re-prefilling.
+            from triton_distributed_tpu.models.kv_tier import (
+                FabricClient,
+                LocalFabricPeer,
+            )
+
+            for i, eng in enumerate(engines):
+                fc = FabricClient()
+                fc.set_peers([
+                    LocalFabricPeer(f"r{j}", other.tier)
+                    for j, other in enumerate(engines)
+                    if j != i and other.tier is not None
+                ])
+                eng.fabric = fc
         engine = Router(
             engines, policy=policy, drain_grace_s=args.drain_grace,
             request_timeout_s=args.request_timeout or None,
@@ -488,6 +573,15 @@ def main(argv=None) -> int:
         # migration surface (export_slots/handoff verbs) live.
         from triton_distributed_tpu.models.continuous import ContinuousEngine
 
+        fabric = None
+        if args.tier_bytes or args.tier_dir:
+            # Every tiered fleet child carries a FabricClient so the
+            # supervisor's tier_peers broadcast has somewhere to land
+            # (docs/scale-out.md "KV fabric"); peerless it is inert —
+            # _tier_fill treats an empty peer table as fabric-off.
+            from triton_distributed_tpu.models.kv_tier import FabricClient
+
+            fabric = FabricClient()
         engine = ContinuousEngine(
             model, max_batch=args.max_batch, mode=args.mode,
             temperature=args.temperature, prefix_cache=True,
@@ -495,6 +589,7 @@ def main(argv=None) -> int:
             kernel_trace=kernel_trace,
             snapshot_every=args.snapshot_every,
             tier_bytes=args.tier_bytes, tier_dir=args.tier_dir,
+            fabric=fabric,
         )
         what = f"{args.model} (continuous, tp={args.tp})"
     else:

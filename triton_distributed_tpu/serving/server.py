@@ -128,6 +128,7 @@ serialize), and drains the replica fleet on shutdown.
 
 from __future__ import annotations
 
+import base64
 import itertools
 import json
 import os
@@ -154,7 +155,12 @@ from triton_distributed_tpu.runtime.faults import fault_point, mutate_point
 # it serializes behind generation — run it quiesced).
 PROBE_CMDS = ("ping", "healthz", "stats", "metrics", "events",
               "kernel_trace", "audit", "shutdown", "export_slots",
-              "handoff", "cancel", "slo")
+              "handoff", "cancel", "slo", "tier_probe", "tier_get",
+              "tier_peers")
+
+# Bound on one tier_probe's key list: probes are per-page walks, and a
+# prompt's page count is small — an unbounded list is a client bug.
+MAX_TIER_PROBE_KEYS = 256
 
 # Server-assigned stream ticket ids (payloads that stream without
 # client ticket_ids still need cancellable identities); pid-suffixed
@@ -602,6 +608,68 @@ class ModelServer:
                     )
                 rh()
                 return {"ok": True}
+            if cmd in ("tier_probe", "tier_get"):
+                # KV fabric serve side (docs/scale-out.md "KV fabric").
+                # Engine-lock-FREE like metrics/healthz: the PageStore
+                # has its own lock, and peers probe/pull MID-batch —
+                # that is the point of cross-replica fault-back.
+                # ``prefix`` entries only: snapshots are per-ticket
+                # crash-recovery state, not shareable cache.
+                from triton_distributed_tpu.models import kv_tier
+
+                tier = getattr(self.engine, "tier", None)
+                if tier is None:
+                    raise _BadRequest(
+                        "this engine has no KV tier (run with "
+                        "tier_bytes/tier_dir; see docs/serving.md "
+                        "'Tiered KV')"
+                    )
+                kind = req.get("kind", kv_tier.PREFIX_KIND)
+                if kind != kv_tier.PREFIX_KIND:
+                    raise _BadRequest(
+                        "the KV fabric serves 'prefix' entries only"
+                    )
+                if cmd == "tier_probe":
+                    keys = req.get("keys")
+                    if (not isinstance(keys, list) or not keys
+                            or len(keys) > MAX_TIER_PROBE_KEYS
+                            or not all(isinstance(k, str) for k in keys)):
+                        raise _BadRequest(
+                            "tier_probe needs a non-empty keys list of "
+                            f"<= {MAX_TIER_PROBE_KEYS} strings"
+                        )
+                    return {
+                        "have": [bool(tier.contains(kind, k))
+                                 for k in keys],
+                    }
+                key = req.get("key")
+                if not isinstance(key, str) or not key:
+                    raise _BadRequest("tier_get needs a string key")
+                blob = tier.get_blob(kind, key)
+                if blob is None:
+                    return {"found": False}
+                b64 = base64.b64encode(blob).decode()
+                if len(b64) > self.MAX_LINE_BYTES - 4096:
+                    # The response must fit one wire line; an oversized
+                    # entry reads as a miss — the puller re-prefills.
+                    return {"found": False, "reason": "oversized"}
+                return {"found": True, "blob": b64}
+            if cmd == "tier_peers":
+                # Supervisor broadcast: (re)wire this replica's fabric
+                # client at the engine's peer set. Engine-lock-free (a
+                # list swap under the client's own lock).
+                fabric = getattr(self.engine, "fabric", None)
+                if fabric is None:
+                    raise _BadRequest(
+                        "this engine has no KV fabric client (run with "
+                        "a tier + fabric; see docs/scale-out.md "
+                        "'KV fabric')"
+                    )
+                peers = req.get("peers")
+                if not isinstance(peers, list):
+                    raise _BadRequest("tier_peers needs a peers list")
+                fabric.set_wire_peers(peers)
+                return {"ok": True, "peers": len(fabric.peers)}
             if cmd == "shutdown":
                 self._shutdown.set()
                 return {"ok": True}
@@ -762,8 +830,8 @@ class ModelServer:
                 f"cmd ({'|'.join(PROBE_CMDS)})",
                 "requests + gen_lens/temperatures/top_ps/top_ks/"
                 "deadline_s/trace_ids/ticket_ids/want_digest/"
-                "snapshots/prefill_only/stream/slo_class (continuous "
-                "batching)",
+                "want_tier_digest/snapshots/prefill_only/stream/"
+                "slo_class (continuous batching)",
                 "input_ids + gen_len/prompt_start (fixed batch)",
             ]
             raise _BadRequest(
@@ -1132,6 +1200,13 @@ class ModelServer:
                 resp["prefix_digest"] = (
                     digest() if digest is not None else None
                 )
+            if req.get("want_tier_digest"):
+                # Tier-digest piggyback (docs/scale-out.md "KV
+                # fabric"): same batch-boundary publication protocol as
+                # want_digest, one response field over — the remote
+                # replica's router scores tier affinity from this.
+                td = getattr(self.engine, "tier_digest", None)
+                resp["tier_digest"] = td() if td is not None else None
             return resp
         if req.get("stream"):
             raise _BadRequest(

@@ -222,6 +222,7 @@ class FleetSupervisor:
         router_kw: dict | None = None,
         snapshot_s: float = 0.0,
         resume_dir: str | None = None,
+        tier_fabric: bool = False,
     ):
         if not specs:
             raise ValueError("FleetSupervisor needs at least one spec")
@@ -274,6 +275,14 @@ class FleetSupervisor:
         # the entry (the request replays: degraded, never wrong), and
         # a CLEAN shutdown clears the store — leftovers mean a crash.
         self.resume_dir = resume_dir
+        # KV fabric peer wiring (docs/scale-out.md "KV fabric"):
+        # opt-in — after every membership change (boot, add_slot,
+        # retire_slot, respawn) each live child learns its peers via
+        # the ``tier_peers`` verb, so tier entries one replica spilled
+        # are pullable by the others. Off by default: the broadcast is
+        # probe traffic, and fleets without tiers (or chaos tests with
+        # probe-narrowed wire seams) must not see it.
+        self.tier_fabric = bool(tier_fabric)
         self._store = None
         self._store_keys: dict[str, set] = {}  # slot name → persisted tids
         self._resume: dict[str, tuple[str, dict]] = {}  # digest → (tid, snap)
@@ -406,6 +415,7 @@ class FleetSupervisor:
         # router IS that engine, so it carries the back-reference
         # ({"cmd": "metrics", "scope": "fleet"}, docs/scale-out.md).
         self.router.fleet = self
+        self._broadcast_tier_peers()
         self._thread = threading.Thread(
             target=self._monitor, daemon=True, name="fleet-supervisor",
         )
@@ -525,7 +535,10 @@ class FleetSupervisor:
                 "slot_added", slot=spec.name, replica=rep.name,
                 role=getattr(spec, "role", "mixed"), pid=rep.pid,
             )
-            return rep
+        # Outside the lock: the broadcast is N wire calls and must not
+        # hold the monitor off while they run.
+        self._broadcast_tier_peers()
+        return rep
 
     def retire_slot(self, name: str) -> bool:
         """Remove one slot from supervision — the autoscaler's
@@ -559,7 +572,8 @@ class FleetSupervisor:
                 "slot_retired", slot=name,
                 replica=rep.name if rep is not None else slot.last_name,
             )
-            return True
+        self._broadcast_tier_peers()
+        return True
 
     def stats(self) -> dict:
         """The supervisor ledger (per-slot generation/parked/failure
@@ -1010,6 +1024,41 @@ class FleetSupervisor:
             "replica_respawn", replica=rep.name, slot=slot.spec.name,
             generation=slot.generation, pid=rep.pid,
         )
+        self._broadcast_tier_peers()
+
+    def _broadcast_tier_peers(self) -> None:
+        """Best-effort KV-fabric (re)wiring (docs/scale-out.md "KV
+        fabric"): tell every live child who its peers are via the
+        ``tier_peers`` verb, so each engine's ``FabricClient`` can
+        pull tier entries its neighbors spilled. Called after every
+        membership change; failures (and children without a fabric —
+        their server answers ``bad_request``) are skipped, never
+        fatal: a child that missed a broadcast keeps its last peer set
+        and pays at most one cooldown per dead peer."""
+        if not self.tier_fabric:
+            return
+        live = []
+        for slot in self._slots:
+            rep = slot.replica
+            remote = (getattr(rep, "_remote", None)
+                      if rep is not None else None)
+            if remote is not None and rep.state == "healthy":
+                live.append((rep, remote))
+        for rep, remote in live:
+            peers = [
+                {"name": o.name, "host": orem.host, "port": orem.port}
+                for o, orem in live if o is not rep
+            ]
+            try:
+                remote.call(
+                    {"cmd": "tier_peers", "peers": peers},
+                    timeout=max(self.heartbeat_timeout_s, 1.0),
+                )
+            except Exception as e:  # noqa: BLE001 — best-effort wiring
+                obs_events.emit(
+                    "fabric_wire_failed", replica=rep.name,
+                    reason=f"{type(e).__name__}: {e}"[:160],
+                )
 
     def _spawn(self, slot: _Slot) -> RemoteReplica:
         return spawn_replica(
