@@ -146,8 +146,22 @@ def _fleet_demo(args) -> int:
             return ReplicaSpec(name, argv_i, env=env, role=role)
 
     specs = [make_spec(name, role) for name, role in members]
+    launcher = None
+    if args.fake_hosts:
+        # Local host failure domains (docs/scale-out.md "Multi-host
+        # fleet"): round-robin the children across N named process
+        # groups so killing a "host" is one correlated loss.
+        from triton_distributed_tpu.serving.launcher import (
+            FakeHostLauncher,
+        )
+
+        host_names = [f"h{i}" for i in range(args.fake_hosts)]
+        launcher = FakeHostLauncher(host_names)
+        for i, spec in enumerate(specs):
+            spec.host = host_names[i % len(host_names)]
     sup = FleetSupervisor(
         specs,
+        launcher=launcher,
         policy="pools" if pool_fleet else "affinity",
         resume_dir=(os.path.join(args.tier_dir, "resume")
                     if args.tier_dir else None),
@@ -176,6 +190,7 @@ def _fleet_demo(args) -> int:
         "serving": args.model, "mode": mode,
         "fleet": len(members), "pools": router.pool_shape()
         if pool_fleet else None,
+        "hosts": args.fake_hosts or None,
         "autoscale": bool(scaler), "port": server.port,
         "logs": sup.log_dir,
         "startup_s": round(time.time() - t0, 1),
@@ -297,6 +312,13 @@ def main(argv=None) -> int:
     p.add_argument("--autoscale", action="store_true",
                    help="run the pool autoscaler over the role-typed "
                    "fleet (needs --prefill-replicas/--decode-replicas)")
+    p.add_argument("--fake-hosts", type=int, default=0, metavar="N",
+                   help="with --fleet/--prefill-replicas: partition "
+                   "the children into N named fake hosts (process "
+                   "groups h0..h{N-1}, docs/scale-out.md 'Multi-host "
+                   "fleet') so host failure domains run locally — the "
+                   "supervisor classifies whole-host loss as ONE "
+                   "host_down and re-places survivors")
     p.add_argument("--stream", action="store_true",
                    help="drive the generation through the streaming "
                    "wire ('stream': true): tokens print as they "
@@ -342,6 +364,14 @@ def main(argv=None) -> int:
             "--tier-bytes does nothing on a stub fleet (stub children "
             "have no KV tier); --tier-dir still arms the supervisor's "
             "durable resume store, or use a real --model"
+        )
+    if args.tier_shared and args.fake_hosts:
+        # Mirror run_server's refusal: a shared tier dir is one
+        # filesystem and host failure domains model separate machines
+        # — per-child tiers reach each other over the wire fabric.
+        p.error(
+            "--tier-shared cannot cross --fake-hosts failure domains "
+            "(a shared dir is ONE host's disk); drop --tier-shared"
         )
     if args.tier_shared:
         # Refuse by flag name (the run_server convention): sharing a
@@ -395,6 +425,12 @@ def main(argv=None) -> int:
         p.error(
             "--autoscale resizes role pools: add --prefill-replicas N "
             "and --decode-replicas M"
+        )
+    if args.fake_hosts and not (args.fleet or pool_fleet):
+        p.error(
+            "--fake-hosts places PROCESS-fleet children on failure "
+            "domains; add --fleet N or --prefill-replicas/"
+            "--decode-replicas (docs/scale-out.md 'Multi-host fleet')"
         )
 
     import jax

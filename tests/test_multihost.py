@@ -1,0 +1,490 @@
+"""Multi-host fleet tests (docs/scale-out.md "Multi-host fleet"):
+pluggable launchers, host failure domains, epoch fencing.
+
+Layers of evidence:
+
+- pure: launcher contracts (FakeHostLauncher bookkeeping, SSHLauncher
+  argv rewriting and port assignment), the ``launcher.spawn`` fault
+  seam, the supervisor's host ledger (rejoin refused by name, epochs
+  monotonic across revive), spread-aware ``_pick_host``, and the CLI
+  refusals — milliseconds, no processes;
+- SSHLauncher's WIRE handshake with an empty command template (the
+  child runs locally, the handshake is the real healthz poll): success
+  path round-trips, and a child that never answers fails the spawn on
+  OUR deadline, not the OS connect default;
+- chaos (ISSUE-18 acceptance): SIGKILLing a whole fake host lands as
+  exactly ONE ``host_down`` classification with parallel re-placement
+  onto the survivor; a spawn-refused host drives spawn FAILOVER; and
+  the SIGSTOP→thaw zombie path shows the epoch fence — the thawed
+  host's late batch completions latch ZERO results.
+
+Process tests spawn ``run_server --model stub`` children and
+synchronize on conditions with deadlines, never bare sleeps.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from triton_distributed_tpu.models.stub import stub_generate
+from triton_distributed_tpu.runtime.faults import FaultPlan
+from triton_distributed_tpu.serving.launcher import (
+    FakeHostLauncher,
+    Launcher,
+    LocalLauncher,
+    SpawnError,
+    SSHLauncher,
+)
+
+
+def _can_spawn() -> bool:
+    try:
+        return subprocess.run(
+            [sys.executable, "-c", "pass"], timeout=60
+        ).returncode == 0
+    except Exception:  # noqa: BLE001 — any failure means "cannot"
+        return False
+
+
+_SPAWN_OK = _can_spawn()
+needs_procs = pytest.mark.skipif(
+    not _SPAWN_OK or not hasattr(signal, "SIGKILL"),
+    reason="child-process spawning unavailable on this platform",
+)
+
+PROMPTS = [
+    np.arange(1, 9, dtype=np.int32),
+    np.arange(20, 30, dtype=np.int32),
+    np.arange(40, 46, dtype=np.int32),
+]
+GENS = [5, 4, 3]
+GOLDS = [stub_generate(p, g) for p, g in zip(PROMPTS, GENS)]
+
+
+def _stub_specs(n, delay_s=0.4, hosts=None):
+    from triton_distributed_tpu.serving.supervisor import stub_spec
+
+    specs = [
+        stub_spec(f"r{i}", delay_s=delay_s, page_size=4, num_pages=64)
+        for i in range(n)
+    ]
+    if hosts:
+        for i, s in enumerate(specs):
+            s.host = hosts[i % len(hosts)]
+    return specs
+
+
+# -- pure: launcher contracts, seam, ledger, placement, CLI --------------
+
+
+def test_launcher_contract_and_fake_host_bookkeeping():
+    """The seam's base contract (no host notion → host machinery
+    dormant) and FakeHostLauncher's ledger: named hosts, down-marking,
+    and spawn refusal BEFORE any process work when the target (or
+    every) host is down."""
+    base = Launcher()
+    assert base.hosts() == [] and base.host_up("anything")
+    base.reap()  # no-op, never raises
+    with pytest.raises(NotImplementedError):
+        base.spawn(object())
+    # LocalLauncher reports no hosts: a supervisor over it keeps every
+    # host-domain feature dormant (the byte-identical default path).
+    assert LocalLauncher().hosts() == []
+
+    laun = FakeHostLauncher(("h0", "h1"))
+    assert laun.hosts() == ["h0", "h1"]
+    assert laun.host_up("h0") and not laun.host_up("nope")
+    laun.set_down("h1")
+    assert not laun.host_up("h1")
+    spec = _stub_specs(1)[0]
+    spec.host = "h1"
+    with pytest.raises(SpawnError, match="fake host h1 is down"):
+        laun.spawn(spec)
+    spec.host = "hX"
+    with pytest.raises(SpawnError, match="unknown fake host"):
+        laun.spawn(spec)
+    laun.set_down("h0")
+    spec.host = None
+    with pytest.raises(SpawnError, match="every fake host is down"):
+        laun.spawn(spec)
+    with pytest.raises(ValueError):
+        FakeHostLauncher(())
+    # kill/hang/thaw on an empty host: zero groups hit, no exception.
+    assert FakeHostLauncher(("h0",)).kill_host("h0") == 0
+
+
+def test_ssh_launcher_argv_and_port_assignment():
+    """The launcher owns the port (a child binding :0 remotely cannot
+    report back) and rewrites the child argv for routable addressing:
+    ``--port`` pinned, ``--host 0.0.0.0``, ``--advertise-host`` the
+    placement host, ``spec.env`` as env-prefix tokens."""
+    from triton_distributed_tpu.serving.supervisor import ReplicaSpec
+
+    laun = SSHLauncher(["ha", "hb"], port_base=50000)
+    spec = ReplicaSpec("r0", ["x", "--port", "0"],
+                       env={"JAX_PLATFORMS": "cpu"})
+    spec.host = "hb"
+    host, port = laun._alloc(spec)
+    assert (host, port) == ("hb", 50000)
+    argv = SSHLauncher._child_argv(spec, port, host)
+    assert argv[:2] == ["env", "JAX_PLATFORMS=cpu"]
+    i = argv.index("--port")
+    assert argv[i + 1] == "50000"
+    assert argv[argv.index("--host") + 1] == "0.0.0.0"
+    assert argv[argv.index("--advertise-host") + 1] == "hb"
+    # Pre-set --host / --advertise-host are respected, --port appended
+    # when absent.
+    spec2 = ReplicaSpec("r1", ["x", "--host", "10.0.0.9"])
+    argv2 = SSHLauncher._child_argv(spec2, 50001, "ha")
+    assert argv2[argv2.index("--host") + 1] == "10.0.0.9"
+    assert argv2[argv2.index("--port") + 1] == "50001"
+    # Hostless specs fall back least-spawned; ports stay monotonic.
+    spec2.host = None
+    host2, port2 = laun._alloc(spec2)
+    assert host2 == "ha" and port2 == 50001
+    with pytest.raises(ValueError):
+        SSHLauncher([])
+
+
+def test_refuse_spawn_seam_units():
+    """``FaultPlan.refuse_spawn`` arms the ``launcher.spawn`` seam:
+    the gate surfaces it as SpawnError (the supervisor's failover
+    type), and ``host=`` narrows the blast radius."""
+    from triton_distributed_tpu.serving.launcher import _spawn_gate
+
+    with FaultPlan(seed=1).refuse_spawn(host="h1", times=2) as plan:
+        _spawn_gate("r0", "h0")  # wrong host: not matched
+        with pytest.raises(SpawnError, match="spawn refused on host h1"):
+            _spawn_gate("r1", "h1")
+        assert plan.fired and plan.fired[0][0] == "launcher.spawn"
+    with FaultPlan(seed=1).refuse_spawn(replica="rZ") as plan:
+        _spawn_gate("r0", None)  # wrong replica: not matched
+        with pytest.raises(SpawnError):
+            _spawn_gate("rZ", None)
+
+
+def test_host_ledger_rejoin_refused_and_epoch_monotonic():
+    """The supervisor's host ledger: a down host refuses spawns BY
+    NAME (the zombie-rejoin gate), revive reopens placement but the
+    fence epoch stays bumped — a revive can never un-fence results
+    from the dead generation."""
+    from triton_distributed_tpu.serving.supervisor import FleetSupervisor
+
+    laun = FakeHostLauncher(("h0", "h1"))
+    sup = FleetSupervisor(
+        _stub_specs(2, hosts=["h0", "h1"]), launcher=laun,
+    )
+    assert set(sup.host_stats()) == {"h0", "h1"}
+    sup.mark_host_down("h1")
+    st = sup.host_stats()["h1"]
+    assert st["down"] and st["epoch"] == 1
+    slot = next(s for s in sup._slots if s.spec.host == "h1")
+    with pytest.raises(SpawnError, match="host h1 is marked down"):
+        sup._spawn(slot)
+    # Placement refuses it too.
+    assert sup._pick_host() == "h0"
+    sup.revive_host("h1")
+    st = sup.host_stats()["h1"]
+    assert not st["down"] and st["epoch"] == 1  # epoch survives revive
+    sup.mark_host_down("h1")
+    assert sup.host_stats()["h1"]["epoch"] == 2  # strictly monotonic
+    # Idempotent: re-marking a down host does not re-bump.
+    sup.mark_host_down("h1")
+    assert sup.host_stats()["h1"]["epoch"] == 2
+
+
+def test_pick_host_spreads_roles_across_up_hosts():
+    """Spread-aware placement: the next slot of a role lands on the
+    host carrying the fewest of that role (ties: fewest total, then
+    name), never on a down host; no up host → None."""
+    from triton_distributed_tpu.serving.supervisor import FleetSupervisor
+
+    laun = FakeHostLauncher(("h0", "h1"))
+    specs = _stub_specs(3, hosts=["h0", "h0", "h1"])
+    specs[2].role = "decode"
+    sup = FleetSupervisor(specs, launcher=laun)
+    # h0 has 2 mixed, h1 has 1 decode → mixed placement prefers h1.
+    assert sup._pick_host(role="mixed") == "h1"
+    # decode placement prefers h0 (zero decode slots there).
+    assert sup._pick_host(role="decode") == "h0"
+    assert sup._pick_host(role="mixed", exclude={"h1"}) == "h0"
+    sup.mark_host_down("h1")
+    assert sup._pick_host(role="mixed") == "h0"
+    sup.mark_host_down("h0")
+    assert sup._pick_host(role="mixed") is None
+
+
+def test_cli_refusals_multihost():
+    """run_server refuses the multi-host misuses BY FLAG NAME before
+    anything boots: a shared tier dir cannot cross hosts, rival
+    launchers cannot combine, and host flags need a fleet shape."""
+    from triton_distributed_tpu.serving.run_server import main
+
+    for argv in (
+        ["--model", "tiny", "--fleet", "2", "--fake-hosts", "2",
+         "--tier-shared", "--tier-dir", "/tmp/x"],
+        ["--model", "tiny", "--fleet", "2", "--hosts", "a,b",
+         "--tier-shared", "--tier-dir", "/tmp/x"],
+        ["--model", "stub", "--fleet", "2", "--hosts", "a,b",
+         "--fake-hosts", "2"],
+        ["--model", "stub", "--fake-hosts", "2"],
+        ["--model", "stub", "--hosts", "a,b"],
+    ):
+        with pytest.raises(SystemExit) as exc:
+            main(argv)
+        assert exc.value.code == 2, argv
+
+
+# -- SSH launcher: the wire handshake, no ssh needed ---------------------
+
+
+@needs_procs
+def test_ssh_wire_handshake_success_and_bounded_timeout():
+    """An empty command template runs the child locally, so this is
+    the REAL healthz-poll handshake: the launcher-assigned port comes
+    up serving, and a child that never answers fails the spawn within
+    the deadline (plus kill/reap), not the OS connect default."""
+    import socket
+
+    from triton_distributed_tpu.serving.supervisor import ReplicaSpec
+
+    # Grab a free port for the launcher to assign deterministically.
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port_base = s.getsockname()[1]
+    s.close()
+    laun = SSHLauncher(["127.0.0.1"], cmd_template=(),
+                       port_base=port_base)
+    spec = _stub_specs(1, delay_s=0.0)[0]
+    spec.host = "127.0.0.1"
+    rep = laun.spawn(spec, spawn_timeout_s=120.0)
+    try:
+        assert rep.healthz() == {"ok": True, "state": "serving"}
+        assert rep.host_tag == "127.0.0.1"
+        assert rep.proc.poll() is None
+    finally:
+        rep.proc.kill()
+        rep.proc.wait(timeout=10)
+
+    # Never-answering child: the handshake fails on OUR deadline.
+    mute = ReplicaSpec(
+        "mute", [sys.executable, "-c", "import time; time.sleep(60)"]
+    )
+    mute.host = "127.0.0.1"
+    t0 = time.monotonic()
+    with pytest.raises(SpawnError, match="never answered healthz"):
+        laun.spawn(mute, spawn_timeout_s=1.0)
+    assert time.monotonic() - t0 < 15.0
+
+
+# -- chaos: whole-host loss, failover, zombie fence ----------------------
+
+
+@needs_procs
+def test_kill_host_single_host_down_and_parallel_replace(fresh_telemetry):
+    """ISSUE-18 acceptance core: SIGKILLing every process on a fake
+    host lands as exactly ONE ``host_down`` event (correlated
+    classification, not N independent timeouts), every lost slot is
+    re-placed on the survivor (spawn failover events + counter), and
+    the recovered fleet serves bit-exact."""
+    from triton_distributed_tpu.obs import events as obs_events
+    from triton_distributed_tpu.obs import metrics as obs_metrics
+    from triton_distributed_tpu.serving.supervisor import FleetSupervisor
+
+    laun = FakeHostLauncher(("h0", "h1"))
+    sup = FleetSupervisor(
+        _stub_specs(4, delay_s=0.05, hosts=["h0", "h1"]),
+        launcher=laun, heartbeat_s=0.1, heartbeat_timeout_s=1.0,
+        heartbeat_misses=2, respawn_backoff_s=0.2,
+        spawn_timeout_s=120.0,
+    )
+    try:
+        router = sup.start()
+        assert sup.host_stats()["h1"]["slots"] == ["r1", "r3"]
+        # Raw SIGKILL of every process group on h1 WITHOUT telling the
+        # launcher: the supervisor must classify the correlated loss
+        # from sibling corroboration alone (the production shape — a
+        # dead machine does not announce itself).
+        assert laun._signal_host("h1", signal.SIGKILL) == 2
+        assert sup.wait_for(
+            lambda: sup.host_stats()["h1"]["down"], timeout_s=30
+        ), sup.stats()
+        assert sup.wait_healthy(4, timeout_s=60), sup.stats()
+        # Everything lives on the survivor now.
+        hosts = sup.host_stats()
+        assert sorted(hosts["h0"]["slots"]) == ["r0", "r1", "r2", "r3"]
+        assert hosts["h1"]["slots"] == [] and hosts["h1"]["epoch"] == 1
+        res = router.run(list(zip(PROMPTS, GENS)), results=True)
+        for r, gold in zip(res, GOLDS):
+            assert r.status == "ok", (r.status, r.reason)
+            assert r.tokens.tolist() == gold
+
+        evts = [e.as_dict() for e in obs_events.default_ring().tail(0)[0]]
+        downs = [e for e in evts if e["kind"] == "host_down"]
+        assert len(downs) == 1, downs  # ONE event for the whole host
+        assert downs[0]["fields"]["host"] == "h1"
+        assert sorted(downs[0]["fields"]["slots"]) == ["r1", "r3"]
+        fo = [e["fields"] for e in evts if e["kind"] == "spawn_failover"]
+        assert sorted(f["slot"] for f in fo) == ["r1", "r3"]
+        assert all(f == {"slot": f["slot"], "from_host": "h1",
+                         "to_host": "h0"} for f in fo)
+        snap = obs_metrics.default_registry().snapshot()
+        hd = snap["tdt_supervisor_host_down_total"]["series"]
+        assert {s["labels"]["host"]: s["value"] for s in hd} == {
+            "h0": 0, "h1": 1,
+        }
+        up = snap["tdt_host_up"]["series"]
+        assert {s["labels"]["host"]: s["value"] for s in up} == {
+            "h0": 1.0, "h1": 0.0,
+        }
+    finally:
+        sup.shutdown()
+
+
+@needs_procs
+def test_spawn_refused_host_drives_failover(fresh_telemetry):
+    """A host that refuses the respawn (the ``launcher.spawn`` seam)
+    costs one ``spawn`` failure and a FAILOVER: the slot re-places on
+    the next up host and comes back healthy there — still under the
+    backoff schedule, never a hot loop."""
+    from triton_distributed_tpu.obs import events as obs_events
+    from triton_distributed_tpu.serving.supervisor import FleetSupervisor
+
+    laun = FakeHostLauncher(("h0", "h1"))
+    sup = FleetSupervisor(
+        _stub_specs(2, delay_s=0.0, hosts=["h0", "h1"]),
+        launcher=laun, heartbeat_s=0.1, heartbeat_timeout_s=1.0,
+        heartbeat_misses=2, respawn_backoff_s=0.2,
+        spawn_timeout_s=120.0, crash_limit=4,
+    )
+    try:
+        router = sup.start()
+        with FaultPlan(seed=2).refuse_spawn(host="h0", times=9):
+            os.kill(router.replica("r0").pid, signal.SIGKILL)
+            assert sup.wait_for(
+                lambda: sup.slot("r0").spec.host == "h1", timeout_s=30
+            ), sup.stats()
+            assert sup.wait_healthy(2, timeout_s=60), sup.stats()
+        evts = [e.as_dict() for e in obs_events.default_ring().tail(0)[0]]
+        fo = [e["fields"] for e in evts
+              if e["kind"] == "spawn_failover"]
+        assert {"slot": "r0", "from_host": "h0", "to_host": "h1"} in fo
+        # An independent single-process crash is NOT a host_down.
+        assert not sup.host_stats()["h0"]["down"]
+        assert all(e["kind"] != "host_down" for e in evts)
+        res = router.run([(PROMPTS[0], GENS[0])], results=True)
+        assert res[0].tokens.tolist() == GOLDS[0]
+    finally:
+        sup.shutdown()
+
+
+@needs_procs
+@pytest.mark.slow
+def test_hang_host_zombie_thaw_latches_zero(fresh_telemetry):
+    """The epoch-fence acceptance: SIGSTOPping a whole host mid-batch
+    classifies as ONE host_down; the requests re-route and finish
+    bit-exact on the survivor; and when the zombie host THAWS, its
+    late completions hit the fence — ``fenced_result_dropped`` fires
+    and the dead generation latches ZERO results into the fleet."""
+    from triton_distributed_tpu.obs import events as obs_events
+    from triton_distributed_tpu.serving.supervisor import FleetSupervisor
+
+    laun = FakeHostLauncher(("h0", "h1"))
+    sup = FleetSupervisor(
+        _stub_specs(3, delay_s=0.4, hosts=["h0", "h1", "h1"]),
+        launcher=laun, heartbeat_s=0.1, heartbeat_timeout_s=1.0,
+        heartbeat_misses=2, respawn_backoff_s=0.2,
+        spawn_timeout_s=120.0,
+        router_kw={"request_timeout_s": 1.5},
+    )
+    try:
+        router = sup.start()
+        zombies = [router.replica("r1"), router.replica("r2")]
+        # Freeze the WHOLE h1 host the instant a batch lands on it:
+        # the host.down seam fires mid-flight, exactly like a machine
+        # wedging with requests on the wire.
+        with FaultPlan(seed=4).hang_host(laun, host="h1") as plan:
+            res = router.run(list(zip(PROMPTS, GENS)), results=True)
+            assert plan.fired
+            for r, gold in zip(res, GOLDS):
+                assert r.status == "ok", (r.status, r.reason)
+                assert r.tokens.tolist() == gold
+            assert sup.wait_for(
+                lambda: sup.host_stats()["h1"]["down"], timeout_s=30
+            ), sup.stats()
+            assert sup.wait_healthy(3, timeout_s=60), sup.stats()
+            # Both h1 replicas are fenced under the down epoch.
+            assert all(z.fenced for z in zombies)
+            assert {z.fence_epoch for z in zombies} == {1}
+            # Thaw: the zombie children resume and push completions
+            # for tickets the fleet already finished elsewhere.
+            laun.thaw_host("h1")
+            assert sup.wait_for(
+                lambda: any(
+                    e.kind == "fenced_result_dropped"
+                    for e in obs_events.default_ring().tail(0)[0]
+                ),
+                timeout_s=30,
+            )
+        # The fence held: the dead generation latched NOTHING.
+        for z in zombies:
+            assert z.served == 0 and z.runs == 0
+        evts = [e.as_dict() for e in obs_events.default_ring().tail(0)[0]]
+        downs = [e for e in evts if e["kind"] == "host_down"]
+        assert len(downs) == 1 and downs[0]["fields"]["host"] == "h1"
+        # Rejoin refused: the thawed host takes no placements until an
+        # operator revives it.
+        assert sup.host_stats()["h1"]["down"]
+        assert sup._pick_host() == "h0"
+    finally:
+        sup.shutdown()
+
+
+@needs_procs
+@pytest.mark.slow
+def test_add_slot_spreads_and_revive_reopens(fresh_telemetry):
+    """Autoscaler-shaped growth over hosts: ``add_slot`` without a
+    pinned host avoids concentrating the pool (the new slot lands on
+    the emptier host), and after kill → revive the host takes NEW
+    generations again while its fence epoch stays bumped."""
+    from triton_distributed_tpu.serving.supervisor import (
+        FleetSupervisor,
+        stub_spec,
+    )
+
+    laun = FakeHostLauncher(("h0", "h1"))
+    sup = FleetSupervisor(
+        _stub_specs(2, delay_s=0.0, hosts=["h0", "h0"]),
+        launcher=laun, heartbeat_s=0.1, heartbeat_timeout_s=1.0,
+        heartbeat_misses=2, respawn_backoff_s=0.2,
+        spawn_timeout_s=120.0,
+    )
+    try:
+        sup.start()
+        spec = stub_spec("g0", page_size=4, num_pages=64)
+        sup.add_slot(spec)
+        assert spec.host == "h1"  # the emptier host, not the crowd
+        assert sup.wait_healthy(3, timeout_s=60)
+        laun.kill_host("h1")
+        assert sup.wait_for(
+            lambda: sup.host_stats()["h1"]["down"], timeout_s=30
+        )
+        assert sup.wait_healthy(3, timeout_s=60)
+        # Revive (the machine came back, fresh boot): placement reopens
+        # under the SAME epoch — only new generations land there.
+        laun.set_down("h1", False)
+        sup.revive_host("h1")
+        st = sup.host_stats()["h1"]
+        assert not st["down"] and st["epoch"] == 1
+        spec2 = stub_spec("g1", page_size=4, num_pages=64)
+        sup.add_slot(spec2)
+        assert spec2.host == "h1"
+        assert sup.wait_healthy(4, timeout_s=60)
+    finally:
+        sup.shutdown()

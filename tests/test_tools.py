@@ -407,6 +407,35 @@ def test_pools_modules_compile():
     )
 
 
+def test_multihost_modules_compile():
+    """ISSUE-18: the multi-host launcher seam must byte-compile —
+    launcher.py is imported by the supervisor (a syntax error takes
+    every fleet down at import time), and the host-loss bench that
+    writes perf/HOST_LOSS.json rides along (repo convention: perf
+    harnesses fail tier-1, not a relay window)."""
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    serving = os.path.join(root, "triton_distributed_tpu", "serving")
+    targets = [
+        os.path.join(serving, "launcher.py"),
+        os.path.join(serving, "supervisor.py"),
+        os.path.join(serving, "remote.py"),
+        os.path.join(serving, "run_server.py"),
+        os.path.join(root, "perf", "host_loss_bench.py"),
+    ]
+    proc = subprocess.run(
+        [sys.executable, "-m", "compileall", "-q", "-f", *targets],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, (
+        f"multi-host modules failed to compile:\n"
+        f"{proc.stdout}\n{proc.stderr}"
+    )
+
+
 def test_tier1_marker_audit():
     """ISSUE 8 satellite: the tier-1 window is spent by conftest's
     ``_FILE_ORDER`` schedule — audit it against reality so new trace
@@ -515,6 +544,21 @@ def test_tier1_marker_audit():
     assert len(pool_fast) >= 5, (
         f"elastic-pools suite has too few tier-1-runnable tests: "
         f"{pool_fast}"
+    )
+    # ISSUE-18: the multi-host suite (launcher contracts, host failure
+    # domains, epoch fencing, spawn failover) rides right behind the
+    # pools suite, ahead of the interpret tail, and must carry tier-1-
+    # runnable tests — a fencing or correlated-classification
+    # regression has to FAIL tier-1, not wait for a host_loss_bench
+    # run.
+    assert "test_multihost.py" in order
+    assert (order.index("test_pools.py")
+            < order.index("test_multihost.py")
+            < order.index("test_serving.py"))
+    mh_fast = fast_tests("test_multihost.py")
+    assert len(mh_fast) >= 5, (
+        f"multi-host suite has too few tier-1-runnable tests: "
+        f"{mh_fast}"
     )
     # ISSUE-16: the tree-speculation suite rides right behind the
     # linear-speculation suite (shared tiny-model jit warmup), ahead of

@@ -838,9 +838,15 @@ class FabricClient:
     request."""
 
     def __init__(self, *, pull_timeout_s: float = 0.5,
-                 max_inflight: int = 2, cooldown_s: float = 5.0):
+                 max_inflight: int = 2, cooldown_s: float = 5.0,
+                 connect_timeout_s: float = 0.25):
         self.pull_timeout_s = float(pull_timeout_s)
         self.cooldown_s = float(cooldown_s)
+        # Dial bound for wire peers (docs/scale-out.md "Multi-host
+        # fleet"): an unroutable peer host must fail the probe on
+        # THIS deadline, not the OS connect default — cross-host peer
+        # lists make black-holed addresses a normal failure mode.
+        self.connect_timeout_s = float(connect_timeout_s)
         self._sem = threading.BoundedSemaphore(max(1, int(max_inflight)))
         self._lock = threading.Lock()
         self._peers: list = []
@@ -907,6 +913,7 @@ class FabricClient:
             try:
                 built.append(WireFabricPeer(
                     str(p["name"]), str(p["host"]), int(p["port"]),
+                    connect_timeout_s=self.connect_timeout_s,
                 ))
             except (KeyError, TypeError, ValueError):
                 continue

@@ -73,6 +73,17 @@ Seams currently instrumented (grep for ``fault_point``/``mutate_point``):
                    exactly like a corrupt local one (the PR 12 codec
                    is the transport); a stall past the pull deadline
                    discards even valid late bytes
+``launcher.spawn`` ``serving/launcher.py`` — offered (``replica=``,
+                   ``host=``) before any spawn work; an armed rule
+                   surfaces as ``SpawnError``, driving the
+                   supervisor's spawn-FAILOVER path
+                   (``refuse_spawn``)
+``host.down``      the replica's host TAG, offered mid-batch next to
+                   ``proc.kill`` — a ``kill_host``/``hang_host`` rule
+                   takes the WHOLE fake host down while a batch is in
+                   flight (``host=`` narrows; the mutate closure
+                   holds the ``FakeHostLauncher`` that owns the
+                   process groups)
 =================  =====================================================
 
 The ``wire.*``/``proc.*`` seams live on the *router-process* side of
@@ -561,6 +572,64 @@ class FaultPlan:
         match = {} if replica is None else {"replica": replica}
         kw = {"at": at} if at else {"every": 1}
         return self.on("proc.hang", times=times, mutate=_stop,
+                       **kw, **match)
+
+    def refuse_spawn(self, host: str | None = None,
+                     replica: str | None = None, at: int = 0,
+                     times: int = 1) -> "FaultPlan":
+        """A launcher refuses to spawn: the ``launcher.spawn`` seam
+        raises, which every launcher converts to ``SpawnError`` — the
+        exact failure the supervisor's spawn-FAILOVER path re-places
+        around (``host=`` / ``replica=`` narrow the target)."""
+        match = {}
+        if host is not None:
+            match["host"] = host
+        if replica is not None:
+            match["replica"] = replica
+        kw = {"at": at} if at else {"every": 1}
+        return self.on(
+            "launcher.spawn", times=times,
+            exc=ConnectionRefusedError("host refused spawn (injected)"),
+            **kw, **match,
+        )
+
+    def kill_host(self, launcher, host: str | None = None,
+                  at: int = 0, times: int = 1,
+                  after_s: float = 0.0) -> "FaultPlan":
+        """SIGKILL a WHOLE fake host mid-batch: the ``host.down`` seam
+        offers the host tag right after a batch payload went out to a
+        replica living there, and the rule kills every process group
+        the launcher tagged with that host — losing the machine while
+        its work is in flight, deterministically. ``after_s`` sleeps
+        first (on the waiting worker thread) so the host makes real
+        progress before it dies."""
+
+        def _down(tag, _ctx):
+            if after_s:
+                time.sleep(after_s)
+            launcher.kill_host(tag)
+            return tag
+
+        match = {} if host is None else {"host": host}
+        kw = {"at": at} if at else {"every": 1}
+        return self.on("host.down", times=times, mutate=_down,
+                       **kw, **match)
+
+    def hang_host(self, launcher, host: str | None = None,
+                  at: int = 0, times: int = 1) -> "FaultPlan":
+        """SIGSTOP a WHOLE fake host mid-batch: every process on it
+        stays alive but stops answering — the correlated wedge only
+        the supervisor's host-window classification reads as ONE
+        ``host_down``. Thaw later with ``launcher.thaw_host`` to drive
+        the zombie-vs-epoch-fence race."""
+
+        def _freeze(tag, _ctx):
+            launcher.hang_host(tag)
+            return tag
+
+        match = {} if host is None else {"host": host}
+        kw = {"at": at} if at else {"every": 1}
+        return self.on("host.down", times=times, mutate=_freeze,
                        **kw, **match)
 
     # -- firing ----------------------------------------------------------

@@ -1,0 +1,440 @@
+"""Pluggable replica launchers: the host boundary behind ``spawn``.
+
+Everything above the transport is already host-agnostic — a
+:class:`~triton_distributed_tpu.serving.remote.RemoteReplica` speaks
+line-JSON to any address, snapshots ride the wire as base64, and the
+supervisor classifies failures without assuming co-residence. The one
+place "which machine" still leaks in is the *spawn*: the port-file
+handshake is a filesystem rendezvous, and files do not cross hosts.
+This module makes that seam explicit (docs/scale-out.md "Multi-host
+fleet"):
+
+- :class:`LocalLauncher` — today's subprocess + port-file path,
+  byte-identical to the original ``spawn_replica`` (which now
+  delegates here). The default; single-host fleets never see a
+  behavior change.
+- :class:`SSHLauncher` — command-template spawn of ``run_server`` on a
+  remote host. The port-file handshake becomes a bounded
+  ``healthz``-poll *wire* handshake: the launcher assigns the port
+  up front (a child binding port 0 on another machine has no way to
+  tell us what it got), starts the remote command, and polls
+  ``{"cmd": "healthz"}`` against ``host:port`` until the child answers
+  or the spawn deadline passes. The template is just argv prefix
+  tokens (``{host}`` substituted), so tests exercise the wire
+  handshake with an empty template — no real ssh in tier-1.
+- :class:`FakeHostLauncher` — local process groups tagged as named
+  "hosts". Children already spawn with ``start_new_session=True``
+  (their own process group), so killing or SIGSTOPping *every replica
+  on a host in one call* is exactly ``killpg`` over the host's tag —
+  which is how the chaos suite and ``perf/host_loss_bench.py`` lose a
+  whole machine without owning two.
+
+Fault seam: every launcher offers ``launcher.spawn`` (ctx:
+``replica``, ``host``) before doing any work — an armed plan rule
+(``FaultPlan.refuse_spawn``) turns into a :class:`SpawnError`, which
+drives the supervisor's spawn-FAILOVER path deterministically.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import tempfile
+import threading
+import time
+
+from triton_distributed_tpu.runtime.faults import fault_point
+from triton_distributed_tpu.serving.remote import RemoteEngine, RemoteReplica
+
+
+class SpawnError(RuntimeError):
+    """A replica child never reached its handshake."""
+
+
+def _gen_name(spec, generation: int) -> str:
+    return spec.name if generation == 0 else f"{spec.name}#{generation}"
+
+
+def _spawn_gate(name: str, host: str | None) -> None:
+    """The ``launcher.spawn`` fault seam: an armed refusal (or any
+    injected exception) surfaces as a :class:`SpawnError`, so chaos
+    plans drive the supervisor's failover path through the same
+    exception type a real failed bind raises."""
+    try:
+        fault_point("launcher.spawn", replica=name, host=host or "")
+    except Exception as e:
+        raise SpawnError(
+            f"replica {name} spawn refused on host "
+            f"{host or 'local'}: {e}"
+        ) from e
+
+
+def _log_tail(log_path: str, n: int = 800) -> str:
+    try:
+        with open(log_path, "rb") as f:
+            return f.read()[-n:].decode(errors="replace")
+    except OSError:
+        return ""
+
+
+class Launcher:
+    """The spawn seam: one method, returning a connected
+    :class:`RemoteReplica` (``.proc`` holds the handle the supervisor
+    reaps) or raising :class:`SpawnError`. ``hosts()``/``host_up()``
+    feed the supervisor's spread-aware placement and spawn failover;
+    a launcher with no host notion (local) reports no hosts and the
+    supervisor's host machinery stays entirely dormant."""
+
+    def spawn(self, spec, *, generation: int = 0,
+              spawn_timeout_s: float = 120.0, max_pending: int = 8,
+              log_dir: str | None = None,
+              connect_timeout_s: float = 10.0) -> RemoteReplica:
+        raise NotImplementedError
+
+    def hosts(self) -> list[str]:
+        """Named hosts this launcher can place on ([] = no host
+        notion; placement stays flat)."""
+        return []
+
+    def host_up(self, host: str) -> bool:
+        """Launcher-side liveness of a host (the supervisor keeps its
+        own down-ledger on top; both must agree up for placement)."""
+        return True
+
+    def reap(self) -> None:
+        """Kill anything the launcher still tracks — shutdown hook for
+        zombies the supervisor deliberately did NOT kill (a fenced
+        host's children are unreachable in production; locally they
+        would leak without this)."""
+
+
+def local_spawn(spec, *, generation: int = 0,
+                spawn_timeout_s: float = 120.0, max_pending: int = 8,
+                log_dir: str | None = None,
+                connect_timeout_s: float = 10.0,
+                host_tag: str | None = None) -> RemoteReplica:
+    """Launch one replica child on THIS machine and wait for its
+    port-file handshake — the original ``spawn_replica`` path, moved
+    behind the launcher seam verbatim. Returns a connected
+    :class:`RemoteReplica`; raises :class:`SpawnError` — with the
+    child's log tail attached — when the child dies or stalls before
+    binding."""
+    name = _gen_name(spec, generation)
+    _spawn_gate(name, host_tag)
+    if log_dir is None:
+        log_dir = tempfile.mkdtemp(prefix="tdt-fleet-")
+    os.makedirs(log_dir, exist_ok=True)
+    port_file = os.path.join(log_dir, f"{name.replace('#', '_')}.port")
+    log_path = os.path.join(log_dir, f"{name.replace('#', '_')}.log")
+    if os.path.exists(port_file):
+        os.unlink(port_file)
+    env = dict(os.environ)
+    if spec.env:
+        env.update(spec.env)
+    with open(log_path, "ab") as log_f:
+        proc = subprocess.Popen(
+            spec.argv + ["--port-file", port_file],
+            stdout=log_f, stderr=subprocess.STDOUT, env=env,
+            start_new_session=True,
+        )
+    deadline = time.monotonic() + spawn_timeout_s
+    addr = None
+    while time.monotonic() < deadline:
+        if os.path.exists(port_file):
+            with open(port_file) as f:
+                text = f.read().strip()
+            if text:  # the rename made this atomic; non-empty == done
+                addr = text
+                break
+        if proc.poll() is not None:
+            break
+        time.sleep(0.02)
+    if addr is None:
+        tail = _log_tail(log_path)
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait(timeout=10)
+        raise SpawnError(
+            f"replica {name} never bound within {spawn_timeout_s}s "
+            f"(rc={proc.returncode}); log tail:\n{tail}"
+        )
+    host, _, port = addr.rpartition(":")
+    rep = RemoteReplica(host, int(port), name=name, proc=proc,
+                        max_pending=max_pending,
+                        role=getattr(spec, "role", "mixed"),
+                        connect_timeout_s=connect_timeout_s,
+                        host_tag=host_tag)
+    return rep
+
+
+class LocalLauncher(Launcher):
+    """Today's behavior, verbatim: subprocess + port-file rendezvous
+    on the local machine. ``spec.host`` is ignored (there is only one
+    host) and ``hosts()`` is empty, so every host-domain feature in
+    the supervisor stays dormant."""
+
+    def spawn(self, spec, *, generation: int = 0,
+              spawn_timeout_s: float = 120.0, max_pending: int = 8,
+              log_dir: str | None = None,
+              connect_timeout_s: float = 10.0) -> RemoteReplica:
+        return local_spawn(
+            spec, generation=generation,
+            spawn_timeout_s=spawn_timeout_s, max_pending=max_pending,
+            log_dir=log_dir, connect_timeout_s=connect_timeout_s,
+        )
+
+
+class SSHLauncher(Launcher):
+    """Spawn ``run_server`` children on remote hosts via a command
+    template, with a wire handshake instead of a port file.
+
+    ``cmd_template`` is an argv *prefix* — each token is
+    ``str.format``-ed with ``host=...`` and prepended to the child
+    command (default: ``("ssh", "-o", "BatchMode=yes", "{host}")``).
+    An empty template runs the child locally, which is how the tests
+    exercise the healthz-poll handshake without ssh.
+
+    Because the child cannot hand its bound port back across machines,
+    the launcher owns port assignment: each spawn takes the next port
+    from ``port_base`` and rewrites the child's ``--port``. The child
+    is told to bind ``0.0.0.0`` and advertise its host name, so the
+    addresses that flow into heartbeats and fabric peer lists are
+    routable from everywhere (docs/scale-out.md "Multi-host fleet").
+    ``spec.env`` rides as ``env K=V`` prefix tokens (ssh does not
+    forward the local environment)."""
+
+    def __init__(self, hosts, *,
+                 cmd_template=("ssh", "-o", "BatchMode=yes", "{host}"),
+                 port_base: int = 47311,
+                 handshake_poll_s: float = 0.1):
+        if not hosts:
+            raise ValueError("SSHLauncher needs at least one host")
+        self._hosts = [str(h) for h in hosts]
+        self.cmd_template = tuple(cmd_template)
+        self.handshake_poll_s = float(handshake_poll_s)
+        self._next_port = int(port_base)
+        self._spawned: dict[str, int] = {h: 0 for h in self._hosts}
+        self._lock = threading.Lock()
+
+    def hosts(self) -> list[str]:
+        return list(self._hosts)
+
+    def _alloc(self, spec) -> tuple[str, int]:
+        with self._lock:
+            host = getattr(spec, "host", None)
+            if host is None:
+                # Least-loaded fallback; the supervisor normally
+                # assigns spec.host before spawning.
+                host = min(self._hosts, key=lambda h: self._spawned[h])
+            host = str(host)
+            self._spawned.setdefault(host, 0)
+            self._spawned[host] += 1
+            port = self._next_port
+            self._next_port += 1
+            return host, port
+
+    @staticmethod
+    def _child_argv(spec, port: int, host: str) -> list[str]:
+        argv = list(spec.argv)
+        try:
+            i = argv.index("--port")
+            argv[i + 1] = str(port)
+        except (ValueError, IndexError):
+            argv += ["--port", str(port)]
+        if "--host" not in argv:
+            argv += ["--host", "0.0.0.0"]
+        if "--advertise-host" not in argv:
+            argv += ["--advertise-host", host]
+        if spec.env:
+            argv = ["env", *(f"{k}={v}" for k, v in spec.env.items()),
+                    *argv]
+        return argv
+
+    def spawn(self, spec, *, generation: int = 0,
+              spawn_timeout_s: float = 120.0, max_pending: int = 8,
+              log_dir: str | None = None,
+              connect_timeout_s: float = 10.0) -> RemoteReplica:
+        name = _gen_name(spec, generation)
+        host, port = self._alloc(spec)
+        _spawn_gate(name, host)
+        if log_dir is None:
+            log_dir = tempfile.mkdtemp(prefix="tdt-fleet-")
+        os.makedirs(log_dir, exist_ok=True)
+        log_path = os.path.join(log_dir,
+                                f"{name.replace('#', '_')}.log")
+        argv = [
+            *(part.format(host=host) for part in self.cmd_template),
+            *self._child_argv(spec, port, host),
+        ]
+        with open(log_path, "ab") as log_f:
+            proc = subprocess.Popen(
+                argv, stdout=log_f, stderr=subprocess.STDOUT,
+                start_new_session=True,
+            )
+        # Wire handshake: poll healthz until the child answers. Each
+        # probe's connect is bounded — an unroutable host must fail
+        # the spawn at the deadline, not hang on the OS default.
+        probe = RemoteEngine(
+            host, port, name=name,
+            connect_timeout_s=min(connect_timeout_s, 1.0),
+        )
+        deadline = time.monotonic() + spawn_timeout_s
+        up = False
+        while time.monotonic() < deadline:
+            try:
+                if probe.healthz(timeout=1.0).get("ok"):
+                    up = True
+                    break
+            except (OSError, ConnectionError, ValueError):
+                pass
+            if proc.poll() is not None:
+                break
+            time.sleep(self.handshake_poll_s)
+        if not up:
+            tail = _log_tail(log_path)
+            if proc.poll() is None:
+                proc.kill()
+            proc.wait(timeout=10)
+            raise SpawnError(
+                f"replica {name} on {host}:{port} never answered "
+                f"healthz within {spawn_timeout_s}s "
+                f"(rc={proc.returncode}); log tail:\n{tail}"
+            )
+        return RemoteReplica(host, port, name=name, proc=proc,
+                             max_pending=max_pending,
+                             role=getattr(spec, "role", "mixed"),
+                             connect_timeout_s=connect_timeout_s,
+                             host_tag=host)
+
+
+class FakeHostLauncher(Launcher):
+    """Named fake hosts over local process groups — multi-host chaos
+    on one machine. Each child already runs in its own process group
+    (``start_new_session=True``), so the launcher tags groups with a
+    host name and takes a WHOLE host down in one call:
+    :meth:`kill_host` (SIGKILL — the machine died),
+    :meth:`hang_host` (SIGSTOP — the machine wedged; a later
+    :meth:`thaw_host` SIGCONTs it back into a zombie the epoch fence
+    must refuse). A host marked down refuses spawns with
+    :class:`SpawnError`, which is what exercises the supervisor's
+    spawn failover."""
+
+    def __init__(self, hosts=("h0", "h1"), *, log_dir: str | None = None):
+        if not hosts:
+            raise ValueError("FakeHostLauncher needs at least one host")
+        self._state = {
+            str(h): {"procs": [], "down": False} for h in hosts
+        }
+        self.log_dir = log_dir
+        self._lock = threading.Lock()
+
+    def hosts(self) -> list[str]:
+        return list(self._state)
+
+    def host_up(self, host: str) -> bool:
+        st = self._state.get(str(host))
+        return st is not None and not st["down"]
+
+    def set_down(self, host: str, down: bool = True) -> None:
+        self._state[str(host)]["down"] = bool(down)
+
+    def spawn(self, spec, *, generation: int = 0,
+              spawn_timeout_s: float = 120.0, max_pending: int = 8,
+              log_dir: str | None = None,
+              connect_timeout_s: float = 10.0) -> RemoteReplica:
+        name = _gen_name(spec, generation)
+        with self._lock:
+            host = getattr(spec, "host", None)
+            if host is None:
+                host = min(
+                    (h for h, st in self._state.items()
+                     if not st["down"]),
+                    key=lambda h: len(self._state[h]["procs"]),
+                    default=None,
+                )
+                if host is None:
+                    raise SpawnError(
+                        f"replica {name}: every fake host is down"
+                    )
+                spec.host = host
+            host = str(host)
+            st = self._state.get(host)
+        if st is None:
+            raise SpawnError(
+                f"replica {name}: unknown fake host {host!r} "
+                f"(have {sorted(self._state)})"
+            )
+        _spawn_gate(name, host)
+        if st["down"]:
+            raise SpawnError(
+                f"replica {name}: fake host {host} is down"
+            )
+        rep = local_spawn(
+            spec, generation=generation,
+            spawn_timeout_s=spawn_timeout_s, max_pending=max_pending,
+            log_dir=log_dir or self.log_dir,
+            connect_timeout_s=connect_timeout_s, host_tag=host,
+        )
+        with self._lock:
+            st["procs"].append(rep.proc)
+        return rep
+
+    # -- whole-host chaos ---------------------------------------------------
+
+    def _signal_host(self, host: str, sig: int) -> int:
+        """Signal every live process GROUP on ``host``; returns how
+        many groups were hit. Children are session leaders, so the
+        group id is the child pid."""
+        with self._lock:
+            procs = list(self._state[str(host)]["procs"])
+        hit = 0
+        for proc in procs:
+            if proc.poll() is not None:
+                continue
+            try:
+                os.killpg(proc.pid, sig)
+                hit += 1
+            except (ProcessLookupError, PermissionError):
+                pass
+        return hit
+
+    def kill_host(self, host: str) -> int:
+        """The machine died: SIGKILL every process group on ``host``
+        in one call and mark it down."""
+        self.set_down(host, True)
+        return self._signal_host(host, signal.SIGKILL)
+
+    def hang_host(self, host: str) -> int:
+        """The machine wedged (NIC down, scheduler stall): SIGSTOP
+        every process group on ``host`` and mark it down. Processes
+        survive — :meth:`thaw_host` turns them into zombies."""
+        self.set_down(host, True)
+        if not hasattr(signal, "SIGSTOP"):  # pragma: no cover
+            raise RuntimeError("SIGSTOP unavailable on this platform")
+        return self._signal_host(host, signal.SIGSTOP)
+
+    def thaw_host(self, host: str) -> int:
+        """SIGCONT a hung host's process groups: the zombie case. The
+        host stays marked down — a thawed machine does not rejoin by
+        itself; the supervisor's epoch fence is what keeps its stale
+        results out (tests/test_multihost.py)."""
+        return self._signal_host(host, signal.SIGCONT)
+
+    def reap(self) -> None:
+        """SIGKILL every tracked process group (stopped ones
+        included — SIGKILL does not queue behind SIGSTOP) and wait."""
+        with self._lock:
+            procs = [p for st in self._state.values()
+                     for p in st["procs"]]
+        for proc in procs:
+            if proc.poll() is None:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001 — best-effort reap
+                pass

@@ -84,6 +84,10 @@ class RemoteEngine:
         # supervisor heartbeat's) job to detect, exactly like a wedged
         # in-process worker.
         self.recv_timeout_s = recv_timeout_s
+        # Launcher-assigned host tag (docs/scale-out.md "Multi-host
+        # fleet"): names the failure domain this child lives in and
+        # arms the mid-batch `host.down` seam. None = no host notion.
+        self.host_tag: str | None = None
         self.last_stats: dict = {}
         self._digest = None
         self._tier_digest = None
@@ -131,6 +135,14 @@ class RemoteEngine:
                 if generation:
                     mutate_point("proc.kill", self.pid, replica=self.name)
                     mutate_point("proc.hang", self.pid, replica=self.name)
+                    if self.host_tag is not None:
+                        # Whole-host chaos lands mid-batch too: the
+                        # seam offers the host TAG (the plan's mutate
+                        # closure holds the launcher that can kill or
+                        # freeze the whole group).
+                        mutate_point("host.down", self.host_tag,
+                                     replica=self.name,
+                                     host=self.host_tag)
                 while True:
                     line = f.readline()
                     if not line:
@@ -278,7 +290,8 @@ class RemoteReplica(EngineReplica):
     def __init__(self, host: str, port: int, *, name: str,
                  proc=None, max_pending: int = 8, role: str = "mixed",
                  connect_timeout_s: float = 10.0,
-                 recv_timeout_s: float | None = None):
+                 recv_timeout_s: float | None = None,
+                 host_tag: str | None = None):
         self.proc = proc
         remote = RemoteEngine(
             host, port, name=name,
@@ -286,13 +299,43 @@ class RemoteReplica(EngineReplica):
             connect_timeout_s=connect_timeout_s,
             recv_timeout_s=recv_timeout_s,
         )
+        remote.host_tag = host_tag
         self._remote = remote
+        # Epoch fence (docs/scale-out.md "Multi-host fleet"): set when
+        # the supervisor declares this replica's HOST dead without
+        # being able to kill the process (you cannot SIGKILL a machine
+        # you cannot reach). A fenced replica's late batch responses
+        # latch NOTHING — stronger than the plain-DEAD rule, because a
+        # zombie host that thaws minutes later must not race the
+        # reroutes that already ran under a newer epoch.
+        self._fenced = False
+        self._fence_epoch: int | None = None
         super().__init__(remote, name=name, max_pending=max_pending,
                          role=role)
 
     @property
     def pid(self) -> int | None:
         return self._remote.pid
+
+    @property
+    def host_tag(self) -> str | None:
+        return self._remote.host_tag
+
+    @property
+    def fenced(self) -> bool:
+        return self._fenced
+
+    @property
+    def fence_epoch(self) -> int | None:
+        return self._fence_epoch
+
+    def fence(self, epoch: int | None = None) -> None:
+        """Drop the fence: from now on NO result from this replica's
+        process may latch — not even harmlessly. Called by the
+        supervisor when the replica's host is declared down (the
+        process may still be alive out there)."""
+        self._fenced = True
+        self._fence_epoch = epoch
 
     def healthz(self, timeout: float | None = None) -> dict:
         """The supervisor's heartbeat probe (lock-free on the child)."""
@@ -398,6 +441,20 @@ class RemoteReplica(EngineReplica):
             self._die(f"malformed remote response: {type(e).__name__}: {e}")
             return
         if self._state == DEAD:
+            if self._fenced:
+                # Epoch-fenced: the supervisor declared this replica's
+                # HOST dead (and could not kill the process). A thawed
+                # zombie's late results must latch ZERO — the fleet
+                # already re-dispatched these tickets under a newer
+                # epoch, and "harmless latch-first" only holds for
+                # processes known to be gone, not for machines that
+                # may keep computing stale state indefinitely.
+                obs_events.emit(
+                    "fenced_result_dropped", replica=self.name,
+                    host=self.host_tag, epoch=self._fence_epoch,
+                    tickets=len(tickets),
+                )
+                return
             # Late batch on a replica the router already gave up on:
             # latch what we can (latch-first dedup by ticket id makes
             # this harmless), fold NOTHING into fleet accounting — the

@@ -82,7 +82,18 @@ def main(argv=None) -> int:
     p.add_argument("--moe-intermediate", type=int, default=0,
                    help="override the MoE preset's per-expert FFN width")
     p.add_argument("--tp", type=int, default=1)
-    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--host", default="127.0.0.1",
+                   help="bind address; 0.0.0.0 listens on every "
+                   "interface (pair with --advertise-host so peers "
+                   "get a ROUTABLE address, docs/scale-out.md "
+                   "'Multi-host fleet')")
+    p.add_argument("--advertise-host", default=None, metavar="ADDR",
+                   help="the address OTHER machines reach this server "
+                   "at — written to the --port-file handshake, "
+                   "reported in server_stats, and broadcast in fabric "
+                   "peer tables instead of the bind address (which "
+                   "with --host 0.0.0.0 is unroutable). Default: the "
+                   "bind address.")
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--mode", default="xla",
@@ -172,6 +183,25 @@ def main(argv=None) -> int:
                    "autoscaler replica boots warm from the pool's "
                    "spills); with --replicas the engines share one "
                    "in-process PageStore")
+    p.add_argument("--hosts", default=None, metavar="H1,H2,...",
+                   help="with --fleet/--prefill-replicas: spread the "
+                   "children across these ssh-reachable hosts "
+                   "(SSHLauncher, docs/scale-out.md 'Multi-host "
+                   "fleet'); replicas are assigned round-robin and "
+                   "the supervisor treats each host as a failure "
+                   "domain (whole-host loss classifies as ONE "
+                   "host_down, survivors are re-placed)")
+    p.add_argument("--fake-hosts", type=int, default=0, metavar="N",
+                   help="with --fleet/--prefill-replicas: partition "
+                   "the LOCAL children into N named fake hosts "
+                   "(process groups h0..h{N-1}) so host-loss "
+                   "semantics run without real ssh — the chaos-suite "
+                   "and host_loss_bench shape")
+    p.add_argument("--connect-timeout", type=float, default=10.0,
+                   help="supervisor-side dial timeout in seconds for "
+                   "replica connections (cross-host dials to a dead "
+                   "machine fail on THIS deadline instead of the OS "
+                   "default)")
     p.add_argument("--snapshot-s", type=float, default=0.0,
                    help="with --fleet: supervisor snapshot-pull period "
                    "in seconds (0 = off) — failed replicas' requests "
@@ -264,6 +294,20 @@ def main(argv=None) -> int:
             "have no KV tier); --tier-dir still arms the supervisor's "
             "durable resume store, or use a real --model."
         )
+    if args.tier_shared and (args.hosts or args.fake_hosts):
+        # A shared tier dir is files on ONE machine's disk; children
+        # on another host would mount a path that isn't there (or
+        # worse, a same-named local dir holding nothing). Refuse by
+        # flag name — the cross-host KV path is the wire fabric, which
+        # per-child tiers get for free from the supervisor's
+        # tier_peers broadcast.
+        p.error(
+            "--tier-shared shares a tier through ONE host's "
+            "filesystem and cannot cross --hosts/--fake-hosts "
+            "boundaries; drop --tier-shared (per-child --tier-dir "
+            "tiers reach each other over the wire KV fabric, "
+            "docs/scale-out.md 'KV fabric')."
+        )
     if args.tier_shared:
         # Same fail-fast-by-flag-name convention: a shared tier only
         # means something when there are multiple engines to share it.
@@ -329,6 +373,21 @@ def main(argv=None) -> int:
             "and --decode-replicas M (docs/scale-out.md "
             "'Disaggregated pools & autoscaling')."
         )
+    if args.hosts and args.fake_hosts:
+        p.error(
+            "--hosts and --fake-hosts are rival launchers (real ssh "
+            "spawns vs local process-group fakes); give one."
+        )
+    if (args.hosts or args.fake_hosts) and not (
+            args.fleet > 0 or pool_fleet):
+        p.error(
+            "--hosts/--fake-hosts place PROCESS-fleet children on "
+            "failure domains; add --fleet N or the "
+            "--prefill-replicas/--decode-replicas pool shape "
+            "(docs/scale-out.md 'Multi-host fleet')."
+        )
+    if args.fake_hosts < 0:
+        p.error("--fake-hosts takes N >= 1 fake hosts.")
     policy = args.policy or ("pools" if pool_fleet else "affinity")
 
     from triton_distributed_tpu.serving.server import ModelServer
@@ -430,8 +489,32 @@ def main(argv=None) -> int:
                 return ReplicaSpec(name, argv_i, role=role)
 
         specs = [make_spec(name, role) for name, role in members]
+        launcher = None
+        if args.hosts or args.fake_hosts:
+            # Multi-host fleet (docs/scale-out.md "Multi-host fleet"):
+            # spread the children round-robin across named failure
+            # domains so losing a whole host is ONE host_down event
+            # with parallel re-placement, not N independent timeouts.
+            from triton_distributed_tpu.serving.launcher import (
+                FakeHostLauncher,
+                SSHLauncher,
+            )
+
+            if args.hosts:
+                host_names = [h.strip() for h in args.hosts.split(",")
+                              if h.strip()]
+                if not host_names:
+                    p.error("--hosts got no host names.")
+                launcher = SSHLauncher(host_names)
+            else:
+                host_names = [f"h{i}" for i in range(args.fake_hosts)]
+                launcher = FakeHostLauncher(host_names)
+            for i, spec in enumerate(specs):
+                spec.host = host_names[i % len(host_names)]
         sup = FleetSupervisor(
             specs, policy=policy, snapshot_s=args.snapshot_s,
+            launcher=launcher,
+            connect_timeout_s=args.connect_timeout,
             # --tier-dir makes the FLEET restart-safe too: pulled
             # snapshots persist under DIR/resume and a restarted
             # supervisor resumes re-submitted requests from them.
@@ -466,6 +549,7 @@ def main(argv=None) -> int:
             ).start()
         server = ModelServer(
             router, host=args.host, port=args.port,
+            advertise_host=args.advertise_host,
             drain_grace_s=args.drain_grace, slo=slo,
         )
         shape = (f"{args.prefill_replicas}p+{args.decode_replicas}d"
@@ -475,7 +559,7 @@ def main(argv=None) -> int:
               f"{', autoscaled' if scaler is not None else ''}, "
               f"logs {sup.log_dir}) on "
               f"{server.host}:{server.port}")
-        _write_port_file(args.port_file, server.host, server.port)
+        _write_port_file(args.port_file, server.advertise_host, server.port)
         try:
             server.serve_forever()
         finally:
@@ -496,10 +580,11 @@ def main(argv=None) -> int:
         )
         server = ModelServer(
             engine, host=args.host, port=args.port,
+            advertise_host=args.advertise_host,
             drain_grace_s=args.drain_grace, slo=slo,
         )
         print(f"serving stub on {server.host}:{server.port}")
-        _write_port_file(args.port_file, server.host, server.port)
+        _write_port_file(args.port_file, server.advertise_host, server.port)
         server.serve_forever()
         return 0
 
@@ -605,10 +690,11 @@ def main(argv=None) -> int:
         what = f"{args.model} (tp={args.tp})"
     server = ModelServer(
         engine, host=args.host, port=args.port,
+        advertise_host=args.advertise_host,
         drain_grace_s=args.drain_grace, trace_dir=args.trace, slo=slo,
     )
     print(f"serving {what} on {server.host}:{server.port}")
-    _write_port_file(args.port_file, server.host, server.port)
+    _write_port_file(args.port_file, server.advertise_host, server.port)
     if args.trace:
         # Host capture wraps the whole serving run; on exit the ranks'
         # chrome traces AND every traced mega launch's device task rows

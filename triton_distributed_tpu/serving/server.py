@@ -330,6 +330,7 @@ class ModelServer:
         drain_grace_s: float = 2.0,
         trace_dir: str | None = None,
         slo=None,
+        advertise_host: str | None = None,
     ):
         self.engine = engine
         self.max_pending = max_pending
@@ -359,6 +360,13 @@ class ModelServer:
         self._sock.bind((host, port))
         self._sock.listen(16)
         self.host, self.port = self._sock.getsockname()
+        # The address peers should DIAL (docs/scale-out.md "Multi-host
+        # fleet"): binding 0.0.0.0 (or any wildcard) makes the bound
+        # host meaningless to other machines, so port files, peer
+        # lists, and server_stats carry this instead. Defaults to the
+        # bound host — single-host setups see no change.
+        self.advertise_host = (str(advertise_host) if advertise_host
+                               else self.host)
         self._shutdown = threading.Event()
         self._thread: threading.Thread | None = None
         # One generation at a time (the accelerator is serial); probes
@@ -418,6 +426,7 @@ class ModelServer:
             stats["pending"] = self._pending
         stats["draining"] = self._shutdown.is_set()
         stats["drain_grace_s"] = self.drain_grace_s
+        stats["advertise_host"] = self.advertise_host
         # Deployed engine knobs (docs/serving.md): scrapers see what
         # configuration is actually serving without shelling into the
         # host. Routers surface per-replica details in the stats verb's

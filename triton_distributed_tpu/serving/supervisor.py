@@ -31,6 +31,18 @@ this module owns the part neither of them can see — the *processes*.
   ``Router.replace_replica`` and rejoins routing with a fresh prefix
   digest.
 
+Hosts are failure domains (docs/scale-out.md "Multi-host fleet"):
+specs carry an optional ``host`` (a launcher placement target), and
+when EVERY replica on one host goes missing inside one window the
+monitor classifies a single correlated ``host_down`` — fencing the
+dead host's replicas under a bumped epoch (a zombie that thaws can
+neither latch results nor take new placements), re-routing all their
+work in the same tick, and re-placing their respawns on surviving
+hosts (spawn failover). Spawning itself hides behind the pluggable
+:class:`~triton_distributed_tpu.serving.launcher.Launcher` seam; the
+default ``LocalLauncher`` is today's subprocess + port-file path,
+byte-identical.
+
 Everything observable lands in the PR 5 telemetry:
 ``tdt_supervisor_failures_total{replica,kind}``,
 ``tdt_supervisor_respawns_total{replica}``,
@@ -54,6 +66,14 @@ import time
 
 from triton_distributed_tpu.obs import events as obs_events
 from triton_distributed_tpu.obs import metrics as obs_metrics
+from triton_distributed_tpu.serving.launcher import (  # noqa: F401 —
+    # SpawnError is re-exported: it predates the launcher seam and
+    # callers import it from here.
+    Launcher,
+    LocalLauncher,
+    SpawnError,
+    local_spawn,
+)
 from triton_distributed_tpu.serving.remote import RemoteReplica
 from triton_distributed_tpu.serving.replica import (
     DEAD,
@@ -62,10 +82,6 @@ from triton_distributed_tpu.serving.replica import (
     HEALTHY,
 )
 from triton_distributed_tpu.serving.router import Router
-
-
-class SpawnError(RuntimeError):
-    """A replica child never reached its port handshake."""
 
 
 @dataclasses.dataclass
@@ -79,12 +95,19 @@ class ReplicaSpec:
     cardinality. ``role`` tags the replica's pool (prefill / decode /
     mixed, serving/pools.py) — router-side placement metadata only;
     the child process is identical either way, and respawns keep the
-    slot's role across generations."""
+    slot's role across generations. ``host`` names the failure domain
+    the replica is placed in (a launcher host, docs/scale-out.md
+    "Multi-host fleet"); None means no host notion — every host-domain
+    feature (correlated classification, fencing, failover) stays
+    dormant, which is the single-machine default. Unlike ``role``, the
+    host may CHANGE across respawns: spawn failover re-places a slot
+    whose host died onto a surviving one."""
 
     name: str
     argv: list[str]
     env: dict | None = None
     role: str = "mixed"
+    host: str | None = None
 
 
 def stub_spec(name: str, *, delay_s: float = 0.0, num_pages: int = 256,
@@ -121,54 +144,13 @@ def spawn_replica(spec: ReplicaSpec, *, generation: int = 0,
     """Launch one replica child and wait for its port handshake.
     Returns a connected :class:`RemoteReplica` (``.proc`` holds the
     ``Popen``); raises :class:`SpawnError` — with the child's log tail
-    attached — when the child dies or stalls before binding."""
-    name = spec.name if generation == 0 else f"{spec.name}#{generation}"
-    if log_dir is None:
-        log_dir = tempfile.mkdtemp(prefix="tdt-fleet-")
-    os.makedirs(log_dir, exist_ok=True)
-    port_file = os.path.join(log_dir, f"{name.replace('#', '_')}.port")
-    log_path = os.path.join(log_dir, f"{name.replace('#', '_')}.log")
-    if os.path.exists(port_file):
-        os.unlink(port_file)
-    env = dict(os.environ)
-    if spec.env:
-        env.update(spec.env)
-    with open(log_path, "ab") as log_f:
-        proc = subprocess.Popen(
-            spec.argv + ["--port-file", port_file],
-            stdout=log_f, stderr=subprocess.STDOUT, env=env,
-            start_new_session=True,
-        )
-    deadline = time.monotonic() + spawn_timeout_s
-    addr = None
-    while time.monotonic() < deadline:
-        if os.path.exists(port_file):
-            with open(port_file) as f:
-                text = f.read().strip()
-            if text:  # the rename made this atomic; non-empty == done
-                addr = text
-                break
-        if proc.poll() is not None:
-            break
-        time.sleep(0.02)
-    if addr is None:
-        tail = ""
-        try:
-            with open(log_path, "rb") as f:
-                tail = f.read()[-800:].decode(errors="replace")
-        except OSError:
-            pass
-        if proc.poll() is None:
-            proc.kill()
-        proc.wait(timeout=10)
-        raise SpawnError(
-            f"replica {name} never bound within {spawn_timeout_s}s "
-            f"(rc={proc.returncode}); log tail:\n{tail}"
-        )
-    host, _, port = addr.rpartition(":")
-    return RemoteReplica(host, int(port), name=name, proc=proc,
-                         max_pending=max_pending,
-                         role=getattr(spec, "role", "mixed"))
+    attached — when the child dies or stalls before binding. The
+    implementation lives behind the launcher seam now
+    (serving/launcher.py); this is the local path, verbatim."""
+    return local_spawn(
+        spec, generation=generation, spawn_timeout_s=spawn_timeout_s,
+        max_pending=max_pending, log_dir=log_dir,
+    )
 
 
 @dataclasses.dataclass
@@ -223,6 +205,8 @@ class FleetSupervisor:
         snapshot_s: float = 0.0,
         resume_dir: str | None = None,
         tier_fabric: bool = False,
+        launcher: Launcher | None = None,
+        connect_timeout_s: float = 10.0,
     ):
         if not specs:
             raise ValueError("FleetSupervisor needs at least one spec")
@@ -231,6 +215,30 @@ class FleetSupervisor:
             raise ValueError(f"spec names must be unique, got {names}")
         self._slots = [_Slot(spec=s) for s in specs]
         self.policy = policy
+        # The spawn seam (serving/launcher.py): default is the local
+        # subprocess + port-file path, byte-identical to before the
+        # seam existed. Every dial the supervisor makes is bounded by
+        # ``connect_timeout_s`` — against an unroutable host, refusal
+        # must arrive on OUR deadline, not the OS connect default.
+        self.launcher: Launcher = launcher or LocalLauncher()
+        self.connect_timeout_s = float(connect_timeout_s)
+        # Host failure domains (docs/scale-out.md "Multi-host fleet").
+        # Ledger per named host: ``down`` gates placement and spawns,
+        # ``epoch`` is the fence generation (bumped every time the
+        # host is declared dead — a zombie thawing under an old epoch
+        # can neither latch results nor get spawns placed on it until
+        # an operator revives the host), ``crash_times`` feeds the
+        # per-host crash-loop breaker.
+        self._hosts: dict[str, dict] = {}
+        for h in list(self.launcher.hosts()) + [
+            s.host for s in specs if getattr(s, "host", None)
+        ]:
+            self._hosts.setdefault(
+                str(h), {"down": False, "epoch": 0, "crash_times": []}
+            )
+        # Children a host_down deliberately did NOT kill (unreachable
+        # in production; locally they would leak) — reaped at shutdown.
+        self._zombies: list = []
         self.heartbeat_s = float(heartbeat_s)
         self.heartbeat_timeout_s = float(heartbeat_timeout_s)
         # Deadline tolerance: a wedged process is declared after this
@@ -359,6 +367,29 @@ class FleetSupervisor:
             "replay.",
             labels=("replica",),
         )
+        self._g_host_up = obs_metrics.gauge(
+            "tdt_host_up",
+            "1 while the named host is in service, 0 after it was "
+            "declared down (host_down classification or operator "
+            "mark); revive_host restores it.",
+            labels=("host",),
+        )
+        self._m_host_down = obs_metrics.counter(
+            "tdt_supervisor_host_down_total",
+            "Whole-host failures: ALL replicas on one host missing "
+            "heartbeats inside one window classifies as a single "
+            "correlated host_down, not N independent timeouts.",
+            labels=("host",),
+        )
+        self._m_failovers = obs_metrics.counter(
+            "tdt_supervisor_spawn_failovers_total",
+            "Slots re-placed onto another host after their spawn "
+            "target failed or was down, by slot.",
+            labels=("slot",),
+        )
+        for h in self._hosts:
+            self._g_host_up.set(1.0, host=h)
+            self._m_host_down.inc(0, host=h)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -447,6 +478,19 @@ class FleetSupervisor:
                 except subprocess.TimeoutExpired:
                     proc.kill()
                     proc.wait(timeout=10)
+            # Fenced hosts' children were deliberately left unkilled
+            # (unreachable in production); locally they must not
+            # outlive the fleet. SIGKILL lands on SIGSTOPped zombies
+            # too.
+            for proc in self._zombies:
+                if proc.poll() is None:
+                    proc.kill()
+                    try:
+                        proc.wait(timeout=10)
+                    except subprocess.TimeoutExpired:  # pragma: no cover
+                        pass
+            self._zombies.clear()
+            self.launcher.reap()
             if self._store is not None:
                 from triton_distributed_tpu.models.kv_tier import SNAP_KIND
 
@@ -506,6 +550,7 @@ class FleetSupervisor:
                     "name": s.spec.name,
                     "parked": s.parked,
                     "down": rep is None,
+                    "host": getattr(s.spec, "host", None),
                     "replica_name": (rep.name if rep is not None
                                      else s.last_name),
                     "replica_state": (rep.state if rep is not None
@@ -524,6 +569,18 @@ class FleetSupervisor:
         with self._lock:
             if any(s.spec.name == spec.name for s in self._slots):
                 raise ValueError(f"slot {spec.name!r} already exists")
+            if getattr(spec, "host", None) is None:
+                # Spread-aware placement (docs/scale-out.md
+                # "Multi-host fleet"): with ≥2 hosts up, scale-up goes
+                # to the host carrying the fewest replicas of this
+                # role — the autoscaler must not stack a pool onto one
+                # failure domain. Hostless launchers return None and
+                # placement stays flat.
+                picked = self._pick_host(
+                    role=getattr(spec, "role", "mixed")
+                )
+                if picked is not None:
+                    spec.host = picked
             slot = _Slot(spec=spec)
             rep = self._spawn(slot)
             slot.replica = rep
@@ -584,6 +641,7 @@ class FleetSupervisor:
                 {
                     "name": s.spec.name,
                     "role": getattr(s.spec, "role", "mixed"),
+                    "host": getattr(s.spec, "host", None),
                     "generation": s.generation,
                     "respawns": s.respawns,
                     "parked": s.parked,
@@ -595,6 +653,7 @@ class FleetSupervisor:
                 }
                 for s in self._slots
             ],
+            "hosts": self.host_stats(),
             "log_dir": self.log_dir,
         }
 
@@ -742,6 +801,12 @@ class FleetSupervisor:
         if self.snapshot_s and now >= self._next_snap_t:
             self._next_snap_t = now + self.snapshot_s
             self._pull_snapshots()
+        # Two phases: collect every slot's failure VERDICT first, act
+        # second — so failures sharing a host classify as one
+        # correlated host_down instead of N independent timeouts
+        # (docs/scale-out.md "Multi-host fleet"). Hostless slots act
+        # exactly as before.
+        verdicts: list[tuple[_Slot, str, str]] = []
         for slot in self._slots:
             if slot.parked:
                 continue
@@ -771,11 +836,228 @@ class FleetSupervisor:
                     kind = "conn"
                 else:
                     kind = "hung_request"
-                self._fail(slot, kind, err)
+                verdicts.append((slot, kind, err))
             elif rc is not None:
-                self._fail(slot, "exit", f"process exited rc={rc}")
+                verdicts.append(
+                    (slot, "exit", f"process exited rc={rc}")
+                )
             else:
-                self._heartbeat(slot, now)
+                v = self._heartbeat(slot, now)
+                if v is not None:
+                    verdicts.append((slot, v[0], v[1]))
+        if verdicts:
+            self._classify(verdicts)
+
+    def _classify(self, verdicts: list) -> None:
+        """Act on this tick's failure verdicts, folding same-host
+        failures into ONE ``host_down``. A verdict on a hosted slot
+        with live siblings triggers an immediate out-of-band probe of
+        each sibling — all siblings failing inside the same window is
+        a machine, not a process; any sibling answering means the
+        failures are independent and classify as before."""
+        vmap = {id(s): (k, w) for s, k, w in verdicts}
+        handled: set[int] = set()
+        for slot, kind, why in verdicts:
+            if id(slot) in handled:
+                continue
+            host = getattr(slot.spec, "host", None)
+            # The launcher's own liveness view is authoritative when
+            # it has one (an ssh launcher can ping the machine; the
+            # fake launcher knows what it took down) — it settles the
+            # machine-vs-process call even for a host with a single
+            # replica, where sibling corroboration has no one to ask.
+            launcher_down = (host is not None
+                             and not self.launcher.host_up(host))
+            siblings = [
+                s for s in self._slots
+                if s is not slot and not s.parked
+                and getattr(s.spec, "host", None) == host
+            ] if host is not None else []
+            if not siblings and not launcher_down:
+                handled.add(id(slot))
+                self._fail(slot, kind, why)
+                continue
+            corroborated = [(slot, kind, why)]
+            all_down = True
+            for sib in siblings:
+                v = vmap.get(id(sib))
+                if v is None:
+                    v = self._probe_sibling(sib)
+                if v is None:
+                    if launcher_down:
+                        v = ("down", "launcher reports host down")
+                    else:
+                        all_down = False
+                        break
+                corroborated.append((sib, v[0], v[1]))
+            if all_down:
+                for s, _, _ in corroborated:
+                    handled.add(id(s))
+                self._declare_host_down(host, corroborated)
+            else:
+                handled.add(id(slot))
+                self._fail(slot, kind, why)
+
+    def _probe_sibling(self, slot: _Slot):
+        """Out-of-band corroboration probe for correlated-failure
+        classification: does this same-host sibling ALSO look gone
+        right now? Returns a (kind, why) verdict, or None while the
+        sibling still answers. One failed probe corroborates here even
+        below ``heartbeat_misses`` — the sibling is not being declared
+        on its own, it is tie-breaking a machine-vs-process call."""
+        rep = slot.replica
+        if rep is None:
+            # Already down — but only a RECENT fall corroborates "the
+            # machine died"; an old independent crash (mid-backoff)
+            # must not upgrade a sibling's process failure into a
+            # host_down.
+            last = slot.crash_times[-1] if slot.crash_times else None
+            window = max(
+                self.heartbeat_s * self.heartbeat_misses,
+                self.heartbeat_timeout_s,
+            )
+            if (last is not None
+                    and time.monotonic() - last <= window):
+                return ("down", slot.last_failure or "already down")
+            return None
+        if rep.state in (DRAINING, DRAINED):
+            return None  # deliberately out of rotation, not a casualty
+        rc = rep.proc.poll() if rep.proc is not None else None
+        if rc is not None:
+            return ("exit", f"process exited rc={rc}")
+        if rep.state == DEAD:
+            return ("conn", rep.last_error or "router marked dead")
+        try:
+            resp = rep.healthz(timeout=self.heartbeat_timeout_s)
+            if resp.get("ok"):
+                return None
+            return ("conn", f"healthz answered {resp!r}")
+        except Exception as e:  # noqa: BLE001 — timeout or refusal,
+            # either way the host claim is corroborated
+            return ("conn", f"{type(e).__name__}: {e}")
+
+    def _declare_host_down(self, host: str, items: list) -> None:
+        """One whole-host failure, end to end: bump the fence epoch,
+        emit a SINGLE ``host_down`` event, fence + fail every affected
+        slot (their reroutes all land this tick — the parallel part),
+        and re-place their respawns onto surviving hosts."""
+        st = self._hosts.setdefault(
+            host, {"down": False, "epoch": 0, "crash_times": []}
+        )
+        already = st["down"]
+        st["down"] = True
+        st["epoch"] += 1
+        if not already:
+            self._m_host_down.inc(host=host)
+            self._g_host_up.set(0.0, host=host)
+            obs_events.emit(
+                "host_down", host=host, epoch=st["epoch"],
+                slots=[s.spec.name for s, _, _ in items],
+                reasons={s.spec.name: f"{k}: {str(w)[:120]}"
+                         for s, k, w in items},
+            )
+        for slot, kind, why in items:
+            if slot.replica is not None:
+                # _fail → _record_failure sees the host down and
+                # re-places the slot (spawn failover); already-down
+                # siblings fail over when their next respawn attempt
+                # is refused.
+                self._fail(
+                    slot, "host_down",
+                    f"host {host} down ({kind}: {why})",
+                    unreachable=True,
+                )
+
+    def _failover_placement(self, slot: _Slot, from_host: str) -> None:
+        """Re-place a slot whose host is gone onto the next surviving
+        host (spawn FAILOVER). With nowhere to go the spec keeps its
+        host — respawns against it are refused and the crash-loop
+        breaker eventually parks the slot."""
+        nxt = self._pick_host(
+            role=getattr(slot.spec, "role", "mixed"),
+            exclude={from_host},
+        )
+        if nxt is None or nxt == slot.spec.host:
+            return
+        slot.spec.host = nxt
+        self._m_failovers.inc(slot=slot.spec.name)
+        obs_events.emit(
+            "spawn_failover", slot=slot.spec.name,
+            from_host=from_host, to_host=nxt,
+        )
+
+    def _pick_host(self, *, role: str = "mixed",
+                   exclude: set | None = None) -> str | None:
+        """Least-loaded UP host for placing ``role`` — ties broken by
+        total slot count, then name (deterministic). None when the
+        launcher has no host notion or nothing is up."""
+        exclude = exclude or set()
+        up = [
+            h for h in dict.fromkeys(
+                list(self.launcher.hosts()) + list(self._hosts)
+            )
+            if h not in exclude
+            and not self._hosts.get(h, {}).get("down")
+            and self.launcher.host_up(h)
+        ]
+        if not up:
+            return None
+
+        def load(h: str) -> tuple:
+            mine = [
+                s for s in self._slots
+                if getattr(s.spec, "host", None) == h and not s.parked
+            ]
+            in_role = sum(
+                1 for s in mine
+                if getattr(s.spec, "role", "mixed") == role
+            )
+            return (in_role, len(mine), h)
+
+        return min(up, key=load)
+
+    def mark_host_down(self, host: str) -> None:
+        """Operator/ chaos hook: declare ``host`` down out-of-band.
+        Spawns and placement refuse it until :meth:`revive_host`;
+        live replicas on it classify through the normal monitor
+        path."""
+        st = self._hosts.setdefault(
+            str(host), {"down": False, "epoch": 0, "crash_times": []}
+        )
+        if not st["down"]:
+            st["down"] = True
+            st["epoch"] += 1
+            self._g_host_up.set(0.0, host=str(host))
+            obs_events.emit("host_down", host=str(host),
+                            epoch=st["epoch"], slots=[], operator=True)
+
+    def revive_host(self, host: str) -> None:
+        """Bring a down host back into placement. Its fence epoch
+        stays bumped: anything fenced under the old epoch stays
+        fenced — only NEW generations spawn there."""
+        st = self._hosts.get(str(host))
+        if st is not None and st["down"]:
+            st["down"] = False
+            st["crash_times"] = []
+            self._g_host_up.set(1.0, host=str(host))
+            obs_events.emit("host_revived", host=str(host),
+                            epoch=st["epoch"])
+
+    def host_stats(self) -> dict:
+        """The host ledger (down/epoch/slot placement), for benches
+        and debugging; the scrape path is tdt_host_up /
+        tdt_supervisor_host_down_total."""
+        return {
+            h: {
+                "down": st["down"],
+                "epoch": st["epoch"],
+                "slots": [
+                    s.spec.name for s in self._slots
+                    if getattr(s.spec, "host", None) == h
+                ],
+            }
+            for h, st in self._hosts.items()
+        }
 
     def _pull_snapshots(self) -> None:
         """One snapshot sweep: replace each healthy slot's snapshot
@@ -880,7 +1162,12 @@ class FleetSupervisor:
                 return snap
         return None
 
-    def _heartbeat(self, slot: _Slot, now: float) -> None:
+    def _heartbeat(self, slot: _Slot,
+                   now: float) -> tuple[str, str] | None:
+        """One heartbeat probe. Returns the failure VERDICT (kind,
+        why) instead of acting on it — _classify folds same-host
+        verdicts into a correlated host_down; None means healthy (or
+        not yet enough misses for a verdict)."""
         rep = slot.replica
         try:
             resp = rep.healthz(timeout=self.heartbeat_timeout_s)
@@ -904,6 +1191,7 @@ class FleetSupervisor:
                     "replica_drain", replica=rep.name,
                     slot=slot.spec.name, external=True,
                 )
+            return None
         except Exception as e:  # noqa: BLE001 — every flavor classifies
             age = (time.monotonic() - slot.last_beat_t
                    if slot.last_beat_t is not None else float("inf"))
@@ -919,7 +1207,7 @@ class FleetSupervisor:
             elif timeout_like:
                 slot.missed_beats += 1
                 if slot.missed_beats < self.heartbeat_misses:
-                    return  # not yet a verdict — next tick retries
+                    return None  # not yet a verdict — next tick retries
                 kind, why = "heartbeat_timeout", (
                     f"{slot.missed_beats} consecutive beats missed "
                     f"(deadline {self.heartbeat_timeout_s}s, "
@@ -927,13 +1215,23 @@ class FleetSupervisor:
                 )
             else:
                 kind, why = "conn", f"{type(e).__name__}: {e}"
-            self._fail(slot, kind, why)
+            return kind, why
 
-    def _fail(self, slot: _Slot, kind: str, reason: str) -> None:
+    def _fail(self, slot: _Slot, kind: str, reason: str, *,
+              unreachable: bool = False) -> None:
         """One replica failure, end to end: mark dead through the
         router's re-route path, make sure the process is gone, then
-        schedule (or refuse) the respawn."""
+        schedule (or refuse) the respawn. ``unreachable`` is the
+        host_down shape: the machine cannot be reached, so instead of
+        killing the process (impossible out there, and locally it
+        would hide the zombie case) the replica is EPOCH-FENCED — any
+        result its process ever produces again latches nothing."""
         rep = slot.replica
+        if unreachable:
+            host = getattr(slot.spec, "host", None)
+            epoch = self._hosts.get(host, {}).get("epoch")
+            if hasattr(rep, "fence"):
+                rep.fence(epoch)
         if rep.state != DEAD:
             orphans = rep.mark_unhealthy(f"supervisor: {kind}: {reason}")
             if self.router is not None:
@@ -943,11 +1241,14 @@ class FleetSupervisor:
                 # still-in-flight remote batch harmless.
                 self.router._on_replica_failure(rep, orphans)
         if rep.proc is not None and rep.proc.poll() is None:
-            rep.proc.kill()
-            try:
-                rep.proc.wait(timeout=10)
-            except subprocess.TimeoutExpired:  # pragma: no cover
-                pass
+            if unreachable:
+                self._zombies.append(rep.proc)
+            else:
+                rep.proc.kill()
+                try:
+                    rep.proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:  # pragma: no cover
+                    pass
         obs_events.emit(
             "replica_proc_failed", replica=rep.name,
             slot=slot.spec.name, failure=kind, reason=str(reason)[:200],
@@ -967,6 +1268,38 @@ class FleetSupervisor:
             t for t in slot.crash_times if now - t <= self.crash_window_s
         ] + [now]
         slot.fails_in_a_row += 1
+        host = getattr(slot.spec, "host", None)
+        if host is not None:
+            # Per-host crash-loop breaker: a host eating failures
+            # across ITS slots faster than any single slot would park
+            # is a bad machine — stop placing there before every slot
+            # burns its own budget. (Double the per-slot budget: one
+            # flapping slot alone must not condemn its host.)
+            st = self._hosts.setdefault(
+                host, {"down": False, "epoch": 0, "crash_times": []}
+            )
+            st["crash_times"] = [
+                t for t in st["crash_times"]
+                if now - t <= self.crash_window_s
+            ] + [now]
+            if (not st["down"]
+                    and len(st["crash_times"]) >= 2 * self.crash_limit):
+                st["down"] = True
+                st["epoch"] += 1
+                self._m_host_down.inc(host=host)
+                self._g_host_up.set(0.0, host=host)
+                obs_events.emit(
+                    "host_down", host=host, epoch=st["epoch"],
+                    breaker=True,
+                    crashes=len(st["crash_times"]),
+                    window_s=self.crash_window_s,
+                )
+            if kind == "spawn" or st["down"]:
+                # Spawn FAILOVER: a host that failed (or refused) the
+                # spawn gets this slot re-placed on the next up host;
+                # the pending backoff still applies, so the re-placed
+                # spawn happens "under backoff", not immediately.
+                self._failover_placement(slot, host)
         if len(slot.crash_times) >= self.crash_limit:
             slot.parked = True
             slot.next_respawn_t = None
@@ -1043,12 +1376,23 @@ class FleetSupervisor:
             remote = (getattr(rep, "_remote", None)
                       if rep is not None else None)
             if remote is not None and rep.state == "healthy":
-                live.append((rep, remote))
-        for rep, remote in live:
-            peers = [
-                {"name": o.name, "host": orem.host, "port": orem.port}
-                for o, orem in live if o is not rep
-            ]
+                live.append((slot, rep, remote))
+        for _, rep, remote in live:
+            peers = []
+            for oslot, o, orem in live:
+                if o is rep:
+                    continue
+                # Routable addressing: a child that bound the
+                # wildcard (0.0.0.0) without advertising is reachable
+                # only through its spec's host name; the port-file /
+                # handshake address is authoritative otherwise.
+                h = orem.host
+                if (h in ("", "0.0.0.0")
+                        and getattr(oslot.spec, "host", None)):
+                    h = oslot.spec.host
+                peers.append(
+                    {"name": o.name, "host": h, "port": orem.port}
+                )
             try:
                 remote.call(
                     {"cmd": "tier_peers", "peers": peers},
@@ -1061,9 +1405,27 @@ class FleetSupervisor:
                 )
 
     def _spawn(self, slot: _Slot) -> RemoteReplica:
-        return spawn_replica(
+        host = getattr(slot.spec, "host", None)
+        if host is not None and self._hosts.get(host, {}).get("down"):
+            # Epoch fence, spawn side: a host declared dead takes no
+            # placements — a zombie machine that thaws cannot rejoin
+            # under its stale generation; only revive_host (operator)
+            # reopens it.
+            st = self._hosts[host]
+            raise SpawnError(
+                f"replica {slot.spec.name}: host {host} is marked "
+                f"down (fence epoch {st['epoch']}); spawn refused"
+            )
+        rep = self.launcher.spawn(
             slot.spec, generation=slot.generation,
             spawn_timeout_s=self.spawn_timeout_s,
             max_pending=self.replica_max_pending,
             log_dir=self.log_dir,
+            connect_timeout_s=self.connect_timeout_s,
         )
+        if getattr(slot.spec, "host", None) is not None:
+            self._hosts.setdefault(
+                slot.spec.host,
+                {"down": False, "epoch": 0, "crash_times": []},
+            )
+        return rep
