@@ -346,8 +346,11 @@ def main(argv=None) -> int:
     if args.speculative and args.mode == "mega" and not args.cpu:
         p.error(
             "--speculative and --mode mega do not compose (the NS-step "
-            "fused launch already amortizes per-step dispatch); drop "
-            "--speculative or use --mode xla/pallas"
+            "fused launch advances all slots in lockstep and already "
+            "amortizes per-step dispatch, and the resident work ring "
+            "splices whole slots between rounds — never a mid-launch "
+            "verify/rollback; docs/megakernel.md 'Resident decode'); "
+            "drop --speculative or use --mode xla/pallas"
         )
     if (args.tier_bytes or args.tier_dir) and not (
             args.fleet or args.replicas):

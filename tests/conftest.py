@@ -139,6 +139,7 @@ _FILE_ORDER = [
     "test_multihost.py",
     "test_attention.py", "test_p2p.py", "test_kv_quant.py",
     "test_speculative.py", "test_tree_spec.py", "test_kernel_trace.py",
+    "test_resident.py",
     "test_moe_serving.py", "test_megakernel.py",
     "test_tpu_lowering.py",
     "test_prefix_cache.py", "test_faults.py", "test_serving.py",

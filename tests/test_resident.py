@@ -1,0 +1,316 @@
+"""Resident megakernel decode (ISSUE 19): host work ring, in-kernel
+top-k/top-p, batch-bucket launches, device-side stop-token retire.
+
+Coverage contract (ISSUE 19 acceptance):
+- WorkRing semantics: publish-then-consume round protocol, monotonic
+  doorbell, loud overflow (a dropped admit/retire item would
+  desynchronize the device scheduler from the engine's slot state);
+- ``validate_ring``'s doorbell-gap check: a RING_POLL record that
+  observed a doorbell the host did not publish for that launch flags
+  as a stale ring snapshot;
+- the new ``tdt_mega_*`` ring/retire series pre-touch to 0 at engine
+  construction (the PR 15 convention: a cold counter must READ 0 on
+  the dashboard, not be missing), and
+  ``tdt_mega_single_step_fallbacks_total`` scrapes 0 after a PURE
+  SAMPLED mega run — the in-kernel filter replaced the fallback;
+- both serving CLIs refuse --speculative × --mode mega with the
+  ring-splice reason (the flag-name substring is pinned by
+  test_tools.py; THIS file pins the new wording);
+- device-side stop-token retire: a slot hitting eos mid-multi-step
+  retires with no host round trip, its pages flow back through the
+  normal teardown path (radix tree receives the chain, pool audit
+  clean), and the co-batched survivor's tokens are bit-exact;
+- batch-bucket launches emit bit-identical tokens to the full-width
+  program; the resident pipeline's rings validate gap-free against
+  their published doorbells.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from triton_distributed_tpu.megakernel.ring import (
+    RING_ADMIT,
+    RING_CANCEL,
+    RING_RETIRE,
+    WorkRing,
+)
+from triton_distributed_tpu.models import AutoLLM
+from triton_distributed_tpu.models.engine import Engine
+from triton_distributed_tpu.obs import kernel_trace as kt
+
+
+@pytest.fixture
+def ctx1():
+    from triton_distributed_tpu.runtime import mesh as mesh_mod
+
+    ctx = mesh_mod.initialize_distributed(tp=1, devices=jax.devices()[:1])
+    yield ctx
+    mesh_mod.finalize_distributed()
+
+
+# -- host-side units (no model) -----------------------------------------
+
+
+def test_work_ring_semantics():
+    """The round protocol: push N items, publish bumps the doorbell and
+    snapshots [doorbell, head, tail, occupancy], consume drains oldest
+    first with monotonic seqs; overflow raises instead of dropping."""
+    ring = WorkRing(capacity=4)
+    ring.push(RING_ADMIT, 0, 12)
+    ring.push(RING_RETIRE, 1, 7)
+    ring.push(RING_CANCEL, 2)
+    snap = ring.publish()
+    assert snap.dtype == np.int32
+    assert snap.tolist() == [1, 0, 3, 3]
+    items = ring.consume()
+    assert [(i.kind, i.slot, i.arg) for i in items] == [
+        (RING_ADMIT, 0, 12), (RING_RETIRE, 1, 7), (RING_CANCEL, 2, 0),
+    ]
+    assert [i.seq for i in items] == [0, 1, 2]
+    assert ring.occupancy == 0 and ring.peak_occupancy == 3
+    # Empty round: the doorbell still advances (the kernel must be able
+    # to tell "round with no work" from "no round").
+    assert ring.publish().tolist() == [2, 3, 3, 0]
+    # Wrap past capacity, then overflow loudly.
+    for n in range(4):
+        ring.push(RING_ADMIT, n)
+    with pytest.raises(RuntimeError, match="work ring full"):
+        ring.push(RING_ADMIT, 9)
+    ring.publish()
+    assert [i.slot for i in ring.consume()] == [0, 1, 2, 3]
+
+
+def _rec(index, opcode, begin, end, mid=0, task_id=None):
+    return kt.TaskRecord(0, 0, index, task_id or index, opcode, 0, 0,
+                         begin, end, mid)
+
+
+def test_validate_ring_doorbell_gap_check():
+    """RING_POLL's mid column carries the OBSERVED doorbell, not a
+    clock tick: it is exempt from the mid-in-interval check, and with
+    ``doorbell=`` it must equal the published value exactly."""
+    from triton_distributed_tpu.megakernel.task import TaskType
+
+    poll = int(TaskType.RING_POLL)
+    other = int(TaskType.LM_HEAD)
+    records = [
+        _rec(0, poll, 10, 20, mid=7),       # mid=doorbell, outside clock
+        _rec(1, other, 20, 40, mid=30),
+    ]
+    assert kt.validate_ring(records) == []
+    assert kt.validate_ring(records, doorbell=7) == []
+    problems = kt.validate_ring(records, doorbell=8)
+    assert len(problems) == 1 and "stale ring snapshot" in problems[0]
+    # A non-poll record's mid stays clock-checked.
+    bad = [_rec(0, other, 10, 20, mid=99)]
+    assert any("outside" in p for p in kt.validate_ring(bad))
+    # overlap_report summarizes the polls and their doorbell range.
+    rep = kt.overlap_report(records)
+    assert rep["ring_polls"] == 1
+    assert rep["ring_doorbell_min"] == rep["ring_doorbell_max"] == 7
+
+
+def test_cli_refusals_carry_ring_splice_reason(capsys):
+    """Both CLIs still refuse --speculative × --mode mega as an
+    argparse error (exit 2, before any model load), and the message now
+    explains the RESIDENT reason: the work ring splices whole slots
+    between rounds, never a mid-launch verify/rollback."""
+    from perf import serve_demo
+    from triton_distributed_tpu.serving import run_server
+
+    for main in (run_server.main, serve_demo.main):
+        with pytest.raises(SystemExit) as exc:
+            main(["--speculative", "2", "--mode", "mega"])
+        assert exc.value.code == 2
+        err = capsys.readouterr().err
+        assert "--speculative and --mode mega" in err
+        assert "work ring splices whole slots" in err
+
+
+def test_resident_knob_validation(capsys, ctx1):
+    """--resident without --mode mega refuses by flag name at the CLI
+    (exit 2, nothing loaded); the engine ctor enforces the same pair."""
+    from triton_distributed_tpu.models.continuous import ContinuousEngine
+    from triton_distributed_tpu.serving import run_server
+
+    with pytest.raises(SystemExit) as exc:
+        run_server.main(["--resident", "--mode", "xla"])
+    assert exc.value.code == 2
+    assert "--resident requires --mode mega" in capsys.readouterr().err
+    with pytest.raises(SystemExit):
+        run_server.main(["--ns", "0"])
+
+    model = AutoLLM.from_pretrained("tiny", ctx=ctx1)
+    with pytest.raises(ValueError, match="resident"):
+        ContinuousEngine(model, max_batch=1, max_length=64,
+                         mode="xla", resident=True)
+    with pytest.raises(ValueError, match="ns"):
+        ContinuousEngine(model, max_batch=1, max_length=64,
+                         mode="mega", ns=0)
+
+
+def test_ring_metrics_pretouch(fresh_telemetry, ctx1):
+    """Engine construction alone pre-touches the resident-decode
+    catalog: every new series reads 0 from the first scrape (PR 15
+    convention), including the fallback counter the acceptance gate
+    watches."""
+    from triton_distributed_tpu.models.continuous import ContinuousEngine
+    from triton_distributed_tpu.obs import metrics as obs_metrics
+
+    model = AutoLLM.from_pretrained("tiny", ctx=ctx1)
+    ContinuousEngine(model, max_batch=1, page_size=16, max_length=64,
+                     mode="mega")
+    text = obs_metrics.prometheus_text()
+    for name in (
+        "tdt_mega_single_step_fallbacks_total",
+        "tdt_mega_ring_items_total",
+        "tdt_mega_ring_doorbells_total",
+        "tdt_mega_device_retires_total",
+        "tdt_mega_resident_rounds_total",
+        "tdt_mega_bucket_launches_total",
+        "tdt_mega_filtered_rounds_total",
+    ):
+        assert f"{name} 0" in text, name
+
+
+# -- engine paths (tiny model, CPU interpret) ---------------------------
+
+
+@pytest.mark.slow
+def test_device_stop_retire_no_host_round_trip(ctx1):
+    """A slot hitting eos mid-multi-step retires off the DEVICE stop
+    test (mega_device_retires, not a host-side trim of a full launch),
+    its pages flow back through the normal teardown (pool audit clean,
+    radix tree receives the finished chain for reuse), and the
+    co-batched survivor's tokens are bit-exact."""
+    from triton_distributed_tpu.models.continuous import ContinuousEngine
+
+    model = AutoLLM.from_pretrained("tiny", ctx=ctx1)
+    p0 = np.asarray([5, 9, 2, 4], np.int32)
+    p1 = np.asarray([7, 1, 3, 8, 6, 2, 4, 9], np.int32)
+    probe = Engine(model, temperature=0.0).serve(p0[None], gen_len=6)[0, 4:]
+    gold1 = Engine(model, temperature=0.0).serve(p1[None], gen_len=6)[0, 8:]
+    eos = int(probe[1])  # p0 retires at its 2nd generated token
+
+    eng = ContinuousEngine(
+        model, max_batch=2, page_size=16, max_length=64, eos_id=eos,
+        mode="mega", prefix_cache=True,
+    )
+    free0 = len(eng.pool.free)
+    outs = eng.run([(p0, 6), (p1, 6)])
+    st = eng.stats
+    assert st["mega_device_retires"] >= 1, st
+    np.testing.assert_array_equal(outs[0], probe[:2])
+    gold1_trim = gold1[: np.argmax(gold1 == eos) + 1] \
+        if eos in gold1.tolist() else gold1
+    np.testing.assert_array_equal(outs[1], np.asarray(gold1_trim))
+    # Pages audit clean and back in the free list ∪ radix tree.
+    assert eng.audit() == []
+    # The retired chain landed in the radix tree: a re-run of the same
+    # prompt + generated chain matches cached pages.
+    chain = np.concatenate([p0, outs[0]])
+    m = eng.prefix.match(chain)
+    assert m.matched_len > 0
+    eng.prefix.release_match(m)
+    assert free0 == len(eng.pool.free) + eng.prefix.reclaimable_pages()
+
+
+@pytest.mark.slow
+def test_bucket_launch_bit_exact(ctx1):
+    """2 live slots in a max_batch=4 engine ride a 2-wide bucket
+    program (mega_bucket_launches) and emit exactly the tokens the
+    full-width program emits — which themselves match the unfused
+    goldens."""
+    from triton_distributed_tpu.models.continuous import ContinuousEngine
+
+    model = AutoLLM.from_pretrained("tiny", ctx=ctx1)
+    prompts = [np.asarray([5, 9, 2, 4], np.int32),
+               np.asarray([7, 1, 3, 8, 6, 2, 4, 9], np.int32)]
+    gens = [5, 3]
+    golds = [
+        Engine(model, temperature=0.0).serve(p[None], gen_len=g)[0, len(p):]
+        for p, g in zip(prompts, gens)
+    ]
+
+    def run(buckets):
+        eng = ContinuousEngine(
+            model, max_batch=4, page_size=16, max_length=64,
+            mode="mega", mega_buckets=buckets,
+        )
+        outs = eng.run(list(zip(prompts, gens)))
+        return outs, eng.stats
+
+    outs_full, st_full = run(False)
+    outs_b, st_b = run(True)
+    assert st_full["mega_bucket_launches"] == 0
+    assert st_b["mega_bucket_launches"] > 0, st_b
+    for a, b, gold in zip(outs_full, outs_b, golds):
+        np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(b, np.asarray(gold))
+
+
+@pytest.mark.slow
+def test_resident_pipeline_ring_gap_free(ctx1):
+    """Resident decode: round i+1 issues off round i's device outputs
+    (mega_resident_rounds), admit/retire items flow through the work
+    ring, every traced launch's ring validates gap-free against the
+    doorbell the host published for it, and tokens stay bit-exact."""
+    from triton_distributed_tpu.models.continuous import ContinuousEngine
+
+    model = AutoLLM.from_pretrained("tiny", ctx=ctx1)
+    prompts = [np.asarray([5, 9, 2, 4], np.int32),
+               np.asarray([7, 1, 3, 8, 6, 2, 4, 9], np.int32)]
+    golds = [
+        Engine(model, temperature=0.0).serve(p[None], gen_len=6)[0, len(p):]
+        for p in prompts
+    ]
+    eng = ContinuousEngine(
+        model, max_batch=2, page_size=16, max_length=64, mode="mega",
+        resident=True, kernel_trace=True, ns=2,
+    )
+    outs = eng.run([(p, 6) for p in prompts])
+    for got, gold in zip(outs, golds):
+        np.testing.assert_array_equal(got, np.asarray(gold))
+    st = eng.stats
+    assert st["mega_resident_rounds"] > 0, st
+    assert st["mega_ring_items"] >= 4, st       # 2 admits + 2 retires
+    assert st["mega_ring_doorbells"] > 0, st
+    launches = eng.kernel_trace_launches()
+    assert launches
+    belled = 0
+    for ln in launches:
+        assert kt.validate_ring(ln.get_records(), doorbell=ln.doorbell) == []
+        belled += ln.doorbell is not None
+    assert belled > 0
+    # Doorbells climb monotonically across the resident session.
+    bells = [ln.doorbell for ln in launches if ln.doorbell is not None]
+    assert bells == sorted(bells) and len(set(bells)) == len(bells)
+
+
+@pytest.mark.slow
+def test_sampled_run_scrapes_zero_fallbacks(fresh_telemetry, ctx1):
+    """The acceptance gate: a PURE SAMPLED workload (every slot top-k +
+    top-p) serves entirely through the in-kernel bisection filter —
+    ``tdt_mega_single_step_fallbacks_total`` scrapes 0 and the filtered
+    counter shows the rounds that previously fell back."""
+    from triton_distributed_tpu.models.continuous import ContinuousEngine
+    from triton_distributed_tpu.obs import metrics as obs_metrics
+
+    model = AutoLLM.from_pretrained("tiny", ctx=ctx1)
+    prompts = [np.asarray([5, 9, 2, 4], np.int32),
+               np.asarray([7, 1, 3, 8, 6, 2, 4, 9], np.int32)]
+    eng = ContinuousEngine(
+        model, max_batch=2, page_size=16, max_length=64, mode="mega",
+        temperature=0.8, top_k=5, top_p=0.9, seed=3,
+    )
+    outs = eng.run([(p, 6) for p in prompts])
+    assert all(len(o) == 6 for o in outs)
+    st = eng.stats
+    assert st["mega_filtered_rounds"] > 0, st
+    assert st["mega_fallback_steps"] == 0, st
+    reg = obs_metrics.default_registry()
+    assert reg.get("tdt_mega_single_step_fallbacks_total").value() == 0
+    assert reg.get("tdt_mega_filtered_rounds_total").value() > 0
+    assert "tdt_mega_single_step_fallbacks_total 0" in \
+        obs_metrics.prometheus_text()

@@ -353,6 +353,35 @@ def test_kernel_trace_modules_compile():
     )
 
 
+def test_resident_modules_compile():
+    """ISSUE-19: the resident-decode pieces must byte-compile — the
+    work ring is imported lazily from the engine's mega round loop (a
+    syntax error would surface mid-serve, not at import), and the
+    bench that writes the resident section of perf/MEGA_SERVE.json
+    rides along (repo convention: perf harnesses fail tier-1, not a
+    relay window)."""
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    targets = [
+        os.path.join(root, "triton_distributed_tpu", "megakernel",
+                     "ring.py"),
+        os.path.join(root, "triton_distributed_tpu", "models",
+                     "continuous.py"),
+        os.path.join(root, "perf", "mega_serve_bench.py"),
+    ]
+    proc = subprocess.run(
+        [sys.executable, "-m", "compileall", "-q", "-f", *targets],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, (
+        f"resident-decode modules failed to compile:\n"
+        f"{proc.stdout}\n{proc.stderr}"
+    )
+
+
 def test_goodput_modules_compile():
     """ISSUE-13: the SLO-goodput yardstick's modules must byte-compile
     — obs/slo.py is imported by the server (a syntax error takes the
@@ -593,6 +622,21 @@ def test_tier1_marker_audit():
     assert len(kt_fast) >= 5, (
         f"device-tracer suite has too few tier-1-runnable tests: "
         f"{kt_fast}"
+    )
+    # ISSUE-19: the resident-decode suite (work-ring protocol, doorbell
+    # validation, metric pre-touch, CLI refusal wording, knob guards)
+    # rides right behind the tracer suite whose validate_ring it
+    # extends, ahead of the interpret tail, and must carry tier-1-
+    # runnable tests — a ring-desync or fallback regression has to
+    # FAIL tier-1, not wait for a mega_serve_bench run.
+    assert "test_resident.py" in order
+    assert (order.index("test_kernel_trace.py")
+            < order.index("test_resident.py")
+            < order.index("test_serving.py"))
+    res_fast = fast_tests("test_resident.py")
+    assert len(res_fast) >= 5, (
+        f"resident-decode suite has too few tier-1-runnable tests: "
+        f"{res_fast}"
     )
 
 

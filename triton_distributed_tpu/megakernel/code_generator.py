@@ -139,6 +139,41 @@ class MegaDims:
     # host) turns the greedy machinery into temperature sampling while
     # the RNG stays in JAX-land (reproducible, testable).
     sampled: bool = False
+    # In-kernel top-k/top-p filtered sampling (requires ``sampled`` and
+    # ``nsteps`` > 1, single-rank only): a per-row sampling config
+    # ``sampcfg [B, 4]`` f32 — ``[inv_temperature, top_k_effective,
+    # top_p, enable]`` — rides as a VMEM operand and the LM head, after
+    # streaming the raw logits, derives the EXACT host filter_logits
+    # keep-set by per-row parallel bisection (64 fixed iterations on
+    # the scaled-logit axis: the top-k threshold is the largest τ with
+    # #{l/T > τ} ≥ k, the top-p threshold the largest τ whose
+    # above-mass ≥ p·Z over the top-k survivors — both converge to the
+    # float just below the host's cutoff value, so ties survive exactly
+    # as in ``models.sampling.filter_logits``), then argmaxes
+    # ``logits + noise`` over the kept set. With ``noise =
+    # temperature · gumbel`` this IS top-k/top-p temperature sampling
+    # (Gumbel-max over the filtered support ≡ categorical over the
+    # filtered softmax). Rows with enable=0 keep the whole real vocab —
+    # a zero-noise greedy row in a filtered batch stays bit-identical
+    # to the greedy build. Single-rank only: the filter needs the full
+    # logit row, which under TP is column-sharded across ranks.
+    filtered: bool = False
+    # Device-side stop-token testing (requires ``page`` and ``nsteps``
+    # > 1): a ``stop_tok [B]`` i32 scalar-prefetch operand (-1 = none)
+    # and a ``stop_step [1, B]`` i32 SMEM output — the LM head stamps
+    # the first step whose sampled token equals the row's stop token
+    # (``nsteps`` = never). The caller clamps its KV append counts to
+    # ``stop_step + 1`` so rows decoded past a stop route to the trash
+    # page, and finished slots retire at the next host drain without a
+    # KV-rollback round trip.
+    eos: bool = False
+    # Host work ring (resident decode): a ``ring_state [4]`` i32
+    # scalar-prefetch operand ``[doorbell, head, tail, occupancy]``
+    # published by ``megakernel.ring.WorkRing`` and a RING_POLL task
+    # prepended to the graph that stamps the observed doorbell into its
+    # trace record — the proof hook that every round consumed the ring
+    # state the host rang for it (see ring.py for the hardware story).
+    ring: bool = False
     # Race-provocation fixture (parity: the reference's for_correctness
     # sleeps / straggler_option): lag this rank's LM-head argmax
     # exchange so a peer missing a wait reads stale candidates.
@@ -381,6 +416,17 @@ class KernelCtx:
         self.tok_smem: Any = None   # [B] i32 — next-token feedback
         self.toks_out: Any = None   # [nsteps, 1, B] i32 — greedy tokens
         self.noise: Any = None  # [1, B, v_loc] VMEM — this step's noise
+        # Filtered-sampling config [B, 4] f32 (None unless dims.filtered):
+        # per-row [inv_temperature, top_k_effective, top_p, enable].
+        self.sampcfg: Any = None
+        # Device stop-token refs (None unless dims.eos): the [B] i32
+        # stop-token scalar-prefetch operand and the [1, B] i32 SMEM
+        # stop_step output the LM head stamps.
+        self.stop_tok: Any = None
+        self.stop_out: Any = None
+        # Work-ring snapshot [4] i32 (None unless dims.ring):
+        # [doorbell, head, tail, occupancy] as published by the host.
+        self.ring_state: Any = None
         # cross_prefetch SMEM flags: slot 0 of col/rowstage already
         # holds the current task's tile 0 (started by the previous
         # task's prefetch block; the stream skips its own start).
@@ -444,12 +490,23 @@ def make_mega_kernel(
         *rest,
     ):
         # Paged mode inserts the page table as a 4th scalar-prefetch
-        # operand; prefill mode inserts the embedded prompt rows x0
-        # before the weights. The operand order is otherwise identical.
+        # operand; eos adds the stop-token row and ring the work-ring
+        # snapshot after it (both scalar-prefetch — SMEM-resident for
+        # the LM head's / RING_POLL's scalar reads); prefill mode
+        # inserts the embedded prompt rows x0 before the weights. The
+        # operand order is otherwise identical.
         if dims.page:
             page_tab, *rest = rest
         else:
             page_tab = None
+        if dims.eos:
+            stop_tok, *rest = rest
+        else:
+            stop_tok = None
+        if dims.ring:
+            ring_state, *rest = rest
+        else:
+            ring_state = None
         (
             embed, wqkv, wo, w1, w2, lm_head,              # ANY (HBM)
             ln1, ln2, normf, qn, kn,                       # VMEM (small)
@@ -471,12 +528,22 @@ def make_mega_kernel(
             noise, *rest = rest
         else:
             noise = None
+        if dims.filtered:  # per-row sampling config, after the noise
+            sampcfg, *rest = rest
+        else:
+            sampcfg = None
         if dims.kv_quant:  # int8 pool: cache block is (kc, vc, ksc, vsc)
             kc, vc, ksc, vsc, *rest = rest
         else:
             kc, vc, *rest = rest
             ksc = vsc = None
         rest = list(rest)
+        if dims.eos:
+            # Stop-step output rides after the token output (index 4);
+            # popping it first keeps the trace pop's index stable.
+            stop_out = rest.pop(4)
+        else:
+            stop_out = None
         if dims.trace:
             # Trace builds append the SMEM ring after the outputs and
             # the logical-clock counter after the scratch; popping them
@@ -518,6 +585,9 @@ def make_mega_kernel(
         kctx.ksc, kctx.vsc = ksc, vsc
         kctx.x0 = x0
         kctx.noise = noise
+        kctx.sampcfg = sampcfg
+        kctx.stop_tok, kctx.stop_out = stop_tok, stop_out
+        kctx.ring_state = ring_state
         kctx.toks_out = toks_out
         kctx.embed, kctx.wqkv, kctx.wo = embed, wqkv, wo
         kctx.w1, kctx.w2, kctx.lm_head = w1, w2, lm_head
@@ -645,7 +715,10 @@ def build_mega_call(
     hkv, hd = dims.hkv_loc, dims.head_dim
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=4 if dims.page else 3,
+        # task_tab, kv_len, tokens [+ page_table] [+ stop_tok]
+        # [+ ring_state] — all SMEM-resident scalar prefetch.
+        num_scalar_prefetch=(3 + int(bool(dims.page)) + int(dims.eos)
+                             + int(dims.ring)),
         # Outer grid dim = decode steps within the launch (1 unless
         # multi-step): one task table serves every step, the kernel
         # reads the step index from program_id(0).
@@ -669,6 +742,9 @@ def build_mega_call(
             )]
             if dims.sampled else []
         )
+        # Filtered-sampling config [B, 4] f32: VMEM-resident like the
+        # norms — the LM head reads the per-row columns post-stream.
+        + ([pl.BlockSpec(memory_space=pltpu.VMEM)] if dims.filtered else [])
         + [pl.BlockSpec(memory_space=pl.ANY)] * 2
         # int8 pool scales [L, P, 1, Hkv] f32: VMEM-resident like the
         # norm weights — per-(layer, page, head) scalar reads inside
@@ -681,6 +757,9 @@ def build_mega_call(
             pl.BlockSpec(memory_space=pltpu.VMEM),  # new V rows
             pl.BlockSpec(memory_space=pltpu.VMEM),  # greedy tokens
         ]
+        # Stop-step output [1, B]: SMEM — per-row scalar stamps from
+        # the LM head, read back by the caller's append clamp.
+        + ([pl.BlockSpec(memory_space=pltpu.SMEM)] if dims.eos else [])
         # Trace ring: SMEM, because records are scalar stores at
         # dynamic (step, task) indices — natural on the scalar core,
         # while a VMEM row write at a dynamic sublane offset is exactly
@@ -773,6 +852,8 @@ def build_mega_call(
         in_vmem += itw * B * d
     if dims.sampled:
         in_vmem += 2 * 4 * B * dims.v_loc
+    if dims.filtered:
+        in_vmem += 4 * 4 * max(B, 1)
     if dims.kv_quant:
         # Per-page-per-head f32 scale planes for K and V (num_pages may
         # be 0 = unknown for shape-polymorphic builds; the 1.5× headroom
@@ -832,6 +913,11 @@ def build_mega_call(
             # head runs in single-step mode and the caller ignores it).
             jax.ShapeDtypeStruct((dims.nsteps, 1, max(B, 1)), jnp.int32),
         ] + (
+            # Device stop-step per row: first step whose token hit the
+            # row's stop token (nsteps = never). SMEM scalar stamps.
+            [jax.ShapeDtypeStruct((1, max(B, 1)), jnp.int32)]
+            if dims.eos else []
+        ) + (
             # Device trace ring: one TRACE_INTS-int record per
             # (step, task) grid iteration — dense by construction, so
             # the decoder's gap-free check is exact (every flag must
@@ -870,6 +956,20 @@ def build_mega_call(
     if dims.kv_quant and not dims.page:
         raise ValueError("kv_quant requires the paged cache (scales "
                          "live on pool pages)")
+    if dims.filtered:
+        if not dims.sampled or dims.nsteps <= 1:
+            raise ValueError("filtered sampling rides the sampled "
+                             "multi-step LM head (sampled, nsteps > 1)")
+        if dims.n_ranks > 1:
+            raise NotImplementedError(
+                "in-kernel top-k/top-p needs the full logit row, which "
+                "TP column-shards across ranks — filtered builds are "
+                "single-rank; tp>1 sampled-with-filters rounds keep the "
+                "single-step fallback"
+            )
+    if dims.eos and (not dims.page or dims.nsteps <= 1):
+        raise ValueError("device stop-token testing rides the paged "
+                         "multi-step decode (page > 0, nsteps > 1)")
     if dims.moe:
         if cfg.wq8:
             raise NotImplementedError(
@@ -891,28 +991,23 @@ def build_mega_call(
     # ``wargs`` = the kernel-args block (weights + norms [+ wq8
     # scales]) followed by the cache operands (kc, vc[, ksc, vsc]) —
     # variadic so the wq8/kv_quant paths' extra scale operands flow
-    # through without per-mode signature edits. x0/noise/page_table are
-    # re-sited into the kernel's canonical operand order here.
+    # through without per-mode signature edits. The caller-facing order
+    # is ``(kv_len, tokens, [page_table], [stop_tok], [ring_state],
+    # [x0], [noise], [sampcfg], *wargs)``; the mode operands are
+    # re-sited into the kernel's canonical operand order here (the
+    # scalar-prefetch block up front, x0/noise/sampcfg just before the
+    # cache block) — ONE wrapper instead of a per-mode branch ladder,
+    # so new mode compositions cannot silently miss a re-site.
     nc = 4 if dims.kv_quant else 2  # trailing cache-block operand count
-    if dims.sampled and dims.page:
-        def run(kv_len, tokens, page_table, noise, *wargs):
-            return call(
-                table, kv_len, tokens, page_table, *wargs[:-nc], noise,
-                *wargs[-nc:]
-            )
-    elif dims.sampled:
-        def run(kv_len, tokens, noise, *wargs):
-            return call(
-                table, kv_len, tokens, *wargs[:-nc], noise, *wargs[-nc:]
-            )
-    elif dims.prefill:
-        def run(kv_len, tokens, x0, *wargs):
-            return call(table, kv_len, tokens, *wargs[:-2], x0, *wargs[-2:])
-    elif dims.page:
-        def run(kv_len, tokens, page_table, *wargs):
-            return call(table, kv_len, tokens, page_table, *wargs)
-    else:
-        def run(kv_len, tokens, *wargs):
-            return call(table, kv_len, tokens, *wargs)
+    n_pre = int(bool(dims.page)) + int(dims.eos) + int(dims.ring)
+    n_mid = int(dims.prefill) + int(dims.sampled) + int(dims.filtered)
+
+    def run(kv_len, tokens, *args):
+        pre, mid, wargs = (
+            args[:n_pre], args[n_pre:n_pre + n_mid], args[n_pre + n_mid:]
+        )
+        return call(
+            table, kv_len, tokens, *pre, *wargs[:-nc], *mid, *wargs[-nc:]
+        )
 
     return run

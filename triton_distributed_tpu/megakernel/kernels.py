@@ -68,6 +68,17 @@ def trace_mid(kctx):
         kctx.trace_out[kctx.step, kctx.t, TR_MID] = trace_tick(kctx)
 
 
+def trace_stamp(kctx, value):
+    """Stamp an arbitrary VALUE (not a clock read) into the current
+    task's ``mid`` column — the RING_POLL task records the doorbell it
+    observed so ``validate_ring`` can prove the round consumed the
+    ring state the host published (mid-as-payload records are exempt
+    from the decoder's begin<=mid<=end clock check by opcode). No-op
+    when untraced, same as :func:`trace_mid`."""
+    if getattr(kctx.dims, "trace", False) and kctx.trace_out is not None:
+        kctx.trace_out[kctx.step, kctx.t, TR_MID] = value
+
+
 def _rms(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
     """f32 RMS-norm (matches ``models.qwen.rms_norm``)."""
     x = x * jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
@@ -1312,6 +1323,110 @@ def a2a_wait_body(kctx):
     return body
 
 
+def _multi_step_tail(kctx, row, B):
+    """Shared multi-step epilogue: publish this step's winning tokens
+    (``row`` [1, B]) to the next EMBED (VMEM→SMEM DMA — scalar reads
+    need SMEM) and the per-step token output, then — under ``dims.eos``
+    — test each winner against its slot's stop token and record the
+    FIRST hitting step into the ``stop_step`` SMEM output (``nsteps`` =
+    never hit). The stamp is first-hit-wins: once a slot has stopped,
+    later steps keep generating (their tokens are clamped host/shard
+    side via ``min(n_valid, stop_step + 1)``) but cannot overwrite the
+    retire step — that is what lets a finished slot retire without a
+    host round trip while the co-batched survivor streams on."""
+    dims = kctx.dims
+    kctx.tokrow[...] = row
+    kctx.toks_out[kctx.step] = row
+    if dims.eos:
+        ns = jnp.int32(dims.nsteps)
+        for b in range(B):
+            hit = row[0, b] == kctx.stop_tok[b]
+            prev = jnp.where(kctx.step == 0, ns, kctx.stop_out[0, b])
+            kctx.stop_out[0, b] = jnp.where(
+                jnp.logical_and(hit, prev == ns), kctx.step, prev
+            ).astype(jnp.int32)
+    cp = pltpu.make_async_copy(kctx.tokrow, kctx.tok_smem, kctx.tsem)
+    cp.start()
+    cp.wait()
+
+
+def _filtered_winner(kctx, B, v_real, NEGF):
+    """Exact in-kernel top-k/top-p + Gumbel-max winner over the logits
+    the tile stream just landed (dims.filtered, single-rank).
+
+    Matches ``sampling.filter_logits`` + noisy argmax BIT-EXACTLY on
+    the keep-set by reproducing its thresholds instead of its sorts:
+    sorting a [B, v] tile-streamed buffer in-kernel is the expensive
+    path, but both filters are threshold rules — top-k keeps
+    ``ls >= kth`` (k-th largest, ties survive) and top-p keeps
+    ``ls >= cutoff`` (cutoff = smallest kept sorted logit, which
+    re-includes its ties) — and a threshold is findable by bisection
+    on monotone counts. Per row, in the scaled domain
+    ``ls = logits / temperature`` (pad columns at NEGF):
+
+    * top-k: bisect t with invariant ``C(lo) >= k > C(hi)`` where
+      ``C(t) = #{ls > t}``; after 64 halvings [lo, hi) brackets the
+      k-th largest value so ``ls > lo`` == ``ls >= kth`` exactly
+      (counting in f32 is exact below 2^24 >> vocab). Disabled top-k
+      rows prefetch k = V → keep-all.
+    * top-p: over top-k survivors, weights ``w = exp(ls - max)``; bisect
+      with invariant ``H(lo) >= p*Z > H(hi)``, ``H(t) = sum{ls > t} w``,
+      Z = sum w; converges to the host's cutoff including its tie
+      re-inclusion. Host prep clamps p to [1e-6, 1] so ``H(hi0) = 0 <
+      p*Z`` holds at init (Z > 0: the row max always contributes 1).
+
+    64 fixed iterations shrink the bracket to width*2^-64 — far below
+    the f32 ulp gap between distinct logits — so the bracket ends
+    strictly between adjacent distinct values and the comparison
+    ``ls > lo`` is exact, not approximate. Rows with ``enable = 0``
+    (greedy or unfiltered-sampled) keep every real column; the winner
+    is then argmax over ``logits + noise`` (noise = temperature *
+    gumbel, zero for greedy rows) with jnp.argmax's first-occurrence
+    tie-break, identical to the unfiltered carry path."""
+    lg = kctx.logits[...]  # [B, v_loc] raw f32 (clean output stays)
+    gidx = jax.lax.broadcasted_iota(jnp.int32, lg.shape, 1)
+    real = gidx < v_real
+    inv_t = kctx.sampcfg[:, 0:1]
+    kk = kctx.sampcfg[:, 1:2]
+    pp = kctx.sampcfg[:, 2:3]
+    en = kctx.sampcfg[:, 3:4] > 0.0
+    ls = jnp.where(real, lg * inv_t, NEGF)
+    mx = jnp.max(ls, axis=-1, keepdims=True)
+    mn = jnp.min(jnp.where(real, ls, -NEGF), axis=-1, keepdims=True)
+
+    def bisect(count_ge):
+        # Invariant: count_ge(lo) true, count_ge(hi) false.
+        def it(_, c):
+            lo, hi = c
+            mid = 0.5 * (lo + hi)
+            take = count_ge(mid)
+            return jnp.where(take, mid, lo), jnp.where(take, hi, mid)
+
+        lo, _ = jax.lax.fori_loop(0, 64, it, (mn - 1.0, mx))
+        return lo
+
+    lo_k = bisect(
+        lambda t: jnp.sum(
+            jnp.where(ls > t, 1.0, 0.0), axis=-1, keepdims=True
+        ) >= kk
+    )
+    tk = ls > lo_k
+    w = jnp.where(tk, jnp.exp(ls - mx), 0.0)
+    z = jnp.sum(w, axis=-1, keepdims=True)
+    lo_p = bisect(
+        lambda t: jnp.sum(
+            jnp.where(ls > t, w, 0.0), axis=-1, keepdims=True
+        ) >= pp * z
+    )
+    keep = jnp.where(en, jnp.logical_and(tk, ls > lo_p), real)
+    score = jnp.where(keep, lg + kctx.noise[0], NEGF)
+    bestv = jnp.max(score, axis=-1, keepdims=True)
+    return jnp.min(
+        jnp.where(score == bestv, gidx, jnp.int32(1 << 30)),
+        axis=-1, keepdims=True,
+    )
+
+
 @register_task(TaskType.LM_HEAD)
 def lm_head_body(kctx):
     def body():
@@ -1356,6 +1471,27 @@ def lm_head_body(kctx):
                 v_real = jnp.clip(v_total - me * dims.v_loc, 0, dims.v_loc)
             else:
                 v_real = min(v_total, dims.v_loc)
+
+            if dims.filtered:
+                # Filtered sampling (dims.filtered, single-rank): the
+                # stream writes raw logits only — no running carry; a
+                # filter threshold cannot be known until every tile has
+                # landed — then the post-stream pass derives the exact
+                # host keep-set by per-row bisection and argmaxes
+                # logits + noise over it (_filtered_winner).
+                def fsink(j, val):
+                    val = _q8_scale(kctx, kctx.sc_lm, None, j * tn, val)
+                    kctx.logits[:, pl.ds(j * tn, val.shape[1])] = val
+
+                _stream_cols(
+                    kctx, x_in, kctx.lm_head, n, tn, fsink, tail=rem
+                )
+                besti = _filtered_winner(kctx, B, v_real, NEGF)
+                row = jnp.concatenate(
+                    [besti[b:b + 1, :] for b in range(B)], axis=1
+                )  # [1, B]
+                _multi_step_tail(kctx, row, B)
+                return
 
             def sink(j, val, carry):
                 val = _q8_scale(kctx, kctx.sc_lm, None, j * tn, val)
@@ -1423,13 +1559,7 @@ def lm_head_body(kctx):
             row = jnp.concatenate(
                 [besti[b:b + 1, :] for b in range(B)], axis=1
             )  # [1, B]
-            kctx.tokrow[...] = row
-            kctx.toks_out[kctx.step] = row
-            cp = pltpu.make_async_copy(
-                kctx.tokrow, kctx.tok_smem, kctx.tsem
-            )
-            cp.start()
-            cp.wait()
+            _multi_step_tail(kctx, row, B)
         else:
             def sink(j, val):
                 val = _q8_scale(kctx, kctx.sc_lm, None, j * tn, val)
@@ -1444,5 +1574,24 @@ def lm_head_body(kctx):
 def barrier_body(kctx):
     def body():
         _barrier(kctx)
+
+    return body
+
+
+@register_task(TaskType.RING_POLL)
+def ring_poll_body(kctx):
+    """Observe the host work ring (dims.ring): stamp the published
+    doorbell from the scalar-prefetch ``[doorbell, head, tail,
+    occupancy]`` snapshot into this task's trace mid column, proving
+    the round ran against the ring state the host rang for it
+    (validate_ring's doorbell check). Under interpret/CPU this is the
+    whole task — the ring is consumed host-side at round boundaries;
+    on hardware this is where the persistent loop spins on the
+    doorbell semaphore and splices admitted slots into the task
+    table (megakernel/ring.py module docs)."""
+
+    def body():
+        if kctx.ring_state is not None and kctx.dims.trace:
+            trace_stamp(kctx, kctx.ring_state[0])
 
     return body

@@ -608,6 +608,7 @@ class MegaQwen3:
         page: int = 0, straggler_rank: int | None = None,
         kv_quant: bool = False, num_pages: int = 0,
         valid_arg: bool = False, trace: bool = False,
+        filtered: bool = False, eos: bool = False, ring: bool = False,
     ):
         """``nsteps`` greedy decode steps in ONE kernel launch.
 
@@ -641,13 +642,35 @@ class MegaQwen3:
         — the dense append is a ``dynamic_update_slice``, whose clamped
         start would silently overwrite cached rows past capacity (the
         Engine gates its multi launches on this).
+
+        ``filtered=True`` (requires ``sampled``, single-rank) adds a
+        ``sampcfg [B, 4]`` f32 argument ``[1/temperature,
+        top_k_effective, top_p, enable]`` and the in-kernel winner runs
+        over the exact host top-k/top-p keep-set (bisection —
+        kernels._filtered_winner); ``eos=True`` adds ``stop_tok [B]``
+        i32 (-1 = none) + ``halt [B]`` i32 arguments and appends
+        ``(stop_step [B], halt_out [B])`` to the returns: the kernel
+        records each slot's FIRST EOS-hitting step (``nsteps`` = never),
+        the shard fn clamps that slot's appended rows to ``stop_step +
+        1`` and a carried ``halt`` flag zeroes halted slots' appends in
+        later launches (resident pipelining — docs/megakernel.md
+        "Resident decode"); ``ring=True`` adds the work-ring snapshot
+        ``[doorbell, head, tail, occupancy]`` i32 argument observed by
+        the graph's leading RING_POLL task (megakernel/ring.py).
         """
+        if (eos or ring) and not page:
+            raise ValueError("eos/ring modes ride the paged serving "
+                             "path only")
+        if eos and not valid_arg:
+            raise ValueError("eos needs valid_arg: device retire clamps "
+                             "the per-slot kept-row counts")
         m = self.model
         V = m.cfg.vocab_size
         base = self._dims(batch, s_max, page, kv_quant, num_pages, trace)
         dims = dataclasses.replace(
             base, nsteps=nsteps, v_real=V, sampled=sampled,
-            straggler_rank=straggler_rank,
+            straggler_rank=straggler_rank, filtered=filtered, eos=eos,
+            ring=ring,
         )
         mb = ModelBuilder(
             dims, cfg=self.cfg, axis=m.axis, ctx=m.ctx,
@@ -666,16 +689,21 @@ class MegaQwen3:
         if page:
             def shard_fn(params: Qwen3Params, tokens,
                          cache: PagedKVCache, *extra):
-                if valid_arg:  # serving: per-slot kept-row counts first
-                    n_valid, *noise = extra
-                else:
-                    n_valid, noise = None, extra
+                # Serving extras, in argument order (all optional):
+                # n_valid, stop_tok, halt, ring_state, noise, sampcfg.
+                ex = list(extra)
+                n_valid = ex.pop(0) if valid_arg else None
+                stop_tok = ex.pop(0) if eos else None
+                halt = ex.pop(0) if eos else None
+                ring_state = ex.pop(0) if ring else None
+                pre = [a for a in (stop_tok, ring_state) if a is not None]
                 outs = per_shard(
-                    cache.kv_len, tokens, cache.page_table, *noise,
+                    cache.kv_len, tokens, cache.page_table, *pre, *ex,
                     *kernel_args(params), cache.k_pages, cache.v_pages,
                     *self._scale_args(cache, kv_quant),
                 )
                 logits, k_rows, v_rows, toks = outs[:4]
+                idx = 4
                 # k_rows [NS, L, B, hkv, hd] → [L, B, hkv, NS, hd]:
                 # one scatter lands all nsteps rows in the pool (int8
                 # pools quantize them here, through append_n's
@@ -684,20 +712,39 @@ class MegaQwen3:
                 # retiring pages' scales never cover garbage).
                 k_rows = jnp.transpose(k_rows, (1, 2, 3, 0, 4))
                 v_rows = jnp.transpose(v_rows, (1, 2, 3, 0, 4))
-                ret = (
-                    toks[:, 0, :], logits,
-                    _paged.append_n(cache, k_rows, v_rows, n_valid),
-                )
+                if eos:
+                    # Device-side retire: clamp a hitting slot's kept
+                    # rows to its first EOS step (+1 keeps the EOS
+                    # row itself); slots halted by a PREVIOUS launch
+                    # (resident pipelining issued this one before the
+                    # hit drained) append nothing — their overshoot
+                    # rows route to the trash page.
+                    ss = outs[idx][0]  # [B]; nsteps = never hit
+                    idx += 1
+                    keep = jnp.minimum(n_valid, ss + 1) * (1 - halt)
+                    halt_out = jnp.maximum(
+                        halt, (ss < nsteps).astype(jnp.int32)
+                    )
+                    ret = (
+                        toks[:, 0, :], logits,
+                        _paged.append_n(cache, k_rows, v_rows, keep),
+                        ss, halt_out,
+                    )
+                else:
+                    ret = (
+                        toks[:, 0, :], logits,
+                        _paged.append_n(cache, k_rows, v_rows, n_valid),
+                    )
                 if trace:  # per-rank ring, stacked on a tp leading dim
-                    ret += (outs[4][None],)
+                    ret += (outs[idx][None],)
                 return ret
 
             specs = paged_cache_specs(ax, quantized=kv_quant)
         else:
             def shard_fn(params: Qwen3Params, tokens, cache: KVCache,
-                         *noise):
+                         *extra):  # noise?, sampcfg? — kernel mid order
                 outs = per_shard(
-                    cache.kv_len, tokens, *noise,
+                    cache.kv_len, tokens, *extra,
                     *kernel_args(params), cache.k, cache.v,
                 )
                 logits, k_rows, v_rows, toks = outs[:4]
@@ -728,8 +775,13 @@ class MegaQwen3:
         if valid_arg and not page:
             raise ValueError("valid_arg rides the paged append only")
         extra_specs = (P(),) if valid_arg else ()
+        extra_specs += (P(), P()) if eos else ()      # stop_tok, halt
+        extra_specs += (P(),) if ring else ()         # ring snapshot
         extra_specs += (P(None, None, ax),) if sampled else ()
+        extra_specs += (P(),) if filtered else ()     # sampcfg [B, 4]
         out_specs = (P(), P(None, ax), specs)
+        if eos:
+            out_specs += (P(), P())                   # stop_step, halt
         if trace:
             out_specs += (P(ax),)
         g = m.ctx.shard_map(
@@ -754,6 +806,7 @@ class MegaQwen3:
         self, batch: int, s_max: int, nsteps: int, sampled: bool = False,
         page: int = 0, kv_quant: bool = False, num_pages: int = 0,
         valid_arg: bool = False, trace: bool = False,
+        filtered: bool = False, eos: bool = False, ring: bool = False,
     ):
         """Jitted multi-step fn ``f(params, tokens, cache[, n_valid]
         [, noise]) → (tokens [nsteps, B], last_logits [B, V], cache
@@ -766,15 +819,18 @@ class MegaQwen3:
         loop's ``n_valid [B]`` kept-row counts (guaranteed-overshoot
         rows route to the trash page — see ``append_n``). ``trace``
         appends the device task ring ``[tp, NS, T, 8]`` to the returns
-        (docs/observability.md "Device task tracer"). Cached per the
-        full option tuple."""
+        (docs/observability.md "Device task tracer"). ``filtered``/
+        ``eos``/``ring`` are the resident-serving modes — see
+        :meth:`build_multi`. Cached per the full option tuple."""
         key = self._multi_key(batch, s_max, nsteps, sampled, page,
-                              kv_quant, num_pages, valid_arg, trace)
+                              kv_quant, num_pages, valid_arg, trace,
+                              filtered, eos, ring)
         if key not in self._jit:
             self._jit[key] = self.build_multi(
                 batch, s_max, nsteps, sampled, page,
                 kv_quant=kv_quant, num_pages=num_pages,
                 valid_arg=valid_arg, trace=trace,
+                filtered=filtered, eos=eos, ring=ring,
             )
             # Scheduled order for this build, for trace consumers
             # (obs/kernel_trace.validate_ring's dependency check).
@@ -784,12 +840,12 @@ class MegaQwen3:
     @staticmethod
     def _multi_key(batch, s_max, nsteps, sampled=False, page=0,
                    kv_quant=False, num_pages=0, valid_arg=False,
-                   trace=False):
+                   trace=False, filtered=False, eos=False, ring=False):
         """The ONE multi-build cache key — shared by
         :meth:`decode_multi_fn` and :meth:`multi_task_order` so the
         two can never disagree on what identifies a build."""
         return ("multi", batch, s_max, nsteps, sampled, page, kv_quant,
-                num_pages, valid_arg, trace)
+                num_pages, valid_arg, trace, filtered, eos, ring)
 
     def multi_task_order(self, *args, **kw):
         """The scheduled task order of a multi-step build — same

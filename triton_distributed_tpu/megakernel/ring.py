@@ -1,0 +1,124 @@
+"""Host↔device work ring for resident megakernel decode.
+
+Parity: the reference stack's persistent MegaTritonKernel keeps the
+device looping while the host feeds it work through pinned-memory
+queues (SURVEY §0: whole-model persistent kernel + task scheduler);
+PAPERS.md "Eliminating Hidden Serialization in Multi-Node Megakernel
+Communication" argues the dispatch win comes precisely from the host
+never re-launching.
+
+TPU redesign (docs/megakernel.md "Resident decode"): a Pallas launch
+cannot yet outlive its grid, so the resident loop is EMULATED at round
+granularity — the host pushes admit/retire/cancel work items into this
+ring, bumps the doorbell once per round, and the round's kernel
+observes the published ``[doorbell, head, tail, occupancy]`` snapshot
+through a scalar-prefetch operand (the RING_POLL task stamps the
+doorbell it saw into its trace record, which is how ``validate_ring``
+proves no round ran against a stale ring). On hardware the same layout
+is what the persistent kernel would spin on: the doorbell becomes a
+host-written semaphore, RING_POLL becomes the spin + task-table splice,
+and the items below become the splice arguments. The host-side
+accounting (push/consume/occupancy) is identical either way, which is
+why it lives here as a first-class piece rather than inline engine
+state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+# Work-item kinds (0 is reserved as "empty slot" so a zeroed ring is
+# trivially all-empty).
+RING_ADMIT = 1    # arg = prompt length admitted into the slot
+RING_RETIRE = 2   # arg = generated-token count at retire
+RING_CANCEL = 3   # arg = 0
+
+# Item layout: [kind, slot, arg, seq] int32.
+ITEM_INTS = 4
+
+_KIND_NAMES = {RING_ADMIT: "admit", RING_RETIRE: "retire",
+               RING_CANCEL: "cancel"}
+
+
+def kind_name(kind: int) -> str:
+    return _KIND_NAMES.get(int(kind), f"kind{int(kind)}")
+
+
+@dataclasses.dataclass
+class RingItem:
+    kind: int
+    slot: int
+    arg: int
+    seq: int
+
+    @property
+    def kind_str(self) -> str:
+        return kind_name(self.kind)
+
+
+class WorkRing:
+    """Bounded host→device work queue with a monotonic doorbell.
+
+    ``push`` appends an item at ``tail``; ``publish`` bumps the
+    doorbell and returns the ``[doorbell, head, tail, occupancy]``
+    int32 snapshot a round's kernel prefetches; ``consume`` retires
+    everything the published round covered (round-boundary consumption
+    — the interpret-mode stand-in for the device scheduler draining
+    the ring mid-loop). The ring never silently drops work: pushing
+    into a full ring raises, because a lost admit/retire item would
+    desynchronize the device scheduler from the engine's slot state.
+    """
+
+    def __init__(self, capacity: int = 64):
+        if capacity <= 0:
+            raise ValueError(f"ring capacity must be positive: {capacity}")
+        self.capacity = int(capacity)
+        self.buf = np.zeros((self.capacity, ITEM_INTS), np.int32)
+        self.head = 0       # consumer position (monotonic)
+        self.tail = 0       # producer position (monotonic)
+        self.doorbell = 0   # rounds published
+        self._seq = 0       # items ever pushed
+        self.peak_occupancy = 0
+
+    @property
+    def occupancy(self) -> int:
+        return self.tail - self.head
+
+    def push(self, kind: int, slot: int, arg: int = 0) -> RingItem:
+        if self.occupancy >= self.capacity:
+            raise RuntimeError(
+                f"work ring full ({self.capacity} items): the host "
+                "out-ran the device by a whole ring — raise the ring "
+                "capacity or drain more often"
+            )
+        item = RingItem(int(kind), int(slot), int(arg), self._seq)
+        self.buf[self.tail % self.capacity] = (
+            item.kind, item.slot, item.arg, item.seq
+        )
+        self.tail += 1
+        self._seq += 1
+        self.peak_occupancy = max(self.peak_occupancy, self.occupancy)
+        return item
+
+    def publish(self) -> np.ndarray:
+        """Ring the doorbell for one round; returns the ``[doorbell,
+        head, tail, occupancy]`` int32 snapshot the round's kernel
+        prefetches (RING_POLL stamps snapshot[0] into its trace mid)."""
+        self.doorbell += 1
+        return np.asarray(
+            [self.doorbell, self.head, self.tail, self.occupancy],
+            np.int32,
+        )
+
+    def consume(self) -> list[RingItem]:
+        """Round-boundary drain: everything pushed before the last
+        publish is now owned by the device scheduler. Returns the
+        consumed items (oldest first) for accounting/tests."""
+        items = []
+        while self.head < self.tail:
+            row = self.buf[self.head % self.capacity]
+            items.append(RingItem(*(int(v) for v in row)))
+            self.head += 1
+        return items

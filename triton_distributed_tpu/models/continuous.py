@@ -296,6 +296,46 @@ class Request:
         )
 
 
+@dataclasses.dataclass
+class _MegaPlan:
+    """One composed megakernel launch: the row mapping (launch row →
+    engine slot), the batch-bucket width, and the operand set. Built
+    from host truth by ``_mega_plan``; a resident chain reuses the
+    pending launch's plan with only ``n_valid`` re-projected (the slot
+    set cannot change between issue and drain — every slot-state
+    mutation site drains first)."""
+
+    rows: list
+    B: int
+    compact: bool       # B < max_batch: compacted table/kv_len/tok
+    sampled: bool
+    filtered: bool      # in-kernel top-k/top-p (single-rank only)
+    eos: bool           # device stop-token test + halt chaining
+    temps: np.ndarray   # [B] per-row temperature (0 = greedy row)
+    n_valid: np.ndarray  # [B] kept-row counts fed to append_n
+    sampcfg: np.ndarray | None  # [B, 4] when filtered
+    stop_tok: np.ndarray | None  # [B] when eos
+
+
+@dataclasses.dataclass
+class _MegaLaunch:
+    """An issued — possibly still in-flight — NS-step launch: what the
+    resident pipeline holds between issue and drain. ``toks``/``ss``/
+    ``halt``/``cache``/``ring`` are device arrays nothing has synced
+    on; ``cache`` is the launch's (bucket-shaped) output cache the
+    NEXT chained launch donates."""
+
+    plan: _MegaPlan
+    toks: object        # [NS, B] device
+    cache: object       # PagedKVCache (bucket-shaped)
+    ss: object | None   # [B] first stop-token step (NS = never)
+    halt: object | None  # [B] halt bits chained into the next launch
+    ring: object | None  # device trace ring (kernel_trace only)
+    t0: float
+    doorbell: int | None
+    trace_ids: dict
+
+
 class ContinuousEngine(MegaDispatch):
     """Admission/eviction serving loop over the paged pool.
 
@@ -344,10 +384,45 @@ class ContinuousEngine(MegaDispatch):
         tier=None,
         fabric=None,
         handoff_batch: bool = True,
+        ns: int = 8,
+        mega_buckets: bool = True,
+        resident: bool = False,
     ):
         self.model = model
         self.mode = mode
         self.mega_cfg = mega_cfg
+        # Resident decode (docs/megakernel.md "Resident decode"): the
+        # NS launch width becomes a knob (perf/mega_serve_bench.py
+        # sweeps it), batch buckets give a 2-slot round a 2-wide launch
+        # program instead of the max_batch-wide one, and
+        # ``resident=True`` pipelines launches through a host work
+        # ring — the host pushes admit/retire/cancel items + one
+        # doorbell per round, issues launch i+1 off launch i's device
+        # outputs, and syncs only to drain emitted tokens.
+        if int(ns) < 1:
+            raise ValueError(f"ns must be >= 1, got {ns}")
+        self.NS = int(ns)
+        self.mega_buckets = bool(mega_buckets)
+        self.resident = bool(resident)
+        if resident and mode != "mega":
+            raise ValueError(
+                "resident=True requires mode='mega' (the resident loop "
+                "pipelines megakernel NS-step launches through the host "
+                "work ring; the xla/pallas decode paths have no device "
+                "loop to keep resident)"
+            )
+        if resident:
+            from triton_distributed_tpu.megakernel.ring import WorkRing
+
+            self._ring = WorkRing()
+            self._ring_gauge = obs_metrics.gauge(
+                "tdt_mega_ring_occupancy",
+                "Host work-ring occupancy at the last doorbell publish.",
+            )
+        else:
+            self._ring = None
+            self._ring_gauge = None
+        self._pend = None  # in-flight resident launch (depth-1 pipeline)
         # Device task tracer (docs/observability.md "Device task
         # tracer"): mega launches carry an in-kernel trace ring; every
         # launch's ring is folded into tdt_mega_task_seconds /
@@ -372,10 +447,11 @@ class ContinuousEngine(MegaDispatch):
             raise ValueError(
                 "speculative=K does not compose with mode='mega': the "
                 "NS-step fused launch advances all slots in lockstep "
-                "and already amortizes per-step dispatch; run "
-                "speculative with mode='xla'/'pallas', or drop "
-                "speculative to serve through the megakernel "
-                "(docs/megakernel.md)"
+                "and already amortizes per-step dispatch — the resident "
+                "work ring splices whole slots between rounds, never "
+                "a mid-launch verify/rollback; run speculative with "
+                "mode='xla'/'pallas', or drop speculative to serve "
+                "through the megakernel (docs/megakernel.md)"
             )
         self.speculative = int(speculative)
         # Quantized KV storage (docs/serving.md "Quantized KV cache"):
@@ -487,9 +563,11 @@ class ContinuousEngine(MegaDispatch):
         self._dense1 = None if prefix_cache else model.new_cache(
             1, self.max_length
         )
-        # Lazy megakernel multi-step programs, keyed by whether the
-        # launch samples (greedy rounds must not consume PRNG keys, or
-        # temperature=0 runs would lose their seeded determinism).
+        # Lazy megakernel multi-step programs, keyed by (sampled,
+        # filtered, eos, ring, bucket_B) — greedy rounds must not
+        # consume PRNG keys, or temperature=0 runs would lose their
+        # seeded determinism, and each batch bucket compiles its own
+        # (narrower) program.
         self._multi_fns: dict = {}
         self.stats = self._zero_stats()
         # Metric handles resolved ONCE: the hot decode loop pays a dict
@@ -630,6 +708,16 @@ class ContinuousEngine(MegaDispatch):
             "mega_fallback_steps": 0,
             # Device task tracer: launches whose ring was decoded.
             "mega_trace_launches": 0,
+            # Resident-decode ledger (docs/megakernel.md "Resident
+            # decode"): work-ring items/doorbells, in-kernel filtered
+            # rounds, device stop-token retires, pipelined resident
+            # rounds, and bucket-program launches.
+            "mega_ring_items": 0,
+            "mega_ring_doorbells": 0,
+            "mega_device_retires": 0,
+            "mega_resident_rounds": 0,
+            "mega_bucket_launches": 0,
+            "mega_filtered_rounds": 0,
             # Slot-migration ledger (docs/scale-out.md "Slot migration
             # & handoff"): exports, imports, generated tokens restored
             # without re-generation, and imports that fell back to a
@@ -722,6 +810,20 @@ class ContinuousEngine(MegaDispatch):
             observe_request(tl)
 
     # -- slot management -------------------------------------------------
+
+    def _ring_push(self, kind: str, slot: int, arg: int = 0) -> None:
+        """Queue one work item for the resident device loop — no-op
+        without a ring. ``kind``: "admit" | "retire" | "cancel". The
+        next launch's doorbell publish covers it (megakernel/ring.py)."""
+        if self._ring is None:
+            return
+        from triton_distributed_tpu.megakernel import ring as _ring_mod
+
+        kinds = {"admit": _ring_mod.RING_ADMIT,
+                 "retire": _ring_mod.RING_RETIRE,
+                 "cancel": _ring_mod.RING_CANCEL}
+        self._ring.push(kinds[kind], slot, arg)
+        self._bump("mega_ring_items")
 
     def _sync_tables(self) -> None:
         self._free_pages_gauge.set(len(self.pool.free))
@@ -1021,6 +1123,7 @@ class ContinuousEngine(MegaDispatch):
         slot = req.slot
         self._finish_obs(req)  # status "ok": _evict only runs on success
         obs_events.emit("evict", slot=slot, tokens_out=len(req.out))
+        self._ring_push("retire", slot, len(req.out))
         if self.prefix is not None:
             self._retire_to_prefix(req)
         else:
@@ -1069,6 +1172,7 @@ class ContinuousEngine(MegaDispatch):
         failed request's KV is suspect (non-finite logits, a partial
         verify chunk) and caching it would poison later matches."""
         slot = req.slot
+        self._ring_push("retire", slot, len(req.out))
         truncate_pages(
             self.pool, req.pages, 0, self.page_size,
             shared=len(req.shared_nodes),
@@ -1114,6 +1218,9 @@ class ContinuousEngine(MegaDispatch):
             return fn()
         except Exception as e:  # noqa: BLE001 — isolation boundary
             self._bump("decode_faults")
+            # A fault mid-resident-round may leave a launch in flight;
+            # block on it before the teardown below reuses its state.
+            self._abort_pend()
             slot = getattr(e, "slot", None)
             if (isinstance(slot, int) and 0 <= slot < self.max_batch
                     and self._slots[slot] is not None):
@@ -1175,6 +1282,10 @@ class ContinuousEngine(MegaDispatch):
                 return False
             pending = set(self._cancelled)
         fault_point("engine.cancel", pending=len(pending))
+        if self._pend is not None:
+            # Cancellation tears slots down — the in-flight resident
+            # launch still reads their table rows; sync first.
+            self._drain_pend()
         consumed: set[str] = set()
         changed = False
         for r in list(queue):
@@ -1189,6 +1300,7 @@ class ContinuousEngine(MegaDispatch):
                 continue
             if req.ticket_id in pending:
                 consumed.add(req.ticket_id)
+                self._ring_push("cancel", req.slot)
                 self._fail(
                     req, "cancelled",
                     f"cancelled by client after {len(req.out)} generated "
@@ -1205,6 +1317,12 @@ class ContinuousEngine(MegaDispatch):
         (structured ``deadline_exceeded`` + partial tokens). Returns
         whether slot state changed."""
         now = time.monotonic()
+        if self._pend is not None and any(
+                r is not None and r.deadline_at is not None
+                and now > r.deadline_at for r in self._slots):
+            # The expiry is about to tear a slot down mid-pipeline;
+            # sync first (the drain may even finish it naturally).
+            self._drain_pend()
         changed = False
         for req in list(self._slots):
             if req is None or req.deadline_at is None:
@@ -1739,6 +1857,12 @@ class ContinuousEngine(MegaDispatch):
         admissions (injected faults, pool exhaustion races, non-finite
         prefill logits, expired deadlines) fail ONLY their request and
         the scan continues. Returns whether anything was admitted."""
+        if queue and self._pend is not None:
+            # Admission mutates slot/table/pool state the in-flight
+            # resident launch still reads — the pipeline syncs here
+            # first. An empty queue mutates nothing and keeps the
+            # pipeline unbroken (the steady resident state).
+            self._drain_pend()
         admitted = False
         progress = True
         while progress:  # re-scan: a first-token eviction frees its
@@ -1799,6 +1923,8 @@ class ContinuousEngine(MegaDispatch):
                     self._admit_failure(req, m, e)
                     progress = True
                     break
+                if req.status == "ok":
+                    self._ring_push("admit", slot, len(req.prompt))
                 if first is None:
                     # Snapshot path: either resumed mid-generation (its
                     # pending token is already out[-1]) or failed inside
@@ -1869,9 +1995,11 @@ class ContinuousEngine(MegaDispatch):
         # per slot when sampling), then the host checks eos/gen_len. A
         # finished row's overshoot tokens are discarded; its overshoot
         # KV rows land beyond its allocated pages, where the zeroed
-        # table entries route them to the trash page. Rounds that don't
-        # compose (rows near max_length, slots needing top-k/top-p
-        # filtering) fall back to single steps.
+        # table entries route them to the trash page. Top-k/top-p
+        # slots sample IN-KERNEL through the bisection filter on
+        # single-rank builds; rounds that don't compose (rows near
+        # max_length, filtered slots at tp > 1) fall back to single
+        # steps.
         if self.mode == "mega":
             changed = self._mega_round(active, kv_high)
             if changed is not None:
@@ -1880,56 +2008,253 @@ class ContinuousEngine(MegaDispatch):
         return self._decode_once()
 
     def _mega_round(self, active: np.ndarray, kv_high: int):
-        """One NS-step megakernel launch, or None when this round must
-        use the single-step fallback: a row within NS of ``max_length``
-        (the append would overwrite cached rows past capacity), or an
-        active slot sampling with top-k/top-p (the in-kernel Gumbel
-        argmax draws the unfiltered temperature distribution; filtered
-        slots sample host-side from full logits). Mixed greedy/sampled
-        batches launch fused: per-slot temperatures scale the noise, a
-        zero temperature zeroes it — exactly the greedy argmax."""
+        """One NS-step megakernel launch — pipelined behind the
+        in-flight resident launch when one is pending — or None when
+        this round must use the single-step fallback: a row within NS
+        of ``max_length`` (the append would overwrite cached rows past
+        capacity), or — at tp > 1 only — an active slot sampling with
+        top-k/top-p (the single-rank build filters IN-KERNEL through
+        the bisection filter; the sharded LM head streams vocab shards
+        whose running filter state does not yet cross ranks). Mixed
+        greedy/sampled batches launch fused: per-slot temperatures
+        scale the noise, a zero temperature zeroes it — exactly the
+        greedy argmax."""
+        if self._pend is not None:
+            # Resident pipeline: issue the NEXT launch off the pending
+            # one's device outputs FIRST (tok = its last token row,
+            # halt chained, cache threaded — no host sync anywhere on
+            # that path), THEN drain the pending round's tokens.
+            nxt = self._issue_resident(self._pend)
+            changed = self._drain_pend()
+            if nxt is not None:
+                self._pend = nxt
+                self._bump("mega_resident_rounds")
+            return changed
+        plan = self._mega_plan(active, kv_high)
+        if plan is None:
+            return None
+        pend = self._launch_mega(plan)
+        if self.resident:
+            # Tokens land at the next drain site; the round made
+            # progress (the launch is in flight).
+            self._pend = pend
+            return True
+        return self._drain_launch(pend)
+
+    def _mega_plan(self, active: np.ndarray, kv_high: int):
+        """Compose the next launch from host truth: per-slot sampling
+        knobs (the filtered gate), kept-row counts, the batch bucket,
+        and the eos operand set. None → this round cannot launch fused
+        and falls back to single steps."""
         if kv_high + self.NS > self.max_length:
             return None
-        temps = np.zeros(self.max_batch, np.float32)
+        tp1 = self.model.ctx.axis_size(self.model.axis) == 1
+        act = [s for s in range(self.max_batch)
+               if self._slots[s] is not None]
+        filtered = False
+        for slot in act:
+            t, p, k = self._request_sampling(self._slots[slot])
+            if t > 0.0 and (k > 0 or p < 1.0):
+                # In-kernel top-k/top-p (kernels._filtered_winner's
+                # bisection) needs the full vocab row on one rank and
+                # a multi-step build; otherwise single-step fallback.
+                if not tp1 or self.NS <= 1:
+                    return None
+                filtered = True
+        # Batch bucket: the smallest power-of-two program covering the
+        # active slots, so a 2-slot round stops paying the
+        # max_batch-wide program. Full-width rounds keep the identity
+        # layout (and the exact pre-bucket launch program).
+        B = self.max_batch
+        if self.mega_buckets and act:
+            b = 1
+            while b < len(act):
+                b *= 2
+            B = min(b, self.max_batch)
+        compact = B < self.max_batch
+        rows = act + [-1] * (B - len(act)) if compact \
+            else list(range(self.max_batch))
+        V = self.model.cfg.vocab_size
+        temps = np.zeros(B, np.float32)
         # Kept-row counts: a slot finishing mid-launch (gen_len bound,
         # known NOW) emits guaranteed-overshoot rows — routed to the
         # trash page by the append so a retiring page's int8 scale
         # never covers garbage (append_n docstring).
-        n_valid = np.zeros(self.max_batch, np.int32)
-        for slot, req in enumerate(self._slots):
+        n_valid = np.zeros(B, np.int32)
+        # Inert rows: inv_t 1, top-k window V, top-p 1, filter off.
+        sampcfg = np.tile(
+            np.asarray([[1.0, float(V), 1.0, 0.0]], np.float32), (B, 1)
+        )
+        stop_tok = np.full(B, -1, np.int32)
+        for i, slot in enumerate(rows):
+            req = self._slots[slot] if slot >= 0 else None
             if req is None:
                 continue
             t, p, k = self._request_sampling(req)
-            if t > 0.0 and (k > 0 or p < 1.0):
-                return None
-            temps[slot] = max(t, 0.0)
-            n_valid[slot] = min(req.gen_len - len(req.out), self.NS)
+            temps[i] = max(t, 0.0)
+            n_valid[i] = min(req.gen_len - len(req.out), self.NS)
+            # Mirrors sampling.filter_logits applicability exactly:
+            # top-k only when 0 < k < V, top-p only when p < 1 — rows
+            # with neither keep the unfiltered Gumbel argmax (enable
+            # 0), bit-identical to the pre-filter sampled launch.
+            en = t > 0.0 and (0 < k < V or p < 1.0)
+            sampcfg[i] = [1.0 / t if t > 0.0 else 1.0,
+                          float(k) if 0 < k < V else float(V),
+                          min(max(p, 1e-6), 1.0),
+                          1.0 if en else 0.0]
+            if self.eos_id is not None:
+                stop_tok[i] = self.eos_id
         sampled = bool((temps > 0.0).any())
-        fn = self._mega_multi_fn(sampled)
+        # Device stop-token test needs the multi-step tail (the
+        # stop_step output is per-sub-step bookkeeping).
+        eos = self.eos_id is not None and self.NS > 1
+        return _MegaPlan(
+            rows=rows, B=B, compact=compact, sampled=sampled,
+            filtered=filtered, eos=eos, temps=temps, n_valid=n_valid,
+            sampcfg=sampcfg if filtered else None,
+            stop_tok=stop_tok if eos else None,
+        )
+
+    def _issue_resident(self, chain: _MegaLaunch):
+        """Issue the next resident launch chained off ``chain``'s
+        device outputs — no host sync. None when the projected state
+        cannot compose another launch (the pipeline breaks; the caller
+        drains and the next round replans from host truth). The slot
+        set is ``chain``'s by construction: every slot-state mutation
+        site drains the pipeline first, so only retires-at-drain can
+        differ — those rows ride along with ``n_valid`` 0 (every KV
+        write trash-routed, every token discarded at drain)."""
+        n_valid = np.zeros(chain.plan.B, np.int32)
+        live = False
+        for i, slot in enumerate(chain.plan.rows):
+            req = self._slots[slot] if slot >= 0 else None
+            if req is None:
+                continue
+            # Projected remaining: the pending launch will emit (at
+            # most) its n_valid tokens for this row before this launch
+            # drains. An eos hit inside the pending launch emits fewer
+            # — but then the row retires at its drain and THIS
+            # launch's tokens are discarded (halt chaining already
+            # stopped its KV writes in-kernel).
+            rem = req.gen_len - len(req.out) - int(chain.plan.n_valid[i])
+            n_valid[i] = min(max(rem, 0), self.NS)
+            if n_valid[i] > 0:
+                live = True
+        if not live:
+            return None
+        # Host _kv_len is already projected past the pending launch
+        # (advanced at issue); one more launch must fit under it.
+        active = np.asarray(
+            [r is not None for r in self._slots], np.int32
+        )
+        if int((self._kv_len * active).max()) + self.NS > self.max_length:
+            return None
+        plan = dataclasses.replace(chain.plan, n_valid=n_valid)
+        return self._launch_mega(plan, chain=chain)
+
+    def _launch_mega(self, plan: _MegaPlan,
+                     chain: _MegaLaunch | None = None) -> _MegaLaunch:
+        """Dispatch one NS-step launch for ``plan`` and do the
+        issue-time host bookkeeping (projected ``_kv_len``, counters,
+        the launch event). Nothing here syncs on device results — the
+        returned record's outputs drain later (immediately for
+        non-resident rounds, at the next drain site for resident)."""
+        NS = self.NS
         params = self._mega_model()._step_params()  # Q8Params under wq8
-        args = (params, jnp.asarray(self._tok), self.cache,
-                jnp.asarray(n_valid))
-        t_launch = time.monotonic()
-        if sampled:
+        if chain is not None:
+            tok = chain.toks[NS - 1]  # device gather, async
+            cache_in = chain.cache
+            halt_in = chain.halt
+        else:
+            rows = np.asarray([max(s, 0) for s in plan.rows], np.int32)
+            tok = jnp.asarray(self._tok[rows].copy())
+            if plan.compact:
+                # Bucket launch: compacted table/kv_len views share
+                # the pool buffers (the pools are page-indexed, not
+                # slot-indexed). Inert filler rows keep zeroed table
+                # rows (the trash page) and kv_len 0.
+                tbl = self._table[rows].copy()
+                kvl = self._kv_len[rows].copy()
+                for i, slot in enumerate(plan.rows):
+                    if slot < 0 or self._slots[slot] is None:
+                        tbl[i] = 0
+                        kvl[i] = 0
+                cache_in = dataclasses.replace(
+                    self.cache,
+                    page_table=jnp.asarray(tbl),
+                    kv_len=jnp.asarray(kvl),
+                )
+            else:
+                cache_in = self.cache
+            halt_in = (jnp.zeros((plan.B,), jnp.int32)
+                       if plan.eos else None)
+        extra = []
+        if plan.eos:
+            extra.append(jnp.asarray(plan.stop_tok))
+            extra.append(halt_in)
+        doorbell = None
+        if self._ring is not None:
+            # One doorbell per round; everything pushed since the last
+            # publish is this round's splice (ring.py documents the
+            # hardware spin this stands in for).
+            state = self._ring.publish()
+            doorbell = int(state[0])
+            self._ring_gauge.set(int(state[3]))
+            self._bump("mega_ring_doorbells")
+            self._ring.consume()
+            extra.append(jnp.asarray(state))
+        fn = self._mega_multi_fn(
+            plan.sampled, filtered=plan.filtered, eos=plan.eos,
+            ring=self._ring is not None, B=plan.B,
+        )
+        nv = jnp.asarray(plan.n_valid)
+        t0 = time.monotonic()
+        if plan.sampled:
             self.key, sub = jax.random.split(self.key)
-            outs = fn(*args, sub, jnp.asarray(temps))
+            outs = fn(params, tok, cache_in, nv, tuple(extra), sub,
+                      jnp.asarray(plan.temps),
+                      jnp.asarray(plan.sampcfg) if plan.filtered
+                      else None)
+        elif extra:
+            outs = fn(params, tok, cache_in, nv, tuple(extra))
         else:
-            outs = fn(*args)
-        if self.kernel_trace:
-            toks, _logits, self.cache, ring = outs
-            jax.block_until_ready(toks)  # wall must cover the launch
-        else:
-            toks, _logits, self.cache = outs
-            ring = None
-        wall_s = time.monotonic() - t_launch
+            outs = fn(params, tok, cache_in, nv)
+        outs = list(outs)
+        toks, _logits, new_cache = outs[:3]
+        idx = 3
+        ss = halt = None
+        if plan.eos:
+            ss, halt = outs[idx], outs[idx + 1]
+            idx += 2
+        ring_arr = outs[idx] if self.kernel_trace else None
         # Rebind, never ``+=``: the in-place add mutated the numpy
         # array a zero-copy ``jnp.asarray`` may have aliased into the
         # STILL-RUNNING launch's cache.kv_len (see _sync_tables).
-        self._kv_len = self._kv_len + self.NS * active
-        self._bump("decode_steps", self.NS)
+        adv = np.zeros(self.max_batch, np.int32)
+        n_active = 0
+        for i, slot in enumerate(plan.rows):
+            if slot >= 0 and self._slots[slot] is not None:
+                n_active += 1
+                if plan.n_valid[i] > 0:
+                    adv[slot] = 1
+        self._kv_len = self._kv_len + NS * adv
+        if plan.compact:
+            # Restore the full-width table view over the launch's
+            # output pools; the record keeps the bucket-shaped cache
+            # for chaining.
+            self.cache = dataclasses.replace(
+                new_cache,
+                page_table=jnp.asarray(self._table.copy()),
+                kv_len=jnp.asarray(self._kv_len.copy()),
+            )
+            self._bump("mega_bucket_launches")
+        else:
+            self.cache = new_cache
+        if plan.filtered:
+            self._bump("mega_filtered_rounds")
+        self._bump("decode_steps", NS)
         if self._moe_k:
-            self._bump("moe_routed_tokens",
-                       self.NS * int(active.sum()) * self._moe_k)
+            self._bump("moe_routed_tokens", NS * n_active * self._moe_k)
         self._bump("mega_launches")
         self._ns_gauge.set(
             self.stats["decode_steps"] / max(self.stats["mega_launches"], 1)
@@ -1937,63 +2262,141 @@ class ContinuousEngine(MegaDispatch):
         # Active slots' request trace ids ride the launch event (and
         # the decoded ring's launch metadata), so one request can be
         # followed server → router → replica → engine → device tasks.
+        # Keys are LAUNCH rows (what the device stamps into TR_SLOT) —
+        # identical to engine slots for full-width launches.
         trace_ids = {
-            slot: req.trace_id
-            for slot, req in enumerate(self._slots)
-            if req is not None and req.trace_id
+            i: self._slots[slot].trace_id
+            for i, slot in enumerate(plan.rows)
+            if slot >= 0 and self._slots[slot] is not None
+            and self._slots[slot].trace_id
         }
         obs_events.emit(
-            "mega:launch", ns=self.NS, active=int(active.sum()),
-            sampled=int(sampled),
+            "mega:launch", ns=NS, active=n_active,
+            sampled=int(plan.sampled),
             trace_ids=",".join(trace_ids[k] for k in sorted(trace_ids)),
         )
-        if ring is not None:
+        return _MegaLaunch(
+            plan=plan, toks=toks, cache=new_cache, ss=ss, halt=halt,
+            ring=ring_arr, t0=t0, doorbell=doorbell,
+            trace_ids=trace_ids,
+        )
+
+    def _drain_pend(self) -> bool:
+        """THE sync point of the resident pipeline: fetch the pending
+        launch's emitted tokens and run the normal emit/retire paths.
+        Every slot-state mutation site (_try_admit, _apply_cancels,
+        _expire_deadlines, _handoff_sweep, _update_snapshot_buffer,
+        run()'s teardown) comes through here before touching state an
+        in-flight launch still reads."""
+        pend, self._pend = self._pend, None
+        if pend is None:
+            return False
+        return self._drain_launch(pend)
+
+    def _abort_pend(self) -> None:
+        """Teardown-path drain: block on (then discard) the in-flight
+        resident launch so no exit path leaves a launch reading slot
+        state the teardown is about to reuse."""
+        pend, self._pend = self._pend, None
+        if pend is None:
+            return
+        try:
+            jax.block_until_ready(pend.toks)
+        except Exception:  # noqa: BLE001 — teardown is best-effort
+            pass
+
+    def _drain_launch(self, pend: _MegaLaunch) -> bool:
+        """Fetch one launch's outputs and emit/retire through the
+        normal paths. A device stop-token hit (``ss < n_valid``)
+        truncates the row's stream at the stop token — the host never
+        re-tests tokens the kernel already tested — and the retire
+        flows through ``_maybe_finish``'s standard ``_evict`` (pages →
+        radix tree/pool exactly as before)."""
+        plan = pend.plan
+        toks_np = np.asarray(pend.toks)  # [NS, B] — THE host sync
+        ss_np = np.asarray(pend.ss) if pend.ss is not None else None
+        wall_s = time.monotonic() - pend.t0
+        if pend.ring is not None:
             # Shared MegaDispatch plumbing records the launch; the
             # per-run stats ledger + registry mirror ride _bump.
             self._record_kernel_trace(
-                ring, t_launch, wall_s, self.NS, trace_ids
+                pend.ring, pend.t0, wall_s, self.NS, pend.trace_ids,
+                doorbell=pend.doorbell,
             )
             self._bump("mega_trace_launches")
-        toks_np = np.asarray(toks)  # [NS, max_batch]
-        return self._process(lambda slot: toks_np[:, slot])
+        col = {slot: i for i, slot in enumerate(plan.rows) if slot >= 0}
+        if ss_np is not None:
+            for slot, i in col.items():
+                if (self._slots[slot] is not None
+                        and ss_np[i] < plan.n_valid[i]):
+                    self._bump("mega_device_retires")
 
-    def _mega_multi_fn(self, sampled: bool):
-        """The NS-step launch program (built lazily, cached per
-        ``sampled``). The sampled wrapper draws the Gumbel noise INSIDE
-        the jit — per-sub-step key splits, per-slot temperature scaling
-        — so each rank materializes only its vocab shard and the
-        kernel's argmax over ``logits + T_b·gumbel`` IS per-slot
-        temperature sampling (the Gumbel-max trick, distribution-equal
-        to ``sampling.sample`` at ``top_p=1, top_k=0``)."""
-        fn = self._multi_fns.get(sampled)
+        def slot_tokens(slot):
+            i = col.get(slot)
+            if i is None:
+                return ()
+            n = int(plan.n_valid[i])
+            if ss_np is not None:
+                n = min(n, int(ss_np[i]) + 1)
+            return toks_np[:n, i]
+
+        return self._process(slot_tokens)
+
+    def _mega_multi_fn(self, sampled: bool, *, filtered: bool = False,
+                       eos: bool = False, ring: bool = False,
+                       B: int | None = None):
+        """The NS-step launch program (built lazily, cached per full
+        option tuple — the batch bucket B is part of the key). The
+        sampled wrapper draws the Gumbel noise INSIDE the jit —
+        per-sub-step key splits, per-slot temperature scaling — so
+        each rank materializes only its vocab shard and the kernel's
+        argmax over ``logits + T_b·gumbel`` IS per-slot temperature
+        sampling (the Gumbel-max trick, distribution-equal to
+        ``sampling.sample`` at ``top_p=1, top_k=0``). With
+        ``filtered``, the per-row ``sampcfg`` rides along and the
+        in-kernel bisection filter restricts that argmax to the host
+        ``filter_logits`` keep-set. ``eos``/``ring`` append the device
+        stop-token operands and the work-ring snapshot. Unified call
+        shape past the base four args: ``fn(params, tok, cache,
+        n_valid[, extra_tuple][, key, temps, sampcfg])``."""
+        key = (sampled, filtered, eos, ring, B or self.max_batch)
+        fn = self._multi_fns.get(key)
         if fn is not None:
             return fn
+        Bk = key[-1]
         mega = self._mega_model()
         base = mega.decode_multi_fn(
-            self.max_batch, self.max_length, self.NS, sampled=sampled,
+            Bk, self.max_length, self.NS, sampled=sampled,
             page=self.page_size, kv_quant=self.kv_dtype is not None,
             num_pages=int(self.cache.k_pages.shape[1]),
             valid_arg=True, trace=self.kernel_trace,
+            filtered=filtered, eos=eos, ring=ring,
         )
         if sampled:
-            NS, B = self.NS, self.max_batch
+            NS = self.NS
             n = self.model.ctx.axis_size(self.model.axis)
-            v_pad = mega._dims(B, self.max_length).v_loc * n
+            v_pad = mega._dims(Bk, self.max_length).v_loc * n
 
-            def wrapped(params, tok, cache, n_valid, key, temps):
+            def wrapped(params, tok, cache, n_valid, extra, key, temps,
+                        sampcfg):
                 keys = jax.random.split(key, NS)
                 noise = jax.vmap(
                     lambda k: jax.random.gumbel(
-                        k, (B, v_pad), jnp.float32
+                        k, (Bk, v_pad), jnp.float32
                     )
-                )(keys)
-                return base(params, tok, cache, n_valid,
-                            noise * temps[None, :, None])
+                )(keys) * temps[None, :, None]
+                tail = (noise, sampcfg) if filtered else (noise,)
+                return base(params, tok, cache, n_valid, *extra, *tail)
 
             fn = jax.jit(wrapped, donate_argnums=(2,))
+        elif eos or ring:
+            def wrapped_g(params, tok, cache, n_valid, extra):
+                return base(params, tok, cache, n_valid, *extra)
+
+            fn = jax.jit(wrapped_g, donate_argnums=(2,))
         else:
             fn = base
-        self._multi_fns[sampled] = fn
+        self._multi_fns[key] = fn
         return fn
 
     def run(self, requests, *, results: bool = False):
@@ -2163,6 +2566,9 @@ class ContinuousEngine(MegaDispatch):
         finally:
             self._handoff_at = None
             self._round = 0
+            # Block on (and discard) any in-flight resident launch
+            # BEFORE teardown reuses the state it reads.
+            self._abort_pend()
             # Crash-safe teardown: NO exit path — injected fault,
             # engine bug, KeyboardInterrupt — leaves a slot holding
             # pages, a dangling tree pin, or a stale device table; the
@@ -2260,6 +2666,10 @@ class ContinuousEngine(MegaDispatch):
         resumed stale snapshot can only latch-lose."""
         from triton_distributed_tpu.models import slot_state
 
+        if self._pend is not None:
+            # Snapshots read slot KV the in-flight resident launch is
+            # still appending to; sync the pipeline first.
+            self._drain_pend()
         snaps: dict[str, dict] = {}
         for slot, req in enumerate(self._slots):
             if req is None or req.ticket_id is None:
@@ -2344,6 +2754,10 @@ class ContinuousEngine(MegaDispatch):
         a single bad slot never blocks the others' handoff."""
         from triton_distributed_tpu.models import slot_state
 
+        if self._pend is not None:
+            # Exports read slot KV the in-flight resident launch is
+            # still appending to; sync the pipeline first.
+            self._drain_pend()
         active = [s for s in range(self.max_batch)
                   if self._slots[s] is not None]
         snaps: dict = {}

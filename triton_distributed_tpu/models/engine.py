@@ -136,20 +136,23 @@ class MegaDispatch:
 
     def _record_kernel_trace(
         self, ring, t0: float, wall_s: float, nsteps: int,
-        trace_ids: dict | None = None,
+        trace_ids: dict | None = None, doorbell: int | None = None,
     ) -> None:
         """Fold one launch's device ring into telemetry: the inline
         work is vectorized over the raw ring (gap check, per-opcode
         durations, measured overlap → registry); the launch is kept
         (bounded deque) with the ring attached, records decoding
-        lazily for ``kernel_trace_summary`` and the merged timeline."""
+        lazily for ``kernel_trace_summary`` and the merged timeline.
+        ``doorbell`` carries the work-ring doorbell published for a
+        resident round — ``validate_ring`` checks the RING_POLL task
+        observed exactly it (no stale ring snapshot)."""
         from triton_distributed_tpu.obs import kernel_trace as _kt
 
         self._trace_launch_n += 1
         launch = _kt.KernelTraceLaunch(
             wall_s=wall_s, t0=t0, trace_ids=trace_ids or {},
             nsteps=nsteps, launch=self._trace_launch_n,
-            ring=np.asarray(ring),
+            ring=np.asarray(ring), doorbell=doorbell,
         )
         self._kernel_traces.append(launch)
         _kt.observe_launch(launch)
@@ -378,6 +381,7 @@ class Engine(MegaDispatch):
         max_length: int | None = None,
         profile: str | None = None,
         prompt_start: list | np.ndarray | None = None,
+        ns: int = 8,
     ) -> np.ndarray:
         """Generate ``gen_len`` tokens for each sequence; returns
         ``[B, S + gen_len]`` (parity: ``Engine.serve``). ``profile``
@@ -389,6 +393,11 @@ class Engine(MegaDispatch):
         divisibility). Rows are rolled so pads sit on the RIGHT, where
         causal masking makes them inert, and the real length rides to
         ``prefill(true_len=...)`` — pad tokens never influence output.
+
+        ``ns`` is the megakernel multi-step launch width (mode='mega'
+        only; perf/mega_serve_bench.py sweeps it — wider launches
+        amortize more host dispatch per token against a longer
+        host-blind stretch).
         """
         input_ids = np.asarray(input_ids, np.int32)
         b, s = input_ids.shape
@@ -497,7 +506,9 @@ class Engine(MegaDispatch):
 
         from triton_distributed_tpu.runtime.profiling import group_profile
 
-        NS = 8  # multi-step launch width
+        NS = int(ns)  # multi-step launch width
+        if NS < 1:
+            raise ValueError(f"ns must be >= 1, got {ns}")
         if self.paged:
             s_max = int(cache.page_table.shape[1]) * self.page_size
         else:
@@ -508,15 +519,23 @@ class Engine(MegaDispatch):
         # dynamic_update_slice would silently overwrite cached rows).
         kv_high = int(true_lens.max())
         # Sampling composes with multi-step via the Gumbel-max trick
-        # (argmax over logits + T*gumbel == categorical(logits/T)) as
-        # long as no top-p/top-k filter truncates the distribution —
-        # paged and int8 pools included (the serving fast path).
+        # (argmax over logits + T*gumbel == categorical(logits/T));
+        # top-p/top-k truncation now ALSO composes on single-rank
+        # builds — the in-kernel bisection filter restricts that
+        # argmax to the host filter_logits keep-set
+        # (docs/megakernel.md "Resident decode"). Sharded LM heads
+        # fall back to single steps for filtered sampling.
+        V = self.model.cfg.vocab_size
         sampled = self.temperature > 0.0
+        need_filter = sampled and (
+            0 < self.top_k < V or self.top_p < 1.0
+        )
+        filtered = need_filter and n == 1 and NS > 1
         multi_launches = 0
         if (
             self.mode == "mega"
             and not self.speculative
-            and (not sampled or (self.top_p >= 1.0 and self.top_k == 0))
+            and (not need_filter or filtered)
         ):
             multi_launches = min(
                 (gen_len - 1) // NS, max(s_max - kv_high, 0) // NS
@@ -547,7 +566,18 @@ class Engine(MegaDispatch):
                         int(cache.k_pages.shape[1]) if self.paged else 0
                     ),
                     trace=self.kernel_trace,
+                    filtered=filtered,
                 )
+                sampcfg = None
+                if filtered:
+                    # Per-row [1/T, top-k window, top-p, enable] the
+                    # in-kernel bisection filter consumes — identical
+                    # rows here (serve()'s knobs are engine-global).
+                    t, k, p = self.temperature, self.top_k, self.top_p
+                    sampcfg = jnp.asarray(np.tile(np.asarray(
+                        [[1.0 / t, float(k) if 0 < k < V else float(V),
+                          min(max(p, 1e-6), 1.0), 1.0]], np.float32,
+                    ), (b, 1)))
                 if sampled:
                     # Draw the Gumbel noise INSIDE the jit so each rank
                     # materializes only its vocab shard — an eager
@@ -555,14 +585,15 @@ class Engine(MegaDispatch):
                     # array to one device and reshard it every launch.
                     # Cached per shape: a fresh closure per serve()
                     # would retrace + recompile the megakernel program.
-                    wkey = (b, s_max, NS, self.paged, quant)
+                    wkey = (b, s_max, NS, self.paged, quant, filtered)
                     fn = self._sampled_multi.get(wkey)
                     if fn is None:
-                        def fn(params, tok, cache, key, temp):
+                        def fn(params, tok, cache, key, temp, cfg):
                             noise = temp * jax.random.gumbel(
                                 key, (NS, b, v_pad), jnp.float32
                             )
-                            return base_fn(params, tok, cache, noise)
+                            tail = (noise, cfg) if filtered else (noise,)
+                            return base_fn(params, tok, cache, *tail)
 
                         fn = jax.jit(fn, donate_argnums=(2,))
                         self._sampled_multi[wkey] = fn
@@ -571,7 +602,9 @@ class Engine(MegaDispatch):
                 for _ in range(multi_launches):
                     if sampled:
                         self.key, sub = jax.random.split(self.key)
-                        extra = (sub, jnp.float32(self.temperature))
+                        extra = (
+                            sub, jnp.float32(self.temperature), sampcfg,
+                        )
                     else:
                         extra = ()
                     t_launch = time.monotonic()

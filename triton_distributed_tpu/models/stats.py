@@ -117,6 +117,29 @@ STAT_METRICS = {
     "mega_trace_launches": ("tdt_mega_trace_launches_total",
                             "Megakernel launches whose device trace "
                             "ring was decoded."),
+    # Resident decode (docs/megakernel.md "Resident decode"): the host
+    # work ring, in-kernel filtered sampling, batch-bucket launch
+    # programs, and device-side stop-token retire.
+    "mega_ring_items": ("tdt_mega_ring_items_total",
+                        "Admit/retire/cancel work items pushed into "
+                        "the host work ring."),
+    "mega_ring_doorbells": ("tdt_mega_ring_doorbells_total",
+                            "Work-ring doorbell publishes (one per "
+                            "resident round)."),
+    "mega_device_retires": ("tdt_mega_device_retires_total",
+                            "Slots retired by the in-kernel stop-token "
+                            "test (no host round trip)."),
+    "mega_resident_rounds": ("tdt_mega_resident_rounds_total",
+                             "Resident-session rounds issued before "
+                             "the previous round's drain (pipelined "
+                             "dispatch)."),
+    "mega_bucket_launches": ("tdt_mega_bucket_launches_total",
+                             "Mega launches served by a batch-bucket "
+                             "program narrower than max_batch."),
+    "mega_filtered_rounds": ("tdt_mega_filtered_rounds_total",
+                             "Mega rounds sampled in-kernel through "
+                             "the top-k/top-p bisection filter "
+                             "(previously single-step fallbacks)."),
     # MoE serving (docs/serving.md "MoE serving"): token positions
     # routed through the expert FFN × top_k, and EP all-to-all drops —
     # the serving paths are LOSSLESS (splits-exchange protocol /

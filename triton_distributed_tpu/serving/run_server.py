@@ -98,6 +98,18 @@ def main(argv=None) -> int:
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--mode", default="xla",
                    choices=["xla", "pallas", "mega"])
+    p.add_argument("--ns", type=int, default=8,
+                   help="with --mode mega: tokens fused per decode "
+                   "launch (the NS-step chunk; docs/megakernel.md "
+                   "'Serving fast path'). Larger NS amortizes more "
+                   "host dispatch per token at coarser admission "
+                   "granularity; perf/mega_serve_bench.py sweeps it.")
+    p.add_argument("--resident", action="store_true",
+                   help="with --mode mega: resident decode — pipeline "
+                   "round i+1's launch before draining round i, with "
+                   "admit/retire/cancel flowing through the host work "
+                   "ring (docs/megakernel.md 'Resident decode'). "
+                   "Continuous-batching engines only.")
     p.add_argument("--kv-dtype", default=None, choices=["int8"],
                    help="int8-quantized paged KV pool (docs/serving.md "
                    "'Quantized KV cache'); composes with every --mode "
@@ -118,8 +130,8 @@ def main(argv=None) -> int:
                    "respawn, snapshot-based recovery — docs/scale-out.md "
                    "'Process fleet') and serve the router in THIS "
                    "process; children inherit --model/--mode/--kv-dtype/"
-                   "--speculative/--max-batch (or the --stub-* knobs "
-                   "with --model stub)")
+                   "--speculative/--ns/--resident/--max-batch (or the "
+                   "--stub-* knobs with --model stub)")
     p.add_argument("--continuous", action="store_true",
                    help="serve ONE ContinuousEngine (continuous "
                    "batching, 'requests' payloads) instead of the "
@@ -268,10 +280,23 @@ def main(argv=None) -> int:
         # at the CLI names the flags to change.)
         p.error(
             "--speculative and --mode mega do not compose: the "
-            "megakernel's NS-step fused launch already amortizes "
-            "per-step dispatch (docs/megakernel.md 'Serving fast "
-            "path'). Drop --speculative or use --mode xla/pallas."
+            "megakernel's NS-step fused launch advances all slots in "
+            "lockstep and already amortizes per-step dispatch, and "
+            "the resident work ring splices whole slots between "
+            "rounds — never a mid-launch verify/rollback "
+            "(docs/megakernel.md 'Resident decode'). Drop "
+            "--speculative or use --mode xla/pallas."
         )
+    if args.resident and args.mode != "mega":
+        # Same fail-fast convention: resident decode IS the megakernel's
+        # work-ring round loop — there is nothing to make resident on
+        # the xla/pallas paths, and silently ignoring the flag would
+        # leave an operator believing the pipelined dispatch is on.
+        p.error("--resident requires --mode mega (resident decode is "
+                "the megakernel's work-ring round loop; "
+                "docs/megakernel.md 'Resident decode')")
+    if args.ns < 1:
+        p.error("--ns must be >= 1")
     # --model moe: the Qwen3MoE serving alias (tiny-moe preset so a
     # laptop/CI run needs no checkpoint), sized by the knob overrides.
     model_name, overrides = resolve_model_args(
@@ -450,6 +475,10 @@ def main(argv=None) -> int:
                 child += ["--kv-dtype", args.kv_dtype]
             if args.speculative:
                 child += ["--speculative", str(args.speculative)]
+            if args.ns != 8:
+                child += ["--ns", str(args.ns)]
+            if args.resident:
+                child += ["--resident"]
             # --tier-dir promises a restart-safe fleet from one flag:
             # children must actually EXPORT snapshots for the
             # supervisor's resume store to hold anything (the
@@ -621,6 +650,7 @@ def main(argv=None) -> int:
                 temperature=args.temperature, prefix_cache=True,
                 kv_dtype=args.kv_dtype, speculative=args.speculative,
                 kernel_trace=kernel_trace,
+                ns=args.ns, resident=args.resident,
                 snapshot_every=args.snapshot_every,
                 tier=shared_tier,
                 tier_bytes=args.tier_bytes,
@@ -672,6 +702,7 @@ def main(argv=None) -> int:
             temperature=args.temperature, prefix_cache=True,
             kv_dtype=args.kv_dtype, speculative=args.speculative,
             kernel_trace=kernel_trace,
+            ns=args.ns, resident=args.resident,
             snapshot_every=args.snapshot_every,
             tier_bytes=args.tier_bytes, tier_dir=args.tier_dir,
             fabric=fabric,
