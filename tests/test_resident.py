@@ -22,7 +22,14 @@ Coverage contract (ISSUE 19 acceptance):
   clean), and the co-batched survivor's tokens are bit-exact;
 - batch-bucket launches emit bit-identical tokens to the full-width
   program; the resident pipeline's rings validate gap-free against
-  their published doorbells.
+  their published doorbells;
+- review hardening: ``consume`` stops at the publish snapshot and
+  ``flush`` drains fallback rounds host-side (a persistently
+  falling-back workload must not overflow the ring and wedge the
+  engine), no-op filter knobs (top_k >= V, top_p == 1) never force the
+  filtered program or the tp>1 fallback, and a drain that faults
+  reaches the step guard with the just-issued launch parked in
+  ``_pend`` (no orphaned in-flight launch).
 """
 
 import jax
@@ -79,6 +86,32 @@ def test_work_ring_semantics():
         ring.push(RING_ADMIT, 9)
     ring.publish()
     assert [i.slot for i in ring.consume()] == [0, 1, 2, 3]
+
+
+def test_work_ring_publish_snapshot_and_flush():
+    """``consume`` drains exactly up to the last publish's tail
+    snapshot — items pushed after the doorbell stay host-owned for the
+    next round — and ``flush`` drains everything without moving the
+    doorbell (the single-step-fallback path)."""
+    ring = WorkRing(capacity=4)
+    ring.push(RING_ADMIT, 0)
+    ring.publish()
+    ring.push(RING_RETIRE, 1)  # after the publish: the NEXT round's
+    items = ring.consume()
+    assert [(i.kind, i.slot) for i in items] == [(RING_ADMIT, 0)]
+    assert ring.occupancy == 1  # the unpublished item is still queued
+    ring.publish()
+    assert [i.slot for i in ring.consume()] == [1]
+    # Nothing published since the drain: consume is empty even with
+    # items queued; flush takes them all, doorbell untouched.
+    ring.push(RING_CANCEL, 2)
+    ring.push(RING_ADMIT, 3)
+    assert ring.consume() == []
+    bell = ring.doorbell
+    flushed = ring.flush()
+    assert [i.slot for i in flushed] == [2, 3]
+    assert ring.occupancy == 0 and ring.doorbell == bell
+    assert ring.flush() == []
 
 
 def _rec(index, opcode, begin, end, mid=0, task_id=None):
@@ -166,6 +199,7 @@ def test_ring_metrics_pretouch(fresh_telemetry, ctx1):
         "tdt_mega_single_step_fallbacks_total",
         "tdt_mega_ring_items_total",
         "tdt_mega_ring_doorbells_total",
+        "tdt_mega_ring_host_drains_total",
         "tdt_mega_device_retires_total",
         "tdt_mega_resident_rounds_total",
         "tdt_mega_bucket_launches_total",
@@ -314,3 +348,105 @@ def test_sampled_run_scrapes_zero_fallbacks(fresh_telemetry, ctx1):
     assert reg.get("tdt_mega_filtered_rounds_total").value() > 0
     assert "tdt_mega_single_step_fallbacks_total 0" in \
         obs_metrics.prometheus_text()
+
+
+@pytest.mark.slow
+def test_persistent_fallback_drains_ring(ctx1):
+    """A resident session whose every round falls back to single-step
+    (ns=1 + filtered sampling can never compose a fused launch) must
+    drain the work ring host-side: before the fix the admit/retire
+    items were only consumed inside ``_launch_mega``, so a workload
+    that persistently fell back overflowed the ring after ``capacity``
+    items and the RuntimeError wedged every subsequent round."""
+    from triton_distributed_tpu.models.continuous import ContinuousEngine
+
+    model = AutoLLM.from_pretrained("tiny", ctx=ctx1)
+    eng = ContinuousEngine(
+        model, max_batch=1, page_size=16, max_length=64, mode="mega",
+        resident=True, ns=1, temperature=0.8, top_k=5, top_p=0.9, seed=3,
+    )
+    # 4 requests push 4 admits + 4 retires: twice the shrunken
+    # capacity, so any round that fails to drain overflows quickly.
+    eng._ring = WorkRing(capacity=4)
+    prompt = np.asarray([5, 9, 2, 4], np.int32)
+    results = eng.run([(prompt, 4)] * 4, results=True)
+    assert all(r.ok for r in results), [r.status for r in results]
+    assert all(len(r.tokens) == 4 for r in results)
+    st = eng.stats
+    assert st["mega_fallback_steps"] > 0, st
+    assert st["mega_ring_items"] == 8, st
+    assert st["mega_ring_host_drains"] == 8, st
+    assert st["mega_ring_doorbells"] == 0, st  # no fused launch ever
+    assert eng._ring.occupancy == 0  # empty at rest after teardown
+    assert eng.audit() == []
+
+
+@pytest.mark.slow
+def test_noop_filter_knobs_stay_fused(ctx1):
+    """top_k >= vocab_size with top_p == 1 is a NO-OP filter: the plan
+    gate must agree with the per-row enable (0 < k < V or p < 1) and
+    compose the plain sampled launch — no filtered program at tp == 1
+    (and no permanent single-step fallback at tp > 1). Tokens are
+    bit-identical to the unfiltered sampled engine at the same seed."""
+    from triton_distributed_tpu.models.continuous import ContinuousEngine
+
+    model = AutoLLM.from_pretrained("tiny", ctx=ctx1)
+    V = model.cfg.vocab_size
+    prompts = [np.asarray([5, 9, 2, 4], np.int32),
+               np.asarray([7, 1, 3, 8, 6, 2, 4, 9], np.int32)]
+
+    def run(top_k, top_p):
+        eng = ContinuousEngine(
+            model, max_batch=2, page_size=16, max_length=64, mode="mega",
+            temperature=0.8, top_k=top_k, top_p=top_p, seed=3,
+        )
+        return eng.run([(p, 6) for p in prompts]), eng.stats
+
+    outs_noop, st = run(top_k=V, top_p=1.0)
+    assert st["mega_filtered_rounds"] == 0, st
+    assert st["mega_fallback_steps"] == 0, st
+    outs_plain, _ = run(top_k=0, top_p=1.0)
+    for a, b in zip(outs_noop, outs_plain):
+        np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.slow
+def test_resident_drain_fault_parks_inflight_launch(ctx1):
+    """A drain that raises mid-resident-round must reach the step guard
+    with the just-issued NEXT launch already parked in ``_pend`` — so
+    ``_abort_pend`` blocks on it before teardown frees pages it still
+    reads (the pre-fix ordering drained first and orphaned the launch).
+    The engine stays reusable and bit-exact afterwards."""
+    from triton_distributed_tpu.models.continuous import ContinuousEngine
+    from triton_distributed_tpu.runtime.faults import FaultPlan
+
+    model = AutoLLM.from_pretrained("tiny", ctx=ctx1)
+    prompts = [np.asarray([5, 9, 2, 4], np.int32),
+               np.asarray([7, 1, 3, 8, 6, 2, 4, 9], np.int32)]
+    golds = [
+        Engine(model, temperature=0.0).serve(p[None], gen_len=6)[0, len(p):]
+        for p in prompts
+    ]
+    eng = ContinuousEngine(
+        model, max_batch=2, page_size=16, max_length=64, mode="mega",
+        resident=True, ns=2,
+    )
+    # Spy on the drain entry: on pipelined rounds the next launch must
+    # already be owned by ``_pend`` when the (possibly raising) drain
+    # begins.
+    parked, orig = [], eng._drain_launch
+    eng._drain_launch = lambda pend: (
+        parked.append(eng._pend is not None), orig(pend)
+    )[1]
+    with FaultPlan().on("engine.mega_drain", at=1):
+        results = eng.run([(p, 6) for p in prompts], results=True)
+    assert parked and parked[0], parked
+    assert all(r.status == "failed" for r in results)
+    assert all("injected" in r.reason for r in results)
+    assert eng._pend is None  # the guard's _abort_pend reclaimed it
+    assert eng.last_stats["decode_faults"] == 1
+    assert eng.audit() == []
+    eng._drain_launch = orig
+    outs = eng.run([(p, 6) for p in prompts])
+    for got, gold in zip(outs, golds):
+        np.testing.assert_array_equal(got, np.asarray(gold))

@@ -62,13 +62,18 @@ class WorkRing:
     """Bounded host→device work queue with a monotonic doorbell.
 
     ``push`` appends an item at ``tail``; ``publish`` bumps the
-    doorbell and returns the ``[doorbell, head, tail, occupancy]``
-    int32 snapshot a round's kernel prefetches; ``consume`` retires
-    everything the published round covered (round-boundary consumption
-    — the interpret-mode stand-in for the device scheduler draining
-    the ring mid-loop). The ring never silently drops work: pushing
-    into a full ring raises, because a lost admit/retire item would
-    desynchronize the device scheduler from the engine's slot state.
+    doorbell, snapshots ``tail``, and returns the ``[doorbell, head,
+    tail, occupancy]`` int32 snapshot a round's kernel prefetches;
+    ``consume`` retires exactly what the published round covered —
+    items pushed AFTER the publish stay host-owned until the next
+    doorbell (round-boundary consumption — the interpret-mode stand-in
+    for the device scheduler draining the ring mid-loop). ``flush`` is
+    the single-step-fallback escape hatch: rounds that cannot launch
+    fused apply slot state on the host directly, so the device loop
+    never observes their items — they drain here, doorbell untouched.
+    The ring never silently drops work: pushing into a full ring
+    raises, because a lost admit/retire item would desynchronize the
+    device scheduler from the engine's slot state.
     """
 
     def __init__(self, capacity: int = 64):
@@ -80,6 +85,7 @@ class WorkRing:
         self.tail = 0       # producer position (monotonic)
         self.doorbell = 0   # rounds published
         self._seq = 0       # items ever pushed
+        self._published_tail = 0  # tail at the last publish
         self.peak_occupancy = 0
 
     @property
@@ -105,8 +111,11 @@ class WorkRing:
     def publish(self) -> np.ndarray:
         """Ring the doorbell for one round; returns the ``[doorbell,
         head, tail, occupancy]`` int32 snapshot the round's kernel
-        prefetches (RING_POLL stamps snapshot[0] into its trace mid)."""
+        prefetches (RING_POLL stamps snapshot[0] into its trace mid).
+        The ``tail`` snapshot bounds the next ``consume`` — items
+        pushed after this publish belong to a future round."""
         self.doorbell += 1
+        self._published_tail = self.tail
         return np.asarray(
             [self.doorbell, self.head, self.tail, self.occupancy],
             np.int32,
@@ -114,8 +123,24 @@ class WorkRing:
 
     def consume(self) -> list[RingItem]:
         """Round-boundary drain: everything pushed before the last
-        publish is now owned by the device scheduler. Returns the
-        consumed items (oldest first) for accounting/tests."""
+        publish is now owned by the device scheduler. Items pushed
+        since that publish stay queued for the next doorbell. Returns
+        the consumed items (oldest first) for accounting/tests."""
+        items = []
+        while self.head < self._published_tail:
+            row = self.buf[self.head % self.capacity]
+            items.append(RingItem(*(int(v) for v in row)))
+            self.head += 1
+        return items
+
+    def flush(self) -> list[RingItem]:
+        """Host-side drain of EVERYTHING queued, published or not — the
+        doorbell does not move. Single-step fallback rounds call this:
+        they apply admit/retire/cancel directly through host slot
+        state, so the device loop never observes the queued items;
+        leaving them would overflow the ring on a workload that
+        persistently falls back. Returns the drained items."""
+        self._published_tail = self.tail
         items = []
         while self.head < self.tail:
             row = self.buf[self.head % self.capacity]

@@ -714,6 +714,7 @@ class ContinuousEngine(MegaDispatch):
             # rounds, and bucket-program launches.
             "mega_ring_items": 0,
             "mega_ring_doorbells": 0,
+            "mega_ring_host_drains": 0,
             "mega_device_retires": 0,
             "mega_resident_rounds": 0,
             "mega_bucket_launches": 0,
@@ -1051,6 +1052,17 @@ class ContinuousEngine(MegaDispatch):
         """One single-step decode of every active slot; appends sampled
         tokens and evicts finished requests. Returns whether slot state
         changed (caller decides when to re-admit/sync)."""
+        if self._ring is not None:
+            # Single-step fallback under a resident session: this round
+            # applies slot state directly on the host, so no device
+            # loop will ever observe the queued admit/retire/cancel
+            # items — drain them here (no doorbell) or a workload that
+            # persistently falls back (e.g. filtered sampling at
+            # tp > 1, or ns=1) overflows the ring and wedges every
+            # subsequent round.
+            flushed = self._ring.flush()
+            if flushed:
+                self._bump("mega_ring_host_drains", len(flushed))
         active = np.asarray([r is not None for r in self._slots], np.int32)
         if not active.any():
             return False
@@ -2023,13 +2035,18 @@ class ContinuousEngine(MegaDispatch):
             # Resident pipeline: issue the NEXT launch off the pending
             # one's device outputs FIRST (tok = its last token row,
             # halt chained, cache threaded — no host sync anywhere on
-            # that path), THEN drain the pending round's tokens.
-            nxt = self._issue_resident(self._pend)
-            changed = self._drain_pend()
+            # that path), THEN drain the pending round's tokens. The
+            # next launch is parked in ``_pend`` BEFORE the drain runs:
+            # a drain that raises (injected fault, non-finite logits,
+            # per-slot failure) reaches the step guard with the
+            # in-flight launch still owned, so ``_abort_pend`` blocks
+            # on it before teardown frees pages it still reads.
+            pend, self._pend = self._pend, None
+            nxt = self._issue_resident(pend)
             if nxt is not None:
                 self._pend = nxt
                 self._bump("mega_resident_rounds")
-            return changed
+            return self._drain_launch(pend)
         plan = self._mega_plan(active, kv_high)
         if plan is None:
             return None
@@ -2051,10 +2068,16 @@ class ContinuousEngine(MegaDispatch):
         tp1 = self.model.ctx.axis_size(self.model.axis) == 1
         act = [s for s in range(self.max_batch)
                if self._slots[s] is not None]
+        V = self.model.cfg.vocab_size
         filtered = False
         for slot in act:
             t, p, k = self._request_sampling(self._slots[slot])
-            if t > 0.0 and (k > 0 or p < 1.0):
+            # Same predicate as the per-row enable below: top-k >= V
+            # with top-p 1 is a no-op filter, not a filtered round —
+            # treating it as one forced a permanent single-step
+            # fallback at tp > 1 and a needless filtered program at
+            # tp == 1.
+            if t > 0.0 and (0 < k < V or p < 1.0):
                 # In-kernel top-k/top-p (kernels._filtered_winner's
                 # bisection) needs the full vocab row on one rank and
                 # a multi-step build; otherwise single-step fallback.
@@ -2074,7 +2097,6 @@ class ContinuousEngine(MegaDispatch):
         compact = B < self.max_batch
         rows = act + [-1] * (B - len(act)) if compact \
             else list(range(self.max_batch))
-        V = self.model.cfg.vocab_size
         temps = np.zeros(B, np.float32)
         # Kept-row counts: a slot finishing mid-launch (gen_len bound,
         # known NOW) emits guaranteed-overshoot rows — routed to the
@@ -2312,6 +2334,11 @@ class ContinuousEngine(MegaDispatch):
         re-tests tokens the kernel already tested — and the retire
         flows through ``_maybe_finish``'s standard ``_evict`` (pages →
         radix tree/pool exactly as before)."""
+        # Chaos seam: a drain that raises must reach the step guard
+        # with any just-issued resident launch parked in ``_pend``
+        # (tests/test_resident.py pins the no-orphan invariant).
+        fault_point("engine.mega_drain",
+                    launches=self.stats["mega_launches"])
         plan = pend.plan
         toks_np = np.asarray(pend.toks)  # [NS, B] — THE host sync
         ss_np = np.asarray(pend.ss) if pend.ss is not None else None
@@ -2569,6 +2596,14 @@ class ContinuousEngine(MegaDispatch):
             # Block on (and discard) any in-flight resident launch
             # BEFORE teardown reuses the state it reads.
             self._abort_pend()
+            if self._ring is not None:
+                # The resident session ends with the batch: items still
+                # queued (the final retires, teardown cancels) have no
+                # future doorbell to ride — drain them host-side so the
+                # ring is empty at rest.
+                flushed = self._ring.flush()
+                if flushed:
+                    self._bump("mega_ring_host_drains", len(flushed))
             # Crash-safe teardown: NO exit path — injected fault,
             # engine bug, KeyboardInterrupt — leaves a slot holding
             # pages, a dangling tree pin, or a stale device table; the

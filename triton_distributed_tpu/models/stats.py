@@ -126,6 +126,11 @@ STAT_METRICS = {
     "mega_ring_doorbells": ("tdt_mega_ring_doorbells_total",
                             "Work-ring doorbell publishes (one per "
                             "resident round)."),
+    "mega_ring_host_drains": ("tdt_mega_ring_host_drains_total",
+                              "Work-ring items drained host-side "
+                              "(single-step fallback rounds, batch "
+                              "teardown) — no device loop observed "
+                              "them."),
     "mega_device_retires": ("tdt_mega_device_retires_total",
                             "Slots retired by the in-kernel stop-token "
                             "test (no host round trip)."),

@@ -18,6 +18,10 @@ Seams currently instrumented (grep for ``fault_point``/``mutate_point``):
 ``engine.decode``  ``ContinuousEngine._decode_once`` — decode-step
                    exceptions (attributable via ``slot=``)
 ``engine.logits``  decode logits mutation hook — NaN/Inf injection
+``engine.mega_drain``  ``ContinuousEngine._drain_launch`` — a mega
+                   drain that raises mid-resident-round (proves the
+                   just-issued next launch is parked in ``_pend`` for
+                   the guard's ``_abort_pend``, never orphaned)
 ``spec.verify``    ``speculative.spec_verify_slot`` — verify failures
 ``server.recv``    ``ModelServer._serve_lines`` read side — socket
                    drops / slow clients (``delay=``)
