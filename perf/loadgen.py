@@ -84,6 +84,16 @@ class LoadSpec:
     class_mix: tuple = ()
     agentic_motif: int = 6
     agentic_repeats: int = 3
+    # The class name ``"document"`` is special too: those requests
+    # become long-context document jobs — the shared prefix plus a
+    # unique body of ``doc_min``..``doc_max`` tokens (10k+ by default;
+    # tests scale the knobs down), the workload the long-context
+    # serving path (cp prefill, sharded slots — docs/serving.md
+    # "Long-context serving") exists for. Body draws land AFTER the
+    # agentic motif draws, so mixes without "document" (and all
+    # pre-mix specs) keep bit-identical traces.
+    doc_min: int = 10240
+    doc_max: int = 16384
     seed: int = 0
 
 
@@ -183,6 +193,18 @@ def generate_trace(spec: LoadSpec) -> list[dict]:
                 row["prompt"] = (
                     prefixes[pi] + motifs[pi] * spec.agentic_repeats
                 )
+        if "document" in names:
+            # Long-context document class: shared prefix + a unique
+            # 10k+-token body (row order, after the agentic draws —
+            # the same stream-compatibility contract as above).
+            for row in trace:
+                if row["slo_class"] != "document":
+                    continue
+                body_len = int(rng.integers(spec.doc_min,
+                                            spec.doc_max + 1))
+                body = rng.integers(1, spec.vocab,
+                                    size=body_len).tolist()
+                row["prompt"] = prefixes[row["prefix_id"]] + body
     return trace
 
 
@@ -291,3 +313,79 @@ def replay(trace: list[dict], host: str, port: int, *,
         th.join(timeout)
     return [r if r is not None else {"error": "driver timed out"}
             for r in records]
+
+
+def parse_classes(text: str) -> tuple:
+    """``"interactive:4,document:1"`` → ``((name, weight), ...)`` —
+    the ``class_mix`` wire format of the CLI (a bare name means
+    weight 1)."""
+    mix: list[tuple[str, float]] = []
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            name, w = part.rsplit(":", 1)
+            mix.append((name.strip(), float(w)))
+        else:
+            mix.append((part, 1.0))
+    return tuple(mix)
+
+
+def main(argv=None) -> int:
+    """Generate a trace to JSONL (round-trip-verified) and print a
+    per-class summary — the record-once half of cross-PR replay."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="Seeded production-shaped trace generator "
+        "(perf/loadgen.py). Writes JSONL replayable with replay().",
+    )
+    p.add_argument("--out", required=True, help="JSONL trace path")
+    p.add_argument("--rate", type=float, default=4.0)
+    p.add_argument("--n", type=int, default=32,
+                   help="number of requests")
+    p.add_argument("--process", choices=("poisson", "bursty"),
+                   default="poisson")
+    p.add_argument("--burst-size", type=int, default=4)
+    p.add_argument(
+        "--classes", default="",
+        help="SLO class mix, e.g. 'interactive:4,document:1' "
+        "(weights optional). 'agentic' requests become repetitive "
+        "re-ask continuations; 'document' requests become "
+        "long-context jobs (--doc-min/--doc-max body tokens).",
+    )
+    p.add_argument("--doc-min", type=int, default=10240)
+    p.add_argument("--doc-max", type=int, default=16384)
+    p.add_argument("--cancel-frac", type=float, default=0.0)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+    spec = LoadSpec(
+        rate=args.rate, n_requests=args.n, process=args.process,
+        burst_size=args.burst_size, cancel_frac=args.cancel_frac,
+        class_mix=parse_classes(args.classes),
+        doc_min=args.doc_min, doc_max=args.doc_max, seed=args.seed,
+    )
+    trace = generate_trace(spec)
+    save_trace(args.out, trace, spec)
+    back, _spec_d = load_trace(args.out)
+    if back != trace:
+        raise SystemExit(
+            f"JSONL round-trip mismatch for {args.out} — trace is not "
+            "replay-safe"
+        )
+    by_class: dict[str, list[int]] = {}
+    for row in trace:
+        by_class.setdefault(row["slo_class"], []).append(
+            len(row["prompt"])
+        )
+    print(f"{len(trace)} requests -> {args.out}")
+    for name in sorted(by_class):
+        lens = by_class[name]
+        print(f"  {name}: {len(lens)} reqs, prompt tokens "
+              f"{min(lens)}..{max(lens)}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

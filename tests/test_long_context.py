@@ -1,0 +1,442 @@
+"""Long-context serving coverage (ISSUE-20).
+
+What's covered, and why tier-1:
+
+- context-parallel chunked prefill (``cp=``): bit-exact with the cp=1
+  reference on the tiny model, the split-phase KV-exchange tracer
+  records a gap-free ring (``validate_cp_ring``), and the overlap
+  report carries the measured hidden fraction — a scheduling
+  regression (exchange serialized after attention, or a dropped
+  block) has to FAIL tier-1, not wait for a long_context_bench run.
+- sharded-slot paged decode (``rank_page_budget=``): a slot whose KV
+  exceeds the per-rank budget demotes cold pages to the KV tier and
+  decodes through the lse_combine partial merge — greedy tokens stay
+  bit-exact with a big-pool reference, tier faults are observed, and
+  the pool/radix/tier audit stays clean (the conftest autouse fixture
+  re-audits after every test).
+- sharded snapshot → wire → import (the gather-stitch codec): a
+  migrated sharded slot resumes on a PLAIN engine bit-exact with the
+  uninterrupted run — the ROADMAP item 1 sharded-migration seam.
+- ctor validation: ``max_length % page_size`` at BOTH engines, and
+  the cp/rank_page_budget knob guards, each naming its values.
+- interpret-mode parity for the kernels the tentpole builds on:
+  ``ring_attention`` and ``distributed_flash_decode_2level`` vs dense
+  references in bf16, and the 2-level decode over int8 shards with
+  per-chunk scales (the ISSUE-20 satellite closing the "serving
+  depends on unexercised kernels" gap).
+- the ``document`` loadgen class: same-seed-identical, rng-stream
+  compatible with mix-less specs (the cross-PR trace-identity
+  contract), JSONL round-trip, and the ``--classes`` wire format.
+- CLI refusals: ``--cp``/``--rank-page-budget`` fail fast BY FLAG
+  NAME on incompatible paths (stub engine, mega mode, no tier)
+  before any model loads.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu.models import AutoLLM
+from triton_distributed_tpu.runtime import mesh as mesh_mod
+
+
+@pytest.fixture(scope="module")
+def lc_model():
+    """ONE tiny model on a tp=4 mesh for the whole module (the
+    test_migration.py rationale: jit caches live on the model, so
+    every engine in the file shares one compile). tp=4 exercises the
+    sharded decode/prefill programs' real in_specs."""
+    ctx = mesh_mod.initialize_distributed(
+        tp=4, devices=jax.devices()[:4]
+    )
+    model = AutoLLM.from_pretrained("tiny", ctx=ctx)
+    yield model
+    mesh_mod.finalize_distributed()
+
+
+def make_engine(model, **kw):
+    from triton_distributed_tpu.models.continuous import ContinuousEngine
+
+    kw.setdefault("max_batch", 1)
+    kw.setdefault("page_size", 16)
+    kw.setdefault("max_length", 256)
+    return ContinuousEngine(model, **kw)
+
+
+PROMPT_CP = np.random.default_rng(7).integers(
+    1, 200, size=100
+).astype(np.int32)
+PROMPT_LONG = np.random.default_rng(8).integers(
+    1, 200, size=120
+).astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# ctor validation
+
+
+def test_max_length_page_size_validation(lc_model):
+    """A misaligned (max_length, page_size) pair must refuse at
+    construction NAMING BOTH VALUES — before it, ``pps`` silently
+    truncated and the tail tokens had no page."""
+    from triton_distributed_tpu.models.continuous import ContinuousEngine
+    from triton_distributed_tpu.models.engine import Engine
+
+    with pytest.raises(ValueError, match=r"100.*not a multiple.*16"):
+        ContinuousEngine(
+            lc_model, max_batch=1, page_size=16, max_length=100
+        )
+    # Engine validates against the model's cfg.max_length (128 for
+    # tiny) — 48 does not divide it.
+    with pytest.raises(ValueError, match=r"max_length=128.*page_size=48"):
+        Engine(lc_model, paged=True, page_size=48)
+    with pytest.raises(ValueError, match=r"max_length.*page_size"):
+        Engine(lc_model, paged=True, page_size=16).serve(
+            [np.arange(1, 9, dtype=np.int32)], gen_len=1, max_length=100
+        )
+
+
+def test_longctx_knob_validation(lc_model):
+    """cp/rank_page_budget guard rails, each refusing with the value
+    it saw (docs/serving.md "Long-context serving")."""
+    with pytest.raises(ValueError, match="cp must be >= 1"):
+        make_engine(lc_model, cp=0)
+    with pytest.raises(ValueError, match="prefix_cache"):
+        make_engine(lc_model, cp=2)
+    with pytest.raises(ValueError, match="chunked xla/pallas"):
+        make_engine(lc_model, cp=2, prefix_cache=True, speculative=2)
+    with pytest.raises(ValueError, match="not a multiple"):
+        make_engine(lc_model, rank_page_budget=40, tier_bytes=1 << 20)
+    with pytest.raises(ValueError, match=">= 2 pages"):
+        make_engine(lc_model, rank_page_budget=16, tier_bytes=1 << 20)
+    with pytest.raises(ValueError, match="requires a KV tier"):
+        make_engine(lc_model, rank_page_budget=64)
+    with pytest.raises(ValueError, match="xla/pallas decode"):
+        make_engine(
+            lc_model, rank_page_budget=64, tier_bytes=1 << 20,
+            speculative=2,
+        )
+
+
+# ---------------------------------------------------------------------------
+# context-parallel prefill
+
+
+def test_cp_prefill_bit_exact(lc_model):
+    """cp=2 prefill == cp=1 reference token-for-token; the exchange
+    tracer shows a gap-free ring and a well-formed overlap report."""
+    from triton_distributed_tpu.models import long_context as lc
+
+    gold = make_engine(lc_model, prefix_cache=True).run(
+        [(PROMPT_CP, 4)]
+    )[0]
+    eng = make_engine(lc_model, prefix_cache=True, cp=2)
+    got = eng.run([(PROMPT_CP, 4)])[0]
+    np.testing.assert_array_equal(got, gold)
+
+    rep = lc.cp_overlap_report(eng.cp_tracer)
+    assert rep["blocks"] > 0 and rep["exchanges"] > 0
+    assert rep["exchange_bytes"] > 0
+    assert 0.0 <= rep["hidden_fraction"] <= 1.0
+    assert lc.validate_cp_ring(eng.cp_tracer, rep["blocks"], 2) == []
+    assert eng.last_stats["cp_prefills"] == 1
+    assert eng.last_stats["cp_blocks"] == rep["blocks"]
+    assert eng.last_stats["cp_exchange_bytes"] == rep["exchange_bytes"]
+    assert eng.audit() == []
+
+
+def test_cp_metrics_pretouched(lc_model):
+    """Every tdt_cp_*/tdt_longctx_* counter exists at 0 on a COLD
+    engine (the PR 15/18 pre-touch pattern): a fleet scrape sees the
+    full catalog before the first long request arrives."""
+    from triton_distributed_tpu import obs
+    from triton_distributed_tpu.obs import metrics as obs_metrics
+
+    prev = obs.is_enabled()
+    obs.set_enabled(True)
+    obs_metrics.default_registry().clear()
+    try:
+        make_engine(lc_model, prefix_cache=True)
+        names = set(obs_metrics.default_registry().snapshot())
+        for stem in (
+            "cp_prefills", "cp_blocks", "cp_exchange_bytes",
+            "cp_exchange_us", "cp_hidden_us",
+            "longctx_sharded_slots", "longctx_demoted_pages",
+            "longctx_tier_faults", "longctx_tier_bytes",
+            "longctx_decode_steps",
+        ):
+            assert f"tdt_{stem}_total" in names, stem
+    finally:
+        obs_metrics.default_registry().clear()
+        obs.set_enabled(prev)
+
+
+# ---------------------------------------------------------------------------
+# sharded-slot decode + tier-backed paging
+
+
+def test_sharded_slot_decode_parity(lc_model):
+    """A slot whose KV exceeds rank_page_budget demotes cold pages to
+    the tier, faults them back per decode step, and still matches the
+    big-pool reference token-for-token with a clean audit."""
+    gold = make_engine(lc_model).run([(PROMPT_LONG, 6)])[0]
+    eng = make_engine(
+        lc_model, rank_page_budget=64, tier_bytes=32 << 20, num_pages=6,
+    )
+    got = eng.run([(PROMPT_LONG, 6)])[0]
+    np.testing.assert_array_equal(got, gold)
+    assert eng.last_stats["longctx_sharded_slots"] == 1
+    assert eng.last_stats["longctx_demoted_pages"] > 0
+    assert eng.last_stats["longctx_tier_faults"] > 0
+    assert eng.last_stats["longctx_decode_steps"] >= 5
+    assert eng.audit() == []
+
+
+def test_sharded_snapshot_roundtrip(lc_model):
+    """Sharded slot → handoff → import into a PLAIN engine resumes
+    bit-exact (the gather-stitch codec re-materializes cold pages from
+    the tier into one absolute-order snapshot)."""
+    from triton_distributed_tpu.models.continuous import Request
+
+    gold = make_engine(lc_model).run([(PROMPT_LONG, 6)])[0]
+    A = make_engine(
+        lc_model, rank_page_budget=64, tier_bytes=32 << 20, num_pages=6,
+    )
+    A.request_handoff(after_rounds=3)
+    r = A.run([(PROMPT_LONG, 6)], results=True)[0]
+    assert r.status == "migrated" and r.snapshot is not None
+    assert A.audit() == []
+    B = make_engine(lc_model)
+    out = B.run(
+        [Request(PROMPT_LONG, 6, snapshot=r.snapshot)], results=True
+    )[0]
+    np.testing.assert_array_equal(out.tokens, gold)
+    assert B.last_stats["migrated_in"] == 1
+    assert B.audit() == [] and A.audit() == []
+
+
+# ---------------------------------------------------------------------------
+# kernel parity (the ops the tentpole builds on), bf16 + int8
+
+
+def test_ring_attention_bf16(ctx4, rng):
+    """Causal ring attention in bf16 vs the dense causal reference —
+    the cp-prefill kernel substrate at serving's own dtype."""
+    from triton_distributed_tpu.ops.attention import (
+        mha_reference,
+        ring_attention,
+    )
+
+    s, hq, hkv, hd = 256, 4, 2, 64
+    q = jnp.asarray(rng.standard_normal((hq, s, hd)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((hkv, s, hd)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((hkv, s, hd)), jnp.bfloat16)
+    f = ctx4.shard_map(
+        functools.partial(
+            ring_attention, axis="tp", causal=True, block_q=64,
+            block_k=64,
+        ),
+        in_specs=(P(None, "tp", None),) * 3,
+        out_specs=P(None, "tp", None),
+    )
+    out = f(q, k, v)
+    assert out.dtype == jnp.bfloat16
+    ref = mha_reference(
+        q[None].astype(jnp.float32), k[None].astype(jnp.float32),
+        v[None].astype(jnp.float32), causal=True,
+    )[0]
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), atol=5e-2,
+        rtol=5e-2,
+    )
+
+
+def test_distributed_flash_decode_2level_bf16(ctx2x4, rng):
+    """Two-level (DCN×ICI) decode merge in bf16 vs the dense golden —
+    the sharded-slot decode substrate at serving's own dtype."""
+    from triton_distributed_tpu.ops.attention import (
+        distributed_flash_decode_2level,
+        gqa_decode_reference,
+    )
+
+    b, hq, hkv, s, hd = 2, 4, 2, 256, 64
+    q = jnp.asarray(rng.standard_normal((b, hq, hd)), jnp.bfloat16)
+    kc = jnp.asarray(rng.standard_normal((b, hkv, s, hd)), jnp.bfloat16)
+    vc = jnp.asarray(rng.standard_normal((b, hkv, s, hd)), jnp.bfloat16)
+    lens = jnp.asarray([200, 37], jnp.int32)
+    f = ctx2x4.shard_map(
+        functools.partial(
+            distributed_flash_decode_2level, inner_axis="tp",
+            outer_axis="dp", chunk_k=32, method="xla", ctx=ctx2x4,
+        ),
+        in_specs=(P(), P(None, None, ("dp", "tp"), None),
+                  P(None, None, ("dp", "tp"), None), P()),
+        out_specs=P(),
+    )
+    out = f(q, kc, vc, lens)
+    assert out.dtype == jnp.bfloat16
+    ref = gqa_decode_reference(
+        q.astype(jnp.float32), kc.astype(jnp.float32),
+        vc.astype(jnp.float32), lens,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), atol=5e-2,
+        rtol=5e-2,
+    )
+
+
+def test_distributed_flash_decode_2level_int8(ctx2x4, rng):
+    """Two-level decode over int8 shards with per-chunk scales: each
+    rank dequantizes in-kernel, the (O, LSE) combine is unchanged —
+    the layout a quantized sharded slot streams through."""
+    from triton_distributed_tpu.models.paged_kv_cache import quantize_pages
+    from triton_distributed_tpu.ops.attention import (
+        distributed_flash_decode_2level,
+        gqa_decode_reference,
+    )
+
+    b, hq, hkv, s, hd, chunk = 2, 4, 2, 256, 64, 32
+    q = jnp.asarray(rng.standard_normal((b, hq, hd)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, hkv, s, hd)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, hkv, s, hd)), jnp.float32)
+    lens = jnp.asarray([180, 47], jnp.int32)
+    k_q, k_sc = quantize_pages(k.reshape(b, hkv, s // chunk, chunk, hd))
+    v_q, v_sc = quantize_pages(v.reshape(b, hkv, s // chunk, chunk, hd))
+    def shard_fn(q, k, v, lens, ks, vs):
+        return distributed_flash_decode_2level(
+            q, k, v, lens, inner_axis="tp", outer_axis="dp",
+            chunk_k=chunk, method="xla", k_scale=ks, v_scale=vs,
+            ctx=ctx2x4,
+        )
+
+    f = ctx2x4.shard_map(
+        shard_fn,
+        in_specs=(P(), P(None, None, ("dp", "tp"), None),
+                  P(None, None, ("dp", "tp"), None), P(),
+                  P(None, None, ("dp", "tp")),
+                  P(None, None, ("dp", "tp"))),
+        out_specs=P(),
+    )
+    out = f(
+        q, k_q.reshape(b, hkv, s, hd), v_q.reshape(b, hkv, s, hd),
+        lens, k_sc, v_sc,
+    )
+    ref = gqa_decode_reference(q, k, v, lens)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=0.1, rtol=0.1
+    )
+
+
+# ---------------------------------------------------------------------------
+# document loadgen class
+
+
+def _doc_spec(**kw):
+    import perf.loadgen as lg
+
+    kw.setdefault("n_requests", 12)
+    kw.setdefault("seed", 3)
+    kw.setdefault("doc_min", 64)
+    kw.setdefault("doc_max", 96)
+    return lg.LoadSpec(**kw)
+
+
+def test_document_class_draws():
+    """The document class lands 10k-scale bodies (shrunk here) on its
+    rows only, deterministically per seed."""
+    import perf.loadgen as lg
+
+    spec = _doc_spec(
+        class_mix=(("interactive", 2.0), ("document", 1.0))
+    )
+    a = lg.generate_trace(spec)
+    b = lg.generate_trace(spec)
+    assert a == b  # same-seed-identical
+    docs = [r for r in a if r["slo_class"] == "document"]
+    rest = [r for r in a if r["slo_class"] != "document"]
+    assert docs and rest
+    for r in docs:
+        assert len(r["prompt"]) >= spec.prefix_len + spec.doc_min
+    for r in rest:
+        assert len(r["prompt"]) <= spec.prefix_len + spec.suffix_max
+
+
+def test_document_class_stream_compatible():
+    """The rng-stream contract: document draws land strictly AFTER all
+    pre-existing draws, so a mix WITHOUT the class consumes the stream
+    exactly as before — and the doc knobs are inert on such specs."""
+    import perf.loadgen as lg
+
+    base = _doc_spec(class_mix=(("interactive", 1.0),))
+    tweaked = _doc_spec(
+        class_mix=(("interactive", 1.0),), doc_min=100, doc_max=200
+    )
+    assert lg.generate_trace(base) == lg.generate_trace(tweaked)
+    # Adding the document class changes only class labels and the
+    # relabeled rows' prompts — arrivals and gen_lens are upstream
+    # draws and stay identical.
+    mixed = lg.generate_trace(
+        _doc_spec(class_mix=(("interactive", 1.0), ("document", 1.0)))
+    )
+    plain = lg.generate_trace(base)
+    assert [r["t"] for r in mixed] == [r["t"] for r in plain]
+    assert [r["gen_len"] for r in mixed] == [r["gen_len"] for r in plain]
+
+
+def test_document_class_jsonl_roundtrip(tmp_path):
+    """save_trace → load_trace is lossless for document rows, and
+    parse_classes speaks the CLI wire format."""
+    import perf.loadgen as lg
+
+    assert lg.parse_classes("interactive:4,document:1") == (
+        ("interactive", 4.0), ("document", 1.0),
+    )
+    assert lg.parse_classes("document") == (("document", 1.0),)
+    assert lg.parse_classes("") == ()
+    spec = _doc_spec(
+        class_mix=(("interactive", 1.0), ("document", 1.0))
+    )
+    trace = lg.generate_trace(spec)
+    path = str(tmp_path / "doc.jsonl")
+    lg.save_trace(path, trace, spec)
+    back, spec_dict = lg.load_trace(path)
+    assert back == trace
+    assert spec_dict["doc_min"] == spec.doc_min
+    assert tuple(map(tuple, spec_dict["class_mix"])) == spec.class_mix
+
+
+# ---------------------------------------------------------------------------
+# CLI refusals
+
+
+def test_cli_cp_refusals(capsys):
+    """--cp/--rank-page-budget refuse BY FLAG NAME on incompatible
+    paths (exit 2, before any model loads) in both CLIs."""
+    from perf import serve_demo
+    from triton_distributed_tpu.serving import run_server
+
+    cases = [
+        (run_server.main, ["--cp", "2", "--model", "stub",
+                           "--continuous"], "stub"),
+        (run_server.main, ["--cp", "2", "--mode", "mega",
+                           "--continuous"], "--mode mega"),
+        (run_server.main, ["--rank-page-budget", "64", "--continuous"],
+         "--tier-bytes"),
+        (run_server.main, ["--cp", "2"], "--continuous"),
+        (serve_demo.main, ["--cp", "2"], "--mode"),
+        (serve_demo.main, ["--cp", "2", "--stream", "--mode", "xla",
+                           "--model", "stub"], "stub"),
+        (serve_demo.main, ["--rank-page-budget", "64", "--replicas",
+                           "2", "--mode", "xla"], "--tier-bytes"),
+    ]
+    for main, argv, needle in cases:
+        with pytest.raises(SystemExit) as exc:
+            main(argv)
+        assert exc.value.code == 2, argv
+        err = capsys.readouterr().err
+        assert "--cp" in err or "--rank-page-budget" in err, argv
+        assert needle in err, (argv, err)

@@ -250,6 +250,19 @@ class Engine(MegaDispatch):
         # through the table, decode attends the pool directly.
         self.paged = paged
         self.page_size = page_size
+        # Paged geometry must tile exactly: a ragged final page would
+        # make every ceil-divide table bound (``pps``, ``gather_bucket``
+        # widths, per-slot page counts) silently disagree with the
+        # device cache shape. Checked after the knob-composition
+        # refusals below — a caller holding two mistakes should hear
+        # about the flag conflict before the geometry.
+        geometry_error = None
+        if paged and model.cfg.max_length % page_size != 0:
+            geometry_error = (
+                f"max_length={model.cfg.max_length} is not a multiple "
+                f"of page_size={page_size}; paged serving needs the "
+                "context to tile into whole pages"
+            )
         # Quantized KV storage (docs/serving.md "Quantized KV cache"):
         # int8 pool + per-page-per-head scales, dequantized inside the
         # attention kernels. The explicit knob wins over the model
@@ -294,6 +307,8 @@ class Engine(MegaDispatch):
                     "speculative=K composes with mode='xla'/'pallas', "
                     "not the megakernel"
                 )
+        if geometry_error:
+            raise ValueError(geometry_error)
         self.speculative = int(speculative)
         # Tree speculation (docs/serving.md "Speculative decoding"):
         # multi-branch draft tries verified in one chunk forward.
@@ -411,6 +426,12 @@ class Engine(MegaDispatch):
                 f"{starts.tolist()}"
             )
         max_length = max_length or self.model.cfg.max_length
+        if self.paged and max_length % self.page_size != 0:
+            raise ValueError(
+                f"max_length={max_length} is not a multiple of "
+                f"page_size={self.page_size}; paged serving needs the "
+                "context to tile into whole pages"
+            )
 
         # Batched prefill (one jitted program for all rows — the
         # reference engine loops rows from host, engine.py:113). Client

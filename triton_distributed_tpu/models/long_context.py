@@ -1,0 +1,271 @@
+"""Context-parallel prefill plumbing (docs/serving.md "Long-context
+serving").
+
+A cp>1 prefill shards ONE request's prompt over ``cp`` virtual ranks:
+chunk (block) ``i`` belongs to rank ``i % cp``, and the KV a block just
+wrote must reach the next block's rank before that rank can extend the
+context — the ring-attention dataflow
+(``ops/attention/ring_attention.py``), driven at serving granularity.
+On this host-emulated mesh every rank computes on the same devices, so
+the blocks still execute in program order through the SAME
+``prefill_paged_chunk`` call sequence a cp=1 prefill runs — cp>1 logits
+are bit-exact with cp=1 **by construction** — and what cp adds is the
+EXCHANGE schedule: after block i's program is dispatched and its pages
+are gathered, the staging of those bytes toward rank ``(i+1) % cp``
+runs on a background thread while the main thread blocks on block
+i+1's attention compute. That is the split-phase discipline the AR/A2A
+kernels use (fire the send for tile i+1 under tile i's GEMM,
+``AR_SEND``/``AR_WAIT``); here the windows are host-stamped
+(``time.perf_counter_ns``) around genuinely concurrent work — the
+staging thread runs NumPy materialize/copy/checksum (GIL-released C
+loops) while the main thread sits in ``block_until_ready`` — so the
+tracer's ``hidden_fraction`` is a measurement, not an assertion.
+
+The tracer mirrors the device-side AR_SEND/AR_WAIT taxonomy:
+
+- ``CP_ATTN``  — block i's chunk program, dispatch → blocked-ready;
+- ``CP_SEND``  — block i's KV bytes staged toward rank (i+1) % cp
+  (device gather → host materialize → staging copy → crc32);
+- ``CP_WAIT``  — the receiving block joining the stage thread (the
+  exposed, un-hidden remainder of the exchange).
+
+``validate_cp_ring`` checks the schedule the way the collective tests
+check a ring: every non-final block exchanged exactly once to its
+successor rank, sends paired with waits, per-rank attention windows
+monotone — a gap or a duplicate is a bug report, not a perf footnote.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+import zlib
+
+import numpy as np
+
+CP_ATTN = "CP_ATTN"
+CP_SEND = "CP_SEND"
+CP_WAIT = "CP_WAIT"
+
+
+def cp_block_rank(block: int, cp: int) -> int:
+    """The virtual rank owning prefill block ``block`` (round-robin —
+    contiguous ranges would idle rank 0 for the whole tail of a long
+    prompt; round-robin keeps every rank's compute interleaved, the
+    layout ring attention assumes)."""
+    return int(block) % max(int(cp), 1)
+
+
+@dataclasses.dataclass(frozen=True)
+class CPWindow:
+    """One stamped interval of the cp prefill schedule.
+
+    ``block`` is the prefill chunk index; ``src``/``dst`` the virtual
+    ranks (for ``CP_ATTN`` both are the computing rank); ``t0``/``t1``
+    are ``time.perf_counter_ns`` stamps; ``nbytes`` the staged payload
+    (sends only)."""
+
+    kind: str
+    block: int
+    src: int
+    dst: int
+    t0: int
+    t1: int
+    nbytes: int = 0
+
+    @property
+    def dur_ns(self) -> int:
+        return max(int(self.t1) - int(self.t0), 0)
+
+
+class CPTracer:
+    """Append-only window log for one (or more) cp prefills.
+
+    Thread-safe: the staging thread records ``CP_SEND`` windows while
+    the main thread records ``CP_ATTN``/``CP_WAIT``."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.windows: list[CPWindow] = []
+
+    def record(self, kind: str, block: int, src: int, dst: int,
+               t0: int, t1: int, nbytes: int = 0) -> CPWindow:
+        w = CPWindow(kind=kind, block=int(block), src=int(src),
+                     dst=int(dst), t0=int(t0), t1=int(t1),
+                     nbytes=int(nbytes))
+        with self._lock:
+            self.windows.append(w)
+        return w
+
+    def by_kind(self, kind: str) -> list[CPWindow]:
+        with self._lock:
+            return [w for w in self.windows if w.kind == kind]
+
+
+class SplitPhaseExchange:
+    """Stage each block's KV toward its successor rank under the next
+    block's attention.
+
+    ``dispatch(block, arrays, ...)`` takes device arrays whose gather
+    is ALREADY enqueued (the caller must dispatch the ``jnp.take``
+    before the next chunk program donates the cache — enqueue order is
+    what keeps the read ahead of the donation) and hands them to a
+    worker thread that materializes them host-side, copies them into a
+    staging buffer, and checksums the bytes — the host half of a real
+    inter-rank send, all GIL-released, so it genuinely overlaps the
+    main thread's ``block_until_ready``. ``join(...)`` is the receive
+    barrier: it stamps the exposed ``CP_WAIT`` window."""
+
+    def __init__(self, tracer: CPTracer, cp: int) -> None:
+        self.tracer = tracer
+        self.cp = max(int(cp), 1)
+        self._pending: list[dict] = []
+        self.total_bytes = 0
+        self.checksums: dict[int, int] = {}
+
+    def dispatch(self, block: int, arrays) -> None:
+        src = cp_block_rank(block, self.cp)
+        dst = cp_block_rank(block + 1, self.cp)
+        entry = {"block": int(block), "src": src, "dst": dst}
+        th = threading.Thread(
+            target=self._stage, args=(entry, list(arrays)), daemon=True
+        )
+        entry["thread"] = th
+        self._pending.append(entry)
+        th.start()
+
+    def _stage(self, entry: dict, arrays) -> None:
+        t0 = time.perf_counter_ns()
+        crc = 0
+        nbytes = 0
+        staged = []
+        for a in arrays:
+            host = np.asarray(a)        # device → host materialize
+            buf = host.copy()           # staging copy (the TX buffer)
+            crc = zlib.crc32(buf.tobytes(), crc)
+            nbytes += buf.nbytes
+            staged.append(buf)
+        t1 = time.perf_counter_ns()
+        entry["staged"] = staged
+        entry["crc"] = crc
+        entry["nbytes"] = nbytes
+        self.tracer.record(CP_SEND, entry["block"], entry["src"],
+                           entry["dst"], t0, t1, nbytes)
+
+    def join_oldest(self):
+        """Barrier on the oldest in-flight exchange; stamps its
+        ``CP_WAIT`` window and returns the entry (or None)."""
+        if not self._pending:
+            return None
+        entry = self._pending.pop(0)
+        t0 = time.perf_counter_ns()
+        entry["thread"].join()
+        t1 = time.perf_counter_ns()
+        self.tracer.record(CP_WAIT, entry["block"], entry["src"],
+                           entry["dst"], t0, t1, entry["nbytes"])
+        self.total_bytes += entry["nbytes"]
+        self.checksums[entry["block"]] = entry["crc"]
+        return entry
+
+    def join_all(self) -> None:
+        while self._pending:
+            self.join_oldest()
+
+
+def _merge_intervals(ivals):
+    ivals = sorted((int(a), int(b)) for a, b in ivals if b > a)
+    out = []
+    for a, b in ivals:
+        if out and a <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], b))
+        else:
+            out.append((a, b))
+    return out
+
+
+def _overlap_ns(window: CPWindow, merged) -> int:
+    hid = 0
+    for a, b in merged:
+        hid += max(0, min(window.t1, b) - max(window.t0, a))
+    return hid
+
+
+def cp_overlap_report(tracer: CPTracer) -> dict:
+    """Fold a tracer's windows into the overlap accounting the bench
+    and the ``tdt_cp_*`` counters publish: how much of the exchange
+    flew UNDER attention compute.
+
+    ``hidden_fraction`` = (send time inside any ``CP_ATTN`` window) /
+    (total send time) — the same hidden/exposed split the A2A overlap
+    report uses. ``wait_ns`` is the exposed receive tail actually paid
+    by the critical path."""
+    attn = tracer.by_kind(CP_ATTN)
+    sends = tracer.by_kind(CP_SEND)
+    waits = tracer.by_kind(CP_WAIT)
+    merged = _merge_intervals((w.t0, w.t1) for w in attn)
+    send_ns = sum(w.dur_ns for w in sends)
+    hidden_ns = sum(_overlap_ns(w, merged) for w in sends)
+    return {
+        "blocks": len(attn),
+        "exchanges": len(sends),
+        "attn_ns": sum(w.dur_ns for w in attn),
+        "send_ns": send_ns,
+        "hidden_ns": hidden_ns,
+        "wait_ns": sum(w.dur_ns for w in waits),
+        "exchange_bytes": sum(w.nbytes for w in sends),
+        "hidden_fraction": (hidden_ns / send_ns) if send_ns else 0.0,
+    }
+
+
+def validate_cp_ring(tracer: CPTracer, n_blocks: int, cp: int) -> list[str]:
+    """Audit one cp prefill's schedule; empty list == gap-free ring.
+
+    Checks (the collective-test discipline, applied to the serving
+    schedule): every block ran exactly one ``CP_ATTN`` window; every
+    non-final block was exchanged exactly once, from its own rank to
+    its successor's; every send has a receive (``CP_WAIT``) that ends
+    no earlier than the send; per-rank attention windows are monotone
+    and non-overlapping (a rank never computes two blocks at once)."""
+    problems: list[str] = []
+    n_blocks = int(n_blocks)
+    cp = max(int(cp), 1)
+    attn = sorted(tracer.by_kind(CP_ATTN), key=lambda w: w.block)
+    sends = tracer.by_kind(CP_SEND)
+    waits = tracer.by_kind(CP_WAIT)
+    seen = [w.block for w in attn]
+    if seen != list(range(n_blocks)):
+        problems.append(f"attn blocks {seen} != 0..{n_blocks - 1}")
+    by_block: dict[int, list[CPWindow]] = {}
+    for w in sends:
+        by_block.setdefault(w.block, []).append(w)
+    for blk in range(n_blocks - 1):
+        got = by_block.pop(blk, [])
+        if len(got) != 1:
+            problems.append(
+                f"block {blk} exchanged {len(got)} times (want 1)")
+            continue
+        s = got[0]
+        want_src = cp_block_rank(blk, cp)
+        want_dst = cp_block_rank(blk + 1, cp)
+        if (s.src, s.dst) != (want_src, want_dst):
+            problems.append(
+                f"block {blk} sent {s.src}->{s.dst}, "
+                f"want {want_src}->{want_dst}")
+        wmatch = [w for w in waits if w.block == blk]
+        if len(wmatch) != 1:
+            problems.append(
+                f"block {blk} has {len(wmatch)} waits (want 1)")
+        elif wmatch[0].t1 < s.t1:
+            problems.append(
+                f"block {blk} wait ended before its send completed")
+    for blk in sorted(by_block):
+        problems.append(f"unexpected exchange for block {blk}")
+    for rank in range(cp):
+        mine = [w for w in attn if cp_block_rank(w.block, cp) == rank]
+        for prev, cur in zip(mine, mine[1:]):
+            if cur.t0 < prev.t1:
+                problems.append(
+                    f"rank {rank} attn windows overlap "
+                    f"(block {prev.block} vs {cur.block})")
+    return problems

@@ -29,8 +29,10 @@ from triton_distributed_tpu.layers.tp_attn import (
     TPAttnParams,
     tp_attn_decode,
     tp_attn_decode_paged,
+    tp_attn_decode_sharded,
     tp_attn_prefill,
     tp_attn_prefill_paged_chunk,
+    tp_attn_prefill_paged_chunk_cold,
 )
 from triton_distributed_tpu.layers.tp_mlp import TPMLPParams, tp_mlp_fwd
 from triton_distributed_tpu.models.config import ModelConfig
@@ -513,6 +515,205 @@ class Qwen3:
             jnp.asarray(slot, jnp.int32), jnp.asarray(q_offset, jnp.int32),
             jnp.asarray(new_len, jnp.int32), jnp.asarray(last_idx, jnp.int32),
             *tree_args,
+        )
+
+    # -- sharded long-context slot programs ------------------------------
+    #
+    # A slot whose KV exceeds the per-rank page budget splits into a
+    # RESIDENT paged window (local positions, its own explicit
+    # ``table_row`` — the slot's pages are not in the batched device
+    # table) and a COLD dense window of tier-demoted pages (pool dtype +
+    # per-page scales, read-only). Both programs merge the two attention
+    # partials with ``lse_combine`` — the distributed-flash-decode
+    # combine shape (docs/serving.md "Long-context serving").
+
+    def _prefill_chunk_cold_shard(
+        self, params, tokens, cache, k_cold, v_cold, ks_cold, vs_cold,
+        table_row, s_cold, q_offset, q_end, last_idx, *, mode: Mode,
+    ):
+        """Chunk-prefill a SHARDED slot, per-shard: like
+        :meth:`_prefill_chunk_shard` but the KV scatter lands at LOCAL
+        resident positions through the explicit ``table_row`` and the
+        attention adds the cold-window partial. The batched device
+        ``kv_len``/``page_table`` are untouched — a sharded slot is
+        invisible to the batched decode step."""
+        from triton_distributed_tpu.models.paged_kv_cache import PagedKVCache
+
+        cfg = self.cfg
+        x = self._embed(params, tokens)  # [C, d]
+        ar = "pallas_ar" if mode == "pallas" else "xla_ar"
+
+        def layer_fn(carry, inp):
+            x = carry
+            lp, kp, vp, ks, vs, kc, vc, ksc, vsc = inp
+            h = rms_norm(x, lp.ln1, cfg.rms_eps)
+            a, kp, vp, ks, vs = tp_attn_prefill_paged_chunk_cold(
+                lp.attn, h, kp, vp, table_row, kc, vc, s_cold, q_offset,
+                self.dims, axis=self.axis, mode=ar, ctx=self.ctx,
+                k_scale=ks, v_scale=vs, ks_cold=ksc, vs_cold=vsc,
+                q_end=q_end,
+            )
+            x = x + a
+            h = rms_norm(x, lp.ln2, cfg.rms_eps)
+            x = x + self._mlp_fwd(lp.mlp, h, ar)
+            return x, (kp, vp, ks, vs)
+
+        x, (k_new, v_new, ks_new, vs_new) = jax.lax.scan(
+            layer_fn, x,
+            (params.layers, cache.k_pages, cache.v_pages,
+             cache.k_scale, cache.v_scale, k_cold, v_cold,
+             ks_cold, vs_cold),
+        )
+        x = rms_norm(x, params.norm, cfg.rms_eps)
+        x_last = jnp.take(x, last_idx, axis=0)
+        logits = self._logits(params, x_last[None])[0]
+        return logits, PagedKVCache(
+            k_pages=k_new, v_pages=v_new, page_table=cache.page_table,
+            kv_len=cache.kv_len, k_scale=ks_new, v_scale=vs_new,
+        )
+
+    def prefill_paged_chunk_cold(
+        self,
+        tokens,          # [C] int32 — one (padded) suffix chunk
+        table_row,       # [budget_pages] int32 — the slot's resident row
+        q_offset: int,   # absolute chunk start
+        q_end: int,      # absolute end of REAL rows
+        last_idx: int,
+        cache,           # PagedKVCache
+        k_cold, v_cold,  # [L, Hkv, S_bucket, hd] pool-dtype cold window
+        ks_cold=None, vs_cold=None,  # [L, Hkv, S_bucket/page] f32
+        s_cold: int = 0,             # valid cold tokens (≤ S_bucket)
+        mode: Mode = "xla",
+    ):
+        """Jitted sharded-slot chunk prefill. Keyed on chunk width, the
+        cold bucket width (a power-of-two page count — log-many
+        programs over a prompt's life) and the resident row length;
+        offsets and ``s_cold`` ride as traced operands."""
+        from triton_distributed_tpu.models.paged_kv_cache import (
+            paged_cache_specs,
+        )
+
+        quant = cache.k_scale is not None
+        s_bucket = int(k_cold.shape[2])
+        row_len = int(table_row.shape[0])
+        key = ("chunk_cold", mode, int(tokens.shape[0]), quant, s_bucket,
+               row_len)
+        if key not in self._prefill_jit:
+            cold_spec = P(None, self.axis, None, None)
+            scale_spec = P(None, self.axis, None) if quant else None
+            f = self.ctx.shard_map(
+                functools.partial(self._prefill_chunk_cold_shard,
+                                  mode=mode),
+                in_specs=(
+                    self.param_specs, P(),
+                    paged_cache_specs(self.axis, quant),
+                    cold_spec, cold_spec, scale_spec, scale_spec,
+                    P(), P(), P(), P(), P(),
+                ),
+                out_specs=(P(), paged_cache_specs(self.axis, quant)),
+            )
+            self._prefill_jit[key] = jax.jit(
+                lambda p, t, c, kc, vc, ksc, vsc, tr, sc, o, e, li: f(
+                    p, t, c, kc, vc, ksc, vsc, tr, sc, o, e, li
+                ),
+                donate_argnums=(2,),
+            )
+        return self._prefill_jit[key](
+            self.params, jnp.asarray(tokens, jnp.int32), cache,
+            k_cold, v_cold, ks_cold, vs_cold,
+            jnp.asarray(table_row, jnp.int32),
+            jnp.asarray(s_cold, jnp.int32),
+            jnp.asarray(q_offset, jnp.int32),
+            jnp.asarray(q_end, jnp.int32),
+            jnp.asarray(last_idx, jnp.int32),
+        )
+
+    def _decode_shard_sharded(
+        self, params, token, cache, k_cold, v_cold, ks_cold, vs_cold,
+        table_row, kv_len_loc, s_cold, *, mode: Mode,
+    ):
+        """One decode step of ONE sharded slot, per-shard: resident
+        paged partial + cold dense partial, ``lse_combine``d. The
+        batched ``kv_len``/``page_table`` are untouched."""
+        from triton_distributed_tpu.models.paged_kv_cache import PagedKVCache
+
+        cfg = self.cfg
+        x = self._embed(params, token)  # [1, d]
+        ar = "pallas_ar" if mode == "pallas" else "xla_ar"
+
+        def layer_fn(carry, inp):
+            x = carry
+            lp, kp, vp, ks, vs, kc, vc, ksc, vsc = inp
+            h = rms_norm(x, lp.ln1, cfg.rms_eps)
+            a, kp, vp, ks, vs = tp_attn_decode_sharded(
+                lp.attn, h, kp, vp, table_row, kv_len_loc, kc, vc,
+                s_cold, self.dims, axis=self.axis, mode=ar, ctx=self.ctx,
+                k_scale=ks, v_scale=vs, ks_cold=ksc, vs_cold=vsc,
+            )
+            x = x + a
+            h = rms_norm(x, lp.ln2, cfg.rms_eps)
+            x = x + self._mlp_fwd(lp.mlp, h, ar)
+            return x, (kp, vp, ks, vs)
+
+        x, (k_new, v_new, ks_new, vs_new) = jax.lax.scan(
+            layer_fn, x,
+            (params.layers, cache.k_pages, cache.v_pages,
+             cache.k_scale, cache.v_scale, k_cold, v_cold,
+             ks_cold, vs_cold),
+        )
+        x = rms_norm(x, params.norm, cfg.rms_eps)
+        logits = self._logits(params, x)  # [1, V]
+        return logits, PagedKVCache(
+            k_pages=k_new, v_pages=v_new, page_table=cache.page_table,
+            kv_len=cache.kv_len, k_scale=ks_new, v_scale=vs_new,
+        )
+
+    def decode_step_sharded(
+        self,
+        token,           # [1] int32 — the slot's new token
+        cache,           # PagedKVCache
+        table_row,       # [budget_pages] int32
+        kv_len_loc: int,  # tokens in the resident region
+        k_cold, v_cold,  # [L, Hkv, S_bucket, hd] pool-dtype cold window
+        ks_cold=None, vs_cold=None,
+        s_cold: int = 0,
+        mode: Mode = "xla",
+    ):
+        """Jitted sharded-slot decode step → ``(logits [1, V], cache)``.
+        Keyed on the cold bucket width and resident row length."""
+        from triton_distributed_tpu.models.paged_kv_cache import (
+            paged_cache_specs,
+        )
+
+        quant = cache.k_scale is not None
+        s_bucket = int(k_cold.shape[2])
+        row_len = int(table_row.shape[0])
+        key = ("sharded", mode, quant, s_bucket, row_len)
+        if key not in self._decode_jit:
+            cold_spec = P(None, self.axis, None, None)
+            scale_spec = P(None, self.axis, None) if quant else None
+            f = self.ctx.shard_map(
+                functools.partial(self._decode_shard_sharded, mode=mode),
+                in_specs=(
+                    self.param_specs, P(),
+                    paged_cache_specs(self.axis, quant),
+                    cold_spec, cold_spec, scale_spec, scale_spec,
+                    P(), P(), P(),
+                ),
+                out_specs=(P(), paged_cache_specs(self.axis, quant)),
+            )
+            self._decode_jit[key] = jax.jit(
+                lambda p, t, c, kc, vc, ksc, vsc, tr, kl, sc: f(
+                    p, t, c, kc, vc, ksc, vsc, tr, kl, sc
+                ),
+                donate_argnums=(2,),
+            )
+        return self._decode_jit[key](
+            self.params, jnp.asarray(token, jnp.int32), cache,
+            k_cold, v_cold, ks_cold, vs_cold,
+            jnp.asarray(table_row, jnp.int32),
+            jnp.asarray([kv_len_loc], jnp.int32),
+            jnp.asarray([s_cold], jnp.int32),
         )
 
     # -- jitted SPMD entry points ----------------------------------------
